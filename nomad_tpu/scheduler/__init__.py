@@ -1,0 +1,7 @@
+"""Scheduler layer: dense TPU scheduling engine + host-side reconciler.
+
+Reference: scheduler/ in hollowsunsets/nomad.  The lazy pull-based
+RankIterator pipeline is replaced by batched dense kernels in
+`nomad_tpu.ops`; this package holds the schedulers that drive them, the
+reconciler, the factory registry, and the test harness.
+"""

@@ -1,0 +1,73 @@
+"""Shared data model (reference: nomad/structs/).
+
+Plain Python dataclasses for the control-plane objects; the dense device
+encoding lives in `nomad_tpu.encode`.
+"""
+
+from nomad_tpu.structs.resources import (
+    ComparableResources,
+    DeviceRequest,
+    NetworkPort,
+    NetworkResource,
+    NodeDevice,
+    Resources,
+    allocs_fit_host,
+    score_fit_binpack_host,
+    score_fit_spread_host,
+)
+from nomad_tpu.structs.job import (
+    Affinity,
+    Constraint,
+    DispatchPayloadConfig,
+    EphemeralDisk,
+    Job,
+    JobStatus,
+    JobType,
+    MigrateStrategy,
+    PeriodicConfig,
+    ReschedulePolicy,
+    RestartPolicy,
+    Spread,
+    SpreadTarget,
+    Task,
+    TaskGroup,
+    UpdateStrategy,
+)
+from nomad_tpu.structs.node import (
+    DrainStrategy,
+    Node,
+    NodeReservedResources,
+    NodeResources,
+    NodeSchedulingEligibility,
+    NodeStatus,
+    compute_node_class,
+)
+from nomad_tpu.structs.alloc import (
+    AllocClientStatus,
+    AllocDesiredStatus,
+    Allocation,
+    AllocMetric,
+    DesiredTransition,
+    RescheduleEvent,
+    RescheduleTracker,
+    TaskState,
+)
+from nomad_tpu.structs.evaluation import (
+    EvalStatus,
+    EvalTrigger,
+    Evaluation,
+)
+from nomad_tpu.structs.plan import (
+    Plan,
+    PlanAnnotations,
+    PlanResult,
+    DesiredUpdates,
+)
+from nomad_tpu.structs.deployment import (
+    Deployment,
+    DeploymentState,
+    DeploymentStatus,
+)
+from nomad_tpu.structs.config import SchedulerConfiguration
+
+__all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,0 +1,14 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+import so multi-chip sharding paths are exercised without TPU hardware
+(matches the driver's dryrun_multichip environment).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

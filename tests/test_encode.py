@@ -1,0 +1,101 @@
+"""ClusterMatrix / AttrTable incremental-mirror tests."""
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.encode import ClusterMatrix, RES_CPU, RES_MEM, pad_to_bucket
+from nomad_tpu.encode.attrs import AttrTable, hash_code
+
+
+def test_pad_to_bucket():
+    assert pad_to_bucket(1) == 8
+    assert pad_to_bucket(8) == 8
+    assert pad_to_bucket(9) == 16
+    assert pad_to_bucket(1000) == 1024
+
+
+def test_upsert_node_and_grow():
+    cm = ClusterMatrix()
+    nodes = [mock.node() for _ in range(20)]  # forces growth past 8 and 16
+    rows = [cm.upsert_node(n) for n in nodes]
+    assert cm.n_rows == 32
+    assert len(set(rows)) == 20
+    r0 = cm.row_of[nodes[0].id]
+    assert cm.capacity[r0, RES_CPU] == 4000
+    assert cm.ready[r0]
+    assert cm.attrs.column("node.datacenter").values[r0] == "dc1"
+
+
+def test_alloc_usage_tracking():
+    cm = ClusterMatrix()
+    n = mock.node()
+    cm.upsert_node(n)
+    j = mock.job()
+    a = mock.alloc_for(j, n.id)
+    cm.upsert_alloc(a)
+    r = cm.row_of[n.id]
+    assert cm.used[r, RES_CPU] == 500
+    assert cm.used[r, RES_MEM] == 256
+    # terminal update removes usage
+    a.client_status = "failed"
+    cm.upsert_alloc(a)
+    assert cm.used[r, RES_CPU] == 0
+
+
+def test_node_removal_recycles_row():
+    cm = ClusterMatrix()
+    n1, n2 = mock.node(), mock.node()
+    r1 = cm.upsert_node(n1)
+    cm.remove_node(n1.id)
+    assert not cm.ready[r1]
+    r2 = cm.upsert_node(n2)
+    assert r2 == r1  # recycled
+
+
+def test_port_accounting():
+    cm = ClusterMatrix()
+    n = mock.node()
+    n.reserved_resources.reserved_ports = [22, 80]
+    cm.upsert_node(n)
+    free = cm.static_ports_free([22])
+    r = cm.row_of[n.id]
+    assert not free[r]
+    assert cm.static_ports_free([8080])[r]
+    # dynamic port count excludes claims inside the dynamic range
+    base_free = cm.free_dynamic_ports()[r]
+    assert base_free == 12001
+    j = mock.job()
+    a = mock.alloc_for(j, n.id)
+    from nomad_tpu.structs.resources import NetworkPort, NetworkResource
+    a.allocated_resources.shared_ports = [NetworkPort(label="http", value=20005)]
+    cm.upsert_alloc(a)
+    assert cm.free_dynamic_ports()[r] == 12000
+    assert not cm.static_ports_free([20005])[r]
+
+
+def test_attr_ordinals_lexical():
+    t = AttrTable(4)
+    col = t.column("attr.ver")
+    for i, v in enumerate(["1.10", "1.9", None, "2.0"]):
+        col.set(i, v)
+    ords = col.ordinals()
+    # lexical: "1.10" < "1.9" < "2.0"
+    assert ords[0] < ords[1] < ords[3]
+    assert ords[2] == -1
+    r, exact = col.ordinal_of("1.9")
+    assert exact and r == ords[1]
+
+
+def test_hash_code_stable_nonzero():
+    assert hash_code("x") == hash_code("x")
+    assert hash_code("x") != hash_code("y")
+    assert hash_code("") != 0
+
+
+def test_dc_mask():
+    cm = ClusterMatrix()
+    a = mock.node(datacenter="dc1")
+    b = mock.node(datacenter="dc2")
+    cm.upsert_node(a)
+    cm.upsert_node(b)
+    m = cm.dc_mask(["dc2"])
+    assert m[cm.row_of[b.id]] and not m[cm.row_of[a.id]]

@@ -1,0 +1,123 @@
+"""Data-model semantics tests (reference parity: nomad/structs/funcs.go)."""
+import math
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import (
+    AllocClientStatus,
+    AllocDesiredStatus,
+    ComparableResources,
+    allocs_fit_host,
+    score_fit_binpack_host,
+    score_fit_spread_host,
+)
+from nomad_tpu.structs.node import compute_node_class
+
+
+def test_allocs_fit_empty():
+    n = mock.node()
+    fit, dim, used = allocs_fit_host(n, [])
+    assert fit and dim == ""
+    assert used.cpu_shares == 0
+
+
+def test_allocs_fit_exact_capacity():
+    n = mock.node()  # 4000 MHz, 8192 MB
+    j = mock.job()
+    j.task_groups[0].tasks[0].resources.cpu = 4000
+    j.task_groups[0].tasks[0].resources.memory_mb = 8192
+    j.task_groups[0].ephemeral_disk.size_mb = 0
+    a = mock.alloc_for(j, n.id)
+    fit, dim, used = allocs_fit_host(n, [a])
+    assert fit, dim
+    fit2, dim2, _ = allocs_fit_host(n, [a, a])
+    assert not fit2 and dim2 == "cpu"
+
+
+def test_allocs_fit_ignores_terminal():
+    n = mock.node()
+    j = mock.job()
+    j.task_groups[0].tasks[0].resources.cpu = 4000
+    a1 = mock.alloc_for(j, n.id)
+    a2 = mock.alloc_for(j, n.id)
+    a2.desired_status = AllocDesiredStatus.STOP
+    fit, _, used = allocs_fit_host(n, [a1, a2])
+    assert fit
+    assert used.cpu_shares == 4000
+
+
+def test_allocs_fit_respects_node_reserved():
+    n = mock.node()
+    n.reserved_resources.cpu_shares = 3800
+    j = mock.job()
+    j.task_groups[0].tasks[0].resources.cpu = 500
+    a = mock.alloc_for(j, n.id)
+    fit, dim, _ = allocs_fit_host(n, [a])
+    assert not fit and dim == "cpu"
+
+
+def test_allocs_fit_core_overlap():
+    n = mock.node()
+    j = mock.job()
+    a1 = mock.alloc_for(j, n.id)
+    a2 = mock.alloc_for(j, n.id)
+    for a in (a1, a2):
+        tr = a.allocated_resources.tasks["web"]
+        tr.reserved_cores = (0, 1)
+    fit, dim, _ = allocs_fit_host(n, [a1, a2])
+    assert not fit and dim == "cores"
+
+
+def test_score_fit_binpack_known_values():
+    """Empty node scores 0; perfectly full node scores 18 (funcs.go:259-279)."""
+    n = mock.node()
+    empty = ComparableResources()
+    assert score_fit_binpack_host(n, empty) == pytest.approx(0.0)
+    full = ComparableResources(cpu_shares=4000, memory_mb=8192)
+    assert score_fit_binpack_host(n, full) == pytest.approx(18.0)
+    # half-utilized: 20 - 2*10^0.5
+    half = ComparableResources(cpu_shares=2000, memory_mb=4096)
+    assert score_fit_binpack_host(n, half) == pytest.approx(20 - 2 * math.sqrt(10))
+    # spread is the mirror image
+    assert score_fit_spread_host(n, empty) == pytest.approx(18.0)
+    assert score_fit_spread_host(n, full) == pytest.approx(0.0)
+
+
+def test_computed_node_class_stability():
+    n1 = mock.node()
+    n2 = mock.node()
+    # unique.* attrs must not affect the class
+    assert n1.attributes["unique.hostname"] != n2.attributes["unique.hostname"]
+    assert compute_node_class(n1) == compute_node_class(n2)
+    n2.attributes["kernel.name"] = "windows"
+    assert compute_node_class(n1) != compute_node_class(n2)
+
+
+def test_alloc_terminal_status():
+    a = mock.alloc()
+    assert not a.terminal_status()
+    a.client_status = AllocClientStatus.FAILED
+    assert a.terminal_status()
+    b = mock.alloc()
+    b.desired_status = AllocDesiredStatus.EVICT
+    assert b.terminal_status()
+
+
+def test_alloc_name_index():
+    a = mock.alloc()
+    a.name = "job.web[7]"
+    assert a.index() == 7
+
+
+def test_plan_append_stopped_alloc():
+    from nomad_tpu.structs import Plan
+    p = Plan()
+    a = mock.alloc()
+    p.append_stopped_alloc(a, "node drain", client_status="lost")
+    assert len(p.node_update[a.node_id]) == 1
+    stopped = p.node_update[a.node_id][0]
+    assert stopped.desired_status == AllocDesiredStatus.STOP
+    assert stopped.client_status == "lost"
+    # the original alloc is untouched
+    assert a.desired_status == AllocDesiredStatus.RUN
