@@ -57,6 +57,8 @@ class ClusterMatrix:
         self.port_words = np.zeros((cap, _PORT_WORDS), dtype=np.uint32)
         self.dyn_port_lo = np.full(cap, 20000, dtype=np.int32)
         self.dyn_port_hi = np.full(cap, 32000, dtype=np.int32)
+        # device-group id -> i32[N] instance capacity per node
+        self.device_caps: Dict[str, np.ndarray] = {}
         # generation counter bumped on any mutation (device cache invalidation)
         self.generation = 0
         # authoritative live-alloc usage, keyed by node id so it survives node
@@ -83,6 +85,9 @@ class ClusterMatrix:
         self.node_ids.extend([None] * old)
         self._free_rows.extend(range(new - 1, old - 1, -1))
         self.attrs.resize(new)
+        for k in self.device_caps:
+            self.device_caps[k] = np.concatenate(
+                [self.device_caps[k], np.zeros(old, np.int32)])
         self._n_rows = new
 
     # ------------------------------------------------------------- nodes
@@ -107,6 +112,18 @@ class ClusterMatrix:
             healthy = info.get("detected") and info.get("healthy", True)
             self.attrs.column(f"attr.driver.{name}").set(
                 row, "1" if healthy else None)
+        # host volumes: column per volume name, value "ro"/"rw"
+        for name, vol in node.host_volumes.items():
+            self.attrs.column(f"hostvol.{name}").set(
+                row, "ro" if vol.get("read_only") else "rw")
+        # device capacity: numeric count column per device-group id (clear
+        # stale groups first — re-registration may drop devices)
+        for col in self.device_caps.values():
+            col[row] = 0
+        for dev in node.node_resources.devices:
+            col = self.device_caps.setdefault(
+                dev.id, np.zeros(self._n_rows, dtype=np.int32))
+            col[row] = len(dev.instance_ids)
         self.dyn_port_lo[row] = res.min_dynamic_port
         self.dyn_port_hi[row] = res.max_dynamic_port
         words = np.zeros(_PORT_WORDS, dtype=np.uint32)
@@ -132,6 +149,8 @@ class ClusterMatrix:
         self.used[row] = 0
         self.ready[row] = False
         self.port_words[row] = 0
+        for col in self.device_caps.values():
+            col[row] = 0
         self.attrs.clear_row(row)
         self._free_rows.append(row)
         self.generation += 1

@@ -1,0 +1,51 @@
+"""Vectorized fit + scoring primitives.
+
+Device twins of nomad_tpu.structs.resources.{allocs_fit_host,
+score_fit_binpack_host, score_fit_spread_host} (reference
+nomad/structs/funcs.go:166-297), lifted over the node axis: every function
+here takes [N, R] matrices and returns [N] vectors, so one call covers what
+the reference computes node-by-node inside BinPackIterator.Next and the
+plan applier's EvaluatePool fan-out (nomad/plan_apply_pool.go).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from nomad_tpu.encode.matrixizer import RES_CPU, RES_MEM
+
+MAX_FIT_SCORE = 18.0
+
+
+def fits_after(capacity: jax.Array, used: jax.Array, demand: jax.Array) -> jax.Array:
+    """bool[N]: does `demand` (f32[R]) fit on each node given current usage?
+    The resource superset check of AllocsFit (funcs.go:197-203)."""
+    return jnp.all(used + demand <= capacity, axis=-1)
+
+
+def validate_capacity(capacity: jax.Array, used: jax.Array) -> jax.Array:
+    """bool[N]: per-node totals within capacity — the plan-validation path
+    (evaluateNodePlan -> AllocsFit, nomad/plan_apply.go:640)."""
+    return jnp.all(used <= capacity, axis=-1)
+
+
+def free_fractions(capacity: jax.Array, util: jax.Array) -> jax.Array:
+    """f32[N, 2]: free cpu/mem fractions after `util`, with the zero-capacity
+    convention of structs.resources._free_ratio (used>0 on cap<=0 -> -inf,
+    0 on 0 -> 1)."""
+    cap = jnp.asarray(capacity)[:, (RES_CPU, RES_MEM)]
+    use = jnp.asarray(util)[:, (RES_CPU, RES_MEM)]
+    frac = 1.0 - use / cap
+    zero_cap = cap <= 0.0
+    frac = jnp.where(zero_cap & (use > 0.0), -jnp.inf, frac)
+    frac = jnp.where(zero_cap & (use <= 0.0), 1.0, frac)
+    return frac
+
+
+def score_fit(capacity: jax.Array, util: jax.Array, spread: bool) -> jax.Array:
+    """f32[N] in [0, 18]: BestFit v3 (binpack) or Worst Fit (spread) score
+    (funcs.go:259-297)."""
+    frac = free_fractions(capacity, util)
+    total = jnp.sum(jnp.power(10.0, frac), axis=-1)
+    raw = (total - 2.0) if spread else (20.0 - total)
+    return jnp.clip(raw, 0.0, MAX_FIT_SCORE)

@@ -1,0 +1,206 @@
+"""The dense placement engine.
+
+One jitted `lax.scan` places every missing allocation of an evaluation:
+each step scores ALL candidate nodes at once (feasibility mask -> resource
+fit -> binpack/spread fit score -> anti-affinity / reschedule-penalty /
+affinity / spread scoring -> normalization -> masked argmax) and the carry
+threads the proposed usage matrix, per-taskgroup co-placement counts, and
+per-spread-attribute value counts, so sequential placement coupling
+(reference scheduler/context.go:173-210 ProposedAllocs) is preserved.
+
+This single kernel replaces the reference's entire iterator stack for one
+eval (scheduler/stack.go:344-439 GenericStack.Select and everything it
+pulls: feasible.go checkers, rank.go BinPackIterator/scoring iterators,
+spread.go SpreadIterator, select.go Limit/MaxScore).  Candidate subsampling
+(log2-n limits, power-of-two-choices, stack.go:79-92) is intentionally
+absent: the TPU scores every node densely.
+
+Tie-breaking: the reference shuffles nodes with a seeded shuffle and takes
+the first strict maximum (scheduler/util.go:464, select.go:94-116); here
+argmax takes the lowest node row among equals.  Deterministic, documented
+deviation — score values are parity-tested, selections may differ on exact
+ties.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nomad_tpu.ops.fit import score_fit
+
+TOP_K = 5  # score_meta entries kept per placement (structs.go:10341 kheap)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PlaceInputs:
+    """Dense inputs for one evaluation's placement pass.
+
+    Axes: N nodes, G task groups, S placement slots, K spread attributes,
+    V spread attribute values (all padded).
+    """
+    capacity: jax.Array        # f32[N, R]
+    used: jax.Array            # f32[N, R]  proposed-usage basis
+    feasible: jax.Array        # bool[G, N]
+    affinity: jax.Array        # f32[G, N]
+    has_affinity: jax.Array    # bool[G]
+    desired_count: jax.Array   # i32[G]
+    penalty: jax.Array         # bool[G, N]
+    tg_count: jax.Array        # i32[G, N] existing co-placed (job, tg) allocs
+    # spread tensors (K may be 0)
+    spread_vidx: jax.Array     # i32[G, K, N] value index per node (V = missing)
+    spread_desired: jax.Array  # f32[G, K, V+1] desired counts, -1 = no target
+    spread_targeted: jax.Array # bool[G, K] targets specified vs even-spread
+    spread_wfrac: jax.Array    # f32[G, K] weight / sum(|weights|)
+    spread_counts: jax.Array   # f32[G, K, V+1] initial per-value counts
+    spread_active: jax.Array   # bool[G, K]
+    # slots
+    demand: jax.Array          # f32[S, R]
+    slot_tg: jax.Array         # i32[S]
+    slot_active: jax.Array     # bool[S]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PlaceResult:
+    node: jax.Array            # i32[S] selected node row, -1 = no placement
+    score: jax.Array           # f32[S] final normalized score of the pick
+    fit_score: jax.Array       # f32[S] raw binpack/spread component of the pick
+    nodes_evaluated: jax.Array # i32[S] feasible nodes considered
+    nodes_exhausted: jax.Array # i32[S] feasible but resource-exhausted nodes
+    top_nodes: jax.Array       # i32[S, TOP_K]
+    top_scores: jax.Array      # f32[S, TOP_K]
+    used: jax.Array            # f32[N, R] final proposed usage
+
+
+def _spread_boost(inp: PlaceInputs, g: jax.Array, counts: jax.Array) -> jax.Array:
+    """f32[N]: total spread score per node for task group `g` given current
+    per-value counts f32[K, V+1] (reference scheduler/spread.go:116-272)."""
+    vidx = inp.spread_vidx[g]          # i32[K, N]
+    desired = inp.spread_desired[g]    # f32[K, V+1]
+    targeted = inp.spread_targeted[g]  # bool[K]
+    wfrac = inp.spread_wfrac[g]        # f32[K]
+    active = inp.spread_active[g]      # bool[K]
+    K, Vp1 = desired.shape
+    V = Vp1 - 1                        # last slot = "missing attribute"
+
+    missing = vidx >= V                                    # bool[K, N]
+    safe_idx = jnp.minimum(vidx, V)
+    cur = jnp.take_along_axis(counts, safe_idx, axis=1)    # f32[K, N]
+    des = jnp.take_along_axis(desired, safe_idx, axis=1)   # f32[K, N]
+
+    # --- targeted spread: ((desired - (used+1)) / desired) * weight_frac
+    has_target = des >= 0.0
+    t_boost = jnp.where(
+        missing, -1.0,                                     # attr build error
+        jnp.where(has_target,
+                  (des - (cur + 1.0)) / jnp.maximum(des, 1e-9) * wfrac[:, None],
+                  -1.0))                                   # no target: flat -1
+
+    # --- even spread: boost from delta vs min/max of *placed* values
+    placed = counts[:, :V] > 0.0                           # bool[K, V]
+    any_placed = jnp.any(placed, axis=1)                   # bool[K]
+    big = jnp.float32(3.4e38)
+    minc = jnp.min(jnp.where(placed, counts[:, :V], big), axis=1)   # f32[K]
+    maxc = jnp.max(jnp.where(placed, counts[:, :V], -big), axis=1)
+    minc_ = jnp.maximum(minc, 1e-9)
+    at_min = cur == minc[:, None]
+    e_boost = jnp.where(
+        ~at_min, (minc[:, None] - cur) / minc_[:, None],
+        jnp.where((minc == maxc)[:, None], -1.0,
+                  ((maxc - minc) / minc_)[:, None]))
+    e_boost = jnp.where(missing, -1.0, e_boost)
+    e_boost = jnp.where(any_placed[:, None], e_boost, 0.0)  # empty map -> 0
+
+    boost = jnp.where(targeted[:, None], t_boost, e_boost)  # f32[K, N]
+    return jnp.sum(jnp.where(active[:, None], boost, 0.0), axis=0)
+
+
+def _place_step(inp: PlaceInputs, spread_algorithm: bool, carry, slot):
+    used, tg_count, spread_counts = carry
+    g = inp.slot_tg[slot]
+    d = inp.demand[slot]
+    active = inp.slot_active[slot]
+
+    feas = inp.feasible[g]
+    util = used + d
+    fits = jnp.all(util <= inp.capacity, axis=-1) & feas
+
+    # --- scoring stack (normalization = mean over appended scorers only,
+    # reference rank.go ScoreNormalizationIterator)
+    fit_score = score_fit(inp.capacity, util, spread_algorithm) / 18.0
+    total = fit_score
+    n_scorers = jnp.ones_like(fit_score)
+
+    coll = tg_count[g].astype(jnp.float32)
+    anti = -(coll + 1.0) / jnp.maximum(inp.desired_count[g].astype(jnp.float32), 1.0)
+    has_coll = coll > 0.0
+    total = total + jnp.where(has_coll, anti, 0.0)
+    n_scorers = n_scorers + has_coll
+
+    pen = inp.penalty[g]
+    total = total - pen
+    n_scorers = n_scorers + pen
+
+    aff = inp.affinity[g]
+    aff_on = inp.has_affinity[g] & (aff != 0.0)
+    total = total + jnp.where(aff_on, aff, 0.0)
+    n_scorers = n_scorers + aff_on
+
+    sboost = _spread_boost(inp, g, spread_counts[g])
+    sb_on = jnp.any(inp.spread_active[g]) & (sboost != 0.0)
+    total = total + jnp.where(sb_on, sboost, 0.0)
+    n_scorers = n_scorers + sb_on
+
+    final = total / n_scorers
+    masked = jnp.where(fits & active, final, -jnp.inf)
+
+    sel = jnp.argmax(masked)
+    ok = masked[sel] > -jnp.inf
+
+    # --- carry updates
+    sel_onehot = (jnp.arange(used.shape[0]) == sel) & ok
+    used = used + jnp.where(sel_onehot[:, None], d, 0.0)
+    tg_count = tg_count.at[g, sel].add(jnp.where(ok, 1, 0))
+    v = inp.spread_vidx[g, :, sel]                      # i32[K]
+    Vp1 = spread_counts.shape[-1]
+    upd = jax.nn.one_hot(jnp.minimum(v, Vp1 - 1), Vp1, dtype=spread_counts.dtype)
+    upd = upd * (inp.spread_active[g] & (v < Vp1 - 1))[:, None] * ok
+    spread_counts = spread_counts.at[g].add(upd)
+
+    top_scores, top_nodes = jax.lax.top_k(masked, TOP_K)
+    out = (
+        jnp.where(ok, sel, -1).astype(jnp.int32),
+        jnp.where(ok, masked[sel], 0.0),
+        jnp.where(ok, fit_score[sel], 0.0),
+        jnp.sum(feas & active).astype(jnp.int32),
+        jnp.sum(feas & ~fits & active).astype(jnp.int32),
+        top_nodes.astype(jnp.int32),
+        top_scores,
+    )
+    return (used, tg_count, spread_counts), out
+
+
+@functools.partial(jax.jit, static_argnames=("spread_algorithm",))
+def place_eval_jit(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceResult:
+    """Place all slots of one evaluation.  Shapes are static; callers bucket
+    N/G/S/K/V so the jit cache stays small."""
+    S = inp.demand.shape[0]
+    carry0 = (inp.used, inp.tg_count, inp.spread_counts)
+    step = functools.partial(_place_step, inp, spread_algorithm)
+    (used, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
+    node, score, fit_s, n_eval, n_exh, top_n, top_s = outs
+    return PlaceResult(node=node, score=score, fit_score=fit_s,
+                       nodes_evaluated=n_eval, nodes_exhausted=n_exh,
+                       top_nodes=top_n, top_scores=top_s, used=used)
+
+
+def place_eval(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceResult:
+    """Convenience host wrapper returning numpy-backed results."""
+    res = place_eval_jit(inp, spread_algorithm=spread_algorithm)
+    return jax.tree_util.tree_map(np.asarray, res)

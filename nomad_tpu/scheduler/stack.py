@@ -1,0 +1,294 @@
+"""DenseStack: compiles a job against the cluster mirror into PlaceInputs.
+
+Dense analog of scheduler/stack.go (GenericStack/SystemStack): where the
+reference wires an iterator chain per eval and pulls nodes through it, we
+compile the job's constraints/affinities/spreads once into padded tensors
+and hand them to ops.place.place_eval.  Job-level and task-group-level
+checkers are merged exactly like the reference's FeasibilityWrapper
+(feasible.go:1010-1174): job constraints apply to every group, task
+constraints/drivers fold into their group.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nomad_tpu.encode.attrs import AttrTable
+from nomad_tpu.encode.matrixizer import (
+    ClusterMatrix,
+    NUM_RESOURCE_DIMS,
+    RES_CPU,
+    RES_DISK,
+    RES_MEM,
+    pad_to_bucket,
+)
+from nomad_tpu.ops.place import PlaceInputs, PlaceResult, place_eval
+from nomad_tpu.scheduler import feasible as fz
+from nomad_tpu.structs.job import Constraint, Job, Operand, Spread, TaskGroup
+from nomad_tpu.structs.config import (
+    SCHEDULER_ALGORITHM_SPREAD,
+    SchedulerConfiguration,
+)
+
+IMPLICIT_TARGET = "*"   # reference scheduler/spread.go implicitTarget
+
+
+def group_demand(tg: TaskGroup) -> np.ndarray:
+    """f32[R] total resource demand of one instance of the group."""
+    d = np.zeros(NUM_RESOURCE_DIMS, dtype=np.float32)
+    for t in tg.tasks:
+        d[RES_CPU] += t.resources.cpu
+        d[RES_MEM] += t.resources.memory_mb
+    d[RES_DISK] = tg.ephemeral_disk.size_mb
+    return d
+
+
+def group_static_ports(tg: TaskGroup) -> List[int]:
+    ports: List[int] = []
+    for net in tg.networks:
+        ports.extend(p.value for p in net.reserved_ports)
+    for t in tg.tasks:
+        for net in t.resources.networks:
+            ports.extend(p.value for p in net.reserved_ports)
+    return ports
+
+
+def group_dynamic_port_count(tg: TaskGroup) -> int:
+    n = sum(len(net.dynamic_ports) for net in tg.networks)
+    n += sum(len(net.dynamic_ports) for t in tg.tasks for net in t.resources.networks)
+    return n
+
+
+@dataclass
+class CompiledGroup:
+    """Per-task-group dense artifacts."""
+    tg: TaskGroup
+    feasible: np.ndarray          # bool[N] static part (no distinct_* yet)
+    affinity: np.ndarray          # f32[N]
+    has_affinity: bool
+    demand: np.ndarray            # f32[R]
+    spreads: List[Spread]
+    distinct_hosts_job: bool
+    distinct_hosts_tg: bool
+    distinct_property: List[Tuple[str, int, bool]]  # (target, limit, job-level)
+
+
+class DenseStack:
+    """Compiles one job against one ClusterMatrix generation."""
+
+    def __init__(self, cm: ClusterMatrix, config: Optional[SchedulerConfiguration] = None):
+        self.cm = cm
+        self.config = config or SchedulerConfiguration()
+        self.spread_algorithm = (
+            self.config.effective_scheduler_algorithm() == SCHEDULER_ALGORITHM_SPREAD)
+
+    # ------------------------------------------------------------- compile
+
+    def compile_group(self, job: Job, tg: TaskGroup) -> CompiledGroup:
+        cm = self.cm
+        n = cm.n_rows
+        mask = cm.ready.copy()
+        mask &= cm.dc_mask(job.datacenters)
+
+        # job-level vs group-level matters for distinct_* scoping
+        # (feasible.go:566-620: job-level collides with any job alloc,
+        # group-level only with allocs of the same group)
+        job_constraints = list(job.constraints)
+        tg_constraints = list(tg.constraints)
+        drivers = []
+        affinities = list(job.affinities) + list(tg.affinities)
+        for t in tg.tasks:
+            tg_constraints += list(t.constraints)
+            affinities += list(t.affinities)
+            drivers.append(t.driver)
+            for dev in t.resources.devices:
+                mask &= fz.device_mask(cm, [dev])
+        constraints = job_constraints + tg_constraints
+
+        distinct_hosts_job = any(c.operand == Operand.DISTINCT_HOSTS
+                                 for c in job_constraints)
+        distinct_hosts_tg = any(c.operand == Operand.DISTINCT_HOSTS
+                                for c in tg_constraints)
+        distinct_property = [
+            (c.ltarget, int(c.rtarget) if c.rtarget else 1, c in job_constraints)
+            for c in constraints if c.operand == Operand.DISTINCT_PROPERTY]
+
+        mask &= fz.constraints_mask(cm, constraints)
+        mask &= fz.driver_mask(cm, drivers)
+        mask &= fz.host_volume_mask(cm, tg.volumes)
+
+        static_ports = group_static_ports(tg)
+        if static_ports:
+            mask &= cm.static_ports_free(static_ports)
+        dyn = group_dynamic_port_count(tg)
+        if dyn:
+            mask &= cm.free_dynamic_ports() >= dyn
+
+        # affinity score: sum(weight * match) / sum(|weight|), rank.go:722-749
+        aff = np.zeros(n, dtype=np.float32)
+        has_aff = bool(affinities)
+        if has_aff:
+            total_w = sum(abs(a.weight) for a in affinities) or 1.0
+            for a in affinities:
+                m = fz.constraint_mask(
+                    cm, Constraint(a.ltarget, a.rtarget, a.operand))
+                aff += a.weight * m.astype(np.float32)
+            aff /= total_w
+
+        spreads = list(tg.spreads) + list(job.spreads)
+        return CompiledGroup(tg=tg, feasible=mask, affinity=aff,
+                             has_affinity=has_aff, demand=group_demand(tg),
+                             spreads=spreads,
+                             distinct_hosts_job=distinct_hosts_job,
+                             distinct_hosts_tg=distinct_hosts_tg,
+                             distinct_property=distinct_property)
+
+    # ------------------------------------------------------------- assemble
+
+    def build_inputs(
+        self,
+        job: Job,
+        groups: Sequence[CompiledGroup],
+        slots: Sequence[int],                      # tg index per placement slot
+        allocs_by_tg: Dict[str, List],             # existing (non-terminal) job allocs
+        penalty_nodes: Optional[Dict[str, set]] = None,   # tg name -> node ids
+        used_override: Optional[np.ndarray] = None,
+    ) -> PlaceInputs:
+        cm = self.cm
+        N = cm.n_rows
+        G = len(groups)
+        S = pad_to_bucket(max(len(slots), 1), minimum=1)
+        R = NUM_RESOURCE_DIMS
+        penalty_nodes = penalty_nodes or {}
+
+        feas = np.zeros((G, N), bool)
+        aff = np.zeros((G, N), np.float32)
+        has_aff = np.zeros(G, bool)
+        desired = np.ones(G, np.int32)
+        penalty = np.zeros((G, N), bool)
+        tg_count = np.zeros((G, N), np.int32)
+
+        K = max([len(g.spreads) for g in groups] + [1])
+        # distinct value space per (g, k): padded to the max across groups
+        vidx_all, desired_all, targeted_all, wfrac_all, counts_all, active_all = \
+            [], [], [], [], [], []
+        Vmax = 1
+        spread_specs = []
+        for gi, g in enumerate(groups):
+            per_k = []
+            for sp in g.spreads:
+                col_name = AttrTable.target_to_column(sp.attribute)
+                col = cm.attrs.columns.get(col_name) if col_name and col_name != "__unresolvable__" else None
+                values = col.distinct() if col is not None else []
+                Vmax = max(Vmax, len(values))
+                per_k.append((sp, col, values))
+            spread_specs.append(per_k)
+
+        vidx = np.full((G, K, N), 0, np.int32)
+        sdesired = np.full((G, K, Vmax + 1), -1.0, np.float32)
+        stargeted = np.zeros((G, K), bool)
+        swfrac = np.zeros((G, K), np.float32)
+        scounts = np.zeros((G, K, Vmax + 1), np.float32)
+        sactive = np.zeros((G, K), bool)
+
+        for gi, g in enumerate(groups):
+            feas[gi] = g.feasible
+            aff[gi] = g.affinity
+            has_aff[gi] = g.has_affinity
+            desired[gi] = max(g.tg.count, 1)
+            for nid in penalty_nodes.get(g.tg.name, ()):  # reschedule penalties
+                row = cm.row_of.get(nid)
+                if row is not None:
+                    penalty[gi, row] = True
+            # existing co-placements for anti-affinity + spread counts
+            existing = allocs_by_tg.get(g.tg.name, [])
+            for a in existing:
+                row = cm.row_of.get(a.node_id)
+                if row is not None:
+                    tg_count[gi, row] += 1
+            # distinct_hosts: co-hosted nodes infeasible (feasible.go:523-620);
+            # job-level collides with any job alloc, group-level with same group
+            if g.distinct_hosts_job or g.distinct_hosts_tg:
+                for tg_name, allocs in allocs_by_tg.items():
+                    if not g.distinct_hosts_job and tg_name != g.tg.name:
+                        continue
+                    for a in allocs:
+                        row = cm.row_of.get(a.node_id)
+                        if row is not None:
+                            feas[gi, row] = False
+            # distinct_property: value counts >= limit infeasible (propertyset.go)
+            for target, limit, job_level in g.distinct_property:
+                col_name = AttrTable.target_to_column(target)
+                col = cm.attrs.columns.get(col_name) if col_name else None
+                if col is None:
+                    continue
+                counts: Dict[str, int] = {}
+                for tg_name, allocs in allocs_by_tg.items():
+                    if not job_level and tg_name != g.tg.name:
+                        continue
+                    for a in allocs:
+                        row = cm.row_of.get(a.node_id)
+                        if row is not None and col.values[row] is not None:
+                            counts[col.values[row]] = counts.get(col.values[row], 0) + 1
+                for row in range(N):
+                    v = col.values[row]
+                    if v is not None and counts.get(v, 0) >= limit:
+                        feas[gi, row] = False
+
+            sum_w = sum(sp.weight for sp, _, _ in spread_specs[gi]) or 1
+            for ki, (sp, col, values) in enumerate(spread_specs[gi]):
+                sactive[gi, ki] = True
+                swfrac[gi, ki] = sp.weight / sum_w
+                rank = {v: i for i, v in enumerate(values)}
+                V = len(values)
+                if col is not None:
+                    vidx[gi, ki] = np.array(
+                        [rank.get(v, Vmax) if v is not None else Vmax
+                         for v in col.values], np.int32)
+                else:
+                    vidx[gi, ki] = Vmax
+                if sp.targets:
+                    stargeted[gi, ki] = True
+                    total = max(g.tg.count, 1)
+                    sum_desired = 0.0
+                    for t in sp.targets:
+                        dcount = (t.percent / 100.0) * total
+                        if t.value in rank:
+                            sdesired[gi, ki, rank[t.value]] = dcount
+                        sum_desired += dcount
+                    if 0 < sum_desired < total:
+                        # implicit target: remaining count for untargeted values
+                        rem = total - sum_desired
+                        for v, i in rank.items():
+                            if sdesired[gi, ki, i] < 0:
+                                sdesired[gi, ki, i] = rem
+                # initial counts from existing allocs of this tg
+                if col is not None:
+                    for a in allocs_by_tg.get(g.tg.name, []):
+                        row = cm.row_of.get(a.node_id)
+                        if row is not None and col.values[row] in rank:
+                            scounts[gi, ki, rank[col.values[row]]] += 1
+
+        demand = np.zeros((S, R), np.float32)
+        slot_tg = np.zeros(S, np.int32)
+        slot_active = np.zeros(S, bool)
+        for si, gi in enumerate(slots):
+            demand[si] = groups[gi].demand
+            slot_tg[si] = gi
+            slot_active[si] = True
+
+        used = used_override if used_override is not None else self.cm.used
+        return PlaceInputs(
+            capacity=np.ascontiguousarray(cm.capacity),
+            used=np.ascontiguousarray(used.astype(np.float32)),
+            feasible=feas, affinity=aff, has_affinity=has_aff,
+            desired_count=desired, penalty=penalty, tg_count=tg_count,
+            spread_vidx=vidx, spread_desired=sdesired, spread_targeted=stargeted,
+            spread_wfrac=swfrac, spread_counts=scounts, spread_active=sactive,
+            demand=demand, slot_tg=slot_tg, slot_active=slot_active,
+        )
+
+    def place(self, inputs: PlaceInputs) -> PlaceResult:
+        return place_eval(inputs, spread_algorithm=self.spread_algorithm)

@@ -1,0 +1,52 @@
+"""Kernel golden tests: device ops vs host reference semantics."""
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.encode import ClusterMatrix
+from nomad_tpu.ops import fits_after, score_fit, validate_capacity
+from nomad_tpu.structs import ComparableResources, score_fit_binpack_host, score_fit_spread_host
+
+
+def _matrix(n=5):
+    cm = ClusterMatrix()
+    nodes = [mock.node() for _ in range(n)]
+    for nd in nodes:
+        cm.upsert_node(nd)
+    return cm, nodes
+
+
+def test_score_fit_matches_host_reference():
+    cm, nodes = _matrix()
+    rng = np.random.default_rng(0)
+    util = np.zeros_like(cm.used)
+    rows = [cm.row_of[n.id] for n in nodes]
+    for r in rows:
+        util[r, 0] = rng.integers(0, 4000)
+        util[r, 1] = rng.integers(0, 8192)
+    dev_bp = np.asarray(score_fit(cm.capacity, util, False))
+    dev_sp = np.asarray(score_fit(cm.capacity, util, True))
+    for n in nodes:
+        r = cm.row_of[n.id]
+        u = ComparableResources(cpu_shares=int(util[r, 0]), memory_mb=int(util[r, 1]))
+        assert dev_bp[r] == pytest.approx(score_fit_binpack_host(n, u), rel=1e-5)
+        assert dev_sp[r] == pytest.approx(score_fit_spread_host(n, u), rel=1e-5)
+
+
+def test_score_fit_zero_capacity_rows():
+    """Padded rows (capacity 0) must not produce NaNs."""
+    cm, _ = _matrix(2)
+    util = np.zeros_like(cm.used)
+    s = np.asarray(score_fit(cm.capacity, util, False))
+    assert not np.isnan(s).any()
+
+
+def test_fits_after_and_validate():
+    cm, nodes = _matrix(2)
+    r = cm.row_of[nodes[0].id]
+    d = np.array([4000.0, 8192.0, 0.0], np.float32)
+    f = np.asarray(fits_after(cm.capacity, cm.used, d))
+    assert f[r]
+    used = cm.used.copy()
+    used[r] = [4001, 0, 0]
+    assert not np.asarray(validate_capacity(cm.capacity, used))[r]
