@@ -1,0 +1,15 @@
+"""Server core (reference: nomad/ — the control plane around the scheduler).
+
+Eval broker with ack/nack leases, blocked-eval tracking with
+unblock-on-capacity, plan queue + serialized optimistic-concurrency plan
+applier (partial commit), scheduler workers, heartbeats, and the leader
+control loops.  All host-side; device work happens in nomad_tpu.ops via the
+schedulers.
+"""
+
+from nomad_tpu.core.broker import EvalBroker
+from nomad_tpu.core.blocked import BlockedEvals
+from nomad_tpu.core.plan_apply import PlanApplier
+from nomad_tpu.core.plan_queue import PlanQueue
+
+__all__ = ["EvalBroker", "BlockedEvals", "PlanApplier", "PlanQueue"]

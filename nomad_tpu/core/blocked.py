@@ -1,0 +1,204 @@
+"""Blocked evaluations (reference: nomad/blocked_evals.go — Block:151,
+Unblock:403, UnblockNode:486, watchCapacity:507, GetDuplicates:632).
+
+Evals that failed to place all allocations wait here and re-enter the
+broker when capacity changes: keyed by computed node class (an eval records
+which classes it found eligible/ineligible; an unseen class unblocks it),
+by quota, or by node id (for system evals).
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.structs import Evaluation, EvalStatus
+
+
+class BlockedStats:
+    def __init__(self):
+        self.total_blocked = 0
+        self.total_escaped = 0
+        self.total_quota_limit = 0
+
+
+class BlockedEvals:
+    def __init__(self, broker):
+        self._lock = threading.Lock()
+        self.broker = broker
+        self.enabled = False
+        # eval_id -> eval
+        self._captured: Dict[str, Evaluation] = {}
+        # escaped evals bypass class tracking (had non-class constraints)
+        self._escaped: Set[str] = set()
+        # job key -> eval id (one blocked eval per job; dupes cancelled)
+        self._jobs: Dict[Tuple[str, str], str] = {}
+        self._duplicates: List[Evaluation] = []
+        # (namespace, job) of evals blocked on quota -> quota name
+        self._quota: Dict[str, Set[str]] = {}
+        # per-class (and global) capacity-change indexes for missed-unblock
+        # detection (reference blocked_evals.go unblockIndexes/missedUnblock):
+        # a capacity event that fires between an eval's snapshot and its
+        # block() call must immediately requeue it instead of blocking.
+        self._unblock_indexes: Dict[str, int] = {}
+        self._global_unblock_index = 0
+        self.stats = BlockedStats()
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self.enabled = enabled
+            if not enabled:
+                self._captured.clear()
+                self._escaped.clear()
+                self._jobs.clear()
+                self._duplicates.clear()
+                self._quota.clear()
+
+    # ------------------------------------------------------------- block
+
+    def _missed_unblock_locked(self, ev: Evaluation) -> bool:
+        """Did a relevant capacity change land after this eval's snapshot?"""
+        if self._global_unblock_index > ev.snapshot_index:
+            return True
+        elig = ev.class_eligibility or {}
+        for cls, idx in self._unblock_indexes.items():
+            if idx <= ev.snapshot_index:
+                continue
+            if ev.escaped_computed_class:
+                return True
+            if cls not in elig or elig.get(cls):
+                return True
+        return False
+
+    def block(self, ev: Evaluation) -> None:
+        with self._lock:
+            if not self.enabled:
+                return
+            if self._missed_unblock_locked(ev):
+                # capacity changed between the eval's snapshot and now:
+                # requeue immediately instead of blocking forever
+                latest = max([self._global_unblock_index,
+                              *self._unblock_indexes.values()])
+                missed = ev
+            else:
+                missed = None
+            key = (ev.namespace, ev.job_id)
+            prior_id = self._jobs.get(key)
+            if prior_id is not None:
+                prior = self._captured.get(prior_id)
+                # keep the newer eval, cancel the older as duplicate
+                if prior is not None:
+                    if prior.create_index <= ev.create_index:
+                        self._drop_locked(prior_id)
+                        self._duplicates.append(prior)
+                    else:
+                        self._duplicates.append(ev)
+                        return
+            if missed is not None:
+                self._lock.release()
+                try:
+                    self._requeue([missed], latest)
+                finally:
+                    self._lock.acquire()
+                return
+            self._captured[ev.id] = ev
+            self._jobs[key] = ev.id
+            if ev.escaped_computed_class:
+                self._escaped.add(ev.id)
+                self.stats.total_escaped += 1
+            if ev.quota_limit_reached:
+                self._quota.setdefault(ev.quota_limit_reached, set()).add(ev.id)
+                self.stats.total_quota_limit += 1
+            self.stats.total_blocked += 1
+
+    def _drop_locked(self, eval_id: str) -> None:
+        ev = self._captured.pop(eval_id, None)
+        if ev is None:
+            return
+        self._escaped.discard(eval_id)
+        key = (ev.namespace, ev.job_id)
+        if self._jobs.get(key) == eval_id:
+            del self._jobs[key]
+        for s in self._quota.values():
+            s.discard(eval_id)
+        self.stats.total_blocked -= 1
+
+    def untrack(self, namespace: str, job_id: str) -> None:
+        """Job deregistered: forget its blocked eval (reference Untrack)."""
+        with self._lock:
+            eid = self._jobs.get((namespace, job_id))
+            if eid:
+                self._drop_locked(eid)
+
+    # ------------------------------------------------------------- unblock
+
+    def unblock(self, computed_class: str, index: int) -> List[Evaluation]:
+        """Capacity became available in `computed_class` (node registered /
+        drained alloc freed / alloc stopped).  Returns the released evals
+        (they are also re-enqueued into the broker)."""
+        with self._lock:
+            if not self.enabled:
+                return []
+            self._unblock_indexes[computed_class] = max(
+                index, self._unblock_indexes.get(computed_class, 0))
+            to_release = []
+            for eid, ev in list(self._captured.items()):
+                if eid in self._escaped:
+                    to_release.append(ev)
+                    continue
+                elig = ev.class_eligibility or {}
+                seen = computed_class in elig
+                if not seen or elig.get(computed_class):
+                    # unseen class: might be feasible now; eligible class:
+                    # new capacity
+                    to_release.append(ev)
+            for ev in to_release:
+                self._drop_locked(ev.id)
+        self._requeue(to_release, index)
+        return to_release
+
+    def unblock_all(self, index: int) -> List[Evaluation]:
+        with self._lock:
+            self._global_unblock_index = max(self._global_unblock_index, index)
+            released = list(self._captured.values())
+            for ev in released:
+                self._drop_locked(ev.id)
+        self._requeue(released, index)
+        return released
+
+    def unblock_quota(self, quota: str, index: int) -> List[Evaluation]:
+        with self._lock:
+            ids = list(self._quota.get(quota, ()))
+            released = [self._captured[i] for i in ids if i in self._captured]
+            for ev in released:
+                self._drop_locked(ev.id)
+        self._requeue(released, index)
+        return released
+
+    def _requeue(self, evals: List[Evaluation], index: int) -> None:
+        for ev in evals:
+            e = ev.copy()
+            e.status = EvalStatus.PENDING
+            e.snapshot_index = index
+            self.broker.enqueue(e)
+
+    # ------------------------------------------------------------- readers
+
+    def get_duplicates(self) -> List[Evaluation]:
+        with self._lock:
+            dups = self._duplicates
+            self._duplicates = []
+            return dups
+
+    def blocked_count(self) -> int:
+        with self._lock:
+            return len(self._captured)
+
+    def watch_state(self, table: str, obj) -> None:
+        """StateStore watcher hook: node capacity changes unblock by class
+        (reference watchCapacity fed by the FSM)."""
+        if table != "nodes":
+            return
+        node = obj
+        if node.ready():
+            self.unblock(node.computed_class, getattr(node, "modify_index", 0))

@@ -1,0 +1,235 @@
+"""Evaluation broker (reference: nomad/eval_broker.go — EvalBroker:47,
+Enqueue:182, Dequeue:335, Ack/Nack:537,601, delayed evals:758, priority
+heap:888-925).
+
+Semantics reproduced:
+- priority queues per scheduler type; FIFO within a priority
+- one eval per (namespace, job) outstanding; later ones wait in a per-job
+  pending queue and are released on Ack (dedup of pending evals per job)
+- dequeue hands out a lease token; Ack/Nack must present it
+- Nack requeues with attempt count; after `delivery_limit` attempts the
+  eval is routed to the `_failed` queue (reaped by the leader loop)
+- `wait_until` evals sit in a delay heap until due
+- expired leases auto-nack (checked lazily on broker operations)
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time as _time
+import uuid
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.structs import Evaluation
+
+FAILED_QUEUE = "_failed"
+
+
+class _Lease:
+    __slots__ = ("eval", "token", "expires_at")
+
+    def __init__(self, ev: Evaluation, token: str, expires_at: float):
+        self.eval = ev
+        self.token = token
+        self.expires_at = expires_at
+
+
+class EvalBroker:
+    def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3,
+                 initial_nack_delay: float = 1.0, subsequent_nack_delay: float = 20.0):
+        self._lock = threading.Condition()
+        self.enabled = False
+        self.nack_timeout = nack_timeout
+        self.delivery_limit = delivery_limit
+        self.initial_nack_delay = initial_nack_delay
+        self.subsequent_nack_delay = subsequent_nack_delay
+        self._counter = itertools.count()
+        # scheduler type -> heap of (-priority, seq, eval)
+        self._ready: Dict[str, List[Tuple[int, int, Evaluation]]] = defaultdict(list)
+        self._unack: Dict[str, _Lease] = {}
+        self._attempts: Dict[str, int] = defaultdict(int)
+        # (namespace, job_id) -> deque of evals waiting for the active one.
+        # A job is "active" from the moment one of its evals enters the
+        # ready queue (not just at dequeue) until that eval is acked or
+        # dead-lettered — the reference dedups at enqueue time across
+        # ready+unack, preventing two schedulers from planning the same job
+        # concurrently.
+        self._pending: Dict[Tuple[str, str], deque] = defaultdict(deque)
+        self._active_jobs: Set[Tuple[str, str]] = set()
+        self._delayed: List[Tuple[float, int, Evaluation]] = []
+        self._requeued: List[Tuple[float, int, Evaluation]] = []   # nack delay heap
+        self.stats = defaultdict(int)
+
+    # ------------------------------------------------------------- control
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self.enabled = enabled
+            if not enabled:
+                self.flush()
+
+    def flush(self) -> None:
+        self._ready.clear()
+        self._unack.clear()
+        self._attempts.clear()
+        self._pending.clear()
+        self._active_jobs.clear()
+        self._delayed = []
+        self._requeued = []
+
+    # ------------------------------------------------------------- enqueue
+
+    def enqueue(self, ev: Evaluation) -> None:
+        with self._lock:
+            self._enqueue_locked(ev)
+            self._lock.notify_all()
+
+    def enqueue_all(self, evals: List[Evaluation]) -> None:
+        with self._lock:
+            for ev in evals:
+                self._enqueue_locked(ev)
+            self._lock.notify_all()
+
+    def _enqueue_locked(self, ev: Evaluation) -> None:
+        if not self.enabled:
+            return
+        now = _time.time()
+        if ev.wait_until and ev.wait_until > now:
+            heapq.heappush(self._delayed, (ev.wait_until, next(self._counter), ev))
+            self.stats["delayed"] += 1
+            return
+        key = (ev.namespace, ev.job_id)
+        if ev.job_id and key in self._active_jobs:
+            self._pending[key].append(ev)
+            self.stats["pending_dedup"] += 1
+            return
+        if ev.job_id:
+            self._active_jobs.add(key)
+        heapq.heappush(self._ready[ev.type], (-ev.priority, next(self._counter), ev))
+        self.stats["enqueued"] += 1
+
+    # ------------------------------------------------------------- dequeue
+
+    def _poll_timers_locked(self) -> None:
+        now = _time.time()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, ev = heapq.heappop(self._delayed)
+            ev.wait_until = 0.0
+            self._enqueue_locked(ev)
+        while self._requeued and self._requeued[0][0] <= now:
+            _, _, ev = heapq.heappop(self._requeued)
+            heapq.heappush(self._ready[ev.type], (-ev.priority, next(self._counter), ev))
+        # expire stale leases -> auto-nack
+        expired = [t for t, l in self._unack.items() if l.expires_at <= now]
+        for token in expired:
+            lease = self._unack.pop(token)
+            self._nack_locked(lease.eval, requeue_now=True)
+
+    def dequeue(self, schedulers: List[str], timeout: float = 0.0
+                ) -> Tuple[Optional[Evaluation], str]:
+        """-> (eval, token) or (None, '')."""
+        deadline = _time.time() + timeout
+        with self._lock:
+            while True:
+                self._poll_timers_locked()
+                best_q, best = None, None
+                for s in schedulers:
+                    q = self._ready.get(s)
+                    if q and (best is None or q[0][:2] < best[:2]):
+                        best_q, best = s, q[0]
+                if best is not None:
+                    heapq.heappop(self._ready[best_q])
+                    ev = best[2]
+                    token = str(uuid.uuid4())
+                    self._unack[token] = _Lease(ev, token, _time.time() + self.nack_timeout)
+                    self.stats["dequeued"] += 1
+                    return ev, token
+                remaining = deadline - _time.time()
+                if remaining <= 0:
+                    return None, ""
+                # wake early enough to serve delay heaps
+                wake = min(remaining, 0.05)
+                self._lock.wait(wake)
+
+    # ------------------------------------------------------------- ack/nack
+
+    def ack(self, eval_id: str, token: str) -> bool:
+        with self._lock:
+            lease = self._unack.get(token)
+            if lease is None or lease.eval.id != eval_id:
+                return False
+            del self._unack[token]
+            self._attempts.pop(eval_id, None)
+            ev = lease.eval
+            key = (ev.namespace, ev.job_id)
+            self._active_jobs.discard(key)
+            self._release_pending_locked(key)
+            self.stats["acked"] += 1
+            self._lock.notify_all()
+            return True
+
+    def nack(self, eval_id: str, token: str) -> bool:
+        with self._lock:
+            lease = self._unack.get(token)
+            if lease is None or lease.eval.id != eval_id:
+                return False
+            del self._unack[token]
+            ev = lease.eval
+            # the job stays active: the eval will re-enter the ready queue
+            # (or dead-letter, which releases it in _nack_locked)
+            self._nack_locked(ev)
+            self._lock.notify_all()
+            return True
+
+    def _nack_locked(self, ev: Evaluation, requeue_now: bool = False) -> None:
+        self._attempts[ev.id] += 1
+        attempts = self._attempts[ev.id]
+        if attempts >= self.delivery_limit:
+            # dead-letter: hand to the failed queue for the leader reaper
+            # and release the job so a fresh eval can be scheduled
+            self._active_jobs.discard((ev.namespace, ev.job_id))
+            self._release_pending_locked((ev.namespace, ev.job_id))
+            heapq.heappush(self._ready[FAILED_QUEUE],
+                           (-ev.priority, next(self._counter), ev))
+            self.stats["failed"] += 1
+            return
+        delay = (self.initial_nack_delay if attempts == 1
+                 else self.subsequent_nack_delay)
+        if requeue_now:
+            delay = 0.0
+        heapq.heappush(self._requeued,
+                       (_time.time() + delay, next(self._counter), ev))
+        self.stats["nacked"] += 1
+
+    def _release_pending_locked(self, key: Tuple[str, str]) -> None:
+        pending = self._pending.get(key)
+        if pending:
+            nxt = pending.popleft()
+            if not pending:
+                del self._pending[key]
+            self._enqueue_locked(nxt)
+
+    # ------------------------------------------------------------- inspect
+
+    def outstanding(self, eval_id: str) -> Optional[str]:
+        with self._lock:
+            for token, lease in self._unack.items():
+                if lease.eval.id == eval_id:
+                    return token
+        return None
+
+    def outstanding_reset(self, eval_id: str, token: str) -> bool:
+        """Extend the lease (reference OutstandingReset for long scheds)."""
+        with self._lock:
+            lease = self._unack.get(token)
+            if lease is None or lease.eval.id != eval_id:
+                return False
+            lease.expires_at = _time.time() + self.nack_timeout
+            return True
+
+    def ready_count(self) -> int:
+        with self._lock:
+            self._poll_timers_locked()
+            return sum(len(q) for s, q in self._ready.items() if s != FAILED_QUEUE)

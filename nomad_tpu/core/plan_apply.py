@@ -1,0 +1,171 @@
+"""Serialized plan applier (reference: nomad/plan_apply.go — planApply:71,
+evaluatePlan:400, evaluatePlanPlacements:439, evaluateNodePlan:640,
+applyPlan:204).
+
+The single point where optimistic scheduler output meets ground truth:
+every placement is re-validated against the latest committed state (the
+incremental ClusterMatrix *is* that state, so validation is vectorized
+array math instead of the reference's per-node EvaluatePool fan-out), nodes
+that fail are partially rejected, and the surviving plan is committed to
+the state store in one indexed write.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from nomad_tpu.state.store import AppliedPlanResults, StateStore
+from nomad_tpu.structs import Allocation, Node
+from nomad_tpu.structs.node import NodeStatus
+from nomad_tpu.structs.plan import Plan, PlanResult
+
+
+class PlanApplier:
+    """Serialized: one plan at a time, guarded by a lock (the reference
+    serializes via the single planApply goroutine)."""
+
+    def __init__(self, store: StateStore):
+        self.store = store
+        self._lock = threading.Lock()
+        self.stats = {"applied": 0, "rejected_nodes": 0, "partial": 0}
+
+    # ------------------------------------------------------------- public
+
+    def apply(self, plan: Plan) -> PlanResult:
+        with self._lock:
+            result = self._evaluate(plan)
+            self._commit(plan, result)
+            return result
+
+    def run_loop(self, queue, stop_event: threading.Event) -> None:
+        """Leader plan-apply loop draining the PlanQueue."""
+        while not stop_event.is_set():
+            pending = queue.dequeue(timeout=0.1)
+            if pending is None:
+                continue
+            try:
+                pending.future.set_result(self.apply(pending.plan))
+            except Exception as e:            # noqa: BLE001
+                pending.future.set_exception(e)
+
+    # ------------------------------------------------------------- evaluate
+
+    def _node_ok_for_placement(self, node: Optional[Node]) -> bool:
+        """evaluateNodePlan's node-state gate (plan_apply.go:653-668)."""
+        if node is None:
+            return False
+        if node.status in (NodeStatus.DOWN, NodeStatus.DISCONNECTED):
+            return False
+        # ineligible nodes reject new work at *scheduling* time; the applier
+        # only rejects unsafe nodes (down/disconnected/draining), mirroring
+        # the reference's check of Status and Drain but not eligibility
+        return node.drain_strategy is None
+
+    def _evaluate(self, plan: Plan) -> PlanResult:
+        """Validate placements per node against committed state; drop
+        failing nodes (partial commit) or everything for all_at_once."""
+        store = self.store
+        cm = store.matrix
+        result = PlanResult()
+        result.node_update = {k: list(v) for k, v in plan.node_update.items()}
+        result.node_preemptions = {k: list(v) for k, v in plan.node_preemptions.items()}
+        result.deployment = plan.deployment
+        result.deployment_updates = list(plan.deployment_updates)
+
+        # resources freed on each node by this plan's stops/preemptions
+        freed: Dict[str, np.ndarray] = {}
+        freed_ports: Dict[str, Set[int]] = {}
+        for node_id, stops in list(plan.node_update.items()) + \
+                list(plan.node_preemptions.items()):
+            vec = np.zeros(3, np.float32)
+            ports: Set[int] = set()
+            for a in stops:
+                live = store.alloc_by_id(a.id)
+                src = live if live is not None else a
+                if live is not None and live.terminal_status():
+                    continue   # already free in committed state
+                cr = src.comparable_resources()
+                vec += (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                ports.update(_alloc_ports(src))
+            freed[node_id] = vec
+            freed_ports[node_id] = ports
+
+        rejected: List[str] = []
+        for node_id, placements in plan.node_allocation.items():
+            node = store._nodes.get(node_id)
+            row = cm.row_of.get(node_id)
+            if not self._node_ok_for_placement(node) or row is None:
+                rejected.append(node_id)
+                continue
+            demand = np.zeros(3, np.float32)
+            claimed: Set[int] = set()
+            port_collision = False
+            for a in placements:
+                cr = a.comparable_resources()
+                demand += (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                for p in _alloc_ports(a):
+                    if p in claimed:
+                        port_collision = True
+                    claimed.add(p)
+            used = cm.used[row] + demand - freed.get(node_id, 0.0)
+            if not np.all(used <= cm.capacity[row] + 1e-6):
+                rejected.append(node_id)
+                continue
+            if not port_collision:
+                free_from_stops = freed_ports.get(node_id, set())
+                for p in claimed:
+                    bit = (cm.port_words[row, p >> 5] >> np.uint32(p & 31)) & 1
+                    if bit and p not in free_from_stops:
+                        port_collision = True
+                        break
+            if port_collision:
+                rejected.append(node_id)
+                continue
+            result.node_allocation[node_id] = list(placements)
+
+        if rejected and plan.all_at_once:
+            # the reference nils updates, placements, preemptions AND the
+            # deployment together when AllAtOnce fails (plan_apply.go:428-436)
+            result.node_allocation = {}
+            result.node_update = {}
+            result.node_preemptions = {}
+            result.deployment = None
+            result.deployment_updates = []
+        if rejected:
+            result.rejected_nodes = rejected
+            result.refresh_index = store.latest_index
+            self.stats["partial"] += 1
+            self.stats["rejected_nodes"] += len(rejected)
+        return result
+
+    # ------------------------------------------------------------- commit
+
+    def _commit(self, plan: Plan, result: PlanResult) -> None:
+        store = self.store
+        if (not result.node_allocation and not result.node_update
+                and not result.node_preemptions and result.deployment is None
+                and not result.deployment_updates):
+            return
+        index = store.latest_index + 1
+        applied = AppliedPlanResults(
+            alloc_updates=[a for v in result.node_update.values() for a in v],
+            allocs_to_place=[a for v in result.node_allocation.values() for a in v],
+            allocs_preempted=[a for v in result.node_preemptions.values() for a in v],
+            deployment=result.deployment,
+            deployment_updates=result.deployment_updates,
+            eval_id=plan.eval_id,
+        )
+        store.upsert_plan_results(index, applied)
+        result.alloc_index = index
+        self.stats["applied"] += 1
+
+
+def _alloc_ports(a: Allocation) -> List[int]:
+    out = []
+    for net in a.comparable_resources().networks:
+        out += [p.value for p in net.reserved_ports if p.value]
+        out += [p.value for p in net.dynamic_ports if p.value]
+    out += [p.value for p in a.allocated_resources.shared_ports if p.value]
+    return out

@@ -1,0 +1,65 @@
+"""Leader-side plan queue (reference: nomad/plan_queue.go).
+
+Workers submit plans; the single plan-apply loop pops them in priority
+order.  Each pending plan carries a future the submitting worker blocks on.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+from nomad_tpu.structs.plan import Plan
+
+
+class PendingPlan:
+    __slots__ = ("plan", "future")
+
+    def __init__(self, plan: Plan):
+        self.plan = plan
+        self.future: Future = Future()
+
+
+class PlanQueue:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self.enabled = False
+        self._heap: List[Tuple[int, int, PendingPlan]] = []
+        self._counter = itertools.count()
+        self.stats = {"depth": 0}
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            self.enabled = enabled
+            if not enabled:
+                for _, _, p in self._heap:
+                    p.future.set_exception(RuntimeError("plan queue disabled"))
+                self._heap = []
+            self._lock.notify_all()
+
+    def enqueue(self, plan: Plan) -> PendingPlan:
+        with self._lock:
+            if not self.enabled:
+                raise RuntimeError("plan queue is disabled")
+            pending = PendingPlan(plan)
+            heapq.heappush(self._heap, (-plan.priority, next(self._counter), pending))
+            self.stats["depth"] = len(self._heap)
+            self._lock.notify_all()
+            return pending
+
+    def dequeue(self, timeout: Optional[float] = None) -> Optional[PendingPlan]:
+        with self._lock:
+            if not self._lock.wait_for(lambda: self._heap or not self.enabled,
+                                       timeout=timeout):
+                return None
+            if not self._heap:
+                return None
+            _, _, pending = heapq.heappop(self._heap)
+            self.stats["depth"] = len(self._heap)
+            return pending
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._heap)

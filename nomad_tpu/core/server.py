@@ -1,0 +1,245 @@
+"""Single-process server: the control-plane spine wired together.
+
+Reference analog: nomad/server.go + leader.go establishLeadership — state
+store, eval broker, blocked evals, plan queue, the serialized plan-apply
+loop, N scheduler workers, heartbeats and the periodic dispatcher.  This is
+the in-memory '-dev agent' equivalent (no Raft/Serf: single region,
+immediate consensus — multi-server replication is the RPC layer's job and
+rides on the same indexed writes).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time as _time
+import uuid
+from typing import Dict, List, Optional
+
+from nomad_tpu.core.blocked import BlockedEvals
+from nomad_tpu.core.broker import FAILED_QUEUE, EvalBroker
+from nomad_tpu.core.plan_apply import PlanApplier
+from nomad_tpu.core.plan_queue import PlanQueue
+from nomad_tpu.core.worker import Worker
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import (
+    Evaluation,
+    EvalStatus,
+    Job,
+    JobType,
+    Node,
+)
+from nomad_tpu.structs.evaluation import EvalTrigger
+
+
+class ServerConfig:
+    def __init__(self, num_schedulers: int = 4,
+                 enabled_schedulers: Optional[List[str]] = None,
+                 heartbeat_ttl: float = 10.0):
+        self.num_schedulers = num_schedulers
+        self.enabled_schedulers = enabled_schedulers or \
+            ["service", "batch", "system", "sysbatch"]
+        self.heartbeat_ttl = heartbeat_ttl
+
+
+class Server:
+    def __init__(self, config: Optional[ServerConfig] = None):
+        self.config = config or ServerConfig()
+        self.store = StateStore()
+        self.broker = EvalBroker()
+        self.blocked_evals = BlockedEvals(self.broker)
+        self.plan_queue = PlanQueue()
+        self.applier = PlanApplier(self.store)
+        self.workers: List[Worker] = []
+        self._raft_lock = threading.Lock()     # serializes indexed writes
+        self._stop = threading.Event()
+        self._plan_thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
+        self.store.watch(self.blocked_evals.watch_state)
+        self.store.watch(self._on_state_change)
+        self.leader = False
+
+    # ------------------------------------------------------------- indexes
+
+    def next_index(self) -> int:
+        with self._raft_lock:
+            return self.store.latest_index + 1
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """establishLeadership (reference nomad/leader.go:277-357)."""
+        self.leader = True
+        self.broker.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.plan_queue.set_enabled(True)
+        self._plan_thread = threading.Thread(
+            target=self.applier.run_loop, args=(self.plan_queue, self._stop),
+            name="plan-apply", daemon=True)
+        self._plan_thread.start()
+        for i in range(self.config.num_schedulers):
+            w = Worker(self, i, self.config.enabled_schedulers)
+            w.start()
+            self.workers.append(w)
+        restore = self._restore_evals()
+        t = threading.Thread(target=self._failed_eval_reaper,
+                             name="eval-reaper", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for w in self.workers:
+            w.stop()
+        for w in self.workers:
+            w.join(1.0)
+        self.plan_queue.set_enabled(False)
+        self.broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        if self._plan_thread:
+            self._plan_thread.join(1.0)
+
+    def _restore_evals(self) -> None:
+        """On leadership: re-enqueue non-terminal evals (leader.go:572)."""
+        for ev in list(self.store._evals.values()):
+            if ev.should_enqueue():
+                self.broker.enqueue(ev.copy())
+            elif ev.should_block():
+                self.blocked_evals.block(ev.copy())
+
+    def _failed_eval_reaper(self) -> None:
+        """Mark dead-lettered evals failed and create follow-ups
+        (leader.go:842-884)."""
+        while not self._stop.is_set():
+            ev, token = self.broker.dequeue([FAILED_QUEUE], timeout=0.2)
+            if ev is None:
+                continue
+            updated = ev.copy()
+            updated.status = EvalStatus.FAILED
+            updated.status_description = "maximum attempts reached"
+            self.update_eval(updated)
+            follow = Evaluation(
+                namespace=ev.namespace, priority=ev.priority, type=ev.type,
+                job_id=ev.job_id, triggered_by=EvalTrigger.FAILED_FOLLOW_UP,
+                status=EvalStatus.PENDING,
+                wait_until=_time.time() + 60.0)
+            self.create_evals([follow])
+            self.broker.ack(ev.id, token)
+
+    # ------------------------------------------------------------- watches
+
+    def _on_state_change(self, table: str, obj) -> None:
+        # alloc terminations free capacity: unblock that node's class
+        if table == "allocs":
+            a = obj
+            if a.terminal_status():
+                node = self.store._nodes.get(a.node_id)
+                if node is not None:
+                    self.blocked_evals.unblock(node.computed_class,
+                                               self.store.latest_index)
+
+    # ------------------------------------------------------------- API ops
+    # (these are what the RPC endpoints call; reference nomad/job_endpoint.go,
+    #  node_endpoint.go, eval_endpoint.go)
+
+    def update_eval(self, ev: Evaluation) -> None:
+        with self._raft_lock:
+            self.store.upsert_evals(self.store.latest_index + 1, [ev])
+
+    def create_evals(self, evals: List[Evaluation]) -> None:
+        copies = [e.copy() for e in evals]
+        with self._raft_lock:
+            self.store.upsert_evals(self.store.latest_index + 1, copies)
+        for e in copies:
+            if e.should_enqueue():
+                self.broker.enqueue(e)
+            elif e.should_block():
+                # FSM leader hook: blocked evals go to the blocked tracker
+                self.blocked_evals.block(e)
+
+    def register_job(self, job: Job) -> Evaluation:
+        """Job.Register (nomad/job_endpoint.go:81): upsert + eval."""
+        with self._raft_lock:
+            self.store.upsert_job(self.store.latest_index + 1, job)
+        ev = Evaluation(
+            namespace=job.namespace, priority=job.priority, type=job.type,
+            job_id=job.id, triggered_by=EvalTrigger.JOB_REGISTER,
+            status=EvalStatus.PENDING,
+            job_modify_index=job.job_modify_index)
+        ev.modify_index = job.modify_index
+        if not job.is_periodic() and not job.is_parameterized():
+            self.create_evals([ev])
+        return ev
+
+    def deregister_job(self, namespace: str, job_id: str, purge: bool = False) -> Optional[Evaluation]:
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        with self._raft_lock:
+            if purge:
+                self.store.delete_job(self.store.latest_index + 1, namespace, job_id)
+            else:
+                stopped = job.copy()
+                stopped.stop = True
+                self.store.upsert_job(self.store.latest_index + 1, stopped)
+        self.blocked_evals.untrack(namespace, job_id)
+        ev = Evaluation(
+            namespace=namespace, priority=job.priority, type=job.type,
+            job_id=job_id, triggered_by=EvalTrigger.JOB_DEREGISTER,
+            status=EvalStatus.PENDING)
+        self.create_evals([ev])
+        return ev
+
+    def register_node(self, node: Node) -> None:
+        """Node.Register (nomad/node_endpoint.go:79)."""
+        with self._raft_lock:
+            self.store.upsert_node(self.store.latest_index + 1, node)
+
+    def update_node_status(self, node_id: str, status: str) -> List[Evaluation]:
+        """Node.UpdateStatus: transition + evals for affected jobs."""
+        with self._raft_lock:
+            self.store.update_node_status(
+                self.store.latest_index + 1, node_id, status, _time.time())
+        return self.create_node_evals(node_id)
+
+    def create_node_evals(self, node_id: str) -> List[Evaluation]:
+        """Evaluate all jobs with allocs on the node plus system jobs
+        (reference createNodeEvals, node_endpoint.go)."""
+        evals = []
+        seen = set()
+        for a in self.store.allocs_by_node(node_id):
+            job = a.job or self.store.job_by_id(a.namespace, a.job_id)
+            if job is None or job.id in seen:
+                continue
+            seen.add(job.id)
+            evals.append(Evaluation(
+                namespace=a.namespace, priority=job.priority, type=job.type,
+                job_id=job.id, triggered_by=EvalTrigger.NODE_UPDATE,
+                node_id=node_id, status=EvalStatus.PENDING,
+                modify_index=self.store.latest_index))
+        for job in self.store.jobs():
+            if job.type in (JobType.SYSTEM, JobType.SYSBATCH) \
+                    and job.id not in seen and not job.stopped():
+                seen.add(job.id)
+                evals.append(Evaluation(
+                    namespace=job.namespace, priority=job.priority,
+                    type=job.type, job_id=job.id,
+                    triggered_by=EvalTrigger.NODE_UPDATE, node_id=node_id,
+                    status=EvalStatus.PENDING,
+                    modify_index=self.store.latest_index))
+        if evals:
+            self.create_evals(evals)
+        return evals
+
+    # ------------------------------------------------------------- helpers
+
+    def wait_for_idle(self, timeout: float = 10.0) -> bool:
+        """Testing/bench helper: wait until no evals are queued or in
+        flight."""
+        deadline = _time.time() + timeout
+        while _time.time() < deadline:
+            if (self.broker.ready_count() == 0
+                    and not self.broker._unack
+                    and self.plan_queue.depth() == 0):
+                return True
+            _time.sleep(0.01)
+        return False

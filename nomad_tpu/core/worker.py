@@ -1,0 +1,104 @@
+"""Scheduler worker (reference: nomad/worker.go — run:386,
+dequeueEvaluation:437, snapshotMinIndex:537, invokeScheduler:553,
+SubmitPlan:593-660).
+
+Each worker loops: dequeue an eval (with lease token), wait for the state
+store to catch up to the eval's index, invoke the scheduler via the
+factory, then ack/nack.  The worker object is the scheduler's Planner:
+plans go to the plan queue and the worker blocks on the applier's result.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from nomad_tpu.scheduler import factory
+from nomad_tpu.structs import Evaluation, EvalStatus
+from nomad_tpu.structs.plan import Plan, PlanResult
+
+log = logging.getLogger(__name__)
+
+
+class Worker:
+    def __init__(self, server, worker_id: int = 0,
+                 enabled_schedulers: Optional[List[str]] = None):
+        self.server = server
+        self.id = worker_id
+        self.enabled_schedulers = enabled_schedulers or \
+            ["service", "batch", "system", "sysbatch"]
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._snapshot = None
+        self.stats = {"processed": 0, "failed": 0}
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name=f"worker-{self.id}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def join(self, timeout: float = 5.0) -> None:
+        if self._thread:
+            self._thread.join(timeout)
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            ev, token = self.server.broker.dequeue(
+                self.enabled_schedulers, timeout=0.1)
+            if ev is None:
+                continue
+            self.process_eval(ev, token)
+
+    # ------------------------------------------------------------- process
+
+    def process_eval(self, ev: Evaluation, token: str) -> None:
+        server = self.server
+        snap = server.store.snapshot_min_index(
+            max(ev.modify_index, ev.snapshot_index))
+        if snap is None:
+            server.broker.nack(ev.id, token)
+            return
+        self._snapshot = snap
+        self._token = token
+        ev = ev.copy()
+        try:
+            sched = factory.new_scheduler(ev.type, snap, self)
+            sched.process(ev)
+        except Exception as e:                      # noqa: BLE001
+            log.exception("eval %s failed", ev.id)
+            self.stats["failed"] += 1
+            ev.status = EvalStatus.FAILED
+            ev.status_description = str(e)
+            server.update_eval(ev)
+            server.broker.nack(ev.id, token)
+            return
+        ev.status = EvalStatus.COMPLETE
+        server.update_eval(ev)
+        if server.broker.ack(ev.id, token):
+            self.stats["processed"] += 1
+
+    # ------------------------------------------------------------- planner
+
+    def submit_plan(self, plan: Plan) -> PlanResult:
+        plan.eval_token = getattr(self, "_token", "")
+        pending = self.server.plan_queue.enqueue(plan)
+        return pending.future.result(timeout=30.0)
+
+    def create_evals(self, evals: List[Evaluation]) -> None:
+        self.server.create_evals(evals)
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.server.update_eval(ev)
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.server.blocked_evals.block(ev)
+
+    def refresh_snapshot(self, min_index: int = 0):
+        snap = self.server.store.snapshot_min_index(min_index)
+        self._snapshot = snap
+        return snap

@@ -1,0 +1,371 @@
+"""Generic (service/batch) scheduler over the dense placement engine.
+
+Reference: scheduler/generic_sched.go — Process:144, process:242,
+computeJobAllocs:358, computePlacements:499-679, findPreferredNode:783,
+blocked-eval creation:219-238.  The reconcile step is host-side
+(nomad_tpu.scheduler.reconcile); every placement decision for an eval runs
+as ONE dense kernel call (ops.place) instead of per-node iterator pulls.
+"""
+from __future__ import annotations
+
+import time as _time
+import uuid
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from nomad_tpu.scheduler import factory
+from nomad_tpu.scheduler.placement import PortClaims, build_allocation
+from nomad_tpu.scheduler.reconcile import AllocReconciler, PlacementRequest
+from nomad_tpu.scheduler.stack import DenseStack
+from nomad_tpu.scheduler.util import (
+    adjust_queued_allocations,
+    progress_made,
+    tainted_nodes,
+)
+from nomad_tpu.structs import Allocation, Evaluation, EvalStatus, Job
+from nomad_tpu.structs.alloc import AllocMetric
+from nomad_tpu.structs.evaluation import EvalTrigger
+from nomad_tpu.structs.plan import Plan, PlanResult
+
+MAX_SERVICE_SCHEDULE_ATTEMPTS = 5   # generic_sched.go:19-23
+MAX_BATCH_SCHEDULE_ATTEMPTS = 2
+
+BLOCKED_EVAL_MAX_PLAN_DESC = "created due to placement conflicts"
+BLOCKED_EVAL_FAILED_PLACEMENT_DESC = "created to place remaining allocations"
+
+
+class SetStatusError(Exception):
+    def __init__(self, desc: str):
+        super().__init__(desc)
+        self.desc = desc
+
+
+class GenericScheduler:
+    """One instance per eval invocation (the reference constructs a fresh
+    scheduler per Process call via the factory)."""
+
+    batch = False
+
+    def __init__(self, state, planner):
+        self.state = state            # StateSnapshot-like read view
+        self.planner = planner        # Planner: submit_plan/create_evals/...
+        self.eval: Optional[Evaluation] = None
+        self.job: Optional[Job] = None
+        self.plan: Optional[Plan] = None
+        self.plan_result: Optional[PlanResult] = None
+        self.deployment = None
+        self.queued_allocs: Dict[str, int] = {}
+        self.failed_tg_allocs: Dict[str, AllocMetric] = {}
+        self.blocked: Optional[Evaluation] = None
+        self.followup_evals: List[Evaluation] = []
+
+    # ------------------------------------------------------------- process
+
+    def process(self, ev: Evaluation) -> None:
+        self.eval = ev
+        limit = MAX_BATCH_SCHEDULE_ATTEMPTS if self.batch \
+            else MAX_SERVICE_SCHEDULE_ATTEMPTS
+        attempts = 0
+        while attempts < limit:
+            done, made_progress = self._attempt()
+            if done:
+                return
+            # a partial commit that made progress resets the retry budget
+            # (reference retryMax's reset hook + progressMade, util.go:391-425)
+            attempts = 0 if made_progress else attempts + 1
+            snap = self.planner.refresh_snapshot(
+                self.plan_result.refresh_index if self.plan_result else 0)
+            if snap is None:
+                raise SetStatusError("timed out refreshing state snapshot")
+            self.state = snap
+        # exhausted plan attempts: roll over into a blocked eval
+        if not self.batch:
+            blocked = self._make_blocked_eval(BLOCKED_EVAL_MAX_PLAN_DESC,
+                                              triggered_by=EvalTrigger.MAX_PLANS)
+            self.planner.create_evals([blocked])
+        raise SetStatusError("maximum attempts reached")
+
+    # ------------------------------------------------------------- attempt
+
+    def _attempt(self) -> bool:
+        ev = self.eval
+        self.job = self.state.job_by_id(ev.namespace, ev.job_id)
+        self.failed_tg_allocs = {}
+        self.followup_evals = []
+
+        stopped = self.job is None or self.job.stopped()
+        self.deployment = None
+        if not stopped:
+            self.deployment = self.state.latest_deployment_by_job_id(
+                ev.namespace, ev.job_id)
+
+        allocs = self.state.allocs_by_job(ev.namespace, ev.job_id)
+        tainted = tainted_nodes(self.state, allocs)
+
+        self.plan = ev.make_plan(self.job)
+        if ev.annotate_plan:
+            from nomad_tpu.structs.plan import PlanAnnotations
+            self.plan.annotations = PlanAnnotations()
+
+        reconciler = AllocReconciler(
+            job=None if stopped else self.job,
+            job_id=ev.job_id,
+            existing=allocs,
+            tainted_nodes=tainted,
+            deployment=self.deployment,
+            eval_id=ev.id,
+            batch=self.batch,
+            eval_priority=ev.priority,
+        )
+        results = reconciler.compute()
+
+        # follow-up (delayed) evals must exist before allocs reference them
+        for evs in results.desired_followup_evals.values():
+            self.followup_evals.extend(evs)
+        if self.followup_evals:
+            self.planner.create_evals(self.followup_evals)
+
+        # stops / destructive stops
+        for sr in results.stop:
+            self.plan.append_stopped_alloc(
+                sr.alloc, sr.status_description, sr.client_status,
+                sr.followup_eval_id)
+        for sr in results.destructive_stop:
+            self.plan.append_stopped_alloc(
+                sr.alloc, sr.status_description, sr.client_status,
+                sr.followup_eval_id)
+
+        # in-place updates / attribute-only updates ride the plan as
+        # same-node allocations
+        for a in results.inplace_update:
+            self.plan.append_alloc(a, self.job)
+        for a in results.attribute_updates.values():
+            self.plan.append_alloc(a, a.job)
+        for a in results.disconnect_updates.values():
+            self.plan.append_alloc(a, a.job)
+        for a in results.reconnect_updates.values():
+            self.plan.append_alloc(a, a.job)
+
+        # deployment changes
+        if results.deployment is not None:
+            self.plan.deployment = results.deployment
+        self.plan.deployment_updates = results.deployment_updates
+
+        if results.desired_tg_updates and self.plan.annotations is not None:
+            self.plan.annotations.desired_tg_updates = results.desired_tg_updates
+
+        # queued = placements desired this pass
+        self.queued_allocs = {tg.name: 0 for tg in
+                              (self.job.task_groups if self.job else [])}
+        for pr in results.place:
+            self.queued_allocs[pr.task_group] = \
+                self.queued_allocs.get(pr.task_group, 0) + 1
+
+        if not stopped and results.place:
+            self._compute_placements(results.place, results.stop +
+                                     results.destructive_stop, allocs)
+
+        if self.plan.is_no_op():
+            self._finish_eval()
+            return True, False
+
+        self.plan_result = self.planner.submit_plan(self.plan)
+        adjust_queued_allocations(self.plan_result, self.queued_allocs)
+
+        full, expected, actual = self.plan_result.full_commit(self.plan)
+        if not full:
+            return False, progress_made(self.plan_result)
+        self._finish_eval()
+        return True, True
+
+    # ------------------------------------------------------------- finish
+
+    def _finish_eval(self) -> None:
+        ev = self.eval
+        ev.queued_allocations = dict(self.queued_allocs)
+        if self.failed_tg_allocs and self.blocked is None:
+            blocked = self._make_blocked_eval(BLOCKED_EVAL_FAILED_PLACEMENT_DESC)
+            blocked.status = EvalStatus.BLOCKED
+            self.blocked = blocked
+            self.planner.create_evals([blocked])
+            ev.blocked_eval = blocked.id
+
+    def _make_blocked_eval(self, desc: str, triggered_by: str = "") -> Evaluation:
+        ev = self.eval
+        classes, escaped = self._class_eligibility()
+        return Evaluation(
+            id=str(uuid.uuid4()),
+            namespace=ev.namespace,
+            priority=ev.priority,
+            type=ev.type,
+            triggered_by=triggered_by or EvalTrigger.QUEUED_ALLOCS,
+            job_id=ev.job_id,
+            status=EvalStatus.BLOCKED,
+            status_description=desc,
+            previous_eval=ev.id,
+            class_eligibility=classes,
+            escaped_computed_class=escaped,
+            snapshot_index=getattr(self.state, "index", 0),
+        )
+
+    def _class_eligibility(self) -> Tuple[Dict[str, bool], bool]:
+        """Which computed node classes were feasible (for unblock-on-capacity
+        keying; reference EvalEligibility, context.go:252-420)."""
+        classes: Dict[str, bool] = {}
+        escaped = False
+        if self.job is None:
+            return classes, True
+        for c in self.job.constraints:
+            if "unique." in c.ltarget or "unique." in c.rtarget:
+                escaped = True
+        cm = self.state.matrix
+        feas_union = getattr(self, "_last_feasible_union", None)
+        for node_id, row in cm.row_of.items():
+            node = self.state.node_by_id(node_id)
+            if node is None:
+                continue
+            ok = bool(feas_union[row]) if feas_union is not None else True
+            prev = classes.get(node.computed_class)
+            classes[node.computed_class] = bool(prev) or ok
+        return classes, escaped
+
+    # ------------------------------------------------------------- placing
+
+    def _compute_placements(self, places: List[PlacementRequest],
+                            stops, all_allocs: List[Allocation]) -> None:
+        cm = self.state.matrix
+        stack = DenseStack(cm, self.state.scheduler_config)
+        job = self.job
+        tg_index = {tg.name: i for i, tg in enumerate(job.task_groups)}
+        groups = [stack.compile_group(job, tg) for tg in job.task_groups]
+        self._last_feasible_union = np.any(
+            np.stack([g.feasible for g in groups]), axis=0)
+
+        # proposed-usage basis: committed usage minus what this plan stops
+        used = cm.used.copy()
+        freed_ports: Dict[int, Set[int]] = {}
+        stopped_ids: Set[str] = set()
+        for sr in stops:
+            a = sr.alloc
+            stopped_ids.add(a.id)
+            row = cm.row_of.get(a.node_id)
+            if row is None:
+                continue
+            cr = a.comparable_resources()
+            used[row] -= (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+            from nomad_tpu.core.plan_apply import _alloc_ports
+            freed_ports.setdefault(row, set()).update(_alloc_ports(a))
+
+        # remaining allocs for anti-affinity / spread / distinct_*
+        allocs_by_tg: Dict[str, List[Allocation]] = {}
+        for a in all_allocs:
+            if a.id in stopped_ids or a.terminal_status():
+                continue
+            allocs_by_tg.setdefault(a.task_group, []).append(a)
+
+        penalty_nodes: Dict[str, Set[str]] = {}
+        for pr in places:
+            if pr.is_rescheduling and pr.previous_alloc is not None:
+                penalty_nodes.setdefault(pr.task_group, set()).add(
+                    pr.previous_alloc.node_id)
+
+        # sticky ephemeral disk: prefer the previous node when feasible
+        # (findPreferredNode, generic_sched.go:783)
+        slot_requests: List[PlacementRequest] = []
+        preplaced: List[Tuple[PlacementRequest, int]] = []
+        for pr in places:
+            gi = tg_index[pr.task_group]
+            tg = job.task_groups[gi]
+            if (tg.ephemeral_disk.sticky and pr.previous_alloc is not None
+                    and not pr.is_rescheduling):
+                row = cm.row_of.get(pr.previous_alloc.node_id)
+                if row is not None and groups[gi].feasible[row]:
+                    d = groups[gi].demand
+                    if np.all(used[row] + d <= cm.capacity[row]):
+                        used[row] += d
+                        preplaced.append((pr, row))
+                        continue
+            slot_requests.append(pr)
+
+        slots = [tg_index[pr.task_group] for pr in slot_requests]
+        result = None
+        if slots:
+            inputs = stack.build_inputs(
+                job, groups, slots, allocs_by_tg,
+                penalty_nodes=penalty_nodes, used_override=used)
+            result = stack.place(inputs)
+
+        ports = PortClaims(cm)
+        now = _time.time()
+        deployment = self.plan.deployment or self.deployment
+
+        def metric_for(i: Optional[int]) -> AllocMetric:
+            m = AllocMetric()
+            if result is not None and i is not None:
+                m.nodes_evaluated = int(result.nodes_evaluated[i])
+                m.nodes_exhausted = int(result.nodes_exhausted[i])
+                entries = []
+                for k in range(result.top_nodes.shape[1]):
+                    r = int(result.top_nodes[i, k])
+                    s = float(result.top_scores[i, k])
+                    if r >= 0 and s > -np.inf and cm.node_ids[r]:
+                        entries.append({"node_id": cm.node_ids[r],
+                                        "norm_score": round(s, 6)})
+                m.populate_score_meta(entries)
+            m.allocation_time_s = 0.0
+            return m
+
+        def place_on(pr: PlacementRequest, row: int, metric: AllocMetric) -> None:
+            gi = tg_index[pr.task_group]
+            tg = job.task_groups[gi]
+            node_id = cm.node_ids[row]
+            node = self.state.node_by_id(node_id)
+            dep_id = ""
+            if deployment is not None and tg.name in deployment.task_groups:
+                dep_id = deployment.id
+            alloc = build_allocation(
+                job=job, tg=tg, name=pr.name, node_id=node_id,
+                node_name=node.name if node else "", eval_id=self.eval.id,
+                row=row, ports=ports, freed_ports=freed_ports.get(row, set()),
+                metric=metric, previous=pr.previous_alloc,
+                deployment_id=dep_id, is_canary=pr.is_canary,
+                is_rescheduling=pr.is_rescheduling, now=now)
+            if alloc is None:
+                self._fail_placement(pr, metric, "ports exhausted")
+                return
+            if pr.previous_alloc is not None:
+                pr.previous_alloc.next_allocation = alloc.id
+            self.plan.append_alloc(alloc, None)
+            if pr.is_canary and self.plan.deployment is not None:
+                state = self.plan.deployment.task_groups.get(tg.name)
+                if state is not None:
+                    state.placed_canaries.append(alloc.id)
+
+        for pr, row in preplaced:
+            place_on(pr, row, metric_for(None))
+        if result is not None:
+            for i, pr in enumerate(slot_requests):
+                row = int(result.node[i])
+                if row < 0:
+                    self._fail_placement(pr, metric_for(i), "exhausted")
+                else:
+                    place_on(pr, row, metric_for(i))
+
+    def _fail_placement(self, pr: PlacementRequest, metric: AllocMetric,
+                        reason: str) -> None:
+        prev = self.failed_tg_allocs.get(pr.task_group)
+        if prev is not None:
+            prev.coalesced_failures += 1
+        else:
+            metric.dimension_exhausted[reason] = 1
+            self.failed_tg_allocs[pr.task_group] = metric
+        self.eval.queued_allocations = self.queued_allocs
+
+
+class ServiceScheduler(GenericScheduler):
+    batch = False
+
+
+class BatchScheduler(GenericScheduler):
+    batch = True

@@ -1,0 +1,148 @@
+"""Placement materialization: PlaceResult rows -> Allocation objects.
+
+Port-offer construction stays on the host (SURVEY.md section 7 'hard
+parts': dynamic port assignment is inherently sequential; the device checks
+capacity/collisions, the host constructs the concrete offer — mirroring the
+reference split where the plan applier re-validates).
+"""
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from nomad_tpu.encode.matrixizer import ClusterMatrix
+from nomad_tpu.structs import Allocation, AllocClientStatus, AllocDesiredStatus, Job, TaskGroup
+from nomad_tpu.structs.alloc import (
+    AllocatedResources,
+    AllocatedTaskResources,
+    AllocMetric,
+    RescheduleEvent,
+    RescheduleTracker,
+)
+from nomad_tpu.structs.resources import NetworkPort, NetworkResource
+
+
+class PortClaims:
+    """In-plan port claims per node row (plan-local view on top of the
+    committed bitsets)."""
+
+    def __init__(self, cm: ClusterMatrix):
+        self.cm = cm
+        self.claimed: Dict[int, Set[int]] = {}
+
+    def _is_free(self, row: int, port: int, freed: Set[int]) -> bool:
+        if port in self.claimed.get(row, ()):
+            return False
+        if port in freed:
+            return True
+        bit = (self.cm.port_words[row, port >> 5] >> np.uint32(port & 31)) & 1
+        return not bit
+
+    def claim_static(self, row: int, port: int, freed: Set[int]) -> bool:
+        if not self._is_free(row, port, freed):
+            return False
+        self.claimed.setdefault(row, set()).add(port)
+        return True
+
+    def assign_dynamic(self, row: int, freed: Set[int]) -> Optional[int]:
+        lo = int(self.cm.dyn_port_lo[row])
+        hi = int(self.cm.dyn_port_hi[row])
+        for p in range(lo, hi + 1):
+            if self._is_free(row, p, freed):
+                self.claimed.setdefault(row, set()).add(p)
+                return p
+        return None
+
+
+def build_allocation(
+    job: Job,
+    tg: TaskGroup,
+    name: str,
+    node_id: str,
+    node_name: str,
+    eval_id: str,
+    row: int,
+    ports: PortClaims,
+    freed_ports: Set[int],
+    metric: AllocMetric,
+    previous: Optional[Allocation] = None,
+    deployment_id: str = "",
+    is_canary: bool = False,
+    is_rescheduling: bool = False,
+    now: float = 0.0,
+) -> Optional[Allocation]:
+    """Construct the Allocation for one selected placement; returns None if
+    port assignment fails (caller treats as exhausted node)."""
+    tasks: Dict[str, AllocatedTaskResources] = {}
+    for t in tg.tasks:
+        nets = []
+        for net in t.resources.networks:
+            nets.append(_materialize_net(net, row, ports, freed_ports))
+            if nets[-1] is None:
+                return None
+        tasks[t.name] = AllocatedTaskResources(
+            cpu_shares=t.resources.cpu,
+            memory_mb=t.resources.memory_mb,
+            memory_max_mb=t.resources.memory_max_mb,
+            networks=[n for n in nets if n is not None],
+        )
+    shared_nets = []
+    shared_ports: List[NetworkPort] = []
+    for net in tg.networks:
+        m = _materialize_net(net, row, ports, freed_ports)
+        if m is None:
+            return None
+        shared_nets.append(m)
+        shared_ports.extend(m.reserved_ports + m.dynamic_ports)
+
+    alloc = Allocation(
+        id=str(uuid.uuid4()),
+        namespace=job.namespace,
+        eval_id=eval_id,
+        name=name,
+        node_id=node_id,
+        node_name=node_name,
+        job_id=job.id,
+        job=job,
+        task_group=tg.name,
+        allocated_resources=AllocatedResources(
+            tasks=tasks,
+            shared_disk_mb=tg.ephemeral_disk.size_mb,
+            shared_networks=shared_nets,
+            shared_ports=shared_ports,
+        ),
+        desired_status=AllocDesiredStatus.RUN,
+        client_status=AllocClientStatus.PENDING,
+        metrics=metric,
+        deployment_id=deployment_id,
+        create_time=now,
+        modify_time=now,
+    )
+    if is_canary:
+        alloc.deployment_status = {"canary": True, "healthy": None}
+    if previous is not None:
+        alloc.previous_allocation = previous.id
+        if is_rescheduling:
+            events = list(previous.reschedule_tracker.events) \
+                if previous.reschedule_tracker else []
+            events.append(RescheduleEvent(
+                reschedule_time=now, prev_alloc_id=previous.id,
+                prev_node_id=previous.node_id))
+            alloc.reschedule_tracker = RescheduleTracker(events=events)
+    return alloc
+
+
+def _materialize_net(net: NetworkResource, row: int, ports: PortClaims,
+                     freed: Set[int]) -> Optional[NetworkResource]:
+    out = net.copy()
+    for p in out.reserved_ports:
+        if not ports.claim_static(row, p.value, freed):
+            return None
+    for p in out.dynamic_ports:
+        got = ports.assign_dynamic(row, freed)
+        if got is None:
+            return None
+        p.value = got
+    return out

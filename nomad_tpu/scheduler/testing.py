@@ -1,0 +1,69 @@
+"""Scheduler test harness (reference: scheduler/testing.go:45-302).
+
+A real StateStore + a fake Planner that records submitted plans and created
+evals, and self-applies plans through the real PlanApplier (the reference
+harness applies via UpsertPlanResults).  `reject_plan` forces the
+state-refresh / partial-commit path like the reference's RejectPlan hook.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional
+
+from nomad_tpu.core.plan_apply import PlanApplier
+from nomad_tpu.scheduler import factory
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import Evaluation
+from nomad_tpu.structs.plan import Plan, PlanResult
+
+factory._register_builtins()
+
+
+class Harness:
+    def __init__(self, store: Optional[StateStore] = None):
+        self.store = store or StateStore()
+        self.applier = PlanApplier(self.store)
+        self.plans: List[Plan] = []
+        self.results: List[PlanResult] = []
+        self.create_evals_list: List[Evaluation] = []
+        self.reblock_evals: List[Evaluation] = []
+        self.eval_updates: List[Evaluation] = []
+        self.reject_plan = False
+        self._index = itertools.count(1000)
+
+    # ------------------------------------------------------------- planner
+
+    def submit_plan(self, plan: Plan) -> PlanResult:
+        self.plans.append(plan)
+        if self.reject_plan:
+            result = PlanResult()
+            result.refresh_index = self.store.latest_index
+            self.results.append(result)
+            return result
+        result = self.applier.apply(plan)
+        self.results.append(result)
+        return result
+
+    def create_evals(self, evals: List[Evaluation]) -> None:
+        self.create_evals_list.extend(evals)
+        self.store.upsert_evals(self.next_index(), [e.copy() for e in evals])
+
+    def update_eval(self, ev: Evaluation) -> None:
+        self.eval_updates.append(ev)
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self.reblock_evals.append(ev)
+
+    def refresh_snapshot(self, min_index: int = 0):
+        return self.store.snapshot()
+
+    # ------------------------------------------------------------- helpers
+
+    def next_index(self) -> int:
+        return next(self._index)
+
+    def process(self, scheduler_type: str, ev: Evaluation) -> None:
+        snap = self.store.snapshot()
+        sched = factory.new_scheduler(scheduler_type, snap, self)
+        sched.process(ev)
+        self.last_scheduler = sched

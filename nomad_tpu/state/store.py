@@ -1,0 +1,532 @@
+"""StateStore: versioned in-memory MVCC-style store.
+
+Reference: nomad/state/state_store.go (StateStore:83, Snapshot:190,
+SnapshotMinIndex:217, UpsertPlanResults:337) and the table schemata in
+nomad/state/schema.go:116-1107.  Differences by design:
+
+- go-memdb's immutable radix trees give O(1) snapshots; here objects are
+  treated as immutable-once-inserted (writers always insert copies) and a
+  snapshot shallow-copies the table dicts, memoized per index so concurrent
+  scheduler workers share one snapshot until the next write.
+- The dense ClusterMatrix mirror is maintained inline on every node/alloc
+  write — the TPU analog of memdb watchsets feeding blocking queries.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from nomad_tpu.encode.matrixizer import ClusterMatrix
+from nomad_tpu.structs import (
+    Allocation,
+    AllocClientStatus,
+    AllocDesiredStatus,
+    Deployment,
+    Evaluation,
+    EvalStatus,
+    Job,
+    JobStatus,
+    Node,
+    SchedulerConfiguration,
+)
+from nomad_tpu.structs.evaluation import EvalTrigger
+from nomad_tpu.structs.node import NodeStatus, compute_node_class
+from nomad_tpu.structs.plan import Plan, PlanResult
+
+
+class JobSummary:
+    """Per-job per-taskgroup alloc status counts (reference
+    structs.JobSummary, maintained by state_store alloc writes)."""
+
+    def __init__(self, job_id: str, namespace: str = "default"):
+        self.job_id = job_id
+        self.namespace = namespace
+        self.summary: Dict[str, Dict[str, int]] = {}
+        self.children = {"pending": 0, "running": 0, "dead": 0}
+        self.create_index = 0
+        self.modify_index = 0
+
+    def group(self, tg: str) -> Dict[str, int]:
+        return self.summary.setdefault(tg, {
+            "queued": 0, "complete": 0, "failed": 0,
+            "running": 0, "starting": 0, "lost": 0, "unknown": 0})
+
+
+class StateSnapshot:
+    """A consistent read-only view at one index."""
+
+    def __init__(self, store: "StateStore"):
+        self.index = store.latest_index
+        self.nodes: Dict[str, Node] = dict(store._nodes)
+        self.jobs: Dict[Tuple[str, str], Job] = dict(store._jobs)
+        self.evals: Dict[str, Evaluation] = dict(store._evals)
+        self.allocs: Dict[str, Allocation] = dict(store._allocs)
+        self.deployments: Dict[str, Deployment] = dict(store._deployments)
+        self._allocs_by_job = {k: set(v) for k, v in store._allocs_by_job.items()}
+        self._allocs_by_node = {k: set(v) for k, v in store._allocs_by_node.items()}
+        self.scheduler_config = store.scheduler_config
+        # the matrix is shared (incremental); schedulers use it read-only
+        # together with per-eval used_override deltas
+        self.matrix = store.matrix
+
+    # --- read API mirroring the reference's State interface
+    # (scheduler/scheduler.go:67-116)
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        return self.nodes.get(node_id)
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        return self.jobs.get((namespace, job_id))
+
+    def ready_nodes_in_dcs(self, datacenters: List[str]) -> List[Node]:
+        dcs = set(datacenters)
+        return [n for n in self.nodes.values()
+                if n.ready() and n.datacenter in dcs]
+
+    def allocs_by_job(self, namespace: str, job_id: str,
+                      all_allocs: bool = True) -> List[Allocation]:
+        ids = self._allocs_by_job.get((namespace, job_id), ())
+        return [self.allocs[i] for i in ids]
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        ids = self._allocs_by_node.get(node_id, ())
+        return [self.allocs[i] for i in ids]
+
+    def allocs_by_node_terminal(self, node_id: str, terminal: bool) -> List[Allocation]:
+        return [a for a in self.allocs_by_node(node_id)
+                if a.terminal_status() == terminal]
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        return self.deployments.get(deployment_id)
+
+    def latest_deployment_by_job_id(self, namespace: str, job_id: str) -> Optional[Deployment]:
+        best = None
+        for d in self.deployments.values():
+            if d.namespace == namespace and d.job_id == job_id:
+                if best is None or d.create_index > best.create_index:
+                    best = d
+        return best
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        return self.evals.get(eval_id)
+
+
+class StateStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._index_cv = threading.Condition(self._lock)
+        self.latest_index = 0
+        self._nodes: Dict[str, Node] = {}
+        self._jobs: Dict[Tuple[str, str], Job] = {}
+        self._job_versions: Dict[Tuple[str, str], List[Job]] = defaultdict(list)
+        self._evals: Dict[str, Evaluation] = {}
+        self._allocs: Dict[str, Allocation] = {}
+        self._deployments: Dict[str, Deployment] = {}
+        self._job_summaries: Dict[Tuple[str, str], JobSummary] = {}
+        self._allocs_by_job: Dict[Tuple[str, str], Set[str]] = defaultdict(set)
+        self._allocs_by_node: Dict[str, Set[str]] = defaultdict(set)
+        self._allocs_by_eval: Dict[str, Set[str]] = defaultdict(set)
+        self._evals_by_job: Dict[Tuple[str, str], Set[str]] = defaultdict(set)
+        self.scheduler_config = SchedulerConfiguration()
+        self.matrix = ClusterMatrix()
+        self._snapshot_cache: Optional[StateSnapshot] = None
+        # watchers: fn(table: str, obj) called after commit, outside hot loops
+        self._watchers: List[Callable[[str, object], None]] = []
+
+    # ------------------------------------------------------------ plumbing
+
+    def watch(self, fn: Callable[[str, object], None]) -> None:
+        self._watchers.append(fn)
+
+    def _notify(self, table: str, obj) -> None:
+        for fn in self._watchers:
+            fn(table, obj)
+
+    def _bump(self, index: int) -> None:
+        if index <= self.latest_index:
+            index = self.latest_index  # idempotent replay keeps max
+        self.latest_index = max(self.latest_index, index)
+        self._snapshot_cache = None
+        self._index_cv.notify_all()
+
+    def snapshot(self) -> StateSnapshot:
+        """Memoized per index (reference Snapshot, state_store.go:190)."""
+        with self._lock:
+            if self._snapshot_cache is None:
+                self._snapshot_cache = StateSnapshot(self)
+            return self._snapshot_cache
+
+    def snapshot_min_index(self, index: int, timeout: float = 5.0) -> Optional[StateSnapshot]:
+        """Block until state has caught up to `index` (reference
+        SnapshotMinIndex, state_store.go:217 — gates scheduling on Raft
+        catch-up)."""
+        with self._index_cv:
+            if not self._index_cv.wait_for(
+                    lambda: self.latest_index >= index, timeout=timeout):
+                return None
+            return self.snapshot()
+
+    def wait_for_index(self, index: int, timeout: float = 5.0) -> bool:
+        with self._index_cv:
+            return self._index_cv.wait_for(
+                lambda: self.latest_index >= index, timeout=timeout)
+
+    # ------------------------------------------------------------ nodes
+
+    def upsert_node(self, index: int, node: Node) -> None:
+        with self._lock:
+            node.modify_index = index
+            if node.id not in self._nodes:
+                node.create_index = index
+            if not node.computed_class:
+                node.computed_class = compute_node_class(node)
+            self._nodes[node.id] = node
+            self.matrix.upsert_node(node)
+            self._bump(index)
+        self._notify("nodes", node)
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(node_id, None)
+            self.matrix.remove_node(node_id)
+            self._bump(index)
+        if node:
+            self._notify("nodes", node)
+
+    def update_node_status(self, index: int, node_id: str, status: str,
+                           updated_at: float = 0.0) -> None:
+        with self._lock:
+            old = self._nodes.get(node_id)
+            if old is None:
+                return
+            node = _shallow_copy_node(old)
+            node.status = status
+            node.status_updated_at = updated_at
+            node.modify_index = index
+            self._nodes[node_id] = node
+            self.matrix.upsert_node(node)
+            self._bump(index)
+        self._notify("nodes", node)
+
+    def update_node_drain(self, index: int, node_id: str, drain_strategy,
+                          mark_eligible: bool = False) -> None:
+        with self._lock:
+            old = self._nodes.get(node_id)
+            if old is None:
+                return
+            node = _shallow_copy_node(old)
+            node.drain_strategy = drain_strategy
+            if drain_strategy is not None:
+                node.scheduling_eligibility = "ineligible"
+            elif mark_eligible:
+                node.scheduling_eligibility = "eligible"
+            node.modify_index = index
+            self._nodes[node_id] = node
+            self.matrix.upsert_node(node)
+            self._bump(index)
+        self._notify("nodes", node)
+
+    def update_node_eligibility(self, index: int, node_id: str, eligibility: str) -> None:
+        with self._lock:
+            old = self._nodes.get(node_id)
+            if old is None:
+                return
+            node = _shallow_copy_node(old)
+            node.scheduling_eligibility = eligibility
+            node.modify_index = index
+            self._nodes[node_id] = node
+            self.matrix.upsert_node(node)
+            self._bump(index)
+        self._notify("nodes", node)
+
+    def nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    # ------------------------------------------------------------ jobs
+
+    def upsert_job(self, index: int, job: Job) -> None:
+        with self._lock:
+            job.canonicalize()
+            key = (job.namespace, job.id)
+            existing = self._jobs.get(key)
+            if existing is not None:
+                job.create_index = existing.create_index
+                job.version = existing.version + 1
+            else:
+                job.create_index = index
+                job.version = 0
+            job.modify_index = index
+            job.job_modify_index = index
+            if job.status not in (JobStatus.DEAD,):
+                job.status = JobStatus.PENDING if not job.stop else JobStatus.DEAD
+            self._jobs[key] = job
+            self._job_versions[key].append(job)
+            if len(self._job_versions[key]) > 6:   # JobTrackedVersions
+                self._job_versions[key].pop(0)
+            if key not in self._job_summaries:
+                js = JobSummary(job.id, job.namespace)
+                js.create_index = index
+                self._job_summaries[key] = js
+            for tg in job.task_groups:
+                self._job_summaries[key].group(tg.name)
+            self._bump(index)
+        self._notify("jobs", job)
+
+    def delete_job(self, index: int, namespace: str, job_id: str) -> None:
+        with self._lock:
+            job = self._jobs.pop((namespace, job_id), None)
+            self._job_versions.pop((namespace, job_id), None)
+            self._job_summaries.pop((namespace, job_id), None)
+            self._bump(index)
+        if job:
+            self._notify("jobs_deregistered", job)
+
+    def job_by_id(self, namespace: str, job_id: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get((namespace, job_id))
+
+    def job_version(self, namespace: str, job_id: str, version: int) -> Optional[Job]:
+        with self._lock:
+            for j in self._job_versions.get((namespace, job_id), ()):
+                if j.version == version:
+                    return j
+        return None
+
+    def jobs(self) -> List[Job]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def job_summary(self, namespace: str, job_id: str) -> Optional[JobSummary]:
+        with self._lock:
+            return self._job_summaries.get((namespace, job_id))
+
+    # ------------------------------------------------------------ evals
+
+    def upsert_evals(self, index: int, evals: Iterable[Evaluation]) -> None:
+        out = []
+        with self._lock:
+            for e in evals:
+                if e.id not in self._evals:
+                    e.create_index = index
+                e.modify_index = index
+                self._evals[e.id] = e
+                self._evals_by_job[(e.namespace, e.job_id)].add(e.id)
+                out.append(e)
+            self._bump(index)
+        for e in out:
+            self._notify("evals", e)
+
+    def delete_eval(self, index: int, eval_ids: Iterable[str],
+                    alloc_ids: Iterable[str] = ()) -> None:
+        with self._lock:
+            for eid in eval_ids:
+                e = self._evals.pop(eid, None)
+                if e is not None:
+                    self._evals_by_job[(e.namespace, e.job_id)].discard(eid)
+            for aid in alloc_ids:
+                self._drop_alloc(aid)
+            self._bump(index)
+
+    def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
+        with self._lock:
+            return self._evals.get(eval_id)
+
+    def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
+        with self._lock:
+            return [self._evals[i]
+                    for i in self._evals_by_job.get((namespace, job_id), ())]
+
+    # ------------------------------------------------------------ allocs
+
+    def _drop_alloc(self, alloc_id: str) -> None:
+        a = self._allocs.pop(alloc_id, None)
+        if a is None:
+            return
+        self._allocs_by_job[(a.namespace, a.job_id)].discard(alloc_id)
+        self._allocs_by_node[a.node_id].discard(alloc_id)
+        self._allocs_by_eval[a.eval_id].discard(alloc_id)
+        self.matrix.remove_alloc(alloc_id)
+
+    def _insert_alloc(self, index: int, a: Allocation) -> None:
+        prev = self._allocs.get(a.id)
+        if prev is not None:
+            a.create_index = prev.create_index
+            # client-set fields survive server-side rewrites (reference
+            # UpsertAllocs keeps ClientStatus unless explicitly set)
+        else:
+            a.create_index = index
+        if a.job is None:
+            a.job = self._jobs.get((a.namespace, a.job_id))
+        a.modify_index = index
+        self._allocs[a.id] = a
+        self._allocs_by_job[(a.namespace, a.job_id)].add(a.id)
+        self._allocs_by_node[a.node_id].add(a.id)
+        self._allocs_by_eval[a.eval_id].add(a.id)
+        self.matrix.upsert_alloc(a)
+        self._update_summary(a, prev)
+
+    def _update_summary(self, a: Allocation, prev: Optional[Allocation]) -> None:
+        key = (a.namespace, a.job_id)
+        js = self._job_summaries.get(key)
+        if js is None:
+            js = JobSummary(a.job_id, a.namespace)
+            self._job_summaries[key] = js
+        g = js.group(a.task_group)
+
+        def bucket(al: Optional[Allocation]) -> Optional[str]:
+            if al is None:
+                return None
+            return {
+                AllocClientStatus.PENDING: "starting",
+                AllocClientStatus.RUNNING: "running",
+                AllocClientStatus.COMPLETE: "complete",
+                AllocClientStatus.FAILED: "failed",
+                AllocClientStatus.LOST: "lost",
+                AllocClientStatus.UNKNOWN: "unknown",
+            }.get(al.client_status)
+
+        pb, nb = bucket(prev), bucket(a)
+        if pb == nb:
+            return
+        if pb and g.get(pb, 0) > 0:
+            g[pb] -= 1
+        if nb:
+            g[nb] = g.get(nb, 0) + 1
+
+    def upsert_allocs(self, index: int, allocs: Iterable[Allocation]) -> None:
+        out = []
+        with self._lock:
+            for a in allocs:
+                self._insert_alloc(index, a)
+                out.append(a)
+            self._bump(index)
+        for a in out:
+            self._notify("allocs", a)
+
+    def update_allocs_from_client(self, index: int, updates: Iterable[Allocation]) -> None:
+        """Client status updates merge onto the server copy (reference
+        UpdateAllocsFromClient / nomadFSM ApplyAllocClientUpdate)."""
+        out = []
+        with self._lock:
+            for u in updates:
+                existing = self._allocs.get(u.id)
+                if existing is None:
+                    continue
+                a = existing.copy()
+                a.client_status = u.client_status
+                a.client_description = u.client_description
+                a.task_states = dict(u.task_states)
+                if u.deployment_status is not None:
+                    a.deployment_status = u.deployment_status
+                a.modify_index = index
+                self._insert_alloc(index, a)
+                out.append(a)
+            self._bump(index)
+        for a in out:
+            self._notify("allocs", a)
+
+    def alloc_by_id(self, alloc_id: str) -> Optional[Allocation]:
+        with self._lock:
+            return self._allocs.get(alloc_id)
+
+    def allocs_by_job(self, namespace: str, job_id: str) -> List[Allocation]:
+        with self._lock:
+            return [self._allocs[i]
+                    for i in self._allocs_by_job.get((namespace, job_id), ())]
+
+    def allocs_by_node(self, node_id: str) -> List[Allocation]:
+        with self._lock:
+            return [self._allocs[i] for i in self._allocs_by_node.get(node_id, ())]
+
+    def allocs_by_eval(self, eval_id: str) -> List[Allocation]:
+        with self._lock:
+            return [self._allocs[i] for i in self._allocs_by_eval.get(eval_id, ())]
+
+    # ------------------------------------------------------------ deployments
+
+    def upsert_deployment(self, index: int, d: Deployment) -> None:
+        with self._lock:
+            if d.id not in self._deployments:
+                d.create_index = index
+            d.modify_index = index
+            self._deployments[d.id] = d
+            self._bump(index)
+        self._notify("deployments", d)
+
+    def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
+        with self._lock:
+            return self._deployments.get(deployment_id)
+
+    def deployments(self) -> List[Deployment]:
+        with self._lock:
+            return list(self._deployments.values())
+
+    # ------------------------------------------------------------ config
+
+    def set_scheduler_config(self, index: int, cfg: SchedulerConfiguration) -> None:
+        with self._lock:
+            cfg.modify_index = index
+            self.scheduler_config = cfg
+            self._bump(index)
+
+    # ------------------------------------------------------------ plan results
+
+    def upsert_plan_results(self, index: int, result: "AppliedPlanResults") -> None:
+        """Apply a committed plan (reference UpsertPlanResults,
+        state_store.go:337): denormalize stopped/preempted allocs, insert
+        placements, attach deployment updates."""
+        touched = []
+        with self._lock:
+            for a in result.alloc_updates:      # stops/evicts
+                existing = self._allocs.get(a.id)
+                if existing is not None and a.job is None:
+                    a.job = existing.job
+                self._insert_alloc(index, a)
+                touched.append(a)
+            for a in result.allocs_to_place:    # placements
+                self._insert_alloc(index, a)
+                touched.append(a)
+            for a in result.allocs_preempted:
+                existing = self._allocs.get(a.id)
+                if existing is not None and a.job is None:
+                    a.job = existing.job
+                self._insert_alloc(index, a)
+                touched.append(a)
+            if result.deployment is not None:
+                d = result.deployment
+                if d.id not in self._deployments:
+                    d.create_index = index
+                d.modify_index = index
+                self._deployments[d.id] = d
+            for upd in result.deployment_updates:
+                d = self._deployments.get(upd["deployment_id"])
+                if d is not None:
+                    d = d.copy()
+                    d.status = upd["status"]
+                    d.status_description = upd.get("description", "")
+                    d.modify_index = index
+                    self._deployments[d.id] = d
+            self._bump(index)
+        for a in touched:
+            self._notify("allocs", a)
+
+
+class AppliedPlanResults:
+    """The payload of the ApplyPlanResults Raft message."""
+
+    def __init__(self, alloc_updates=None, allocs_to_place=None,
+                 allocs_preempted=None, deployment=None, deployment_updates=None,
+                 eval_id: str = ""):
+        self.alloc_updates = alloc_updates or []
+        self.allocs_to_place = allocs_to_place or []
+        self.allocs_preempted = allocs_preempted or []
+        self.deployment = deployment
+        self.deployment_updates = deployment_updates or []
+        self.eval_id = eval_id
+
+
+def _shallow_copy_node(node: Node) -> Node:
+    import copy as _copy
+    return _copy.copy(node)

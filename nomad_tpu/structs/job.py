@@ -288,6 +288,21 @@ class Job:
     def stopped(self) -> bool:
         return self.stop
 
+    def canonicalize(self) -> None:
+        """Merge job-level blocks into task groups and fill defaults
+        (reference api/jobs.go Canonicalize + structs Job.Canonicalize:
+        the job update block is copied into groups lacking one, reschedule
+        policies default per job type)."""
+        for tg in self.task_groups:
+            if tg.update is None and self.update is not None \
+                    and self.type == JobType.SERVICE:
+                tg.update = replace(self.update)
+            if tg.reschedule_policy is None:
+                if self.type == JobType.SERVICE:
+                    tg.reschedule_policy = ReschedulePolicy.default_service()
+                elif self.type == JobType.BATCH:
+                    tg.reschedule_policy = ReschedulePolicy.default_batch()
+
     def copy(self) -> "Job":
         return replace(self, datacenters=list(self.datacenters),
                        constraints=list(self.constraints),

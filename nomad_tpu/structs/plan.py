@@ -60,8 +60,11 @@ class Plan:
         self.node_update.setdefault(alloc.node_id, []).append(a)
 
     def append_alloc(self, alloc: Allocation, job: Optional[Job] = None) -> None:
-        """Reference Plan.AppendAlloc; job normalized out unless changed."""
-        alloc.job = job
+        """Reference Plan.AppendAlloc: the job is attached only when the
+        caller passes an updated one (plan normalization); otherwise the
+        alloc keeps the job it already carries."""
+        if job is not None:
+            alloc.job = job
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
 
     def append_preempted_alloc(self, alloc: Allocation, preempting_alloc_id: str) -> None:

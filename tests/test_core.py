@@ -1,0 +1,274 @@
+"""Control-plane tests: broker, blocked evals, plan applier, full server
+spine (reference analogs: nomad/eval_broker_test.go, blocked_evals_test.go,
+plan_apply_test.go, worker_test.go)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.broker import EvalBroker
+from nomad_tpu.core.blocked import BlockedEvals
+from nomad_tpu.core.plan_apply import PlanApplier
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import AllocClientStatus, AllocDesiredStatus, EvalStatus
+from nomad_tpu.structs.plan import Plan
+
+
+# ------------------------------------------------------------------ broker
+
+def make_broker():
+    b = EvalBroker(nack_timeout=5.0, initial_nack_delay=0.0,
+                   subsequent_nack_delay=0.0)
+    b.set_enabled(True)
+    return b
+
+
+def test_broker_priority_and_fifo():
+    b = make_broker()
+    lo = mock.eval(priority=10)
+    hi = mock.eval(priority=90)
+    mid1 = mock.eval(priority=50)
+    mid2 = mock.eval(priority=50)
+    for e in (lo, mid1, hi, mid2):
+        b.enqueue(e)
+    got = [b.dequeue(["service"])[0].id for _ in range(4)]
+    assert got == [hi.id, mid1.id, mid2.id, lo.id]
+
+
+def test_broker_ack_nack_cycle():
+    b = make_broker()
+    ev = mock.eval()
+    b.enqueue(ev)
+    got, token = b.dequeue(["service"])
+    assert got.id == ev.id
+    assert b.dequeue(["service"])[0] is None     # leased, not available
+    assert b.nack(ev.id, token)
+    got2, token2 = b.dequeue(["service"])        # requeued
+    assert got2.id == ev.id
+    assert b.ack(ev.id, token2)
+    assert b.ready_count() == 0
+
+
+def test_broker_job_dedup_pending():
+    b = make_broker()
+    e1 = mock.eval(job_id="same-job")
+    e2 = mock.eval(job_id="same-job")
+    b.enqueue(e1)
+    got, token = b.dequeue(["service"])
+    b.enqueue(e2)                                 # waits behind e1
+    assert b.dequeue(["service"])[0] is None
+    b.ack(got.id, token)
+    got2, _ = b.dequeue(["service"])
+    assert got2.id == e2.id
+
+
+def test_broker_delivery_limit_dead_letters():
+    b = make_broker()
+    b.delivery_limit = 2
+    ev = mock.eval()
+    b.enqueue(ev)
+    for _ in range(2):
+        got, token = b.dequeue(["service"])
+        if got is None:
+            break
+        b.nack(ev.id, token)
+    from nomad_tpu.core.broker import FAILED_QUEUE
+    got, _ = b.dequeue([FAILED_QUEUE])
+    assert got is not None and got.id == ev.id
+
+
+def test_broker_delayed_eval():
+    b = make_broker()
+    ev = mock.eval()
+    ev.wait_until = time.time() + 0.15
+    b.enqueue(ev)
+    assert b.dequeue(["service"])[0] is None
+    got, _ = b.dequeue(["service"], timeout=1.0)
+    assert got is not None and got.id == ev.id
+    assert time.time() >= ev.wait_until
+
+
+def test_broker_scheduler_type_routing():
+    b = make_broker()
+    svc = mock.eval(type="service")
+    sys_ = mock.eval(type="system")
+    b.enqueue(svc)
+    b.enqueue(sys_)
+    got, _ = b.dequeue(["system"])
+    assert got.id == sys_.id
+
+
+# ------------------------------------------------------------------ blocked
+
+def test_blocked_unblock_on_class():
+    b = make_broker()
+    blocked = BlockedEvals(b)
+    blocked.set_enabled(True)
+    ev = mock.eval()
+    ev.status = EvalStatus.BLOCKED
+    ev.class_eligibility = {"v1:abc": False}
+    blocked.block(ev)
+    assert blocked.blocked_count() == 1
+    # same class with no new capacity signal for an ineligible class: the
+    # eval only unblocks for unseen or eligible classes
+    released = blocked.unblock("v1:abc", 100)
+    assert released == []
+    released = blocked.unblock("v1:new-class", 101)
+    assert len(released) == 1
+    assert b.ready_count() == 1
+
+
+def test_blocked_dedup_per_job():
+    b = make_broker()
+    blocked = BlockedEvals(b)
+    blocked.set_enabled(True)
+    e1 = mock.eval(job_id="j1")
+    e1.create_index = 1
+    e2 = mock.eval(job_id="j1")
+    e2.create_index = 2
+    blocked.block(e1)
+    blocked.block(e2)
+    assert blocked.blocked_count() == 1
+    dups = blocked.get_duplicates()
+    assert [d.id for d in dups] == [e1.id]
+
+
+# ------------------------------------------------------------------ applier
+
+def test_plan_applier_rejects_overcommitted_node():
+    store = StateStore()
+    n1, n2 = mock.node(), mock.node()
+    store.upsert_node(1, n1)
+    store.upsert_node(2, n2)
+    j = mock.job()
+    store.upsert_job(3, j)
+    applier = PlanApplier(store)
+
+    # a plan whose placements on n1 exceed capacity but fit on n2
+    big = mock.alloc_for(j, n1.id)
+    big.allocated_resources.tasks["web"].cpu_shares = 5000
+    ok = mock.alloc_for(j, n2.id, index=1)
+    plan = Plan(eval_id="e1", job=j)
+    plan.append_alloc(big, j)
+    plan.append_alloc(ok, j)
+    result = applier.apply(plan)
+    assert n1.id in result.rejected_nodes
+    assert [a.id for a in result.node_allocation[n2.id]] == [ok.id]
+    full, expected, actual = result.full_commit(plan)
+    assert not full and expected == 2 and actual == 1
+    assert result.refresh_index > 0
+
+
+def test_plan_applier_all_at_once_rejects_everything():
+    store = StateStore()
+    n1, n2 = mock.node(), mock.node()
+    store.upsert_node(1, n1)
+    store.upsert_node(2, n2)
+    j = mock.job()
+    store.upsert_job(3, j)
+    applier = PlanApplier(store)
+    big = mock.alloc_for(j, n1.id)
+    big.allocated_resources.tasks["web"].cpu_shares = 5000
+    ok = mock.alloc_for(j, n2.id, index=1)
+    plan = Plan(eval_id="e1", job=j, all_at_once=True)
+    plan.append_alloc(big, j)
+    plan.append_alloc(ok, j)
+    result = applier.apply(plan)
+    assert result.node_allocation == {}
+
+
+def test_plan_applier_stop_frees_capacity_for_placement():
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(1, n)
+    j = mock.job()
+    j.task_groups[0].tasks[0].resources.cpu = 3000
+    store.upsert_job(2, j)
+    old = mock.alloc_for(j, n.id)
+    old.allocated_resources.tasks["web"].cpu_shares = 3000
+    store.upsert_allocs(3, [old])
+    applier = PlanApplier(store)
+
+    new = mock.alloc_for(j, n.id, index=1)
+    new.allocated_resources.tasks["web"].cpu_shares = 3000
+    plan = Plan(eval_id="e2", job=j)
+    plan.append_stopped_alloc(old, "replaced")
+    plan.append_alloc(new, j)
+    result = applier.apply(plan)
+    assert result.rejected_nodes == []
+    assert store.alloc_by_id(old.id).desired_status == AllocDesiredStatus.STOP
+    assert store.alloc_by_id(new.id) is not None
+
+
+# ------------------------------------------------------------------ server
+
+def test_server_end_to_end_spine():
+    """job register -> broker -> worker -> scheduler -> plan queue ->
+    applier -> committed allocs (the section 3.1 call stack)."""
+    s = Server(ServerConfig(num_schedulers=2))
+    s.start()
+    try:
+        for _ in range(5):
+            s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 5
+        s.register_job(job)
+        assert s.wait_for_idle(30.0)
+        allocs = s.store.allocs_by_job("default", job.id)
+        assert len(allocs) == 5
+        ev_list = s.store.evals_by_job("default", job.id)
+        assert any(e.status == EvalStatus.COMPLETE for e in ev_list)
+    finally:
+        s.stop()
+
+
+def test_server_blocked_eval_unblocks_on_new_node():
+    s = Server(ServerConfig(num_schedulers=1))
+    s.start()
+    try:
+        s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 3
+        job.task_groups[0].tasks[0].resources.cpu = 3000
+        s.register_job(job)
+        assert s.wait_for_idle(30.0)
+        assert len(s.store.allocs_by_job("default", job.id)) == 1
+        assert s.blocked_evals.blocked_count() == 1
+        # capacity arrives: two more nodes -> unblock -> placements
+        s.register_node(mock.node())
+        s.register_node(mock.node())
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            allocs = [a for a in s.store.allocs_by_job("default", job.id)
+                      if a.desired_status == AllocDesiredStatus.RUN]
+            if len(allocs) == 3:
+                break
+            time.sleep(0.05)
+        assert len([a for a in s.store.allocs_by_job("default", job.id)
+                    if a.desired_status == AllocDesiredStatus.RUN]) == 3
+    finally:
+        s.stop()
+
+
+def test_server_node_down_triggers_replacement():
+    s = Server(ServerConfig(num_schedulers=2))
+    s.start()
+    try:
+        nodes = [mock.node() for _ in range(3)]
+        for n in nodes:
+            s.register_node(n)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        s.register_job(job)
+        assert s.wait_for_idle(30.0)
+        victim_alloc = s.store.allocs_by_job("default", job.id)[0]
+        s.update_node_status(victim_alloc.node_id, "down")
+        assert s.wait_for_idle(30.0)
+        run = [a for a in s.store.allocs_by_job("default", job.id)
+               if a.desired_status == AllocDesiredStatus.RUN
+               and a.client_status != AllocClientStatus.LOST]
+        assert len(run) == 2
+        assert all(a.node_id != victim_alloc.node_id for a in run)
+    finally:
+        s.stop()

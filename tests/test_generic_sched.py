@@ -1,0 +1,256 @@
+"""End-to-end scheduler tests through the harness (reference analog:
+scheduler/generic_sched_test.go)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import AllocClientStatus, AllocDesiredStatus, EvalStatus
+from nomad_tpu.structs.evaluation import EvalTrigger
+
+
+def make_world(h, n_nodes=10):
+    nodes = [mock.node() for _ in range(n_nodes)]
+    for n in nodes:
+        h.store.upsert_node(h.next_index(), n)
+    return nodes
+
+
+def register_and_eval(h, job):
+    h.store.upsert_job(h.next_index(), job)
+    ev = mock.eval(job_id=job.id, type=job.type, priority=job.priority)
+    h.store.upsert_evals(h.next_index(), [ev])
+    return ev
+
+
+def test_service_job_register_places_all():
+    h = Harness()
+    make_world(h, 10)
+    job = mock.job()                      # count=10
+    ev = register_and_eval(h, job)
+    h.process("service", ev)
+
+    assert len(h.plans) == 1
+    placed = h.store.allocs_by_job("default", job.id)
+    assert len(placed) == 10
+    nodes_used = {a.node_id for a in placed}
+    assert len(nodes_used) == 10          # anti-affinity spreads
+    for a in placed:
+        assert a.desired_status == AllocDesiredStatus.RUN
+        assert a.metrics.nodes_evaluated == 10
+        assert a.metrics.score_meta            # top-K recorded
+    assert ev.queued_allocations == {"web": 0}
+
+
+def test_insufficient_capacity_creates_blocked_eval():
+    h = Harness()
+    make_world(h, 2)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    job.task_groups[0].tasks[0].resources.cpu = 3000   # only one per node
+    ev = register_and_eval(h, job)
+    h.process("service", ev)
+
+    placed = h.store.allocs_by_job("default", job.id)
+    assert len(placed) == 2
+    assert ev.queued_allocations["web"] == 2
+    blocked = [e for e in h.create_evals_list if e.status == EvalStatus.BLOCKED]
+    assert len(blocked) == 1
+    assert ev.blocked_eval == blocked[0].id
+    assert blocked[0].class_eligibility    # keyed for unblocking
+
+
+def test_no_feasible_nodes():
+    h = Harness()
+    make_world(h, 3)
+    from nomad_tpu.structs.job import Constraint
+    job = mock.job()
+    job.constraints.append(Constraint("${attr.kernel.name}", "windows"))
+    ev = register_and_eval(h, job)
+    h.process("service", ev)
+    assert h.store.allocs_by_job("default", job.id) == []
+    assert ev.queued_allocations["web"] == 10
+
+
+def test_job_update_destructive_honors_max_parallel():
+    h = Harness()
+    make_world(h, 10)
+    job = mock.job()
+    job.update.max_parallel = 3
+    ev = register_and_eval(h, job)
+    h.process("service", ev)
+    assert len(h.store.allocs_by_job("default", job.id)) == 10
+
+    # update the job destructively (new env)
+    job2 = job.copy()
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/sleep"}
+    job2.update = job.update
+    h.store.upsert_job(h.next_index(), job2)
+    ev2 = mock.eval(job_id=job.id, triggered_by=EvalTrigger.JOB_REGISTER)
+    h.process("service", ev2)
+
+    allocs = h.store.allocs_by_job("default", job.id)
+    stopped = [a for a in allocs if a.desired_status == AllocDesiredStatus.STOP]
+    new_version = [a for a in allocs if a.desired_status == AllocDesiredStatus.RUN
+                   and a.job is not None and a.job.version == job2.version]
+    assert len(stopped) == 3               # max_parallel
+    assert len(new_version) == 3
+
+
+def test_job_update_inplace_when_compatible():
+    h = Harness()
+    make_world(h, 5)
+    job = mock.job()
+    job.task_groups[0].count = 5
+    ev = register_and_eval(h, job)
+    h.process("service", ev)
+    before = {a.id for a in h.store.allocs_by_job("default", job.id)}
+
+    job2 = job.copy()
+    job2.priority = 70                     # non-destructive change
+    h.store.upsert_job(h.next_index(), job2)
+    ev2 = mock.eval(job_id=job.id)
+    h.process("service", ev2)
+
+    allocs = h.store.allocs_by_job("default", job.id)
+    run = [a for a in allocs if a.desired_status == AllocDesiredStatus.RUN]
+    assert {a.id for a in run} == before   # same allocs, updated in place
+    assert all(a.job.version == job2.version for a in run)
+
+
+def test_scale_down_stops_highest_indices():
+    h = Harness()
+    make_world(h, 6)
+    job = mock.job()
+    job.task_groups[0].count = 6
+    ev = register_and_eval(h, job)
+    h.process("service", ev)
+
+    job2 = job.copy()
+    job2.task_groups[0].count = 2
+    h.store.upsert_job(h.next_index(), job2)
+    h.process("service", mock.eval(job_id=job.id))
+
+    allocs = h.store.allocs_by_job("default", job.id)
+    run = [a for a in allocs if a.desired_status == AllocDesiredStatus.RUN]
+    assert len(run) == 2
+    assert sorted(a.index() for a in run) == [0, 1]
+
+
+def test_stop_job_stops_everything():
+    h = Harness()
+    make_world(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    h.process("service", register_and_eval(h, job))
+    job2 = job.copy()
+    job2.stop = True
+    h.store.upsert_job(h.next_index(), job2)
+    h.process("service", mock.eval(job_id=job.id, triggered_by=EvalTrigger.JOB_DEREGISTER))
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert all(a.desired_status == AllocDesiredStatus.STOP for a in allocs)
+
+
+def test_failed_alloc_batch_reschedules_immediately():
+    h = Harness()
+    nodes = make_world(h, 3)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    ev = register_and_eval(h, job)
+    h.process("batch", ev)
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 1
+
+    failed = allocs[0].copy()
+    failed.client_status = AllocClientStatus.FAILED
+    h.store.update_allocs_from_client(h.next_index(), [failed])
+    h.process("batch", mock.eval(job_id=job.id, type="batch",
+                                 triggered_by=EvalTrigger.RETRY_FAILED_ALLOC))
+    allocs = h.store.allocs_by_job("default", job.id)
+    run = [a for a in allocs if a.desired_status == AllocDesiredStatus.RUN
+           and not a.client_terminal_status()]
+    assert len(run) == 1
+    assert run[0].previous_allocation == failed.id
+    assert run[0].reschedule_tracker is not None
+    # penalized away from the failed node when alternatives exist
+    assert run[0].node_id != failed.node_id
+
+
+def test_failed_service_alloc_creates_delayed_followup():
+    h = Harness()
+    make_world(h, 2)
+    job = mock.job()
+    job.task_groups[0].count = 1
+    h.process("service", register_and_eval(h, job))
+    a = h.store.allocs_by_job("default", job.id)[0].copy()
+    a.client_status = AllocClientStatus.FAILED
+    h.store.update_allocs_from_client(h.next_index(), [a])
+
+    h.process("service", mock.eval(job_id=job.id))
+    followups = [e for e in h.create_evals_list if e.wait_until > 0]
+    assert len(followups) == 1
+    assert followups[0].triggered_by == EvalTrigger.RETRY_FAILED_ALLOC
+
+
+def test_node_down_replaces_allocs():
+    h = Harness()
+    nodes = make_world(h, 3)
+    job = mock.job()
+    job.task_groups[0].count = 3
+    h.process("service", register_and_eval(h, job))
+
+    victim = h.store.allocs_by_job("default", job.id)[0]
+    h.store.update_node_status(h.next_index(), victim.node_id, "down")
+    h.process("service", mock.eval(job_id=job.id, triggered_by=EvalTrigger.NODE_UPDATE))
+
+    allocs = h.store.allocs_by_job("default", job.id)
+    lost = [a for a in allocs if a.client_status == AllocClientStatus.LOST]
+    assert len(lost) == 1 and lost[0].id == victim.id
+    run = [a for a in allocs if a.desired_status == AllocDesiredStatus.RUN
+           and a.client_status != AllocClientStatus.LOST]
+    assert len(run) == 3
+    assert all(a.node_id != victim.node_id for a in run)
+
+
+def test_partial_plan_rejection_retries():
+    h = Harness()
+    make_world(h, 4)
+    job = mock.job()
+    job.task_groups[0].count = 4
+    ev = register_and_eval(h, job)
+    h.reject_plan = True
+    with pytest.raises(Exception):
+        h.process("service", ev)
+    assert len(h.plans) == 5               # MAX_SERVICE_SCHEDULE_ATTEMPTS
+
+
+def test_system_job_places_one_per_node():
+    h = Harness()
+    nodes = make_world(h, 5)
+    job = mock.system_job()
+    ev = register_and_eval(h, job)
+    h.process("system", ev)
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 5
+    assert {a.node_id for a in allocs} == {n.id for n in nodes}
+    # a new node arriving gets the system job too
+    extra = mock.node()
+    h.store.upsert_node(h.next_index(), extra)
+    h.process("system", mock.eval(job_id=job.id, type="system",
+                                  triggered_by=EvalTrigger.NODE_UPDATE))
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 6
+
+
+def test_sysbatch_does_not_rerun_completed():
+    h = Harness()
+    nodes = make_world(h, 2)
+    job = mock.sysbatch_job()
+    h.process("sysbatch", register_and_eval(h, job))
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 2
+    done = allocs[0].copy()
+    done.client_status = AllocClientStatus.COMPLETE
+    h.store.update_allocs_from_client(h.next_index(), [done])
+    h.process("sysbatch", mock.eval(job_id=job.id, type="sysbatch"))
+    allocs = h.store.allocs_by_job("default", job.id)
+    assert len(allocs) == 2                # no rerun
