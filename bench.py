@@ -1,0 +1,114 @@
+"""Benchmark runner (BASELINE.json scenarios).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Headline: end-to-end scheduling throughput (allocs placed per second through
+the full eval->reconcile->dense-kernel->plan->applier spine) on the
+'1K nodes / 5K batch allocations, binpack' configuration (BASELINE.json
+configs[1]).  vs_baseline compares against the north-star C2M rate
+(1M allocs / 30 s = 33,333 allocs/s on a v5e-8; this runs on ONE chip).
+
+Supplementary numbers (kernel-only placement rate at C2M node scale) go to
+stderr so the driver still sees a single JSON line on stdout.
+"""
+import json
+import os
+import sys
+import time
+
+if os.environ.get("BENCH_FORCE_CPU") == "1":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def bench_e2e_1k_nodes_5k_allocs():
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler.testing import Harness
+
+    h = Harness()
+    t0 = time.time()
+    for _ in range(1000):
+        h.store.upsert_node(h.next_index(), mock.node())
+    log(f"world build (1000 nodes): {time.time()-t0:.2f}s")
+
+    jobs = []
+    for _ in range(50):
+        j = mock.batch_job()
+        j.task_groups[0].count = 100
+        h.store.upsert_job(h.next_index(), j)
+        jobs.append(j)
+
+    # warm the jit cache with one eval shape
+    warm = mock.batch_job()
+    warm.task_groups[0].count = 100
+    h.store.upsert_job(h.next_index(), warm)
+    h.process("batch", mock.eval(job_id=warm.id, type="batch"))
+
+    t0 = time.time()
+    for j in jobs:
+        ev = mock.eval(job_id=j.id, type="batch", priority=j.priority)
+        h.process("batch", ev)
+    dt = time.time() - t0
+
+    placed = sum(len(h.store.allocs_by_job("default", j.id)) for j in jobs)
+    log(f"e2e: placed {placed} allocs in {dt:.2f}s "
+        f"({placed/dt:.0f} allocs/s, {50/dt:.1f} evals/s)")
+    assert placed == 5000, placed
+    return placed / dt
+
+
+def bench_kernel_c2m_scale():
+    """Kernel-only: one dense placement scan at 10K-node scale."""
+    import numpy as np
+
+    from nomad_tpu import mock
+    from nomad_tpu.encode import ClusterMatrix
+    from nomad_tpu.ops.place import place_eval
+    from nomad_tpu.scheduler.stack import DenseStack
+
+    cm = ClusterMatrix(initial_rows=16384)
+    t0 = time.time()
+    for i in range(10000):
+        n = mock.node()
+        n.attributes["rack"] = f"r{i % 50}"
+        cm.upsert_node(n)
+    log(f"world build (10000 nodes): {time.time()-t0:.2f}s")
+
+    job = mock.job()
+    job.task_groups[0].count = 1024
+    stack = DenseStack(cm)
+    groups = [stack.compile_group(job, tg) for tg in job.task_groups]
+    inp = stack.build_inputs(job, groups, [0] * 1024, {})
+
+    res = stack.place(inp)          # compile + run
+    t0 = time.time()
+    res = stack.place(inp)
+    dt = time.time() - t0
+    placed = int((res.node[:1024] >= 0).sum())
+    log(f"kernel: {placed} placements over 10K nodes in {dt:.3f}s "
+        f"({placed/dt:.0f} placements/s on one chip)")
+    return placed / dt
+
+
+def main():
+    e2e_rate = bench_e2e_1k_nodes_5k_allocs()
+    try:
+        kernel_rate = bench_kernel_c2m_scale()
+    except Exception as e:          # noqa: BLE001
+        log("kernel bench failed:", e)
+        kernel_rate = 0.0
+
+    target = 1_000_000 / 30.0       # north-star C2M rate (v5e-8)
+    print(json.dumps({
+        "metric": "e2e_allocs_per_sec_1knodes_5kallocs",
+        "value": round(e2e_rate, 1),
+        "unit": "allocs/s",
+        "vs_baseline": round(e2e_rate / target, 4),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Multi-chip scale-out (reference analog: SURVEY.md sections 2.5/2.6 —
+eval parallelism via scheduler workers and EvaluatePool fan-out).
+
+On TPU the two parallel axes are:
+- the **eval batch**: independent evaluations scheduled concurrently
+  (Nomad's optimistic worker concurrency) -> sharded over the 'evals'
+  mesh axis,
+- the **node axis**: the 10K-100K node matrix of one eval -> sharded over
+  the 'nodes' mesh axis with pmax/pmin collectives for the global argmax
+  (the ICI all-gather top-k of SURVEY.md section 5).
+"""
+
+from nomad_tpu.parallel.sharded import (
+    make_mesh,
+    place_eval_batch_sharded,
+    stack_inputs,
+)
+
+__all__ = ["make_mesh", "place_eval_batch_sharded", "stack_inputs"]

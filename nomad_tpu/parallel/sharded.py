@@ -1,0 +1,199 @@
+"""Sharded placement: the dense engine over a ('evals', 'nodes') mesh.
+
+Each device owns a node shard of a subset of the eval batch.  Inside one
+scan step every shard scores its local nodes, the global best node is
+found with pmax (max score) + pmin (lowest global row among ties, matching
+the single-chip argmax tie-break), and each shard applies the carry update
+only to rows it owns.  Cross-shard information (the selected node's spread
+value indices) moves via psum of a masked gather — an ICI-friendly scalar
+collective rather than an all-gather of the whole matrix.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from nomad_tpu.ops.fit import score_fit
+from nomad_tpu.ops.place import PlaceInputs, PlaceResult, TOP_K
+
+BIG = jnp.int32(2**31 - 1)
+
+
+def make_mesh(n_eval_shards: int = 1, n_node_shards: Optional[int] = None,
+              devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if n_node_shards is None:
+        n_node_shards = len(devices) // n_eval_shards
+    dev = np.array(devices[:n_eval_shards * n_node_shards]).reshape(
+        n_eval_shards, n_node_shards)
+    return Mesh(dev, ("evals", "nodes"))
+
+
+def stack_inputs(inputs) -> PlaceInputs:
+    """Stack a list of PlaceInputs (same padded shapes) along a leading
+    eval-batch axis."""
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *inputs)
+
+
+# PartitionSpecs for one eval's PlaceInputs, node axis sharded.  A leading
+# 'evals' batch axis is prepended by place_eval_batch_sharded.
+_NODE_AXIS = {
+    "capacity": 0, "used": 0,
+    "feasible": 1, "affinity": 1, "penalty": 1, "tg_count": 1,
+    "spread_vidx": 2,
+    "has_affinity": None, "desired_count": None,
+    "spread_desired": None, "spread_targeted": None, "spread_wfrac": None,
+    "spread_counts": None, "spread_active": None,
+    "demand": None, "slot_tg": None, "slot_active": None,
+}
+
+
+def _input_specs(batched: bool) -> PlaceInputs:
+    specs = {}
+    for name, axis in _NODE_AXIS.items():
+        ndim = {"capacity": 2, "used": 2, "feasible": 2, "affinity": 2,
+                "penalty": 2, "tg_count": 2, "spread_vidx": 3,
+                "has_affinity": 1, "desired_count": 1, "spread_desired": 3,
+                "spread_targeted": 2, "spread_wfrac": 2, "spread_counts": 3,
+                "spread_active": 2, "demand": 2, "slot_tg": 1,
+                "slot_active": 1}[name]
+        parts = [None] * ndim
+        if axis is not None:
+            parts[axis] = "nodes"
+        if batched:
+            parts = ["evals"] + parts
+        specs[name] = P(*parts)
+    return PlaceInputs(**specs)
+
+
+def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
+                        shard_offset: jax.Array, carry, slot):
+    """One placement step on a node shard (mirrors ops.place._place_step;
+    the selection and carry updates go through 'nodes' collectives)."""
+    used, tg_count, spread_counts = carry
+    g = inp.slot_tg[slot]
+    d = inp.demand[slot]
+    active = inp.slot_active[slot]
+    n_local = used.shape[0]
+    global_rows = shard_offset + jnp.arange(n_local)
+
+    feas = inp.feasible[g]
+    util = used + d
+    fits = jnp.all(util <= inp.capacity, axis=-1) & feas
+
+    fit_score = score_fit(inp.capacity, util, spread_algorithm) / 18.0
+    total = fit_score
+    n_scorers = jnp.ones_like(fit_score)
+
+    coll = tg_count[g].astype(jnp.float32)
+    anti = -(coll + 1.0) / jnp.maximum(inp.desired_count[g].astype(jnp.float32), 1.0)
+    has_coll = coll > 0.0
+    total = total + jnp.where(has_coll, anti, 0.0)
+    n_scorers = n_scorers + has_coll
+
+    pen = inp.penalty[g]
+    total = total - pen
+    n_scorers = n_scorers + pen
+
+    aff = inp.affinity[g]
+    aff_on = inp.has_affinity[g] & (aff != 0.0)
+    total = total + jnp.where(aff_on, aff, 0.0)
+    n_scorers = n_scorers + aff_on
+
+    # spread scoring: counts carry is replicated; per-node boost local
+    from nomad_tpu.ops.place import _spread_boost
+    sboost = _spread_boost(inp, g, spread_counts[g])
+    sb_on = jnp.any(inp.spread_active[g]) & (sboost != 0.0)
+    total = total + jnp.where(sb_on, sboost, 0.0)
+    n_scorers = n_scorers + sb_on
+
+    final = total / n_scorers
+    masked = jnp.where(fits & active, final, -jnp.inf)
+
+    # --- global argmax over 'nodes': pmax score, pmin row among ties
+    local_best = jnp.max(masked)
+    global_best = jax.lax.pmax(local_best, "nodes")
+    local_idx = jnp.argmax(masked)
+    cand = jnp.where((local_best == global_best) & (global_best > -jnp.inf),
+                     global_rows[local_idx], BIG)
+    sel = jax.lax.pmin(cand, "nodes")
+    ok = sel < BIG
+
+    # --- carry updates: only the owning shard touches its rows
+    sel_local = (global_rows == sel) & ok
+    used = used + jnp.where(sel_local[:, None], d, 0.0)
+    tg_count = tg_count + jnp.where(
+        (jnp.arange(tg_count.shape[0]) == g)[:, None] & sel_local[None, :],
+        1, 0)
+    # selected node's spread value indices: psum of masked gather
+    K = inp.spread_vidx.shape[1]
+    Vp1 = spread_counts.shape[-1]
+    v_local = jnp.sum(jnp.where(sel_local[None, :], inp.spread_vidx[g], 0), axis=1)
+    v = jax.lax.psum(v_local, "nodes")                     # i32[K]
+    upd = jax.nn.one_hot(jnp.minimum(v, Vp1 - 1), Vp1, dtype=spread_counts.dtype)
+    upd = upd * (inp.spread_active[g] & (v < Vp1 - 1))[:, None] * ok
+    spread_counts = spread_counts.at[g].add(upd)
+
+    # per-slot metrics (global)
+    n_eval = jax.lax.psum(jnp.sum(feas & active), "nodes")
+    n_exh = jax.lax.psum(jnp.sum(feas & ~fits & active), "nodes")
+    k_local = min(TOP_K, masked.shape[0])
+    top_s_l, top_i_l = jax.lax.top_k(masked, k_local)
+    top_s = jax.lax.all_gather(top_s_l, "nodes", tiled=True)
+    top_i = jax.lax.all_gather(global_rows[top_i_l], "nodes", tiled=True)
+    order = jnp.argsort(-top_s)[:TOP_K]
+
+    out = (
+        jnp.where(ok, sel, -1).astype(jnp.int32),
+        jnp.where(ok, global_best, 0.0),
+        n_eval.astype(jnp.int32),
+        n_exh.astype(jnp.int32),
+        top_i[order].astype(jnp.int32),
+        top_s[order],
+    )
+    return (used, tg_count, spread_counts), out
+
+
+def _shard_body(inp: PlaceInputs, spread_algorithm: bool):
+    """Runs inside shard_map for one eval: scan over slots."""
+    idx = jax.lax.axis_index("nodes")
+    n_local = inp.used.shape[0]
+    shard_offset = idx * n_local
+    S = inp.demand.shape[0]
+    carry0 = (inp.used, inp.tg_count, inp.spread_counts)
+    step = functools.partial(_place_step_sharded, inp, spread_algorithm,
+                             shard_offset)
+    (used, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
+    node, score, n_eval, n_exh, top_i, top_s = outs
+    return node, score, n_eval, n_exh, top_i, top_s, used
+
+
+def place_eval_batch_sharded(mesh: Mesh, stacked: PlaceInputs,
+                             spread_algorithm: bool = False):
+    """Place a batch of evals over the ('evals','nodes') mesh.
+
+    `stacked` has a leading eval-batch axis on every field (see
+    stack_inputs); the batch is sharded over 'evals' and the node axis over
+    'nodes'.  Returns per-eval (node, score, nodes_evaluated,
+    nodes_exhausted, top_nodes, top_scores, used_final).
+    """
+    in_specs = _input_specs(batched=True)
+
+    def body(inp: PlaceInputs):
+        # inside shard_map each device holds a local slice of the eval
+        # batch; vmap over it (collectives batch across the vmapped axis)
+        return jax.vmap(lambda one: _shard_body(one, spread_algorithm))(inp)
+
+    out_specs = (
+        P("evals", None), P("evals", None), P("evals", None),
+        P("evals", None), P("evals", None, None), P("evals", None, None),
+        P("evals", "nodes", None),
+    )
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(in_specs,),
+                               out_specs=out_specs, check_vma=False))
+    return fn(stacked)

@@ -1,14 +1,21 @@
-"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
-import so multi-chip sharding paths are exercised without TPU hardware
-(matches the driver's dryrun_multichip environment).
+"""Test configuration: force an 8-device virtual CPU platform so
+multi-chip sharding paths are exercised without TPU hardware (matches the
+driver's dryrun_multichip environment).
+
+The ambient environment pins the 'axon' TPU platform via a sitecustomize
+that imports jax at interpreter startup, so plain env vars are too late —
+override through jax.config before any backend is initialized.
 """
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
