@@ -136,6 +136,18 @@ class Server:
                 if node is not None:
                     self.blocked_evals.unblock(node.computed_class,
                                                self.store.latest_index)
+            # preempted allocs need their job rescheduled (the reference
+            # creates PreemptionEvals in applyPlan, plan_apply.go:204+)
+            if a.preempted_by_allocation and a.desired_status == "evict" \
+                    and not getattr(a, "_preemption_eval_created", False):
+                a._preemption_eval_created = True
+                job = a.job or self.store.job_by_id(a.namespace, a.job_id)
+                if job is not None and not job.stopped():
+                    self.create_evals([Evaluation(
+                        namespace=a.namespace, priority=job.priority,
+                        type=job.type, job_id=job.id,
+                        triggered_by=EvalTrigger.PREEMPTION,
+                        status=EvalStatus.PENDING)])
 
     # ------------------------------------------------------------- API ops
     # (these are what the RPC endpoints call; reference nomad/job_endpoint.go,

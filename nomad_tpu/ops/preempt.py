@@ -1,0 +1,103 @@
+"""Preemption selection kernel (reference: scheduler/preemption.go —
+PreemptForTaskGroup:198-265, basicResourceDistance:606-624,
+scoreForTaskGroup:663-680, filterAndGroupPreemptibleAllocs:682-732).
+
+For EVERY candidate node at once: given the node's preemptible allocations
+(padded candidate axis A), greedily pick evictions — lowest priority tier
+first, closest resource distance within a tier, distances recomputed as the
+remaining ask shrinks — until the freed+remaining resources cover the ask.
+The per-node greedy loop is a lax.scan over pick steps; nodes are vmapped,
+so one kernel call answers "which nodes become feasible through preemption,
+and what would each evict" for the whole cluster.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+BIG = jnp.float32(3.4e38)
+
+
+def _distance(needed: jax.Array, res: jax.Array) -> jax.Array:
+    """basicResourceDistance vectorized over candidates: Euclidean distance
+    of (ask - candidate)/ask per dimension, dimensions with zero ask
+    contribute 0."""
+    ask = needed[None, :]
+    coord = jnp.where(ask > 0.0, (ask - res) / jnp.maximum(ask, 1e-9), 0.0)
+    return jnp.sqrt(jnp.sum(coord * coord, axis=-1))
+
+
+def _node_preempt(cand_res, cand_prio, cand_valid, remaining, ask,
+                  max_steps: int):
+    """Greedy selection for ONE node.
+
+    cand_res:   f32[A, R] resources of preemptible allocs
+    cand_prio:  i32[A]    job priority of each candidate
+    cand_valid: bool[A]
+    remaining:  f32[R]    node capacity minus ALL current allocs
+    ask:        f32[R]    the task group's demand
+    -> (met: bool, picked: bool[A])
+    """
+    A = cand_res.shape[0]
+
+    def step(state, _):
+        picked, needed, avail, met = state
+        open_ = cand_valid & ~picked
+        # lowest priority tier among open candidates
+        prio_masked = jnp.where(open_, cand_prio, jnp.int32(2**31 - 1))
+        min_prio = jnp.min(prio_masked)
+        tier = open_ & (cand_prio == min_prio)
+        dist = _distance(needed, cand_res)
+        dist = jnp.where(tier, dist, BIG)
+        pick = jnp.argmin(dist)
+        can_pick = jnp.any(tier) & ~met
+        onehot = (jnp.arange(A) == pick) & can_pick
+        picked = picked | onehot
+        freed = jnp.sum(jnp.where(onehot[:, None], cand_res, 0.0), axis=0)
+        avail = avail + freed
+        needed = needed - freed
+        met = met | jnp.all(avail >= ask)
+        return (picked, needed, avail, met), None
+
+    state0 = (jnp.zeros(A, bool), ask - jnp.zeros_like(ask), remaining,
+              jnp.all(remaining >= ask))
+    (picked, _, avail, met), _ = jax.lax.scan(
+        step, state0, None, length=max_steps)
+    return met, picked, avail
+
+
+@functools.partial(jax.jit, static_argnames=("max_steps",))
+def preempt_for_task_group(
+    cand_res: jax.Array,       # f32[N, A, R]
+    cand_prio: jax.Array,      # i32[N, A]
+    cand_valid: jax.Array,     # bool[N, A]
+    remaining: jax.Array,      # f32[N, R] capacity - all current usage
+    ask: jax.Array,            # f32[R]
+    max_steps: int = 16,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """-> (met bool[N], picked bool[N, A], avail_after f32[N, R])."""
+    return jax.vmap(
+        lambda r, p, v, rem: _node_preempt(r, p, v, rem, ask, max_steps)
+    )(cand_res, cand_prio, cand_valid, remaining)
+
+
+def net_priority(prios) -> float:
+    """netPriority heuristic (rank preemption options; preemption.go:745-760):
+    max priority + sum/max penalty."""
+    if not prios:
+        return 0.0
+    mx = float(max(prios))
+    if mx <= 0:
+        return 0.0
+    return mx + (float(sum(prios)) / mx)
+
+
+def preemption_score(net_prio: float) -> float:
+    """Logistic preemption score in (0,1), inflection at 2048
+    (preemption.go:768-780)."""
+    import math
+    rate, origin = 0.0048, 2048.0
+    return 1.0 / (1.0 + math.exp(rate * (net_prio - origin)))

@@ -316,7 +316,8 @@ class GenericScheduler:
             m.allocation_time_s = 0.0
             return m
 
-        def place_on(pr: PlacementRequest, row: int, metric: AllocMetric) -> None:
+        def place_on(pr: PlacementRequest, row: int, metric: AllocMetric,
+                     preempted=None) -> None:
             gi = tg_index[pr.task_group]
             tg = job.task_groups[gi]
             node_id = cm.node_ids[row]
@@ -336,11 +337,46 @@ class GenericScheduler:
                 return
             if pr.previous_alloc is not None:
                 pr.previous_alloc.next_allocation = alloc.id
+            if preempted:
+                # handlePreemptions (generic_sched.go:822-843)
+                alloc.preempted_allocations = [a.id for a in preempted]
+                for a in preempted:
+                    self.plan.append_preempted_alloc(a, alloc.id)
             self.plan.append_alloc(alloc, None)
             if pr.is_canary and self.plan.deployment is not None:
                 state = self.plan.deployment.task_groups.get(tg.name)
                 if state is not None:
                     state.placed_canaries.append(alloc.id)
+
+        # preemption for failed slots (BinPackIterator's evict path,
+        # rank.go:500-530; gated by SchedulerConfiguration like the
+        # reference's per-scheduler-type preemption config)
+        preemptor = None
+        scheduler_type = "batch" if self.batch else "service"
+        preemption_on = self.state.scheduler_config.preemption_enabled(
+            scheduler_type)
+
+        def try_preempt(pr: PlacementRequest, i: Optional[int]) -> bool:
+            nonlocal preemptor
+            if not preemption_on:
+                return False
+            if preemptor is None:
+                from nomad_tpu.scheduler.preemption import Preemptor
+                preemptor = Preemptor(self.state, job.priority)
+            gi = tg_index[pr.task_group]
+            found = preemptor.find(groups[gi].feasible,
+                                   groups[gi].demand, used)
+            if found is None:
+                return False
+            row, evicted = found
+            metric = metric_for(i)
+            place_on(pr, row, metric, preempted=evicted)
+            for a in evicted:
+                cr = a.comparable_resources()
+                used[row] -= (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+            used[row] += groups[gi].demand
+            preemptor.invalidate({a.id for a in evicted})
+            return True
 
         for pr, row in preplaced:
             place_on(pr, row, metric_for(None))
@@ -348,7 +384,8 @@ class GenericScheduler:
             for i, pr in enumerate(slot_requests):
                 row = int(result.node[i])
                 if row < 0:
-                    self._fail_placement(pr, metric_for(i), "exhausted")
+                    if not try_preempt(pr, i):
+                        self._fail_placement(pr, metric_for(i), "exhausted")
                 else:
                     place_on(pr, row, metric_for(i))
 

@@ -1,0 +1,141 @@
+"""Host-side preemption orchestration around the dense kernel.
+
+Reference: scheduler/preemption.go Preemptor.  The device kernel
+(ops.preempt) answers met/picked for every node at once; this module
+builds the padded candidate matrices from the snapshot, ranks the eligible
+nodes (fit score after preemption + logistic preemption score, mirroring
+PreemptionScoringIterator rank.go:817-868), and applies the reference's
+final superset-filter pass (preemption.go:702-732) to the chosen node.
+
+Not yet modeled: per-job migrate max_parallel scoring penalty and the
+network/device-bandwidth preemption variants (PreemptForNetwork/Device).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from nomad_tpu.encode.matrixizer import pad_to_bucket
+from nomad_tpu.ops.preempt import (
+    net_priority,
+    preempt_for_task_group,
+    preemption_score,
+)
+
+PRIORITY_DELTA = 10   # preemption.go:663-697: need >= 10 priority gap
+
+
+class Preemptor:
+    def __init__(self, snapshot, job_priority: int):
+        self.snapshot = snapshot
+        self.cm = snapshot.matrix
+        self.job_priority = job_priority
+        self._built = False
+        self.already_preempted: Set[str] = set()
+
+    # ------------------------------------------------------------- build
+
+    def _build(self) -> None:
+        """Pad per-node preemptible-alloc matrices."""
+        cm = self.cm
+        N = cm.n_rows
+        per_node: List[List] = [[] for _ in range(N)]
+        for node_id, row in cm.row_of.items():
+            for a in self.snapshot.allocs_by_node(node_id):
+                if a.terminal_status():
+                    continue
+                prio = a.job.priority if a.job is not None else 50
+                if self.job_priority - prio < PRIORITY_DELTA:
+                    continue
+                per_node[row].append(a)
+        A = pad_to_bucket(max([len(x) for x in per_node] + [1]), minimum=4)
+        self.cand_allocs = per_node
+        self.cand_res = np.zeros((N, A, 3), np.float32)
+        self.cand_prio = np.zeros((N, A), np.int32)
+        self.cand_valid = np.zeros((N, A), bool)
+        for row, allocs in enumerate(per_node):
+            for i, a in enumerate(allocs):
+                cr = a.comparable_resources()
+                self.cand_res[row, i] = (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                self.cand_prio[row, i] = a.job.priority if a.job else 50
+                self.cand_valid[row, i] = True
+        self.max_steps = min(A, 32)
+        self._built = True
+
+    def invalidate(self, alloc_ids: Set[str]) -> None:
+        """Mark allocs chosen for preemption unusable for later slots."""
+        if not self._built:
+            return
+        for row, allocs in enumerate(self.cand_allocs):
+            for i, a in enumerate(allocs):
+                if a.id in alloc_ids:
+                    self.cand_valid[row, i] = False
+
+    # ------------------------------------------------------------- find
+
+    def find(self, feasible: np.ndarray, demand: np.ndarray,
+             used: np.ndarray) -> Optional[Tuple[int, List]]:
+        """-> (node row, allocs to preempt) or None.
+
+        `used` is the eval's current proposed usage matrix; remaining =
+        capacity - used per node."""
+        if not self._built:
+            self._build()
+        cm = self.cm
+        remaining = cm.capacity - used
+        met, picked, avail_after = preempt_for_task_group(
+            self.cand_res, self.cand_prio, self.cand_valid,
+            remaining.astype(np.float32), demand.astype(np.float32),
+            max_steps=self.max_steps)
+        met = np.asarray(met) & feasible
+        # nodes that fit without eviction are not preemption targets
+        met &= ~np.all(remaining >= demand, axis=-1)
+        if not met.any():
+            return None
+        picked = np.asarray(picked)
+
+        # rank eligible nodes: mean of (binpack fit after preemption) and
+        # the logistic preemption score of the evicted set
+        from nomad_tpu.ops.fit import score_fit
+        rows = np.flatnonzero(met)
+        best_row, best_score = -1, -np.inf
+        for row in rows:
+            evicted = [self.cand_allocs[row][i]
+                       for i in np.flatnonzero(picked[row])]
+            freed = self.cand_res[row][picked[row]].sum(axis=0)
+            util_after = used[row] - freed + demand
+            fit = float(np.asarray(score_fit(
+                cm.capacity[row:row + 1], util_after[None, :], False))[0]) / 18.0
+            p_score = preemption_score(net_priority(
+                [a.job.priority if a.job else 50 for a in evicted]))
+            score = (fit + p_score) / 2.0
+            if score > best_score:
+                best_score, best_row = score, int(row)
+
+        evicted = [self.cand_allocs[best_row][i]
+                   for i in np.flatnonzero(picked[best_row])]
+        evicted = self._superset_filter(
+            evicted, remaining[best_row], demand)
+        return best_row, evicted
+
+    # ------------------------------------------------------------- filter
+
+    def _superset_filter(self, picks: List, remaining: np.ndarray,
+                         ask: np.ndarray) -> List:
+        """Drop picks whose resources are already covered by the rest
+        (reference filterSuperset: iterate largest-first, keep only while
+        the remainder no longer satisfies the ask)."""
+        def vec(a):
+            cr = a.comparable_resources()
+            return np.array([cr.cpu_shares, cr.memory_mb, cr.disk_mb], np.float32)
+
+        picks = sorted(picks, key=lambda a: -vec(a).sum())
+        kept = list(picks)
+        for a in picks:
+            trial = [x for x in kept if x.id != a.id]
+            avail = remaining + sum((vec(x) for x in trial),
+                                    np.zeros(3, np.float32))
+            if np.all(avail >= ask) and trial:
+                kept = trial
+        return kept

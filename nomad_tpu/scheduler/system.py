@@ -32,6 +32,7 @@ class SystemScheduler:
         self.eval: Optional[Evaluation] = None
         self.failed_tg_allocs: Dict[str, AllocMetric] = {}
         self.queued_allocs: Dict[str, int] = {}
+        self._preemptor = None
 
     def process(self, ev: Evaluation) -> None:
         self.eval = ev
@@ -128,11 +129,14 @@ class SystemScheduler:
 
     def _try_place(self, plan, job, tg, name, node_id, row, used, d, ports, now):
         cm = self.state.matrix
+        preempted = []
         if not np.all(used[row] + d <= cm.capacity[row]):
-            m = self.failed_tg_allocs.setdefault(tg.name, AllocMetric())
-            m.exhausted_node(node_id, "resources")
-            self.queued_allocs[tg.name] = self.queued_allocs.get(tg.name, 0) + 1
-            return
+            preempted = self._try_preempt(plan, job, row, d, used)
+            if preempted is None:
+                m = self.failed_tg_allocs.setdefault(tg.name, AllocMetric())
+                m.exhausted_node(node_id, "resources")
+                self.queued_allocs[tg.name] = self.queued_allocs.get(tg.name, 0) + 1
+                return
         node = self.state.node_by_id(node_id)
         metric = AllocMetric()
         metric.nodes_evaluated = 1
@@ -144,8 +148,32 @@ class SystemScheduler:
             m = self.failed_tg_allocs.setdefault(tg.name, AllocMetric())
             m.exhausted_node(node_id, "ports")
             return
+        if preempted:
+            alloc.preempted_allocations = [a.id for a in preempted]
+            for a in preempted:
+                plan.append_preempted_alloc(a, alloc.id)
+                cr = a.comparable_resources()
+                used[row] -= (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
         used[row] += d
         plan.append_alloc(alloc, None)
+
+    def _try_preempt(self, plan, job, row, d, used):
+        """System jobs preempt lower-priority work by default (reference
+        SystemScheduler + PreemptionConfig.SystemSchedulerEnabled)."""
+        if not self.state.scheduler_config.preemption_enabled(
+                "sysbatch" if self.sysbatch else "system"):
+            return None
+        if self._preemptor is None:
+            from nomad_tpu.scheduler.preemption import Preemptor
+            self._preemptor = Preemptor(self.state, job.priority)
+        feas = np.zeros(self.state.matrix.n_rows, bool)
+        feas[row] = True
+        found = self._preemptor.find(feas, d, used)
+        if found is None:
+            return None
+        _, evicted = found
+        self._preemptor.invalidate({a.id for a in evicted})
+        return evicted
 
 
 class SysBatchScheduler(SystemScheduler):

@@ -1,0 +1,152 @@
+"""Preemption tests (reference analog: scheduler/preemption_test.go)."""
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.ops.preempt import preempt_for_task_group, preemption_score
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import AllocDesiredStatus
+from nomad_tpu.structs.config import PreemptionConfig, SchedulerConfiguration
+
+
+def test_kernel_picks_lowest_priority_first():
+    # one node, 3 candidates: prio 20 (big), prio 10 (small), prio 40
+    cand_res = np.array([[[2000, 2000, 0], [1000, 1000, 0], [3000, 3000, 0]]],
+                        np.float32)
+    cand_prio = np.array([[20, 10, 40]], np.int32)
+    cand_valid = np.ones((1, 3), bool)
+    remaining = np.array([[0, 0, 0]], np.float32)
+    ask = np.array([800, 800, 0], np.float32)
+    met, picked, avail = preempt_for_task_group(
+        cand_res, cand_prio, cand_valid, remaining, ask, max_steps=4)
+    assert bool(met[0])
+    assert picked[0].tolist() == [False, True, False]   # prio 10 suffices
+
+
+def test_kernel_spans_priority_tiers_when_needed():
+    cand_res = np.array([[[500, 500, 0], [600, 600, 0]]], np.float32)
+    cand_prio = np.array([[10, 20]], np.int32)
+    cand_valid = np.ones((1, 2), bool)
+    remaining = np.array([[0, 0, 0]], np.float32)
+    ask = np.array([1000, 1000, 0], np.float32)
+    met, picked, _ = preempt_for_task_group(
+        cand_res, cand_prio, cand_valid, remaining, ask, max_steps=4)
+    assert bool(met[0]) and picked[0].all()
+
+
+def test_kernel_unmet_when_insufficient():
+    cand_res = np.array([[[100, 100, 0]]], np.float32)
+    cand_prio = np.array([[10]], np.int32)
+    cand_valid = np.ones((1, 1), bool)
+    remaining = np.array([[0, 0, 0]], np.float32)
+    ask = np.array([1000, 1000, 0], np.float32)
+    met, _, _ = preempt_for_task_group(
+        cand_res, cand_prio, cand_valid, remaining, ask, max_steps=2)
+    assert not bool(met[0])
+
+
+def test_preemption_score_logistic():
+    assert preemption_score(2048.0) == 0.5
+    assert preemption_score(0.0) > 0.99
+    assert preemption_score(10000.0) < 0.01
+
+
+def _enable_service_preemption(h):
+    cfg = SchedulerConfiguration(
+        preemption_config=PreemptionConfig(service_scheduler_enabled=True,
+                                           system_scheduler_enabled=True))
+    h.store.set_scheduler_config(h.next_index(), cfg)
+
+
+def test_service_scheduler_preempts_lower_priority():
+    h = Harness()
+    _enable_service_preemption(h)
+    node = mock.node()
+    h.store.upsert_node(h.next_index(), node)
+
+    low = mock.job(priority=20)
+    low.task_groups[0].tasks[0].resources.cpu = 3500
+    low.task_groups[0].count = 1
+    h.store.upsert_job(h.next_index(), low)
+    h.process("service", mock.eval(job_id=low.id, priority=20))
+    assert len(h.store.allocs_by_job("default", low.id)) == 1
+
+    high = mock.job(priority=70)
+    high.task_groups[0].tasks[0].resources.cpu = 3500
+    high.task_groups[0].count = 1
+    h.store.upsert_job(h.next_index(), high)
+    h.process("service", mock.eval(job_id=high.id, priority=70))
+
+    high_allocs = [a for a in h.store.allocs_by_job("default", high.id)
+                   if a.desired_status == AllocDesiredStatus.RUN]
+    assert len(high_allocs) == 1
+    low_allocs = h.store.allocs_by_job("default", low.id)
+    assert low_allocs[0].desired_status == AllocDesiredStatus.EVICT
+    assert low_allocs[0].preempted_by_allocation == high_allocs[0].id
+    assert high_allocs[0].preempted_allocations == [low_allocs[0].id]
+
+
+def test_no_preemption_within_priority_delta():
+    h = Harness()
+    _enable_service_preemption(h)
+    node = mock.node()
+    h.store.upsert_node(h.next_index(), node)
+    low = mock.job(priority=50)
+    low.task_groups[0].tasks[0].resources.cpu = 3500
+    h.store.upsert_job(h.next_index(), low)
+    h.process("service", mock.eval(job_id=low.id))
+
+    close = mock.job(priority=55)      # delta < 10: not preemptible
+    close.task_groups[0].tasks[0].resources.cpu = 3500
+    close.task_groups[0].count = 1
+    h.store.upsert_job(h.next_index(), close)
+    h.process("service", mock.eval(job_id=close.id, priority=55))
+    assert len([a for a in h.store.allocs_by_job("default", close.id)
+                if a.desired_status == AllocDesiredStatus.RUN]) == 0
+    assert h.store.allocs_by_job("default", low.id)[0].desired_status == \
+        AllocDesiredStatus.RUN
+
+
+def test_system_job_preempts_by_default():
+    h = Harness()   # default config: system preemption enabled
+    node = mock.node()
+    h.store.upsert_node(h.next_index(), node)
+    svc = mock.job(priority=50)
+    svc.task_groups[0].tasks[0].resources.cpu = 3500
+    h.store.upsert_job(h.next_index(), svc)
+    h.process("service", mock.eval(job_id=svc.id))
+
+    sysj = mock.system_job()           # priority 100
+    sysj.task_groups[0].tasks[0].resources.cpu = 1000
+    h.store.upsert_job(h.next_index(), sysj)
+    h.process("system", mock.eval(job_id=sysj.id, type="system", priority=100))
+    placed = [a for a in h.store.allocs_by_job("default", sysj.id)
+              if a.desired_status == AllocDesiredStatus.RUN]
+    assert len(placed) == 1
+    assert h.store.allocs_by_job("default", svc.id)[0].desired_status == \
+        AllocDesiredStatus.EVICT
+
+
+def test_superset_filter_minimizes_evictions():
+    """Placing a small ask on a node with several low-prio allocs should
+    evict as few as possible."""
+    h = Harness()
+    _enable_service_preemption(h)
+    node = mock.node()
+    h.store.upsert_node(h.next_index(), node)
+    low = mock.job(priority=20)
+    low.task_groups[0].tasks[0].resources.cpu = 1300
+    low.task_groups[0].tasks[0].resources.memory_mb = 2000
+    low.task_groups[0].count = 3
+    h.store.upsert_job(h.next_index(), low)
+    h.process("service", mock.eval(job_id=low.id, priority=20))
+    assert len(h.store.allocs_by_job("default", low.id)) == 3
+
+    high = mock.job(priority=70)
+    high.task_groups[0].tasks[0].resources.cpu = 1000
+    high.task_groups[0].tasks[0].resources.memory_mb = 1500
+    high.task_groups[0].count = 1
+    h.store.upsert_job(h.next_index(), high)
+    h.process("service", mock.eval(job_id=high.id, priority=70))
+    evicted = [a for a in h.store.allocs_by_job("default", low.id)
+               if a.desired_status == AllocDesiredStatus.EVICT]
+    assert len(evicted) == 1           # one eviction covers the ask
