@@ -1,0 +1,121 @@
+"""Core scheduler: internal GC job (reference: nomad/core_sched.go —
+CoreScheduler.Process:44, jobGC:94, evalGC:231, nodeGC:434,
+deploymentGC:545; enqueued by the leader's periodic timers,
+leader.go:782-810).
+
+Eval types: 'job-gc', 'eval-gc', 'node-gc', 'deployment-gc', or the
+'force-gc' catch-all.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import List, Optional
+
+from nomad_tpu.structs import EvalStatus, JobStatus, JobType
+from nomad_tpu.structs.deployment import DeploymentStatus
+from nomad_tpu.structs.node import NodeStatus
+
+JOB_GC_THRESHOLD = 4 * 3600.0
+EVAL_GC_THRESHOLD = 1 * 3600.0
+NODE_GC_THRESHOLD = 24 * 3600.0
+DEPLOYMENT_GC_THRESHOLD = 1 * 3600.0
+
+
+class CoreScheduler:
+    """Registered under the '_core' job type; processes GC evals."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def process(self, gc_type: str, now: Optional[float] = None,
+                force: bool = False) -> dict:
+        now = now if now is not None else _time.time()
+        stats = {}
+        if gc_type in ("eval-gc", "force-gc"):
+            stats["evals"] = self.eval_gc(now, force)
+        if gc_type in ("job-gc", "force-gc"):
+            stats["jobs"] = self.job_gc(now, force)
+        if gc_type in ("node-gc", "force-gc"):
+            stats["nodes"] = self.node_gc(now, force)
+        if gc_type in ("deployment-gc", "force-gc"):
+            stats["deployments"] = self.deployment_gc(now, force)
+        return stats
+
+    # ------------------------------------------------------------- passes
+
+    def _old_enough(self, ts: float, now: float, threshold: float,
+                    force: bool) -> bool:
+        return force or (ts and now - ts >= threshold)
+
+    def eval_gc(self, now: float, force: bool = False) -> int:
+        """Terminal evals (and their terminal allocs) past the threshold."""
+        store = self.server.store
+        gc_evals, gc_allocs = [], []
+        for ev in list(store._evals.values()):
+            if not ev.terminal():
+                continue
+            if not self._old_enough(ev.modify_time or ev.create_time, now,
+                                    EVAL_GC_THRESHOLD, force):
+                continue
+            allocs = store.allocs_by_eval(ev.id)
+            if all(a.terminal_status() for a in allocs):
+                gc_evals.append(ev.id)
+                gc_allocs.extend(a.id for a in allocs)
+        if gc_evals:
+            store.delete_eval(self.server.next_index(), gc_evals, gc_allocs)
+        return len(gc_evals)
+
+    def job_gc(self, now: float, force: bool = False) -> int:
+        """Dead jobs with only terminal allocs and terminal evals."""
+        store = self.server.store
+        n = 0
+        for job in store.jobs():
+            if job.status != JobStatus.DEAD and not job.stop:
+                continue
+            if job.is_periodic() and not job.stop:
+                continue
+            if not self._old_enough(job.submit_time, now, JOB_GC_THRESHOLD,
+                                    force):
+                continue
+            allocs = store.allocs_by_job(job.namespace, job.id)
+            evals = store.evals_by_job(job.namespace, job.id)
+            if all(a.terminal_status() for a in allocs) and \
+                    all(e.terminal() for e in evals):
+                store.delete_eval(self.server.next_index(),
+                                  [e.id for e in evals],
+                                  [a.id for a in allocs])
+                store.delete_job(self.server.next_index(), job.namespace, job.id)
+                n += 1
+        return n
+
+    def node_gc(self, now: float, force: bool = False) -> int:
+        """Down nodes with no non-terminal allocs."""
+        store = self.server.store
+        n = 0
+        for node in store.nodes():
+            if node.status != NodeStatus.DOWN:
+                continue
+            if not self._old_enough(node.status_updated_at, now,
+                                    NODE_GC_THRESHOLD, force):
+                continue
+            if any(not a.terminal_status()
+                   for a in store.allocs_by_node(node.id)):
+                continue
+            store.delete_node(self.server.next_index(), node.id)
+            n += 1
+        return n
+
+    def deployment_gc(self, now: float, force: bool = False) -> int:
+        store = self.server.store
+        n = 0
+        for d in store.deployments():
+            if d.active():
+                continue
+            if not self._old_enough(d.modify_time or d.create_time, now,
+                                    DEPLOYMENT_GC_THRESHOLD, force):
+                continue
+            with store._lock:
+                store._deployments.pop(d.id, None)
+                store._bump(store.latest_index + 1)
+            n += 1
+        return n

@@ -1,0 +1,128 @@
+"""Event broker (reference: nomad/stream/event_broker.go:30 — at-most-once
+pub/sub of state-change events with per-topic filtering over a bounded ring
+buffer; surfaced at /v1/event/stream as NDJSON).
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+
+class Event:
+    __slots__ = ("topic", "type", "key", "namespace", "index", "payload", "time")
+
+    def __init__(self, topic: str, type_: str, key: str, namespace: str,
+                 index: int, payload):
+        self.topic = topic
+        self.type = type_
+        self.key = key
+        self.namespace = namespace
+        self.index = index
+        self.payload = payload
+        self.time = _time.time()
+
+    def to_dict(self) -> dict:
+        return {"Topic": self.topic, "Type": self.type, "Key": self.key,
+                "Namespace": self.namespace, "Index": self.index,
+                "Payload": self.payload}
+
+
+class Subscription:
+    def __init__(self, broker: "EventBroker",
+                 topics: Dict[str, List[str]], from_index: int = 0):
+        # NOTE: constructed by EventBroker.subscribe while holding
+        # broker._lock, so replay + registration are atomic w.r.t. publish
+        self.broker = broker
+        self.topics = topics      # topic -> keys ("*" wildcard)
+        self.cv = threading.Condition()
+        self.queue: deque = deque()
+        self.closed = False
+        for ev in broker._buffer:
+            if ev.index > from_index and self.matches(ev):
+                self.queue.append(ev)
+
+    def matches(self, ev: Event) -> bool:
+        for topic, keys in self.topics.items():
+            if topic not in ("*", ev.topic):
+                continue
+            if "*" in keys or ev.key in keys or not keys:
+                return True
+        return False
+
+    def deliver(self, ev: Event) -> None:
+        with self.cv:
+            if not self.closed:
+                self.queue.append(ev)
+                self.cv.notify_all()
+
+    def next(self, timeout: float = 1.0) -> Optional[Event]:
+        with self.cv:
+            if not self.queue:
+                self.cv.wait(timeout)
+            return self.queue.popleft() if self.queue else None
+
+    def close(self) -> None:
+        with self.cv:
+            self.closed = True
+            self.cv.notify_all()
+        self.broker.unsubscribe(self)
+
+
+class EventBroker:
+    """Bounded ring buffer + fan-out to subscriptions."""
+
+    def __init__(self, buffer_size: int = 100):
+        self._lock = threading.Lock()
+        self._buffer: deque = deque(maxlen=buffer_size)
+        self._subs: List[Subscription] = []
+
+    def publish(self, events: List[Event]) -> None:
+        with self._lock:
+            subs = list(self._subs)
+            for ev in events:
+                self._buffer.append(ev)
+        for sub in subs:
+            for ev in events:
+                if sub.matches(ev):
+                    sub.deliver(ev)
+
+    def subscribe(self, topics: Dict[str, List[str]],
+                  from_index: int = 0) -> Subscription:
+        with self._lock:
+            sub = Subscription(self, topics, from_index)
+            self._subs.append(sub)
+        return sub
+
+    def unsubscribe(self, sub: Subscription) -> None:
+        with self._lock:
+            if sub in self._subs:
+                self._subs.remove(sub)
+
+    # ------------------------------------------------------- state bridge
+
+    def watch_state(self, table: str, obj) -> None:
+        """StateStore watcher: convert writes to stream events (reference:
+        state store event publishing into the broker)."""
+        topic_map = {
+            "nodes": ("Node", lambda o: (o.id, "")),
+            "jobs": ("Job", lambda o: (o.id, o.namespace)),
+            "jobs_deregistered": ("Job", lambda o: (o.id, o.namespace)),
+            "evals": ("Evaluation", lambda o: (o.id, o.namespace)),
+            "allocs": ("Allocation", lambda o: (o.id, o.namespace)),
+            "deployments": ("Deployment", lambda o: (o.id, o.namespace)),
+        }
+        entry = topic_map.get(table)
+        if entry is None:
+            return
+        topic, keyfn = entry
+        key, ns = keyfn(obj)
+        type_ = {"jobs": "JobRegistered",
+                 "jobs_deregistered": "JobDeregistered",
+                 "nodes": "NodeRegistration",
+                 "evals": "EvaluationUpdated",
+                 "allocs": "AllocationUpdated",
+                 "deployments": "DeploymentStatusUpdate"}[table]
+        self.publish([Event(topic, type_, key, ns,
+                            getattr(obj, "modify_index", 0), obj)])

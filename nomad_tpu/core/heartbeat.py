@@ -1,0 +1,82 @@
+"""Node heartbeating (reference: nomad/heartbeat.go — nodeHeartbeater:34,
+resetHeartbeatTimer, invalidateHeartbeat:135, disconnectState:177).
+
+Each node has a TTL; a missed TTL transitions the node to `down` — or to
+`disconnected` when any alloc on it uses max_client_disconnect — and
+triggers evaluations for every affected job.
+"""
+from __future__ import annotations
+
+import heapq
+import threading
+import time as _time
+from typing import Dict, Optional, Tuple
+
+from nomad_tpu.structs.node import NodeStatus
+
+
+class HeartbeatTracker:
+    def __init__(self, server, ttl: float = 10.0, tick: float = 0.1):
+        self.server = server
+        self.ttl = ttl
+        self.tick = tick
+        self._lock = threading.Lock()
+        self._deadlines: Dict[str, float] = {}
+        self._heap: list = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="heartbeat",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(1.0)
+
+    def heartbeat(self, node_id: str) -> float:
+        """Reset the node's TTL (Node.UpdateStatus/heartbeat RPC path).
+        Returns the TTL so clients know their deadline."""
+        deadline = _time.time() + self.ttl
+        with self._lock:
+            self._deadlines[node_id] = deadline
+            heapq.heappush(self._heap, (deadline, node_id))
+        return self.ttl
+
+    def untrack(self, node_id: str) -> None:
+        with self._lock:
+            self._deadlines.pop(node_id, None)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            now = _time.time()
+            expired = []
+            with self._lock:
+                while self._heap and self._heap[0][0] <= now:
+                    deadline, node_id = heapq.heappop(self._heap)
+                    # stale entries: node re-heartbeated since
+                    if self._deadlines.get(node_id) == deadline:
+                        del self._deadlines[node_id]
+                        expired.append(node_id)
+            for node_id in expired:
+                self._invalidate(node_id)
+            self._stop.wait(self.tick)
+
+    def _invalidate(self, node_id: str) -> None:
+        """Missed TTL (reference invalidateHeartbeat + disconnectState)."""
+        server = self.server
+        node = server.store.node_by_id(node_id)
+        if node is None or node.status == NodeStatus.DOWN:
+            return
+        # disconnected iff any alloc on the node tolerates disconnects
+        new_status = NodeStatus.DOWN
+        for a in server.store.allocs_by_node(node_id):
+            if a.terminal_status() or a.job is None:
+                continue
+            tg = a.job.lookup_task_group(a.task_group)
+            if tg is not None and tg.max_client_disconnect_s is not None:
+                new_status = NodeStatus.DISCONNECTED
+                break
+        server.update_node_status(node_id, new_status)

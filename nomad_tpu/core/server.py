@@ -17,6 +17,12 @@ from typing import Dict, List, Optional
 
 from nomad_tpu.core.blocked import BlockedEvals
 from nomad_tpu.core.broker import FAILED_QUEUE, EvalBroker
+from nomad_tpu.core.core_gc import CoreScheduler
+from nomad_tpu.core.deployments import DeploymentWatcher
+from nomad_tpu.core.drainer import NodeDrainer
+from nomad_tpu.core.events import EventBroker
+from nomad_tpu.core.heartbeat import HeartbeatTracker
+from nomad_tpu.core.periodic import PeriodicDispatcher
 from nomad_tpu.core.plan_apply import PlanApplier
 from nomad_tpu.core.plan_queue import PlanQueue
 from nomad_tpu.core.worker import Worker
@@ -34,11 +40,13 @@ from nomad_tpu.structs.evaluation import EvalTrigger
 class ServerConfig:
     def __init__(self, num_schedulers: int = 4,
                  enabled_schedulers: Optional[List[str]] = None,
-                 heartbeat_ttl: float = 10.0):
+                 heartbeat_ttl: float = 10.0,
+                 gc_interval: float = 300.0):
         self.num_schedulers = num_schedulers
         self.enabled_schedulers = enabled_schedulers or \
             ["service", "batch", "system", "sysbatch"]
         self.heartbeat_ttl = heartbeat_ttl
+        self.gc_interval = gc_interval
 
 
 class Server:
@@ -54,7 +62,14 @@ class Server:
         self._stop = threading.Event()
         self._plan_thread: Optional[threading.Thread] = None
         self._threads: List[threading.Thread] = []
+        self.event_broker = EventBroker()
+        self.heartbeats = HeartbeatTracker(self, ttl=self.config.heartbeat_ttl)
+        self.deployment_watcher = DeploymentWatcher(self)
+        self.drainer = NodeDrainer(self)
+        self.periodic = PeriodicDispatcher(self)
+        self.core_scheduler = CoreScheduler(self)
         self.store.watch(self.blocked_evals.watch_state)
+        self.store.watch(self.event_broker.watch_state)
         self.store.watch(self._on_state_change)
         self.leader = False
 
@@ -80,14 +95,26 @@ class Server:
             w = Worker(self, i, self.config.enabled_schedulers)
             w.start()
             self.workers.append(w)
-        restore = self._restore_evals()
+        self._restore_evals()
         t = threading.Thread(target=self._failed_eval_reaper,
                              name="eval-reaper", daemon=True)
         t.start()
         self._threads.append(t)
+        self.heartbeats.start()
+        self.deployment_watcher.start()
+        self.drainer.start()
+        self.periodic.start()
+        gc_t = threading.Thread(target=self._gc_loop, name="core-gc",
+                                daemon=True)
+        gc_t.start()
+        self._threads.append(gc_t)
 
     def stop(self) -> None:
         self._stop.set()
+        self.heartbeats.stop()
+        self.deployment_watcher.stop()
+        self.drainer.stop()
+        self.periodic.stop()
         for w in self.workers:
             w.stop()
         for w in self.workers:
@@ -124,6 +151,16 @@ class Server:
                 wait_until=_time.time() + 60.0)
             self.create_evals([follow])
             self.broker.ack(ev.id, token)
+
+    def _gc_loop(self) -> None:
+        """Leader periodic GC timers (reference leader.go:782-810 core-job
+        eval scheduling, here invoked directly)."""
+        while not self._stop.wait(self.config.gc_interval):
+            try:
+                self.core_scheduler.process("force-gc")
+            except Exception:               # noqa: BLE001
+                import logging
+                logging.getLogger(__name__).exception("core gc")
 
     # ------------------------------------------------------------- watches
 
@@ -201,10 +238,27 @@ class Server:
         self.create_evals([ev])
         return ev
 
+    def set_job_stability(self, namespace: str, job_id: str, version: int,
+                          stable: bool) -> None:
+        with self._raft_lock:
+            self.store.mark_job_stability(
+                self.store.latest_index + 1, namespace, job_id, version, stable)
+
     def register_node(self, node: Node) -> None:
         """Node.Register (nomad/node_endpoint.go:79)."""
         with self._raft_lock:
             self.store.upsert_node(self.store.latest_index + 1, node)
+        if self.leader:
+            self.heartbeats.heartbeat(node.id)
+
+    def node_heartbeat(self, node_id: str) -> float:
+        """Node.UpdateStatus heartbeat path: reset TTL; a down node
+        re-heartbeating is brought back to ready (init->ready handled by
+        client re-registration)."""
+        node = self.store.node_by_id(node_id)
+        if node is not None and node.status in ("down", "disconnected"):
+            self.update_node_status(node_id, "ready")
+        return self.heartbeats.heartbeat(node_id)
 
     def update_node_status(self, node_id: str, status: str) -> List[Evaluation]:
         """Node.UpdateStatus: transition + evals for affected jobs."""

@@ -209,14 +209,19 @@ class AllocReconciler:
     def compute(self) -> ReconcileResults:
         job_stopped = self.job is None or self.job.stopped()
 
-        # cancel an active deployment for a stopped job or older version
+        # cancel an ACTIVE deployment for a stopped job or older version;
+        # terminal deployments (failed/successful/cancelled) are left alone
+        # and must not gate the next rollout via stale paused/failed flags
+        if self.deployment is not None and not self.deployment.active():
+            self.deployment = None
+            self.deployment_paused = False
+            self.deployment_failed = False
         if self.deployment is not None:
             cancel = False
             desc = ""
             if job_stopped:
                 cancel, desc = True, "Cancelled because job is stopped"
-            elif self.job.version != self.deployment.job_version and not (
-                    self.deployment.status == DeploymentStatus.SUCCESSFUL):
+            elif self.job.version != self.deployment.job_version:
                 cancel, desc = True, DeploymentStatus.DESC_NEWER_JOB
             if cancel:
                 self.results.deployment_updates.append({
@@ -518,7 +523,16 @@ class AllocReconciler:
         elif requires_canaries and not promoted:
             destructive_allowed = 0
         else:
-            limit = tg.update.max_parallel if (is_service and tg.update) else len(destructive)
+            if is_service and tg.update:
+                # rolling pace: max_parallel minus in-flight not-yet-healthy
+                # replacements of the current version
+                in_flight = sum(
+                    1 for a in current_version
+                    if a.job is not None and a.job.version == self.job.version
+                    and not a.terminal_status() and not a.is_healthy())
+                limit = max(0, tg.update.max_parallel - in_flight)
+            else:
+                limit = len(destructive)
             if self.deployment_paused or self.deployment_failed:
                 limit = 0
             destructive_allowed = min(limit, len(destructive))

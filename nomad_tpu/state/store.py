@@ -14,6 +14,7 @@ nomad/state/schema.go:116-1107.  Differences by design:
 from __future__ import annotations
 
 import threading
+import time as _time
 from collections import defaultdict
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
@@ -244,11 +245,17 @@ class StateStore:
         with self._lock:
             return list(self._nodes.values())
 
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
     # ------------------------------------------------------------ jobs
 
     def upsert_job(self, index: int, job: Job) -> None:
         with self._lock:
             job.canonicalize()
+            if not job.submit_time:
+                job.submit_time = _time.time()
             key = (job.namespace, job.id)
             existing = self._jobs.get(key)
             if existing is not None:
@@ -287,6 +294,29 @@ class StateStore:
         with self._lock:
             return self._jobs.get((namespace, job_id))
 
+    def mark_job_stability(self, index: int, namespace: str, job_id: str,
+                           version: int, stable: bool) -> None:
+        """Job.Stability RPC / deployment success path: flip `stable` on a
+        specific version WITHOUT bumping the job version (reference
+        UpdateJobStability)."""
+        with self._lock:
+            key = (namespace, job_id)
+            versions = self._job_versions.get(key, [])
+            for i, j in enumerate(versions):
+                if j.version == version:
+                    u = j.copy()
+                    u.stable = stable
+                    u.version = j.version
+                    u.create_index = j.create_index
+                    u.modify_index = index
+                    versions[i] = u
+                    if self._jobs.get(key) is j or (
+                            self._jobs.get(key) is not None
+                            and self._jobs[key].version == version):
+                        self._jobs[key] = u
+                    break
+            self._bump(index)
+
     def job_version(self, namespace: str, job_id: str, version: int) -> Optional[Job]:
         with self._lock:
             for j in self._job_versions.get((namespace, job_id), ()):
@@ -306,10 +336,14 @@ class StateStore:
 
     def upsert_evals(self, index: int, evals: Iterable[Evaluation]) -> None:
         out = []
+        now = _time.time()
         with self._lock:
             for e in evals:
                 if e.id not in self._evals:
                     e.create_index = index
+                    if not e.create_time:
+                        e.create_time = now
+                e.modify_time = now
                 e.modify_index = index
                 self._evals[e.id] = e
                 self._evals_by_job[(e.namespace, e.job_id)].add(e.id)
@@ -447,9 +481,13 @@ class StateStore:
     # ------------------------------------------------------------ deployments
 
     def upsert_deployment(self, index: int, d: Deployment) -> None:
+        now = _time.time()
         with self._lock:
             if d.id not in self._deployments:
                 d.create_index = index
+                if not d.create_time:
+                    d.create_time = now
+            d.modify_time = now
             d.modify_index = index
             self._deployments[d.id] = d
             self._bump(index)
@@ -462,6 +500,16 @@ class StateStore:
     def deployments(self) -> List[Deployment]:
         with self._lock:
             return list(self._deployments.values())
+
+    def latest_deployment_by_job_id(self, namespace: str,
+                                    job_id: str) -> Optional[Deployment]:
+        with self._lock:
+            best = None
+            for d in self._deployments.values():
+                if d.namespace == namespace and d.job_id == job_id:
+                    if best is None or d.create_index > best.create_index:
+                        best = d
+            return best
 
     # ------------------------------------------------------------ config
 

@@ -1,0 +1,282 @@
+"""Control-loop tests: heartbeats, deployments, drainer, periodic, events,
+GC (reference analogs: heartbeat_test.go, deploymentwatcher tests,
+drainer tests, periodic_test.go, core_sched_test.go)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.structs import (
+    AllocClientStatus,
+    AllocDesiredStatus,
+    DeploymentStatus,
+    EvalStatus,
+)
+from nomad_tpu.structs.job import PeriodicConfig, UpdateStrategy
+
+
+def make_server(**kw):
+    s = Server(ServerConfig(num_schedulers=2, **kw))
+    s.start()
+    return s
+
+
+# --------------------------------------------------------------- heartbeat
+
+def test_heartbeat_expiry_marks_node_down_and_replaces():
+    s = make_server(heartbeat_ttl=0.3)
+    try:
+        nodes = [mock.node() for _ in range(2)]
+        for n in nodes:
+            s.register_node(n)
+        job = mock.job()
+        job.task_groups[0].count = 1
+        s.register_job(job)
+        # keep both nodes alive until the first placement lands (first jit
+        # compile can exceed the short TTL)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            for n in nodes:
+                s.node_heartbeat(n.id)
+            if s.store.allocs_by_job("default", job.id):
+                break
+            time.sleep(0.05)
+        victim = s.store.allocs_by_job("default", job.id)[0]
+        other = [n for n in nodes if n.id != victim.node_id][0]
+        # keep the other node alive, let the victim's node expire
+        deadline = time.time() + 3.0
+        while time.time() < deadline:
+            s.node_heartbeat(other.id)
+            if s.store.node_by_id(victim.node_id) and \
+               s.store._nodes[victim.node_id].status == "down":
+                break
+            time.sleep(0.05)
+        assert s.store._nodes[victim.node_id].status == "down"
+        s.wait_for_idle(30.0)
+        run = [a for a in s.store.allocs_by_job("default", job.id)
+               if a.desired_status == AllocDesiredStatus.RUN
+               and a.client_status != AllocClientStatus.LOST]
+        assert len(run) == 1 and run[0].node_id == other.id
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------------- deployments
+
+def test_deployment_succeeds_when_allocs_healthy():
+    s = make_server()
+    try:
+        for _ in range(4):
+            s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        s.register_job(job)
+        s.wait_for_idle(30.0)
+        # destructive update creates a deployment
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        s.register_job(job2)
+        s.wait_for_idle(30.0)
+        d = s.store.latest_deployment_by_job_id("default", job.id)
+        assert d is not None and d.status == DeploymentStatus.RUNNING
+        # mark new-version allocs healthy as a client would
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            allocs = [a for a in s.store.allocs_by_job("default", job.id)
+                      if a.deployment_id == d.id
+                      and a.desired_status == AllocDesiredStatus.RUN]
+            for a in allocs:
+                if not a.is_healthy():
+                    u = a.copy()
+                    u.client_status = AllocClientStatus.RUNNING
+                    u.deployment_status = {"healthy": True}
+                    s.store.update_allocs_from_client(s.next_index(), [u])
+            dd = s.store.deployment_by_id(d.id)
+            if dd.status == DeploymentStatus.SUCCESSFUL:
+                break
+            s.wait_for_idle(5.0)
+            time.sleep(0.05)
+        assert s.store.deployment_by_id(d.id).status == DeploymentStatus.SUCCESSFUL
+        assert s.store.job_by_id("default", job.id).stable
+    finally:
+        s.stop()
+
+
+def test_deployment_fails_on_unhealthy_and_autoreverts():
+    s = make_server()
+    try:
+        for _ in range(4):
+            s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.update = UpdateStrategy(max_parallel=2, auto_revert=True)
+        job.task_groups[0].update = None
+        s.register_job(job)
+        s.wait_for_idle(30.0)
+        # v0 healthy -> stable
+        for a in s.store.allocs_by_job("default", job.id):
+            u = a.copy()
+            u.client_status = AllocClientStatus.RUNNING
+            u.deployment_status = {"healthy": True}
+            s.store.update_allocs_from_client(s.next_index(), [u])
+        s.store.job_by_id("default", job.id).stable = True
+
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/bad"}
+        s.register_job(job2)
+        s.wait_for_idle(30.0)
+        d = s.store.latest_deployment_by_job_id("default", job.id)
+        # new allocs report unhealthy
+        for a in s.store.allocs_by_job("default", job.id):
+            if a.deployment_id == d.id and not a.terminal_status():
+                u = a.copy()
+                u.deployment_status = {"healthy": False}
+                s.store.update_allocs_from_client(s.next_index(), [u])
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if s.store.deployment_by_id(d.id).status == DeploymentStatus.FAILED:
+                break
+            time.sleep(0.05)
+        assert s.store.deployment_by_id(d.id).status == DeploymentStatus.FAILED
+        # auto-revert registered a new version with the old config
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            j = s.store.job_by_id("default", job.id)
+            if j.version > job2.version:
+                break
+            time.sleep(0.05)
+        j = s.store.job_by_id("default", job.id)
+        assert j.task_groups[0].tasks[0].config == {"command": "/bin/date"}
+    finally:
+        s.stop()
+
+
+# ----------------------------------------------------------------- drainer
+
+def test_drain_migrates_allocs_off_node():
+    s = make_server()
+    try:
+        nodes = [mock.node() for _ in range(3)]
+        for n in nodes:
+            s.register_node(n)
+        job = mock.job()
+        job.task_groups[0].count = 3
+        s.register_job(job)
+        assert s.wait_for_idle(30.0)
+        victim_alloc = s.store.allocs_by_job("default", job.id)[0]
+        s.drainer.drain_node(victim_alloc.node_id, deadline_s=30.0)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            run = [a for a in s.store.allocs_by_job("default", job.id)
+                   if a.desired_status == AllocDesiredStatus.RUN
+                   and not a.terminal_status()]
+            if len(run) == 3 and all(a.node_id != victim_alloc.node_id
+                                     for a in run):
+                break
+            time.sleep(0.05)
+        run = [a for a in s.store.allocs_by_job("default", job.id)
+               if a.desired_status == AllocDesiredStatus.RUN
+               and not a.terminal_status()]
+        assert len(run) == 3
+        assert all(a.node_id != victim_alloc.node_id for a in run)
+        # drain completes: strategy cleared, node ineligible
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            n = s.store._nodes[victim_alloc.node_id]
+            if n.drain_strategy is None:
+                break
+            time.sleep(0.05)
+        n = s.store._nodes[victim_alloc.node_id]
+        assert n.drain_strategy is None
+        assert n.scheduling_eligibility == "ineligible"
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------- periodic
+
+def test_periodic_dispatch_creates_child_jobs():
+    from nomad_tpu.core.periodic import next_cron_after
+    # cron parsing
+    nxt = next_cron_after("*/5 * * * *", 0.0)
+    assert nxt == 300.0
+    assert next_cron_after("@every 30s", 100.0) == 130.0
+
+    s = make_server()
+    try:
+        s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.periodic = PeriodicConfig(spec="@every 0.2s")
+        s.register_job(job)
+        deadline = time.time() + 10
+        children = []
+        while time.time() < deadline:
+            children = [j for j in s.store.jobs() if j.parent_id == job.id]
+            if children:
+                break
+            time.sleep(0.05)
+        assert children, "no periodic child launched"
+        assert children[0].id.startswith(f"{job.id}/periodic-")
+        assert children[0].periodic is None
+        s.wait_for_idle(30.0)
+        assert len(s.store.allocs_by_job("default", children[0].id)) == 1
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------------------ events
+
+def test_event_stream_delivers_filtered_events():
+    s = make_server()
+    try:
+        sub = s.event_broker.subscribe({"Job": ["*"]})
+        s.register_node(mock.node())
+        job = mock.job()
+        s.register_job(job)
+        ev = sub.next(timeout=5.0)
+        assert ev is not None and ev.topic == "Job"
+        assert ev.type == "JobRegistered" and ev.key == job.id
+        sub.close()
+    finally:
+        s.stop()
+
+
+# --------------------------------------------------------------------- GC
+
+def test_core_gc_collects_dead_jobs_and_evals():
+    s = make_server()
+    try:
+        s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        s.register_job(job)
+        assert s.wait_for_idle(30.0)
+        s.deregister_job("default", job.id)
+        assert s.wait_for_idle(30.0)
+        # allocs stopped but client still reports: mark complete
+        for a in s.store.allocs_by_job("default", job.id):
+            u = a.copy()
+            u.client_status = AllocClientStatus.COMPLETE
+            s.store.update_allocs_from_client(s.next_index(), [u])
+        stats = s.core_scheduler.process("force-gc", force=True)
+        assert stats["jobs"] == 1
+        assert s.store.job_by_id("default", job.id) is None
+        assert s.store.allocs_by_job("default", job.id) == []
+    finally:
+        s.stop()
+
+
+def test_node_gc_removes_down_nodes():
+    s = make_server()
+    try:
+        n = mock.node()
+        s.register_node(n)
+        s.update_node_status(n.id, "down")
+        stats = s.core_scheduler.process("node-gc", force=True)
+        assert stats["nodes"] == 1
+        assert s.store.node_by_id(n.id) is None or \
+            s.store.snapshot().node_by_id(n.id) is None
+    finally:
+        s.stop()
