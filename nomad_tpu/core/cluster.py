@@ -1,0 +1,78 @@
+"""In-process multi-server cluster (reference: nomad.TestServer booting
+full Servers with in-memory Raft + loopback Serf, nomad/testing.go:41-47,
+used by leader_test.go / plan_apply_test.go).
+
+Boots N Servers over one InMemTransport; Raft elects a leader which
+establishes the leader-only subsystems (broker, workers, plan applier,
+watchers).  Supports stopping members and network partitions for failover
+tests.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.raft import InMemTransport, RaftConfig
+
+
+class Cluster:
+    def __init__(self, n: int = 3, config: Optional[ServerConfig] = None,
+                 raft_config: Optional[RaftConfig] = None,
+                 data_dir: Optional[str] = None):
+        self.transport = InMemTransport()
+        names = [f"server-{i}" for i in range(n)]
+        self.raft_config = raft_config or RaftConfig(
+            heartbeat_interval=0.02, election_timeout=0.1)
+        self.servers: List[Server] = []
+        for nm in names:
+            cfg = config or ServerConfig(num_schedulers=2)
+            if data_dir is not None:
+                cfg = ServerConfig(
+                    num_schedulers=cfg.num_schedulers,
+                    enabled_schedulers=cfg.enabled_schedulers,
+                    heartbeat_ttl=cfg.heartbeat_ttl,
+                    gc_interval=cfg.gc_interval,
+                    data_dir=data_dir)
+            self.servers.append(Server(
+                cfg, name=nm, peers=names, raft_transport=self.transport,
+                raft_config=self.raft_config))
+
+    def start(self) -> None:
+        for s in self.servers:
+            s.start()
+
+    def stop(self) -> None:
+        for s in self.servers:
+            s.stop()
+
+    def leader(self, timeout: float = 5.0) -> Server:
+        """Wait for a single established leader."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders = [s for s in self.servers
+                       if s.raft is not None and s.raft.is_leader
+                       and s._established]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.01)
+        raise TimeoutError("no leader elected")
+
+    def followers(self) -> List[Server]:
+        lead = self.leader()
+        return [s for s in self.servers if s is not lead]
+
+    def kill(self, server: Server) -> None:
+        """Hard-stop a member (network drop + component shutdown)."""
+        self.transport.set_down(server.name)
+        server.stop()
+
+    def wait_replication(self, index: int, timeout: float = 5.0) -> bool:
+        """Wait until every live member's store reaches `index`."""
+        deadline = time.monotonic() + timeout
+        live = [s for s in self.servers if not s._stop.is_set()]
+        while time.monotonic() < deadline:
+            if all(s.store.latest_index >= index for s in live):
+                return True
+            time.sleep(0.01)
+        return False

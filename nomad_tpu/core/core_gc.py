@@ -11,6 +11,7 @@ from __future__ import annotations
 import time as _time
 from typing import List, Optional
 
+from nomad_tpu.raft import MessageType
 from nomad_tpu.structs import EvalStatus, JobStatus, JobType
 from nomad_tpu.structs.deployment import DeploymentStatus
 from nomad_tpu.structs.node import NodeStatus
@@ -62,7 +63,8 @@ class CoreScheduler:
                 gc_evals.append(ev.id)
                 gc_allocs.extend(a.id for a in allocs)
         if gc_evals:
-            store.delete_eval(self.server.next_index(), gc_evals, gc_allocs)
+            self.server.apply(MessageType.EVAL_DELETE,
+                              {"eval_ids": gc_evals, "alloc_ids": gc_allocs})
         return len(gc_evals)
 
     def job_gc(self, now: float, force: bool = False) -> int:
@@ -81,10 +83,12 @@ class CoreScheduler:
             evals = store.evals_by_job(job.namespace, job.id)
             if all(a.terminal_status() for a in allocs) and \
                     all(e.terminal() for e in evals):
-                store.delete_eval(self.server.next_index(),
-                                  [e.id for e in evals],
-                                  [a.id for a in allocs])
-                store.delete_job(self.server.next_index(), job.namespace, job.id)
+                self.server.apply(MessageType.EVAL_DELETE,
+                                  {"eval_ids": [e.id for e in evals],
+                                   "alloc_ids": [a.id for a in allocs]})
+                self.server.apply(MessageType.JOB_DEREGISTER,
+                                  {"namespace": job.namespace,
+                                   "job_id": job.id, "purge": True})
                 n += 1
         return n
 
@@ -101,7 +105,8 @@ class CoreScheduler:
             if any(not a.terminal_status()
                    for a in store.allocs_by_node(node.id)):
                 continue
-            store.delete_node(self.server.next_index(), node.id)
+            self.server.apply(MessageType.NODE_DEREGISTER,
+                              {"node_id": node.id})
             n += 1
         return n
 
@@ -114,8 +119,7 @@ class CoreScheduler:
             if not self._old_enough(d.modify_time or d.create_time, now,
                                     DEPLOYMENT_GC_THRESHOLD, force):
                 continue
-            with store._lock:
-                store._deployments.pop(d.id, None)
-                store._bump(store.latest_index + 1)
+            self.server.apply(MessageType.DEPLOYMENT_DELETE,
+                              {"deployment_id": d.id})
             n += 1
         return n

@@ -9,6 +9,7 @@ import threading
 import time as _time
 from typing import Dict, List, Optional
 
+from nomad_tpu.raft import MessageType
 from nomad_tpu.structs import (
     AllocClientStatus,
     Deployment,
@@ -30,6 +31,7 @@ class DeploymentWatcher:
         server.store.watch(self._on_change)
 
     def start(self) -> None:
+        self._stop = threading.Event()   # fresh per leadership tenure
         self._thread = threading.Thread(target=self._run, name="deploy-watcher",
                                         daemon=True)
         self._thread.start()
@@ -124,7 +126,7 @@ class DeploymentWatcher:
         if complete and updated.task_groups:
             updated.status = DeploymentStatus.SUCCESSFUL
             updated.status_description = DeploymentStatus.DESC_SUCCESSFUL
-            store.upsert_deployment(server.next_index(), updated)
+            server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": updated})
             self._mark_job_stable(d)
             return
 
@@ -143,7 +145,7 @@ class DeploymentWatcher:
         # only write when something actually changed — an unconditional
         # upsert re-triggers this watcher through its own state watch
         if counts(updated) != counts(d) or updated.status != d.status:
-            store.upsert_deployment(server.next_index(), updated)
+            server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": updated})
         if progressed:
             self._emit_eval(updated)
 
@@ -155,7 +157,7 @@ class DeploymentWatcher:
         d.status = DeploymentStatus.FAILED
         d.status_description = (DeploymentStatus.DESC_PROGRESS_DEADLINE
                                 if deadline else DeploymentStatus.DESC_FAILED_ALLOCATIONS)
-        server.store.upsert_deployment(server.next_index(), d)
+        server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": d})
         # auto-revert to the latest stable version
         if any(s.auto_revert for s in d.task_groups.values()):
             job = server.store.job_by_id(d.namespace, d.job_id)
@@ -197,7 +199,7 @@ class DeploymentWatcher:
         for name, state in updated.task_groups.items():
             if groups is None or name in groups:
                 state.promoted = True
-        server.store.upsert_deployment(server.next_index(), updated)
+        server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": updated})
         self._emit_eval(updated)
         return True
 
@@ -215,7 +217,7 @@ class DeploymentWatcher:
         updated = d.copy()
         updated.status = (DeploymentStatus.PAUSED if pause
                           else DeploymentStatus.RUNNING)
-        self.server.store.upsert_deployment(self.server.next_index(), updated)
+        self.server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": updated})
         if not pause:
             self._emit_eval(updated)
         return True

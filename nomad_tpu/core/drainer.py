@@ -11,6 +11,7 @@ import threading
 import time as _time
 from typing import Dict, List, Optional
 
+from nomad_tpu.raft import MessageType
 from nomad_tpu.structs import Allocation, Evaluation, EvalStatus, JobType
 from nomad_tpu.structs.alloc import DesiredTransition
 from nomad_tpu.structs.evaluation import EvalTrigger
@@ -27,6 +28,7 @@ class NodeDrainer:
         server.store.watch(self._on_change)
 
     def start(self) -> None:
+        self._stop = threading.Event()   # fresh per leadership tenure
         self._thread = threading.Thread(target=self._run, name="drainer",
                                         daemon=True)
         self._thread.start()
@@ -64,7 +66,8 @@ class NodeDrainer:
             ignore_system_jobs=ignore_system_jobs,
             force_deadline=_time.time() + deadline_s if deadline_s > 0 else 0.0,
             started_at=_time.time())
-        server.store.update_node_drain(server.next_index(), node_id, strategy)
+        server.apply(MessageType.NODE_UPDATE_DRAIN,
+                     {"node_id": node_id, "drain_strategy": strategy})
         self._dirty.set()
 
     # ------------------------------------------------------------- logic
@@ -95,7 +98,8 @@ class NodeDrainer:
 
         if not migratable:
             # drain complete: clear strategy, node stays ineligible
-            server.store.update_node_drain(server.next_index(), node.id, None)
+            server.apply(MessageType.NODE_UPDATE_DRAIN,
+                         {"node_id": node.id, "drain_strategy": None})
             return
 
         deadlined = strategy.force_deadline and now >= strategy.force_deadline
@@ -119,7 +123,8 @@ class NodeDrainer:
                         triggered_by=EvalTrigger.NODE_DRAIN, node_id=node.id,
                         status=EvalStatus.PENDING)
             if updates:
-                server.store.upsert_allocs(server.next_index(), updates)
+                server.apply(MessageType.ALLOC_UPDATE_DESIRED_TRANSITION,
+                             {"allocs": updates})
             if evals:
                 server.create_evals(list(evals.values()))
             return
@@ -140,7 +145,8 @@ class NodeDrainer:
                 continue
             u = a.copy()
             u.desired_transition = DesiredTransition(migrate=True)
-            server.store.upsert_allocs(server.next_index(), [u])
+            server.apply(MessageType.ALLOC_UPDATE_DESIRED_TRANSITION,
+                         {"allocs": [u]})
             key = (a.namespace, a.job_id)
             if key not in evals and a.job is not None:
                 evals[key] = Evaluation(

@@ -27,6 +27,7 @@ class HeartbeatTracker:
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
+        self._stop = threading.Event()   # fresh per leadership tenure
         self._thread = threading.Thread(target=self._run, name="heartbeat",
                                         daemon=True)
         self._thread.start()
@@ -61,7 +62,13 @@ class HeartbeatTracker:
                         del self._deadlines[node_id]
                         expired.append(node_id)
             for node_id in expired:
-                self._invalidate(node_id)
+                try:
+                    self._invalidate(node_id)
+                except Exception:           # noqa: BLE001
+                    # a failed write (e.g. lost quorum mid-invalidate) must
+                    # not kill the heartbeat loop for the whole tenure
+                    import logging
+                    logging.getLogger(__name__).exception("invalidate")
             self._stop.wait(self.tick)
 
     def _invalidate(self, node_id: str) -> None:

@@ -61,6 +61,7 @@ class PeriodicDispatcher:
         self._next_fire: dict = {}     # (ns, job_id) -> ts
 
     def start(self) -> None:
+        self._stop = threading.Event()   # fresh per leadership tenure
         self._thread = threading.Thread(target=self._run, name="periodic",
                                         daemon=True)
         self._thread.start()
@@ -102,10 +103,18 @@ class PeriodicDispatcher:
 
     def _has_running_child(self, job) -> bool:
         for j in self.server.store.jobs():
-            if j.parent_id == job.id and j.status != "dead":
-                for a in self.server.store.allocs_by_job(j.namespace, j.id):
-                    if not a.terminal_status():
-                        return True
+            if j.parent_id != job.id or j.status == "dead":
+                continue
+            allocs = self.server.store.allocs_by_job(j.namespace, j.id)
+            if any(not a.terminal_status() for a in allocs):
+                return True
+            if not allocs:
+                # child not placed yet (pending eval) still counts as
+                # running for prohibit_overlap (reference periodic.go)
+                evals = self.server.store.evals_by_job(j.namespace, j.id)
+                if not allocs and (not evals
+                                   or any(not e.terminal() for e in evals)):
+                    return True
         return False
 
     def _launch(self, job, fire_time: float) -> str:

@@ -26,8 +26,13 @@ class PlanApplier:
     """Serialized: one plan at a time, guarded by a lock (the reference
     serializes via the single planApply goroutine)."""
 
-    def __init__(self, store: StateStore):
+    def __init__(self, store: StateStore, commit_fn=None):
         self.store = store
+        # commit_fn(AppliedPlanResults) -> index routes the commit through
+        # the Raft/FSM write path (reference: applyPlan raft.Apply of an
+        # ApplyPlanResultsRequest, plan_apply.go:204); None = direct store
+        # write (the scheduler Harness mode, testing.go:180)
+        self._commit_fn = commit_fn
         self._lock = threading.Lock()
         self.stats = {"applied": 0, "rejected_nodes": 0, "partial": 0}
 
@@ -148,7 +153,6 @@ class PlanApplier:
                 and not result.node_preemptions and result.deployment is None
                 and not result.deployment_updates):
             return
-        index = store.latest_index + 1
         applied = AppliedPlanResults(
             alloc_updates=[a for v in result.node_update.values() for a in v],
             allocs_to_place=[a for v in result.node_allocation.values() for a in v],
@@ -157,7 +161,11 @@ class PlanApplier:
             deployment_updates=result.deployment_updates,
             eval_id=plan.eval_id,
         )
-        store.upsert_plan_results(index, applied)
+        if self._commit_fn is not None:
+            index = self._commit_fn(applied)
+        else:
+            index = store.latest_index + 1
+            store.upsert_plan_results(index, applied)
         result.alloc_index = index
         self.stats["applied"] += 1
 

@@ -14,6 +14,12 @@ from typing import List, Optional, Tuple
 from nomad_tpu.structs.plan import Plan
 
 
+class LeadershipLostError(Exception):
+    """Raised to plan submitters when the plan queue is torn down by a
+    leadership transition (reference: plan submission RPCs erroring when
+    the leader's planQueue is disabled, plan_queue.go SetEnabled)."""
+
+
 class PendingPlan:
     __slots__ = ("plan", "future")
 
@@ -35,14 +41,14 @@ class PlanQueue:
             self.enabled = enabled
             if not enabled:
                 for _, _, p in self._heap:
-                    p.future.set_exception(RuntimeError("plan queue disabled"))
+                    p.future.set_exception(LeadershipLostError("plan queue disabled"))
                 self._heap = []
             self._lock.notify_all()
 
     def enqueue(self, plan: Plan) -> PendingPlan:
         with self._lock:
             if not self.enabled:
-                raise RuntimeError("plan queue is disabled")
+                raise LeadershipLostError("plan queue is disabled")
             pending = PendingPlan(plan)
             heapq.heappush(self._heap, (-plan.priority, next(self._counter), pending))
             self.stats["depth"] = len(self._heap)

@@ -1,15 +1,21 @@
-"""Single-process server: the control-plane spine wired together.
+"""Server: the control-plane spine wired together.
 
 Reference analog: nomad/server.go + leader.go establishLeadership — state
 store, eval broker, blocked evals, plan queue, the serialized plan-apply
-loop, N scheduler workers, heartbeats and the periodic dispatcher.  This is
-the in-memory '-dev agent' equivalent (no Raft/Serf: single region,
-immediate consensus — multi-server replication is the RPC layer's job and
-rides on the same indexed writes).
+loop, N scheduler workers, heartbeats and the periodic dispatcher.
+
+Two consensus modes, mirroring the reference's raftInmem vs raft-boltdb:
+ - dev (raft=None): single server, writes apply straight through the
+   NomadFSM under a lock (the '-dev agent' in-memory Raft).
+ - cluster: writes go through `RaftNode.apply` and every member's FSM
+   replays them; leadership elections drive establish/revoke of the
+   leader-only subsystems (nomad/leader.go:277,1099).
 """
 from __future__ import annotations
 
 import itertools
+import os
+import pickle
 import threading
 import time as _time
 import uuid
@@ -26,6 +32,13 @@ from nomad_tpu.core.periodic import PeriodicDispatcher
 from nomad_tpu.core.plan_apply import PlanApplier
 from nomad_tpu.core.plan_queue import PlanQueue
 from nomad_tpu.core.worker import Worker
+from nomad_tpu.raft import (
+    FileSnapshotStore,
+    LogStore,
+    MessageType,
+    NomadFSM,
+    RaftNode,
+)
 from nomad_tpu.state import StateStore
 from nomad_tpu.structs import (
     Evaluation,
@@ -41,25 +54,34 @@ class ServerConfig:
     def __init__(self, num_schedulers: int = 4,
                  enabled_schedulers: Optional[List[str]] = None,
                  heartbeat_ttl: float = 10.0,
-                 gc_interval: float = 300.0):
+                 gc_interval: float = 300.0,
+                 data_dir: Optional[str] = None):
         self.num_schedulers = num_schedulers
         self.enabled_schedulers = enabled_schedulers or \
             ["service", "batch", "system", "sysbatch"]
         self.heartbeat_ttl = heartbeat_ttl
         self.gc_interval = gc_interval
+        self.data_dir = data_dir
 
 
 class Server:
-    def __init__(self, config: Optional[ServerConfig] = None):
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 name: str = "server-1",
+                 peers: Optional[List[str]] = None,
+                 raft_transport=None,
+                 raft_config=None):
         self.config = config or ServerConfig()
+        self.name = name
         self.store = StateStore()
         self.broker = EvalBroker()
         self.blocked_evals = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
-        self.applier = PlanApplier(self.store)
+        self.applier = PlanApplier(self.store, commit_fn=self._commit_plan)
         self.workers: List[Worker] = []
         self._raft_lock = threading.Lock()     # serializes indexed writes
         self._stop = threading.Event()
+        self._leader_stop = threading.Event()
+        self._leader_lock = threading.Lock()
         self._plan_thread: Optional[threading.Thread] = None
         self._threads: List[threading.Thread] = []
         self.event_broker = EventBroker()
@@ -72,8 +94,40 @@ class Server:
         self.store.watch(self.event_broker.watch_state)
         self.store.watch(self._on_state_change)
         self.leader = False
+        self._established = False
 
-    # ------------------------------------------------------------- indexes
+        self.fsm = NomadFSM(self.store, hooks=self)
+        self.raft: Optional[RaftNode] = None
+        if raft_transport is not None:
+            data_dir = self.config.data_dir
+            log_store = snapshots = None
+            if data_dir:
+                sdir = os.path.join(data_dir, name)
+                os.makedirs(sdir, exist_ok=True)
+                log_store = LogStore(os.path.join(sdir, "raft.log"))
+                snapshots = FileSnapshotStore(os.path.join(sdir, "snapshots"))
+            self.raft = RaftNode(
+                name, peers or [name], raft_transport, self.fsm,
+                config=raft_config, log_store=log_store, snapshots=snapshots,
+                on_leader=self._establish_leadership,
+                on_follower=self._revoke_leadership)
+
+    # ------------------------------------------------------------- writes
+
+    def apply(self, msg_type: str, payload: dict) -> int:
+        """The single write path: a (type, payload) log entry applied via
+        the FSM — through Raft when clustered, directly in dev mode
+        (reference raft.Apply → nomadFSM.Apply)."""
+        if self.raft is not None:
+            return self.raft.apply(msg_type, payload)
+        with self._raft_lock:
+            index = self.store.latest_index + 1
+            self.fsm.apply(index, msg_type, payload)
+            return index
+
+    def _commit_plan(self, applied) -> int:
+        return self.apply(MessageType.APPLY_PLAN_RESULTS,
+                          {"results": applied})
 
     def next_index(self) -> int:
         with self._raft_lock:
@@ -82,48 +136,98 @@ class Server:
     # ------------------------------------------------------------- lifecycle
 
     def start(self) -> None:
+        if self.raft is not None:
+            self.raft.start()
+        else:
+            self._establish_leadership()
+
+    def _establish_leadership(self) -> None:
         """establishLeadership (reference nomad/leader.go:277-357)."""
-        self.leader = True
-        self.broker.set_enabled(True)
-        self.blocked_evals.set_enabled(True)
-        self.plan_queue.set_enabled(True)
-        self._plan_thread = threading.Thread(
-            target=self.applier.run_loop, args=(self.plan_queue, self._stop),
-            name="plan-apply", daemon=True)
-        self._plan_thread.start()
-        for i in range(self.config.num_schedulers):
-            w = Worker(self, i, self.config.enabled_schedulers)
-            w.start()
-            self.workers.append(w)
-        self._restore_evals()
-        t = threading.Thread(target=self._failed_eval_reaper,
-                             name="eval-reaper", daemon=True)
-        t.start()
-        self._threads.append(t)
-        self.heartbeats.start()
-        self.deployment_watcher.start()
-        self.drainer.start()
-        self.periodic.start()
-        gc_t = threading.Thread(target=self._gc_loop, name="core-gc",
-                                daemon=True)
-        gc_t.start()
-        self._threads.append(gc_t)
+        with self._leader_lock:
+            if self._established:
+                return
+            self._established = True
+            self.leader = True
+            self._leader_stop = threading.Event()
+            stop = self._leader_stop
+            self.broker.set_enabled(True)
+            self.blocked_evals.set_enabled(True)
+            self.plan_queue.set_enabled(True)
+            self._plan_thread = threading.Thread(
+                target=self.applier.run_loop, args=(self.plan_queue, stop),
+                name="plan-apply", daemon=True)
+            self._plan_thread.start()
+            for i in range(self.config.num_schedulers):
+                w = Worker(self, i, self.config.enabled_schedulers)
+                w.start()
+                self.workers.append(w)
+            self._restore_evals()
+            t = threading.Thread(target=self._failed_eval_reaper,
+                                 args=(stop,), name="eval-reaper", daemon=True)
+            t.start()
+            self._threads.append(t)
+            self.heartbeats.start()
+            self.deployment_watcher.start()
+            self.drainer.start()
+            self.periodic.start()
+            gc_t = threading.Thread(target=self._gc_loop, args=(stop,),
+                                    name="core-gc", daemon=True)
+            gc_t.start()
+            self._threads.append(gc_t)
+
+    def _revoke_leadership(self) -> None:
+        """revokeLeadership (reference nomad/leader.go:1099-1132)."""
+        with self._leader_lock:
+            if not self._established:
+                return
+            self._established = False
+            self.leader = False
+            self._leader_stop.set()
+            self.heartbeats.stop()
+            self.deployment_watcher.stop()
+            self.drainer.stop()
+            self.periodic.stop()
+            for w in self.workers:
+                w.stop()
+            for w in self.workers:
+                w.join(1.0)
+            self.workers = []
+            self.plan_queue.set_enabled(False)
+            self.broker.set_enabled(False)
+            self.blocked_evals.set_enabled(False)
+            if self._plan_thread:
+                self._plan_thread.join(1.0)
+                self._plan_thread = None
 
     def stop(self) -> None:
         self._stop.set()
-        self.heartbeats.stop()
-        self.deployment_watcher.stop()
-        self.drainer.stop()
-        self.periodic.stop()
-        for w in self.workers:
-            w.stop()
-        for w in self.workers:
-            w.join(1.0)
-        self.plan_queue.set_enabled(False)
-        self.broker.set_enabled(False)
-        self.blocked_evals.set_enabled(False)
-        if self._plan_thread:
-            self._plan_thread.join(1.0)
+        self._revoke_leadership()
+        if self.raft is not None:
+            self.raft.stop()
+
+    # ------------------------------------------------------------- snapshots
+
+    def save_snapshot(self, path: str) -> None:
+        """Operator snapshot save (reference `nomad operator snapshot save`,
+        helper/snapshot/)."""
+        blob = self.fsm.snapshot()
+        with open(path, "wb") as fh:
+            pickle.dump({"index": self.store.latest_index,
+                         "data": blob}, fh)
+
+    def restore_snapshot(self, path: str) -> None:
+        """Operator snapshot restore: replace state wholesale.  Dev-mode
+        only — a clustered member restoring locally would diverge from its
+        peers; clustered restore must flow through Raft's InstallSnapshot
+        (the reference's operator restore goes through raft.Restore)."""
+        if self.raft is not None:
+            raise RuntimeError(
+                "restore_snapshot on a clustered server would diverge "
+                "from peers; restore the whole cluster from the snapshot "
+                "via fresh data dirs instead")
+        with open(path, "rb") as fh:
+            rec = pickle.load(fh)
+        self.fsm.restore(rec["data"])
 
     def _restore_evals(self) -> None:
         """On leadership: re-enqueue non-terminal evals (leader.go:572)."""
@@ -133,10 +237,10 @@ class Server:
             elif ev.should_block():
                 self.blocked_evals.block(ev.copy())
 
-    def _failed_eval_reaper(self) -> None:
+    def _failed_eval_reaper(self, stop: threading.Event) -> None:
         """Mark dead-lettered evals failed and create follow-ups
         (leader.go:842-884)."""
-        while not self._stop.is_set():
+        while not stop.is_set() and not self._stop.is_set():
             ev, token = self.broker.dequeue([FAILED_QUEUE], timeout=0.2)
             if ev is None:
                 continue
@@ -152,10 +256,12 @@ class Server:
             self.create_evals([follow])
             self.broker.ack(ev.id, token)
 
-    def _gc_loop(self) -> None:
+    def _gc_loop(self, stop: threading.Event) -> None:
         """Leader periodic GC timers (reference leader.go:782-810 core-job
         eval scheduling, here invoked directly)."""
-        while not self._stop.wait(self.config.gc_interval):
+        while not stop.wait(self.config.gc_interval):
+            if self._stop.is_set():
+                return
             try:
                 self.core_scheduler.process("force-gc")
             except Exception:               # noqa: BLE001
@@ -191,24 +297,17 @@ class Server:
     #  node_endpoint.go, eval_endpoint.go)
 
     def update_eval(self, ev: Evaluation) -> None:
-        with self._raft_lock:
-            self.store.upsert_evals(self.store.latest_index + 1, [ev])
+        self.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
 
     def create_evals(self, evals: List[Evaluation]) -> None:
-        copies = [e.copy() for e in evals]
-        with self._raft_lock:
-            self.store.upsert_evals(self.store.latest_index + 1, copies)
-        for e in copies:
-            if e.should_enqueue():
-                self.broker.enqueue(e)
-            elif e.should_block():
-                # FSM leader hook: blocked evals go to the blocked tracker
-                self.blocked_evals.block(e)
+        # pending evals are enqueued / blocked by the FSM's leader hook
+        # (reference: fsm eval apply with the broker attached)
+        self.apply(MessageType.EVAL_UPDATE,
+                   {"evals": [e.copy() for e in evals]})
 
     def register_job(self, job: Job) -> Evaluation:
         """Job.Register (nomad/job_endpoint.go:81): upsert + eval."""
-        with self._raft_lock:
-            self.store.upsert_job(self.store.latest_index + 1, job)
+        self.apply(MessageType.JOB_REGISTER, {"job": job})
         ev = Evaluation(
             namespace=job.namespace, priority=job.priority, type=job.type,
             job_id=job.id, triggered_by=EvalTrigger.JOB_REGISTER,
@@ -223,13 +322,8 @@ class Server:
         job = self.store.job_by_id(namespace, job_id)
         if job is None:
             return None
-        with self._raft_lock:
-            if purge:
-                self.store.delete_job(self.store.latest_index + 1, namespace, job_id)
-            else:
-                stopped = job.copy()
-                stopped.stop = True
-                self.store.upsert_job(self.store.latest_index + 1, stopped)
+        self.apply(MessageType.JOB_DEREGISTER,
+                   {"namespace": namespace, "job_id": job_id, "purge": purge})
         self.blocked_evals.untrack(namespace, job_id)
         ev = Evaluation(
             namespace=namespace, priority=job.priority, type=job.type,
@@ -240,14 +334,13 @@ class Server:
 
     def set_job_stability(self, namespace: str, job_id: str, version: int,
                           stable: bool) -> None:
-        with self._raft_lock:
-            self.store.mark_job_stability(
-                self.store.latest_index + 1, namespace, job_id, version, stable)
+        self.apply(MessageType.JOB_STABILITY,
+                   {"namespace": namespace, "job_id": job_id,
+                    "version": version, "stable": stable})
 
     def register_node(self, node: Node) -> None:
         """Node.Register (nomad/node_endpoint.go:79)."""
-        with self._raft_lock:
-            self.store.upsert_node(self.store.latest_index + 1, node)
+        self.apply(MessageType.NODE_REGISTER, {"node": node})
         if self.leader:
             self.heartbeats.heartbeat(node.id)
 
@@ -262,9 +355,9 @@ class Server:
 
     def update_node_status(self, node_id: str, status: str) -> List[Evaluation]:
         """Node.UpdateStatus: transition + evals for affected jobs."""
-        with self._raft_lock:
-            self.store.update_node_status(
-                self.store.latest_index + 1, node_id, status, _time.time())
+        self.apply(MessageType.NODE_UPDATE_STATUS,
+                   {"node_id": node_id, "status": status,
+                    "updated_at": _time.time()})
         return self.create_node_evals(node_id)
 
     def create_node_evals(self, node_id: str) -> List[Evaluation]:

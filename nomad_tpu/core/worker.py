@@ -13,6 +13,8 @@ import logging
 import threading
 from typing import List, Optional
 
+from nomad_tpu.core.plan_queue import LeadershipLostError
+from nomad_tpu.raft import NotLeaderError
 from nomad_tpu.scheduler import factory
 from nomad_tpu.structs import Evaluation, EvalStatus
 from nomad_tpu.structs.plan import Plan, PlanResult
@@ -52,7 +54,12 @@ class Worker:
                 self.enabled_schedulers, timeout=0.1)
             if ev is None:
                 continue
-            self.process_eval(ev, token)
+            try:
+                self.process_eval(ev, token)
+            except (NotLeaderError, LeadershipLostError):
+                # leadership moved mid-eval (reference: the worker's RPCs
+                # start failing and the eval is nacked for redelivery)
+                self.server.broker.nack(ev.id, token)
 
     # ------------------------------------------------------------- process
 
@@ -69,6 +76,8 @@ class Worker:
         try:
             sched = factory.new_scheduler(ev.type, snap, self)
             sched.process(ev)
+        except (NotLeaderError, LeadershipLostError):
+            raise
         except Exception as e:                      # noqa: BLE001
             log.exception("eval %s failed", ev.id)
             self.stats["failed"] += 1

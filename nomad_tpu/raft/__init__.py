@@ -1,0 +1,19 @@
+"""Raft consensus layer (reference: vendored hashicorp/raft wired in
+nomad/server.go:107-111 + the FSM in nomad/fsm.go).
+
+The control plane's writes are replicated log entries: every mutation is a
+(MessageType, payload) record appended to a Raft log and applied to each
+server's StateStore by the NomadFSM — exactly the reference's
+`nomadFSM.Apply` switch (nomad/fsm.go:211-313).  Leadership drives which
+server runs the broker/workers/plan-applier (nomad/leader.go:277).
+"""
+from nomad_tpu.raft.fsm import MessageType, NomadFSM
+from nomad_tpu.raft.log import LogEntry, LogStore
+from nomad_tpu.raft.node import NotLeaderError, RaftConfig, RaftNode
+from nomad_tpu.raft.snapshot import FileSnapshotStore
+from nomad_tpu.raft.transport import InMemTransport
+
+__all__ = [
+    "MessageType", "NomadFSM", "LogEntry", "LogStore", "RaftNode",
+    "RaftConfig", "NotLeaderError", "InMemTransport", "FileSnapshotStore",
+]

@@ -1,0 +1,243 @@
+"""Replicated state machine (reference: nomad/fsm.go).
+
+`NomadFSM.apply` is the message-type switch (`nomadFSM.Apply`
+nomad/fsm.go:211-313) mapping log entries onto StateStore writes at the
+entry's Raft index.  `snapshot`/`restore` persist the full store
+(`nomadFSM.Snapshot/Restore`, same file) for log compaction and server
+checkpoint/resume.
+
+Leader-side hooks: when an eval lands in the store on the leader, it is
+handed to the EvalBroker / BlockedEvals trackers (the reference FSM holds
+the broker and enqueues when leadership is established — fsm.go eval
+apply + leader.go:572 restore path).
+"""
+from __future__ import annotations
+
+import pickle
+from collections import defaultdict
+from typing import Dict, Optional
+
+from nomad_tpu.state.store import AppliedPlanResults, JobSummary, StateStore
+
+
+class MessageType:
+    """Log entry types (reference: structs.MessageType constants,
+    nomad/structs/structs.go:87-150)."""
+    NODE_REGISTER = "NodeRegisterRequest"
+    NODE_DEREGISTER = "NodeDeregisterRequest"
+    NODE_UPDATE_STATUS = "NodeUpdateStatusRequest"
+    NODE_UPDATE_DRAIN = "NodeUpdateDrainRequest"
+    NODE_UPDATE_ELIGIBILITY = "NodeUpdateEligibilityRequest"
+    JOB_REGISTER = "JobRegisterRequest"
+    JOB_DEREGISTER = "JobDeregisterRequest"
+    JOB_STABILITY = "JobStabilityRequest"
+    EVAL_UPDATE = "EvalUpdateRequest"
+    EVAL_DELETE = "EvalDeleteRequest"
+    ALLOC_UPDATE = "AllocUpdateRequest"
+    ALLOC_CLIENT_UPDATE = "AllocClientUpdateRequest"
+    ALLOC_UPDATE_DESIRED_TRANSITION = "AllocUpdateDesiredTransitionRequest"
+    APPLY_PLAN_RESULTS = "ApplyPlanResultsRequest"
+    DEPLOYMENT_UPSERT = "DeploymentUpsertRequest"
+    DEPLOYMENT_DELETE = "DeploymentDeleteRequest"
+    SCHEDULER_CONFIG = "SchedulerConfigRequest"
+    NAMESPACE_UPSERT = "NamespaceUpsertRequest"
+    NAMESPACE_DELETE = "NamespaceDeleteRequest"
+    CSI_VOLUME_REGISTER = "CSIVolumeRegisterRequest"
+    CSI_VOLUME_DEREGISTER = "CSIVolumeDeregisterRequest"
+    CSI_VOLUME_CLAIM = "CSIVolumeClaimRequest"
+    ACL_POLICY_UPSERT = "ACLPolicyUpsertRequest"
+    ACL_POLICY_DELETE = "ACLPolicyDeleteRequest"
+    ACL_TOKEN_UPSERT = "ACLTokenUpsertRequest"
+    ACL_TOKEN_DELETE = "ACLTokenDeleteRequest"
+    NOOP = "Noop"                  # leadership-establishment barrier entry
+
+
+class NomadFSM:
+    """Applies committed log entries to a StateStore.
+
+    `hooks` is the owning Server (or None): after an EVAL_UPDATE commit on
+    the leader, pending evals are enqueued in the broker and blocked evals
+    registered with the BlockedEvals tracker.
+    """
+
+    def __init__(self, store: StateStore, hooks=None):
+        self.store = store
+        self.hooks = hooks
+        self._dispatch = {
+            MessageType.NODE_REGISTER: self._apply_node_register,
+            MessageType.NODE_DEREGISTER: self._apply_node_deregister,
+            MessageType.NODE_UPDATE_STATUS: self._apply_node_update_status,
+            MessageType.NODE_UPDATE_DRAIN: self._apply_node_update_drain,
+            MessageType.NODE_UPDATE_ELIGIBILITY: self._apply_node_eligibility,
+            MessageType.JOB_REGISTER: self._apply_job_register,
+            MessageType.JOB_DEREGISTER: self._apply_job_deregister,
+            MessageType.JOB_STABILITY: self._apply_job_stability,
+            MessageType.EVAL_UPDATE: self._apply_eval_update,
+            MessageType.EVAL_DELETE: self._apply_eval_delete,
+            MessageType.ALLOC_UPDATE: self._apply_alloc_update,
+            MessageType.ALLOC_CLIENT_UPDATE: self._apply_alloc_client_update,
+            MessageType.ALLOC_UPDATE_DESIRED_TRANSITION:
+                self._apply_alloc_desired_transition,
+            MessageType.APPLY_PLAN_RESULTS: self._apply_plan_results,
+            MessageType.DEPLOYMENT_UPSERT: self._apply_deployment_upsert,
+            MessageType.DEPLOYMENT_DELETE: self._apply_deployment_delete,
+            MessageType.SCHEDULER_CONFIG: self._apply_scheduler_config,
+            MessageType.NOOP: lambda index, p: None,
+        }
+        # optional table handlers registered by periphery subsystems
+        # (CSI volumes, namespaces, ACL) once those stores exist
+        self.extra: Dict[str, callable] = {}
+        self.snapshot_extra: Dict[str, callable] = {}
+        self.restore_extra: Dict[str, callable] = {}
+
+    # ------------------------------------------------------------- apply
+
+    def apply(self, index: int, msg_type: str, payload: dict) -> None:
+        fn = self._dispatch.get(msg_type) or self.extra.get(msg_type)
+        if fn is None:
+            raise ValueError(f"unknown FSM message type {msg_type!r}")
+        fn(index, payload)
+
+    # --- nodes
+
+    def _apply_node_register(self, index, p):
+        self.store.upsert_node(index, p["node"])
+
+    def _apply_node_deregister(self, index, p):
+        self.store.delete_node(index, p["node_id"])
+
+    def _apply_node_update_status(self, index, p):
+        self.store.update_node_status(
+            index, p["node_id"], p["status"], p.get("updated_at", 0.0))
+
+    def _apply_node_update_drain(self, index, p):
+        self.store.update_node_drain(
+            index, p["node_id"], p.get("drain_strategy"),
+            p.get("mark_eligible", False))
+
+    def _apply_node_eligibility(self, index, p):
+        self.store.update_node_eligibility(
+            index, p["node_id"], p["eligibility"])
+
+    # --- jobs
+
+    def _apply_job_register(self, index, p):
+        self.store.upsert_job(index, p["job"])
+
+    def _apply_job_deregister(self, index, p):
+        if p.get("purge"):
+            self.store.delete_job(index, p["namespace"], p["job_id"])
+        else:
+            job = self.store.job_by_id(p["namespace"], p["job_id"])
+            if job is not None:
+                stopped = job.copy()
+                stopped.stop = True
+                self.store.upsert_job(index, stopped)
+
+    def _apply_job_stability(self, index, p):
+        self.store.mark_job_stability(
+            index, p["namespace"], p["job_id"], p["version"], p["stable"])
+
+    # --- evals
+
+    def _apply_eval_update(self, index, p):
+        evals = p["evals"]
+        self.store.upsert_evals(index, evals)
+        hooks = self.hooks
+        if hooks is not None and getattr(hooks, "leader", False):
+            for ev in evals:
+                if ev.should_enqueue():
+                    hooks.broker.enqueue(ev.copy())
+                elif ev.should_block():
+                    hooks.blocked_evals.block(ev.copy())
+
+    def _apply_eval_delete(self, index, p):
+        self.store.delete_eval(index, p["eval_ids"], p.get("alloc_ids", ()))
+
+    # --- allocs
+
+    def _apply_alloc_update(self, index, p):
+        self.store.upsert_allocs(index, p["allocs"])
+
+    def _apply_alloc_client_update(self, index, p):
+        self.store.update_allocs_from_client(index, p["allocs"])
+
+    def _apply_alloc_desired_transition(self, index, p):
+        self.store.upsert_allocs(index, p["allocs"])
+
+    # --- plans / deployments / config
+
+    def _apply_plan_results(self, index, p):
+        self.store.upsert_plan_results(index, p["results"])
+
+    def _apply_deployment_upsert(self, index, p):
+        self.store.upsert_deployment(index, p["deployment"])
+
+    def _apply_deployment_delete(self, index, p):
+        self.store.delete_deployment(index, p["deployment_id"])
+
+    def _apply_scheduler_config(self, index, p):
+        self.store.set_scheduler_config(index, p["config"])
+
+    # ------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> bytes:
+        """Serialize the full store (reference nomadFSM.Snapshot →
+        nomadSnapshot.Persist, nomad/fsm.go)."""
+        s = self.store
+        with s._lock:
+            data = {
+                "latest_index": s.latest_index,
+                "nodes": list(s._nodes.values()),
+                "jobs": dict(s._jobs),
+                "job_versions": {k: list(v) for k, v in s._job_versions.items()},
+                "evals": list(s._evals.values()),
+                "allocs": list(s._allocs.values()),
+                "deployments": list(s._deployments.values()),
+                "job_summaries": dict(s._job_summaries),
+                "scheduler_config": s.scheduler_config,
+                "extra": {name: fn() for name, fn in
+                          getattr(self, "snapshot_extra", {}).items()},
+            }
+        return pickle.dumps(data)
+
+    def restore(self, blob: bytes) -> None:
+        """Rebuild the store from a snapshot (reference nomadFSM.Restore).
+        Indexes, summaries and the dense ClusterMatrix are all restored."""
+        from nomad_tpu.encode import ClusterMatrix
+
+        data = pickle.loads(blob)
+        s = self.store
+        with s._lock:
+            s._nodes = {n.id: n for n in data["nodes"]}
+            s._jobs = dict(data["jobs"])
+            s._job_versions = defaultdict(list)
+            for k, v in data["job_versions"].items():
+                s._job_versions[k] = list(v)
+            s._evals = {e.id: e for e in data["evals"]}
+            s._allocs = {}
+            s._allocs_by_job = defaultdict(set)
+            s._allocs_by_node = defaultdict(set)
+            s._allocs_by_eval = defaultdict(set)
+            s._evals_by_job = defaultdict(set)
+            for e in data["evals"]:
+                s._evals_by_job[(e.namespace, e.job_id)].add(e.id)
+            s._deployments = {d.id: d for d in data["deployments"]}
+            s._job_summaries = dict(data["job_summaries"])
+            s.scheduler_config = data["scheduler_config"]
+            s.matrix = ClusterMatrix()
+            for n in data["nodes"]:
+                s.matrix.upsert_node(n)
+            for a in data["allocs"]:
+                s._allocs[a.id] = a
+                s._allocs_by_job[(a.namespace, a.job_id)].add(a.id)
+                s._allocs_by_node[a.node_id].add(a.id)
+                s._allocs_by_eval[a.eval_id].add(a.id)
+                s.matrix.upsert_alloc(a)
+            s.latest_index = data["latest_index"]
+            s._snapshot_cache = None
+            s._index_cv.notify_all()
+        for name, blob_extra in data.get("extra", {}).items():
+            fn = getattr(self, "restore_extra", {}).get(name)
+            if fn is not None:
+                fn(blob_extra)

@@ -1,0 +1,128 @@
+"""Raft log store (reference: raft-boltdb log store + raftInmem,
+nomad/server.go:107-111).
+
+In-memory list of entries with an optional append-only file behind it so a
+restarted server replays its log from disk (the BoltDB store's job in the
+reference).  Entries before `first_index` have been compacted into a
+snapshot.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import List, Optional
+
+
+class LogEntry:
+    __slots__ = ("index", "term", "msg_type", "payload")
+
+    def __init__(self, index: int, term: int, msg_type: str, payload):
+        self.index = index
+        self.term = term
+        self.msg_type = msg_type
+        self.payload = payload
+
+    def __repr__(self):
+        return f"<LogEntry {self.index} t{self.term} {self.msg_type}>"
+
+
+class LogStore:
+    def __init__(self, path: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._entries: List[LogEntry] = []
+        self.first_index = 1           # index of _entries[0] if any
+        self.path = path
+        self._fh = None
+        if path:
+            self._load(path)
+            self._fh = open(path, "ab")
+
+    # ------------------------------------------------------------- disk
+
+    def _load(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as fh:
+            while True:
+                try:
+                    rec = pickle.load(fh)
+                except EOFError:
+                    break
+                if rec[0] == "entry":
+                    _, index, term, msg_type, payload = rec
+                    self._truncate_from(index)
+                    self._entries.append(LogEntry(index, term, msg_type, payload))
+                elif rec[0] == "compact":
+                    self._compact_to(rec[1])
+
+    def _persist(self, e: LogEntry) -> None:
+        if self._fh is not None:
+            pickle.dump(("entry", e.index, e.term, e.msg_type, e.payload),
+                        self._fh)
+            self._fh.flush()
+
+    # ------------------------------------------------------------- core
+
+    def _truncate_from(self, index: int) -> None:
+        """Drop entries at >= index (conflict resolution)."""
+        keep = index - self.first_index
+        if keep < len(self._entries):
+            del self._entries[max(keep, 0):]
+
+    def _compact_to(self, index: int) -> None:
+        drop = index - self.first_index + 1
+        if drop > 0:
+            del self._entries[:drop]
+            self.first_index = index + 1
+
+    def append(self, e: LogEntry) -> None:
+        with self._lock:
+            self._truncate_from(e.index)
+            if not self._entries:
+                self.first_index = e.index
+            self._entries.append(e)
+            self._persist(e)
+
+    def get(self, index: int) -> Optional[LogEntry]:
+        with self._lock:
+            i = index - self.first_index
+            if 0 <= i < len(self._entries):
+                return self._entries[i]
+            return None
+
+    def entries_from(self, index: int, limit: int = 64) -> List[LogEntry]:
+        with self._lock:
+            i = index - self.first_index
+            if i < 0:
+                return []          # compacted away: caller must snapshot
+            return self._entries[i:i + limit]
+
+    def term_at(self, index: int) -> int:
+        e = self.get(index)
+        return e.term if e is not None else 0
+
+    @property
+    def last_index(self) -> int:
+        with self._lock:
+            if not self._entries:
+                return self.first_index - 1
+            return self._entries[-1].index
+
+    @property
+    def last_term(self) -> int:
+        with self._lock:
+            return self._entries[-1].term if self._entries else 0
+
+    def compact(self, through_index: int) -> None:
+        """Discard entries ≤ through_index (they live in a snapshot now)."""
+        with self._lock:
+            self._compact_to(through_index)
+            if self._fh is not None:
+                pickle.dump(("compact", through_index), self._fh)
+                self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

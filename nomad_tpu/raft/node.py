@@ -1,0 +1,487 @@
+"""Raft consensus node (reference: vendored hashicorp/raft as wired in
+nomad/server.go:107-111 — elections, log replication, commit, snapshot
+install, log compaction).
+
+A compact, threaded Raft: follower/candidate/leader states with randomized
+election timeouts, AppendEntries consistency checks, majority commit, an
+apply loop feeding the NomadFSM, and InstallSnapshot for followers that
+fell behind a compaction.  Designed for in-process clusters over
+InMemTransport (the reference's raftInmem test mode) — the production
+transport boundary is the same `call(dst, method, args)` surface.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import queue
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from nomad_tpu.raft.log import LogEntry, LogStore
+from nomad_tpu.raft.snapshot import FileSnapshotStore
+from nomad_tpu.raft.transport import InMemTransport, Unreachable
+
+log = logging.getLogger(__name__)
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader: Optional[str] = None):
+        super().__init__(f"not the leader (leader={leader})")
+        self.leader = leader
+
+
+class RaftConfig:
+    def __init__(self,
+                 heartbeat_interval: float = 0.05,
+                 election_timeout: float = 0.2,
+                 snapshot_threshold: int = 2048,
+                 max_append_entries: int = 128):
+        self.heartbeat_interval = heartbeat_interval
+        self.election_timeout = election_timeout
+        self.snapshot_threshold = snapshot_threshold
+        self.max_append_entries = max_append_entries
+
+
+class RaftNode:
+    def __init__(self, name: str, peers: List[str],
+                 transport: InMemTransport, fsm,
+                 config: Optional[RaftConfig] = None,
+                 log_store: Optional[LogStore] = None,
+                 snapshots: Optional[FileSnapshotStore] = None,
+                 on_leader: Optional[Callable[[], None]] = None,
+                 on_follower: Optional[Callable[[], None]] = None):
+        self.name = name
+        self.peers = [p for p in peers if p != name]
+        self.transport = transport
+        self.fsm = fsm
+        self.config = config or RaftConfig()
+        self.log = log_store or LogStore()
+        self.snapshots = snapshots
+        self.on_leader = on_leader
+        self.on_follower = on_follower
+
+        self._lock = threading.RLock()
+        self.state = FOLLOWER
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.leader_id: Optional[str] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self._last_snapshot_index = 0
+        self._next_index: Dict[str, int] = {}
+        self._match_index: Dict[str, int] = {}
+        self._futures: Dict[int, concurrent.futures.Future] = {}
+        self._last_contact = time.monotonic()
+        self._stop = threading.Event()
+        self._apply_cv = threading.Condition(self._lock)
+        self._fsm_lock = threading.Lock()   # serializes fsm.apply/restore
+        # leadership transitions execute strictly in order through one
+        # dispatcher thread (an unordered establish/revoke pair would leave
+        # a follower running leader-only subsystems)
+        self._leadership_q: "queue.Queue[str]" = queue.Queue()
+        self._threads: List[threading.Thread] = []
+
+        # restart recovery: restore the snapshot (committed state only).
+        # The persisted log tail is NOT replayed into the FSM here — those
+        # entries may be uncommitted and could be truncated by a new
+        # leader; they apply normally once a leader advances commit_index
+        # (its post-election no-op commits the whole prefix).
+        if self.snapshots is not None:
+            latest = self.snapshots.latest()
+            if latest is not None:
+                idx, term, blob = latest
+                self.fsm.restore(blob)
+                self.last_applied = idx
+                self.commit_index = idx
+                self._last_snapshot_index = idx
+                self._last_snap_term = term
+
+        transport.register(name, self._handle_rpc)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        for target, nm in ((self._run_ticker, "raft-tick"),
+                           (self._run_apply, "raft-apply"),
+                           (self._run_leadership, "raft-leadership")):
+            t = threading.Thread(target=target,
+                                 name=f"{nm}-{self.name}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._apply_cv:
+            self._apply_cv.notify_all()
+        self.transport.deregister(self.name)
+        for t in self._threads:
+            t.join(1.0)
+        self.log.close()
+
+    # ------------------------------------------------------------- public
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def apply(self, msg_type: str, payload,
+              timeout: float = 10.0) -> int:
+        """Append + replicate + commit + FSM-apply one entry; returns its
+        log index (reference raft.Apply)."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            index = self.log.last_index + 1
+            entry = LogEntry(index, self.term, msg_type, payload)
+            self.log.append(entry)
+            self._match_index[self.name] = index
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            self._futures[index] = fut
+            if not self.peers:        # single-voter cluster commits locally
+                self._advance_commit()
+        self._replicate_all()
+        fut.result(timeout=timeout)
+        return index
+
+    def barrier(self, timeout: float = 10.0) -> None:
+        """Wait until everything committed so far is applied locally."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self.last_applied >= self.commit_index:
+                    return
+            time.sleep(0.005)
+
+    # ------------------------------------------------------------- ticker
+
+    def _election_deadline(self) -> float:
+        to = self.config.election_timeout
+        return self._last_contact + to + random.uniform(0, to)
+
+    def _run_ticker(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                state = self.state
+            if state == LEADER:
+                self._replicate_all(heartbeat=True)
+                self._maybe_compact()
+                self._stop.wait(self.config.heartbeat_interval)
+            else:
+                if time.monotonic() >= self._election_deadline():
+                    self._run_election()
+                else:
+                    self._stop.wait(self.config.heartbeat_interval / 2)
+
+    # ------------------------------------------------------------- election
+
+    def _run_election(self) -> None:
+        with self._lock:
+            self.state = CANDIDATE
+            self.term += 1
+            term = self.term
+            self.voted_for = self.name
+            self.leader_id = None
+            self._last_contact = time.monotonic()
+            last_index = self.log.last_index
+            last_term = self.log.last_term or self._snapshot_term()
+        votes = 1
+        for peer in self.peers:
+            try:
+                resp = self.transport.call(self.name, peer, "request_vote", {
+                    "term": term, "candidate": self.name,
+                    "last_log_index": last_index, "last_log_term": last_term})
+            except Unreachable:
+                continue
+            with self._lock:
+                if resp["term"] > self.term:
+                    self._step_down(resp["term"])
+                    return
+            if resp.get("granted"):
+                votes += 1
+        with self._lock:
+            if self.state != CANDIDATE or self.term != term:
+                return
+            if votes * 2 > len(self.peers) + 1:
+                self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.name
+        # commit a no-op in the new term so prior-term entries become
+        # committable immediately (hashicorp/raft's LogNoop on election)
+        nxt = self.log.last_index + 1
+        self.log.append(LogEntry(nxt, self.term, "Noop", None))
+        for p in self.peers:
+            self._next_index[p] = nxt
+            self._match_index[p] = 0
+        self._match_index[self.name] = self.log.last_index
+        if not self.peers:
+            self._advance_commit()
+        log.info("raft: %s became leader (term %d)", self.name, self.term)
+        self._leadership_q.put("leader")
+
+    def _step_down(self, term: int) -> None:
+        was_leader = self.state == LEADER
+        self.state = FOLLOWER
+        self.term = term
+        self.voted_for = None
+        self._last_contact = time.monotonic()
+        if was_leader:
+            for fut in self._futures.values():
+                if not fut.done():
+                    fut.set_exception(NotLeaderError(self.leader_id))
+            self._futures.clear()
+            self._leadership_q.put("follower")
+
+    def _run_leadership(self) -> None:
+        """Ordered establish/revoke dispatcher (the reference's leaderLoop
+        consuming raft.LeaderCh, nomad/leader.go:66-120)."""
+        while not self._stop.is_set():
+            try:
+                evt = self._leadership_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                if evt == "leader" and self.on_leader is not None:
+                    self.on_leader()
+                elif evt == "follower" and self.on_follower is not None:
+                    self.on_follower()
+            except Exception:                       # noqa: BLE001
+                log.exception("leadership transition failed")
+
+    def _snapshot_term(self) -> int:
+        return 0
+
+    # ------------------------------------------------------------- replicate
+
+    def _replicate_all(self, heartbeat: bool = False) -> None:
+        for peer in self.peers:
+            try:
+                self._replicate_one(peer)
+            except Unreachable:
+                continue
+
+    def _replicate_one(self, peer: str) -> None:
+        with self._lock:
+            if self.state != LEADER:
+                return
+            term = self.term
+            nxt = self._next_index.get(peer, self.log.last_index + 1)
+            if nxt < self.log.first_index and self.snapshots is not None:
+                self._send_snapshot(peer)
+                return
+            prev_index = nxt - 1
+            prev_term = self.log.term_at(prev_index)
+            if prev_index > 0 and prev_term == 0 \
+                    and prev_index == self._last_snapshot_index:
+                prev_term = self._last_snap_term
+            entries = self.log.entries_from(
+                nxt, self.config.max_append_entries)
+            commit = self.commit_index
+        resp = self.transport.call(self.name, peer, "append_entries", {
+            "term": term, "leader": self.name,
+            "prev_log_index": prev_index, "prev_log_term": prev_term,
+            "entries": [(e.index, e.term, e.msg_type, e.payload)
+                        for e in entries],
+            "leader_commit": commit})
+        with self._lock:
+            if resp["term"] > self.term:
+                self._step_down(resp["term"])
+                return
+            if self.state != LEADER or self.term != term:
+                return
+            if resp.get("success"):
+                if entries:
+                    self._match_index[peer] = entries[-1].index
+                    self._next_index[peer] = entries[-1].index + 1
+                self._advance_commit()
+            else:
+                # consistency check failed: back off
+                self._next_index[peer] = max(
+                    1, min(nxt - 1, resp.get("last_index", nxt - 1) + 1))
+
+    _last_snap_term = 0
+
+    def _send_snapshot(self, peer: str) -> None:
+        idx = self._last_snapshot_index
+        latest = self.snapshots.latest() if self.snapshots else None
+        if latest is None:
+            return
+        s_idx, s_term, blob = latest
+        resp = self.transport.call(self.name, peer, "install_snapshot", {
+            "term": self.term, "leader": self.name,
+            "last_index": s_idx, "last_term": s_term, "data": blob})
+        with self._lock:
+            if resp["term"] > self.term:
+                self._step_down(resp["term"])
+                return
+            self._next_index[peer] = s_idx + 1
+            self._match_index[peer] = s_idx
+
+    def _advance_commit(self) -> None:
+        """Majority match ⇒ commit (current-term entries only)."""
+        matches = sorted(self._match_index.get(p, 0)
+                         for p in self.peers + [self.name])
+        majority = matches[len(matches) // 2]
+        if majority > self.commit_index \
+                and self.log.term_at(majority) == self.term:
+            self.commit_index = majority
+            self._apply_cv.notify_all()
+
+    # ------------------------------------------------------------- apply
+
+    def _run_apply(self) -> None:
+        """One entry at a time: re-check state under the lock every step so
+        a concurrently installed snapshot (which moves last_applied
+        forward and compacts the log) can never be undone or spun on."""
+        while not self._stop.is_set():
+            with self._apply_cv:
+                while self.last_applied >= self.commit_index \
+                        and not self._stop.is_set():
+                    self._apply_cv.wait(0.1)
+                if self._stop.is_set():
+                    return
+                i = self.last_applied + 1
+                e = self.log.get(i)
+                if e is None:
+                    if i <= self._last_snapshot_index:
+                        # compacted: the snapshot already covers it
+                        self.last_applied = i
+                        continue
+                    # not replicated yet; wait for it
+                    self._apply_cv.wait(0.05)
+                    continue
+            with self._fsm_lock:
+                with self._lock:
+                    if i <= self.last_applied:   # snapshot raced us
+                        continue
+                try:
+                    self.fsm.apply(e.index, e.msg_type, e.payload)
+                    err = None
+                except Exception as exc:           # noqa: BLE001
+                    log.exception("fsm apply failed at %d", e.index)
+                    err = exc
+                with self._lock:
+                    self.last_applied = max(self.last_applied, i)
+                    fut = self._futures.pop(i, None)
+            if fut is not None and not fut.done():
+                if err is None:
+                    fut.set_result(i)
+                else:
+                    fut.set_exception(err)
+
+    # ------------------------------------------------------------- compaction
+
+    def _maybe_compact(self) -> None:
+        if self.snapshots is None:
+            return
+        with self._lock:
+            if self.last_applied - self._last_snapshot_index \
+                    < self.config.snapshot_threshold:
+                return
+        self.force_snapshot()
+
+    def force_snapshot(self) -> None:
+        """Operator snapshot save (command/raft_tools analogue).  Holds the
+        FSM lock so the blob is exactly the state at `last_applied` — a
+        concurrent apply landing mid-snapshot would make restart replay
+        non-idempotent entries (e.g. job version bumps) twice."""
+        if self.snapshots is None:
+            return
+        with self._fsm_lock:
+            with self._lock:
+                applied = self.last_applied
+                term = self.log.term_at(applied) or self._last_snap_term \
+                    or self.term
+            blob = self.fsm.snapshot()
+        with self._lock:
+            self.snapshots.save(applied, term, blob)
+            self._last_snapshot_index = applied
+            self._last_snap_term = term
+            self.log.compact(applied)
+
+    # ------------------------------------------------------------- RPC
+
+    def _handle_rpc(self, method: str, args: dict) -> dict:
+        if method == "request_vote":
+            return self._on_request_vote(args)
+        if method == "append_entries":
+            return self._on_append_entries(args)
+        if method == "install_snapshot":
+            return self._on_install_snapshot(args)
+        raise ValueError(method)
+
+    def _on_request_vote(self, a: dict) -> dict:
+        with self._lock:
+            if a["term"] > self.term:
+                self._step_down(a["term"])
+            granted = False
+            if a["term"] == self.term \
+                    and self.voted_for in (None, a["candidate"]):
+                my_last_term = self.log.last_term or self._last_snap_term
+                up_to_date = (
+                    a["last_log_term"] > my_last_term
+                    or (a["last_log_term"] == my_last_term
+                        and a["last_log_index"] >= self.log.last_index))
+                if up_to_date:
+                    granted = True
+                    self.voted_for = a["candidate"]
+                    self._last_contact = time.monotonic()
+            return {"term": self.term, "granted": granted}
+
+    def _on_append_entries(self, a: dict) -> dict:
+        with self._lock:
+            if a["term"] < self.term:
+                return {"term": self.term, "success": False,
+                        "last_index": self.log.last_index}
+            if a["term"] > self.term or self.state != FOLLOWER:
+                self._step_down(a["term"])
+            self.term = a["term"]
+            self.leader_id = a["leader"]
+            self._last_contact = time.monotonic()
+            prev_index = a["prev_log_index"]
+            if prev_index > 0:
+                local_term = self.log.term_at(prev_index)
+                if local_term == 0 and prev_index == self._last_snapshot_index:
+                    local_term = self._last_snap_term
+                if local_term != a["prev_log_term"] \
+                        and prev_index > self._last_snapshot_index:
+                    return {"term": self.term, "success": False,
+                            "last_index": min(self.log.last_index,
+                                              prev_index - 1)}
+            for (idx, term, msg_type, payload) in a["entries"]:
+                existing = self.log.get(idx)
+                if existing is not None and existing.term == term:
+                    continue
+                self.log.append(LogEntry(idx, term, msg_type, payload))
+            if a["leader_commit"] > self.commit_index:
+                self.commit_index = min(a["leader_commit"],
+                                        self.log.last_index)
+                self._apply_cv.notify_all()
+            return {"term": self.term, "success": True,
+                    "last_index": self.log.last_index}
+
+    def _on_install_snapshot(self, a: dict) -> dict:
+        with self._lock:
+            if a["term"] < self.term:
+                return {"term": self.term}
+            self.term = a["term"]
+            self.leader_id = a["leader"]
+            self._last_contact = time.monotonic()
+        with self._fsm_lock:
+            self.fsm.restore(a["data"])
+        with self._lock:
+            self._last_snapshot_index = a["last_index"]
+            self._last_snap_term = a["last_term"]
+            self.log.compact(a["last_index"])
+            self.last_applied = max(self.last_applied, a["last_index"])
+            self.commit_index = max(self.commit_index, a["last_index"])
+            if self.snapshots is not None:
+                self.snapshots.save(a["last_index"], a["last_term"],
+                                    a["data"])
+            return {"term": self.term}

@@ -1,0 +1,48 @@
+"""Snapshot store (reference: raft snapshots + `nomad operator snapshot
+save/restore`, helper/snapshot/ and command/raft_tools/).
+
+Snapshots are (term, index, fsm blob) files in a directory; `latest()`
+returns the newest for restart/restore, old snapshots are reaped keeping
+`retain`.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import threading
+from typing import Optional, Tuple
+
+
+class FileSnapshotStore:
+    def __init__(self, directory: str, retain: int = 2):
+        self.dir = directory
+        self.retain = retain
+        self._lock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+
+    def save(self, index: int, term: int, blob: bytes) -> str:
+        with self._lock:
+            name = f"snapshot-{term:010d}-{index:012d}.snap"
+            path = os.path.join(self.dir, name)
+            fd, tmp = tempfile.mkstemp(dir=self.dir)
+            with os.fdopen(fd, "wb") as fh:
+                pickle.dump({"index": index, "term": term, "data": blob}, fh)
+            os.replace(tmp, path)
+            self._reap()
+            return path
+
+    def _reap(self) -> None:
+        snaps = sorted(f for f in os.listdir(self.dir) if f.endswith(".snap"))
+        for old in snaps[:-self.retain] if self.retain else []:
+            os.unlink(os.path.join(self.dir, old))
+
+    def latest(self) -> Optional[Tuple[int, int, bytes]]:
+        with self._lock:
+            snaps = sorted(f for f in os.listdir(self.dir)
+                           if f.endswith(".snap"))
+            if not snaps:
+                return None
+            with open(os.path.join(self.dir, snaps[-1]), "rb") as fh:
+                rec = pickle.load(fh)
+            return rec["index"], rec["term"], rec["data"]

@@ -1,0 +1,63 @@
+"""In-memory Raft transport (reference: raftInmem / the TCP raftLayer,
+nomad/raft_rpc.go — here an in-process registry so multi-server clusters
+boot without real sockets, exactly like nomad.TestServer's in-memory Raft,
+nomad/testing.go:41-47).
+
+Payloads are pickle round-tripped so servers never share mutable structs —
+the same isolation a real wire gives.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Callable, Dict, Set
+
+
+class Unreachable(Exception):
+    pass
+
+
+class InMemTransport:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handlers: Dict[str, Callable[[str, dict], dict]] = {}
+        self._down: Set[str] = set()
+        self._partitions: Dict[str, Set[str]] = {}
+
+    def register(self, name: str, handler: Callable[[str, dict], dict]) -> None:
+        with self._lock:
+            self._handlers[name] = handler
+
+    def deregister(self, name: str) -> None:
+        with self._lock:
+            self._handlers.pop(name, None)
+
+    # --- fault injection -------------------------------------------------
+
+    def set_down(self, name: str, down: bool = True) -> None:
+        with self._lock:
+            (self._down.add if down else self._down.discard)(name)
+
+    def partition(self, a: str, b: str, cut: bool = True) -> None:
+        """Cut (or heal) the link between two members."""
+        with self._lock:
+            if cut:
+                self._partitions.setdefault(a, set()).add(b)
+                self._partitions.setdefault(b, set()).add(a)
+            else:
+                self._partitions.get(a, set()).discard(b)
+                self._partitions.get(b, set()).discard(a)
+
+    # --- RPC -------------------------------------------------------------
+
+    def call(self, src: str, dst: str, method: str, args: dict) -> dict:
+        with self._lock:
+            handler = self._handlers.get(dst)
+            blocked = (dst in self._down or src in self._down
+                       or dst in self._partitions.get(src, ()))
+        if handler is None or blocked:
+            raise Unreachable(f"{src}->{dst}")
+        # wire round-trip: no shared mutable state between servers
+        args = pickle.loads(pickle.dumps(args))
+        out = handler(method, args)
+        return pickle.loads(pickle.dumps(out))

@@ -493,6 +493,11 @@ class StateStore:
             self._bump(index)
         self._notify("deployments", d)
 
+    def delete_deployment(self, index: int, deployment_id: str) -> None:
+        with self._lock:
+            self._deployments.pop(deployment_id, None)
+            self._bump(index)
+
     def deployment_by_id(self, deployment_id: str) -> Optional[Deployment]:
         with self._lock:
             return self._deployments.get(deployment_id)
