@@ -37,6 +37,7 @@ from nomad_tpu.raft import (
     LogStore,
     MessageType,
     NomadFSM,
+    NotLeaderError,
     RaftNode,
 )
 from nomad_tpu.state import StateStore
@@ -78,6 +79,7 @@ class Server:
         self.plan_queue = PlanQueue()
         self.applier = PlanApplier(self.store, commit_fn=self._commit_plan)
         self.workers: List[Worker] = []
+        self.remote_workers: List[Worker] = []
         self._raft_lock = threading.Lock()     # serializes indexed writes
         self._stop = threading.Event()
         self._leader_stop = threading.Event()
@@ -98,7 +100,11 @@ class Server:
 
         self.fsm = NomadFSM(self.store, hooks=self)
         self.raft: Optional[RaftNode] = None
+        self._transport = raft_transport
+        from nomad_tpu.rpc.endpoints import Endpoints
+        self.endpoints = Endpoints(self)
         if raft_transport is not None:
+            raft_transport.register(f"rpc:{name}", self.endpoints.handle)
             data_dir = self.config.data_dir
             log_store = snapshots = None
             if data_dir:
@@ -117,13 +123,38 @@ class Server:
     def apply(self, msg_type: str, payload: dict) -> int:
         """The single write path: a (type, payload) log entry applied via
         the FSM — through Raft when clustered, directly in dev mode
-        (reference raft.Apply → nomadFSM.Apply)."""
+        (reference raft.Apply → nomadFSM.Apply).  On a follower the write
+        forwards to the leader over RPC (reference forwardLeader,
+        nomad/rpc.go)."""
+        try:
+            return self.apply_local(msg_type, payload)
+        except NotLeaderError:
+            return self.rpc_leader("Raft.Apply",
+                                   {"msg_type": msg_type, "payload": payload})
+
+    def apply_local(self, msg_type: str, payload: dict) -> int:
+        """Apply on THIS server (no forwarding) — the Raft.Apply endpoint
+        target; raises NotLeaderError if a follower is asked directly."""
         if self.raft is not None:
             return self.raft.apply(msg_type, payload)
         with self._raft_lock:
             index = self.store.latest_index + 1
             self.fsm.apply(index, msg_type, payload)
             return index
+
+    def rpc_leader(self, method: str, args: dict):
+        """Invoke an RPC on the leader: short-circuits locally when this
+        server is the leader (or in dev mode), else rides the transport
+        (reference: rpc.go forward + helper/pool)."""
+        if self.raft is None or self.raft.is_leader:
+            return self.endpoints.handle(method, args)
+        leader = self.raft.leader_id
+        if leader is None or leader == self.name or self._transport is None:
+            # leader == self.name while not is_leader = stale self-pointer
+            # during a transition; forwarding would recurse into ourselves
+            from nomad_tpu.rpc.endpoints import RpcError
+            raise RpcError("no_leader", "no cluster leader")
+        return self._transport.call(self.name, f"rpc:{leader}", method, args)
 
     def _commit_plan(self, applied) -> int:
         return self.apply(MessageType.APPLY_PLAN_RESULTS,
@@ -137,6 +168,14 @@ class Server:
 
     def start(self) -> None:
         if self.raft is not None:
+            # every server runs schedulers against its replicated snapshot,
+            # RPCing the leader for dequeue/ack/plan-submit (reference:
+            # workers run on all servers, nomad/worker.go:81-85)
+            from nomad_tpu.core.worker import RemoteWorker
+            for i in range(self.config.num_schedulers):
+                w = RemoteWorker(self, i, self.config.enabled_schedulers)
+                w.start()
+                self.remote_workers.append(w)
             self.raft.start()
         else:
             self._establish_leadership()
@@ -157,16 +196,25 @@ class Server:
                 target=self.applier.run_loop, args=(self.plan_queue, stop),
                 name="plan-apply", daemon=True)
             self._plan_thread.start()
-            for i in range(self.config.num_schedulers):
-                w = Worker(self, i, self.config.enabled_schedulers)
-                w.start()
-                self.workers.append(w)
+            if self.raft is None:
+                # dev mode: local workers; in cluster mode RemoteWorkers
+                # already run on every member (started in start())
+                for i in range(self.config.num_schedulers):
+                    w = Worker(self, i, self.config.enabled_schedulers)
+                    w.start()
+                    self.workers.append(w)
             self._restore_evals()
             t = threading.Thread(target=self._failed_eval_reaper,
                                  args=(stop,), name="eval-reaper", daemon=True)
             t.start()
             self._threads.append(t)
             self.heartbeats.start()
+            # initializeHeartbeatTimers (leader.go:347): nodes registered
+            # under a previous leader get timers on the new one, so a node
+            # that died around the failover still expires
+            for node in self.store.nodes():
+                if not node.terminal_status():
+                    self.heartbeats.heartbeat(node.id)
             self.deployment_watcher.start()
             self.drainer.start()
             self.periodic.start()
@@ -201,7 +249,12 @@ class Server:
 
     def stop(self) -> None:
         self._stop.set()
+        for w in self.remote_workers:
+            w.stop()
         self._revoke_leadership()
+        for w in self.remote_workers:
+            w.join(1.0)
+        self.remote_workers = []
         if self.raft is not None:
             self.raft.stop()
 
@@ -307,7 +360,17 @@ class Server:
 
     def register_job(self, job: Job) -> Evaluation:
         """Job.Register (nomad/job_endpoint.go:81): upsert + eval."""
-        self.apply(MessageType.JOB_REGISTER, {"job": job})
+        index = self.apply(MessageType.JOB_REGISTER, {"job": job})
+        # when the write was forwarded, the leader mutated a pickled copy;
+        # pull the committed indexes back onto the caller's object so the
+        # eval (and the RPC response) carries the real job_modify_index
+        self.store.wait_for_index(index)
+        stored = self.store.job_by_id(job.namespace, job.id)
+        if stored is not None:
+            job.create_index = stored.create_index
+            job.modify_index = stored.modify_index
+            job.job_modify_index = stored.job_modify_index
+            job.version = stored.version
         ev = Evaluation(
             namespace=job.namespace, priority=job.priority, type=job.type,
             job_id=job.id, triggered_by=EvalTrigger.JOB_REGISTER,
@@ -339,15 +402,19 @@ class Server:
                     "version": version, "stable": stable})
 
     def register_node(self, node: Node) -> None:
-        """Node.Register (nomad/node_endpoint.go:79)."""
+        """Node.Register (nomad/node_endpoint.go:79).  The leader's FSM
+        hook starts the TTL timer."""
         self.apply(MessageType.NODE_REGISTER, {"node": node})
-        if self.leader:
-            self.heartbeats.heartbeat(node.id)
 
     def node_heartbeat(self, node_id: str) -> float:
         """Node.UpdateStatus heartbeat path: reset TTL; a down node
         re-heartbeating is brought back to ready (init->ready handled by
-        client re-registration)."""
+        client re-registration).  TTL timers are leader-local soft state,
+        so follower-received heartbeats forward (heartbeat.go:56)."""
+        if self.raft is not None and not self.raft.is_leader:
+            resp = self.rpc_leader("Node.UpdateStatus",
+                                   {"node_id": node_id, "heartbeat": True})
+            return resp["heartbeat_ttl"]
         node = self.store.node_by_id(node_id)
         if node is not None and node.status in ("down", "disconnected"):
             self.update_node_status(node_id, "ready")

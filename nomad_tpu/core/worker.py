@@ -15,11 +15,17 @@ from typing import List, Optional
 
 from nomad_tpu.core.plan_queue import LeadershipLostError
 from nomad_tpu.raft import NotLeaderError
+from nomad_tpu.raft.transport import Unreachable
+from nomad_tpu.rpc.endpoints import RpcError
 from nomad_tpu.scheduler import factory
 from nomad_tpu.structs import Evaluation, EvalStatus
 from nomad_tpu.structs.plan import Plan, PlanResult
 
 log = logging.getLogger(__name__)
+
+# transient cluster errors: the eval should be redelivered, not failed
+TRANSIENT_ERRORS = (NotLeaderError, LeadershipLostError, RpcError,
+                    Unreachable)
 
 
 class Worker:
@@ -50,16 +56,37 @@ class Worker:
 
     def run(self) -> None:
         while not self._stop.is_set():
-            ev, token = self.server.broker.dequeue(
-                self.enabled_schedulers, timeout=0.1)
-            if ev is None:
+            got = self._dequeue()
+            if got is None:
                 continue
+            ev, token = got
             try:
                 self.process_eval(ev, token)
-            except (NotLeaderError, LeadershipLostError):
+            except TRANSIENT_ERRORS:
                 # leadership moved mid-eval (reference: the worker's RPCs
-                # start failing and the eval is nacked for redelivery)
-                self.server.broker.nack(ev.id, token)
+                # start failing and the eval is nacked for redelivery);
+                # nack best-effort — the lease expires server-side anyway
+                try:
+                    self._nack(ev.id, token)
+                except TRANSIENT_ERRORS:
+                    pass
+            except Exception:                       # noqa: BLE001
+                # never let the worker thread die (reference workers live
+                # for the life of the server, worker.go:386)
+                log.exception("worker %s: unhandled error", self.id)
+
+    # -- broker ops, overridable for the RPC path (RemoteWorker)
+
+    def _dequeue(self):
+        ev, token = self.server.broker.dequeue(
+            self.enabled_schedulers, timeout=0.1)
+        return None if ev is None else (ev, token)
+
+    def _ack(self, eval_id: str, token: str) -> bool:
+        return self.server.broker.ack(eval_id, token)
+
+    def _nack(self, eval_id: str, token: str) -> bool:
+        return self.server.broker.nack(eval_id, token)
 
     # ------------------------------------------------------------- process
 
@@ -68,7 +95,7 @@ class Worker:
         snap = server.store.snapshot_min_index(
             max(ev.modify_index, ev.snapshot_index))
         if snap is None:
-            server.broker.nack(ev.id, token)
+            self._nack(ev.id, token)
             return
         self._snapshot = snap
         self._token = token
@@ -76,19 +103,19 @@ class Worker:
         try:
             sched = factory.new_scheduler(ev.type, snap, self)
             sched.process(ev)
-        except (NotLeaderError, LeadershipLostError):
+        except TRANSIENT_ERRORS:
             raise
         except Exception as e:                      # noqa: BLE001
             log.exception("eval %s failed", ev.id)
             self.stats["failed"] += 1
             ev.status = EvalStatus.FAILED
             ev.status_description = str(e)
-            server.update_eval(ev)
-            server.broker.nack(ev.id, token)
+            server.update_eval(ev)   # raises TRANSIENT -> nacked by run()
+            self._nack(ev.id, token)
             return
         ev.status = EvalStatus.COMPLETE
         server.update_eval(ev)
-        if server.broker.ack(ev.id, token):
+        if self._ack(ev.id, token):
             self.stats["processed"] += 1
 
     # ------------------------------------------------------------- planner
@@ -111,3 +138,44 @@ class Worker:
         snap = self.server.store.snapshot_min_index(min_index)
         self._snapshot = snap
         return snap
+
+
+class RemoteWorker(Worker):
+    """Worker on any cluster member: broker and plan-queue operations RPC
+    to the leader (short-circuiting locally when this member IS the
+    leader), while scheduling reads come from the local replicated
+    snapshot — the reference's every-server worker pool (worker.go:81-85,
+    Eval.Dequeue / Plan.Submit RPCs)."""
+
+    def _rpc(self, method: str, args: dict):
+        return self.server.rpc_leader(method, args)
+
+    def _dequeue(self):
+        try:
+            resp = self._rpc("Eval.Dequeue",
+                             {"schedulers": self.enabled_schedulers,
+                              "timeout": 0.1})
+        except TRANSIENT_ERRORS:
+            self._stop.wait(0.05)
+            return None
+        if resp is None:
+            return None
+        return resp["eval"], resp["token"]
+
+    def _ack(self, eval_id: str, token: str) -> bool:
+        return self._rpc("Eval.Ack",
+                         {"eval_id": eval_id, "token": token})["ok"]
+
+    def _nack(self, eval_id: str, token: str) -> bool:
+        try:
+            return self._rpc("Eval.Nack",
+                             {"eval_id": eval_id, "token": token})["ok"]
+        except TRANSIENT_ERRORS:
+            return False   # lease expires server-side; eval redelivers
+
+    def submit_plan(self, plan: Plan) -> PlanResult:
+        plan.eval_token = getattr(self, "_token", "")
+        return self._rpc("Plan.Submit", {"plan": plan})
+
+    def reblock_eval(self, ev: Evaluation) -> None:
+        self._rpc("Eval.Reblock", {"eval": ev})

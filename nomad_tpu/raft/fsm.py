@@ -102,6 +102,11 @@ class NomadFSM:
 
     def _apply_node_register(self, index, p):
         self.store.upsert_node(index, p["node"])
+        hooks = self.hooks
+        if hooks is not None and getattr(hooks, "leader", False):
+            # TTL timers live on the leader (nomad/heartbeat.go:56); track
+            # here so registrations forwarded from followers get a timer
+            hooks.heartbeats.heartbeat(p["node"].id)
 
     def _apply_node_deregister(self, index, p):
         self.store.delete_node(index, p["node_id"])

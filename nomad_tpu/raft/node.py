@@ -230,6 +230,11 @@ class RaftNode:
         self.state = FOLLOWER
         self.term = term
         self.voted_for = None
+        if was_leader:
+            # don't advertise ourselves as leader after deposition — a
+            # stale self-pointing leader_id would make rpc_leader forward
+            # to itself in a loop until the new leader's heartbeat arrives
+            self.leader_id = None
         self._last_contact = time.monotonic()
         if was_leader:
             for fut in self._futures.values():

@@ -1,0 +1,13 @@
+"""RPC layer (reference: nomad/rpc.go — msgpack-RPC over yamux TCP with
+leader/region forwarding, plus the connection pool in helper/pool).
+
+The TPU build's host RPC is a framed-pickle protocol with the same shape:
+a method-dispatch endpoint registry (`Endpoints`), leader forwarding for
+writes issued on followers, an in-process channel riding the Raft
+InMemTransport for multi-server tests, and a real TCP server/client pair
+for out-of-process agents.
+"""
+from nomad_tpu.rpc.endpoints import Endpoints, RpcError
+from nomad_tpu.rpc.tcp import TcpRpcClient, TcpRpcServer
+
+__all__ = ["Endpoints", "RpcError", "TcpRpcServer", "TcpRpcClient"]

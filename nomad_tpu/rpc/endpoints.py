@@ -1,0 +1,257 @@
+"""RPC endpoint registry (reference: the per-struct endpoints registered in
+nomad/server.go:264+ — Job/Node/Eval/Alloc/Plan/Deployment/Operator/Status
+— with handler names like "Job.Register" nomad/job_endpoint.go:81,
+"Eval.Dequeue" eval_endpoint.go:104, "Plan.Submit" plan_endpoint.go:23).
+
+Handlers take an args dict and return plain values; writes on a follower
+raise RpcError("not_leader") carrying the leader hint so the caller can
+forward (reference: structs.ErrNoLeader / forwardLeader, nomad/rpc.go).
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Dict, Optional
+
+from nomad_tpu.raft import MessageType, NotLeaderError
+from nomad_tpu.structs import Evaluation, EvalStatus
+from nomad_tpu.structs.evaluation import EvalTrigger
+
+
+class RpcError(Exception):
+    def __init__(self, kind: str, detail: str = "", leader: Optional[str] = None):
+        super().__init__(f"{kind}: {detail}")
+        self.kind = kind
+        self.detail = detail
+        self.leader = leader
+
+
+class Endpoints:
+    def __init__(self, server):
+        self.server = server
+        self._methods: Dict[str, Callable] = {}
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                method = name[4:].replace("__", ".")
+                self._methods[method] = getattr(self, name)
+
+    # ------------------------------------------------------------- dispatch
+
+    def handle(self, method: str, args: dict):
+        fn = self._methods.get(method)
+        if fn is None:
+            raise RpcError("unknown_method", method)
+        try:
+            return fn(args or {})
+        except NotLeaderError as e:
+            raise RpcError("not_leader", leader=e.leader)
+
+    def methods(self):
+        return sorted(self._methods)
+
+    # ------------------------------------------------------------- status
+
+    def rpc_Status__Ping(self, args):
+        return {"ok": True, "server": self.server.name}
+
+    def rpc_Status__Leader(self, args):
+        s = self.server
+        if s.raft is None:
+            return s.name if s.leader else None
+        return s.raft.leader_id
+
+    def rpc_Status__Peers(self, args):
+        s = self.server
+        if s.raft is None:
+            return [s.name]
+        return [s.name] + list(s.raft.peers)
+
+    # ------------------------------------------------------------- raft
+
+    def rpc_Raft__Apply(self, args):
+        """Leader-side apply for writes forwarded from followers."""
+        return self.server.apply_local(args["msg_type"], args["payload"])
+
+    # ------------------------------------------------------------- jobs
+
+    def rpc_Job__Register(self, args):
+        ev = self.server.register_job(args["job"])
+        return {"eval_id": ev.id, "job_modify_index":
+                args["job"].job_modify_index}
+
+    def rpc_Job__Deregister(self, args):
+        ev = self.server.deregister_job(
+            args.get("namespace", "default"), args["job_id"],
+            purge=args.get("purge", False))
+        return {"eval_id": ev.id if ev else None}
+
+    def rpc_Job__GetJob(self, args):
+        return self.server.store.job_by_id(
+            args.get("namespace", "default"), args["job_id"])
+
+    def rpc_Job__List(self, args):
+        ns = args.get("namespace")
+        jobs = self.server.store.jobs()
+        if ns:
+            jobs = [j for j in jobs if j.namespace == ns]
+        return jobs
+
+    def rpc_Job__Allocations(self, args):
+        return self.server.store.allocs_by_job(
+            args.get("namespace", "default"), args["job_id"])
+
+    def rpc_Job__Evaluations(self, args):
+        return self.server.store.evals_by_job(
+            args.get("namespace", "default"), args["job_id"])
+
+    # ------------------------------------------------------------- nodes
+
+    def rpc_Node__Register(self, args):
+        self.server.register_node(args["node"])
+        return {"heartbeat_ttl": self.server.config.heartbeat_ttl}
+
+    def rpc_Node__UpdateStatus(self, args):
+        if args.get("status") == "ready" or args.get("heartbeat"):
+            ttl = self.server.node_heartbeat(args["node_id"])
+            return {"heartbeat_ttl": ttl}
+        evals = self.server.update_node_status(args["node_id"], args["status"])
+        return {"eval_ids": [e.id for e in evals]}
+
+    def rpc_Node__List(self, args):
+        return self.server.store.nodes()
+
+    def rpc_Node__GetNode(self, args):
+        return self.server.store.node_by_id(args["node_id"])
+
+    def rpc_Node__GetAllocs(self, args):
+        return self.server.store.allocs_by_node(args["node_id"])
+
+    def rpc_Node__UpdateDrain(self, args):
+        self.server.drainer.drain_node(
+            args["node_id"], deadline_s=args.get("deadline_s", 3600.0),
+            ignore_system_jobs=args.get("ignore_system_jobs", False))
+        return {}
+
+    def rpc_Node__UpdateEligibility(self, args):
+        self.server.apply(MessageType.NODE_UPDATE_ELIGIBILITY,
+                          {"node_id": args["node_id"],
+                           "eligibility": args["eligibility"]})
+        return {}
+
+    def rpc_Node__UpdateAlloc(self, args):
+        """Client pushes task/alloc state (reference Node.UpdateAlloc)."""
+        self.server.apply(MessageType.ALLOC_CLIENT_UPDATE,
+                          {"allocs": args["allocs"]})
+        return {}
+
+    def rpc_Node__Deregister(self, args):
+        self.server.apply(MessageType.NODE_DEREGISTER,
+                          {"node_id": args["node_id"]})
+        return {}
+
+    # ------------------------------------------------------------- evals
+
+    def rpc_Eval__GetEval(self, args):
+        return self.server.store.eval_by_id(args["eval_id"])
+
+    def rpc_Eval__List(self, args):
+        return self.server.store.evals()
+
+    def rpc_Eval__Dequeue(self, args):
+        """Worker dequeue with lease token (eval_endpoint.go:104); only the
+        leader's broker has evals."""
+        ev, token = self.server.broker.dequeue(
+            args["schedulers"], timeout=args.get("timeout", 0.1))
+        if ev is None:
+            return None
+        return {"eval": ev, "token": token}
+
+    def rpc_Eval__Ack(self, args):
+        return {"ok": self.server.broker.ack(args["eval_id"], args["token"])}
+
+    def rpc_Eval__Nack(self, args):
+        return {"ok": self.server.broker.nack(args["eval_id"], args["token"])}
+
+    def rpc_Eval__Update(self, args):
+        self.server.update_eval(args["eval"])
+        return {}
+
+    def rpc_Eval__Create(self, args):
+        self.server.create_evals(args["evals"])
+        return {}
+
+    def rpc_Eval__Reblock(self, args):
+        self.server.blocked_evals.block(args["eval"])
+        return {}
+
+    # ------------------------------------------------------------- allocs
+
+    def rpc_Alloc__GetAlloc(self, args):
+        return self.server.store.alloc_by_id(args["alloc_id"])
+
+    def rpc_Alloc__List(self, args):
+        return self.server.store.allocs()
+
+    def rpc_Alloc__Stop(self, args):
+        """Stop a single allocation and reschedule-evaluate its job."""
+        a = self.server.store.alloc_by_id(args["alloc_id"])
+        if a is None:
+            raise RpcError("not_found", args["alloc_id"])
+        u = a.copy()
+        u.desired_status = "stop"
+        u.desired_description = "alloc stopped by user"
+        self.server.apply(MessageType.ALLOC_UPDATE, {"allocs": [u]})
+        job = a.job or self.server.store.job_by_id(a.namespace, a.job_id)
+        ev = Evaluation(
+            namespace=a.namespace, priority=job.priority if job else 50,
+            type=job.type if job else "service", job_id=a.job_id,
+            triggered_by=EvalTrigger.ALLOC_STOP, status=EvalStatus.PENDING)
+        self.server.create_evals([ev])
+        return {"eval_id": ev.id}
+
+    # ------------------------------------------------------------- plans
+
+    def rpc_Plan__Submit(self, args):
+        """Leader-side plan submission (plan_endpoint.go:23): enqueue and
+        block for the applier's result."""
+        pending = self.server.plan_queue.enqueue(args["plan"])
+        return pending.future.result(timeout=30.0)
+
+    # ------------------------------------------------------------- deploys
+
+    def rpc_Deployment__List(self, args):
+        return self.server.store.deployments()
+
+    def rpc_Deployment__GetDeployment(self, args):
+        return self.server.store.deployment_by_id(args["deployment_id"])
+
+    def rpc_Deployment__Promote(self, args):
+        ok = self.server.deployment_watcher.promote(
+            args["deployment_id"], groups=args.get("groups"))
+        return {"ok": ok}
+
+    def rpc_Deployment__Fail(self, args):
+        return {"ok": self.server.deployment_watcher.fail(
+            args["deployment_id"])}
+
+    def rpc_Deployment__Pause(self, args):
+        return {"ok": self.server.deployment_watcher.pause(
+            args["deployment_id"], args.get("pause", True))}
+
+    # ------------------------------------------------------------- operator
+
+    def rpc_Operator__SchedulerGetConfiguration(self, args):
+        return self.server.store.scheduler_config
+
+    def rpc_Operator__SchedulerSetConfiguration(self, args):
+        self.server.apply(MessageType.SCHEDULER_CONFIG,
+                          {"config": args["config"]})
+        return {}
+
+    def rpc_Operator__SnapshotSave(self, args):
+        if self.server.raft is not None:
+            self.server.raft.force_snapshot()
+            return {"ok": True}
+        path = args.get("path")
+        if path:
+            self.server.save_snapshot(path)
+        return {"ok": True}

@@ -1,0 +1,187 @@
+"""TCP RPC transport (reference: nomad/rpc.go msgpack-RPC over yamux TCP +
+the connection pool in helper/pool; TLS wrap analog = HMAC frame auth).
+
+Framing: 4-byte big-endian length + 32-byte HMAC-SHA256 tag + pickled
+{"method", "args"} request; same framing for the {"result"} |
+{"error", "kind", "leader"} response.  Because payloads are pickled, a
+frame is only unpickled after its HMAC verifies — so a server is only
+reachable by peers holding the cluster secret.  Binding beyond loopback
+without a secret is refused.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional
+
+from nomad_tpu.rpc.endpoints import Endpoints, RpcError
+
+_HDR = struct.Struct(">I")
+_TAG_LEN = 32
+MAX_FRAME = 256 * 1024 * 1024
+_NO_SECRET = b"nomad-tpu-loopback"
+
+
+def _tag(secret: bytes, blob: bytes) -> bytes:
+    return hmac.new(secret, blob, hashlib.sha256).digest()
+
+
+def _send_frame(sock: socket.socket, obj, secret: bytes) -> None:
+    blob = pickle.dumps(obj)
+    sock.sendall(_HDR.pack(len(blob)) + _tag(secret, blob) + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket, secret: bytes):
+    (length,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if length > MAX_FRAME:
+        raise ConnectionError(f"frame too large: {length}")
+    tag = _recv_exact(sock, _TAG_LEN)
+    blob = _recv_exact(sock, length)
+    # authenticate BEFORE unpickling: pickle.loads on attacker bytes is
+    # arbitrary code execution
+    if not hmac.compare_digest(tag, _tag(secret, blob)):
+        raise ConnectionError("bad frame auth")
+    return pickle.loads(blob)
+
+
+# methods safe to transparently resend after a connection error (reads);
+# writes must not be re-executed — the server may have applied them before
+# the connection dropped
+def _is_idempotent(method: str) -> bool:
+    if method.startswith("Status."):
+        return True
+    verb = method.split(".", 1)[-1]
+    return (verb.startswith("Get") or verb.startswith("List")
+            or verb in ("Allocations", "Evaluations", "Peers",
+                        "SchedulerGetConfiguration"))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        endpoints: Endpoints = self.server.endpoints      # type: ignore
+        secret: bytes = self.server.secret                # type: ignore
+        sock = self.request
+        while True:
+            try:
+                req = _recv_frame(sock, secret)
+            except (ConnectionError, EOFError, OSError):
+                return
+            try:
+                result = endpoints.handle(req["method"], req.get("args"))
+                resp = {"result": result}
+            except RpcError as e:
+                resp = {"error": e.detail or e.kind, "kind": e.kind,
+                        "leader": e.leader}
+            except Exception as e:                         # noqa: BLE001
+                resp = {"error": str(e), "kind": "internal"}
+            try:
+                _send_frame(sock, resp, secret)
+            except OSError:
+                return
+
+
+class TcpRpcServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, endpoints: Endpoints, host: str = "127.0.0.1",
+                 port: int = 0, secret: Optional[bytes] = None):
+        if secret is None and host not in ("127.0.0.1", "localhost", "::1"):
+            raise ValueError(
+                "refusing to serve pickled RPC beyond loopback without a "
+                "cluster secret (pass secret=...)")
+        super().__init__((host, port), _Handler)
+        self.endpoints = endpoints
+        self.secret = secret or _NO_SECRET
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        return self.server_address
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="rpc-tcp", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.shutdown()
+        self.server_close()
+
+
+class TcpRpcClient:
+    """Pooled client: one persistent connection per address, redial on
+    error, leader-redirect follow via an address book (helper/pool +
+    forwardLeader in the reference)."""
+
+    def __init__(self, address, addr_book: Optional[Dict[str, tuple]] = None,
+                 timeout: float = 35.0, secret: Optional[bytes] = None):
+        self.address = tuple(address)
+        self.addr_book = addr_book or {}
+        self.timeout = timeout
+        self.secret = secret or _NO_SECRET
+        self._lock = threading.Lock()
+        self._socks: Dict[tuple, socket.socket] = {}
+
+    def _sock(self, addr) -> socket.socket:
+        s = self._socks.get(addr)
+        if s is None:
+            s = socket.create_connection(addr, timeout=self.timeout)
+            s.settimeout(self.timeout)
+            self._socks[addr] = s
+        return s
+
+    def _roundtrip(self, addr, method: str, args: dict):
+        frame = {"method": method, "args": args}
+        with self._lock:
+            try:
+                sock = self._sock(addr)
+                _send_frame(sock, frame, self.secret)
+                return _recv_frame(sock, self.secret)
+            except (ConnectionError, OSError):
+                # redial; resend only reads — a write may already have been
+                # applied server-side before the connection dropped
+                self._socks.pop(addr, None)
+                if not _is_idempotent(method):
+                    raise
+                sock = self._sock(addr)
+                _send_frame(sock, frame, self.secret)
+                return _recv_frame(sock, self.secret)
+
+    def call(self, method: str, args: Optional[dict] = None,
+             _redirects: int = 2):
+        resp = self._roundtrip(self.address, method, args or {})
+        if "error" not in resp:
+            return resp["result"]
+        if resp.get("kind") == "not_leader" and _redirects > 0:
+            leader_addr = self.addr_book.get(resp.get("leader"))
+            if leader_addr is not None:
+                resp = self._roundtrip(tuple(leader_addr), method, args or {})
+                if "error" not in resp:
+                    return resp["result"]
+        raise RpcError(resp.get("kind", "internal"), resp.get("error", ""),
+                       resp.get("leader"))
+
+    def close(self) -> None:
+        with self._lock:
+            for s in self._socks.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._socks.clear()

@@ -367,6 +367,14 @@ class StateStore:
         with self._lock:
             return self._evals.get(eval_id)
 
+    def evals(self) -> List[Evaluation]:
+        with self._lock:
+            return list(self._evals.values())
+
+    def allocs(self) -> List[Allocation]:
+        with self._lock:
+            return list(self._allocs.values())
+
     def evals_by_job(self, namespace: str, job_id: str) -> List[Evaluation]:
         with self._lock:
             return [self._evals[i]

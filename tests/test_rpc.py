@@ -1,0 +1,175 @@
+"""RPC layer tests (reference analogs: nomad/rpc_test.go leader
+forwarding, worker_test.go RPC dequeue, api client round-trips)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.cluster import Cluster
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.rpc import RpcError, TcpRpcClient, TcpRpcServer
+
+
+@pytest.fixture
+def dev_server():
+    s = Server(ServerConfig(num_schedulers=2))
+    s.start()
+    yield s
+    s.stop()
+
+
+def _wait(fn, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if fn():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+# ------------------------------------------------------------- endpoints
+
+
+def test_endpoint_job_lifecycle(dev_server):
+    s = dev_server
+    ep = s.endpoints
+    for _ in range(3):
+        ep.handle("Node.Register", {"node": mock.node()})
+    job = mock.job()
+    job.task_groups[0].count = 2
+    resp = ep.handle("Job.Register", {"job": job})
+    assert resp["eval_id"]
+    assert _wait(lambda: len(ep.handle(
+        "Job.Allocations", {"job_id": job.id})) == 2)
+    got = ep.handle("Job.GetJob", {"job_id": job.id})
+    assert got is not None and got.id == job.id
+    assert len(ep.handle("Node.List", {})) == 3
+    ev = ep.handle("Eval.GetEval", {"eval_id": resp["eval_id"]})
+    assert ev is not None
+
+    # stop one alloc; job reschedules it
+    alloc = ep.handle("Job.Allocations", {"job_id": job.id})[0]
+    ep.handle("Alloc.Stop", {"alloc_id": alloc.id})
+    assert _wait(lambda: ep.handle(
+        "Alloc.GetAlloc", {"alloc_id": alloc.id}).desired_status == "stop")
+
+    resp = ep.handle("Job.Deregister", {"job_id": job.id})
+    assert resp["eval_id"]
+    assert _wait(lambda: all(
+        a.desired_status in ("stop", "evict")
+        for a in ep.handle("Job.Allocations", {"job_id": job.id})))
+
+
+def test_endpoint_unknown_method(dev_server):
+    with pytest.raises(RpcError) as e:
+        dev_server.endpoints.handle("No.Such", {})
+    assert e.value.kind == "unknown_method"
+
+
+def test_operator_scheduler_config(dev_server):
+    ep = dev_server.endpoints
+    cfg = ep.handle("Operator.SchedulerGetConfiguration", {})
+    assert cfg.scheduler_algorithm == "binpack"
+    from nomad_tpu.structs.config import SchedulerConfiguration
+    ep.handle("Operator.SchedulerSetConfiguration",
+              {"config": SchedulerConfiguration(
+                  scheduler_algorithm="spread")})
+    cfg = ep.handle("Operator.SchedulerGetConfiguration", {})
+    assert cfg.scheduler_algorithm == "spread"
+
+
+# ------------------------------------------------------------- tcp
+
+
+def test_tcp_rpc_roundtrip(dev_server):
+    srv = TcpRpcServer(dev_server.endpoints)
+    srv.start()
+    try:
+        client = TcpRpcClient(srv.address)
+        assert client.call("Status.Ping")["ok"]
+        for _ in range(3):
+            client.call("Node.Register", {"node": mock.node()})
+        nodes = client.call("Node.List")
+        assert len(nodes) == 3
+        job = mock.job()
+        job.task_groups[0].count = 2
+        resp = client.call("Job.Register", {"job": job})
+        assert resp["eval_id"]
+        assert _wait(lambda: len(client.call(
+            "Job.Allocations", {"job_id": job.id}))
+            == job.task_groups[0].count)
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_tcp_rpc_error_surface(dev_server):
+    srv = TcpRpcServer(dev_server.endpoints)
+    srv.start()
+    try:
+        client = TcpRpcClient(srv.address)
+        with pytest.raises(RpcError):
+            client.call("No.Such", {})
+        client.close()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- cluster
+
+
+def test_follower_write_forwarding():
+    c = Cluster(3)
+    c.start()
+    try:
+        leader = c.leader()
+        follower = c.followers()[0]
+        # writes submitted on a follower forward to the leader and commit
+        for _ in range(3):
+            follower.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        follower.register_job(job)
+        assert _wait(lambda: len(
+            leader.store.allocs_by_job("default", job.id))
+            == job.task_groups[0].count)
+    finally:
+        c.stop()
+
+
+def test_remote_workers_on_followers_schedule():
+    """Only follower workers run: the leader's own scheduling is disabled,
+    so every placement must flow through RPC dequeue + plan submit."""
+    c = Cluster(3)
+    c.start()
+    try:
+        leader = c.leader()
+        for w in leader.remote_workers:
+            w.stop()
+        for _ in range(4):
+            leader.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 3
+        leader.register_job(job)
+        assert _wait(lambda: len(
+            leader.store.allocs_by_job("default", job.id)) == 3, 15)
+        # follower workers did the scheduling
+        follower_processed = sum(
+            w.stats["processed"]
+            for f in c.followers() for w in f.remote_workers)
+        assert follower_processed >= 1
+    finally:
+        c.stop()
+
+
+def test_status_endpoints_cluster():
+    c = Cluster(3)
+    c.start()
+    try:
+        leader = c.leader()
+        follower = c.followers()[0]
+        assert follower.endpoints.handle("Status.Leader", {}) == leader.name
+        peers = follower.endpoints.handle("Status.Peers", {})
+        assert len(peers) == 3
+    finally:
+        c.stop()
