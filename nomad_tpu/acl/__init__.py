@@ -1,0 +1,13 @@
+"""ACL system (reference: acl/acl.go, acl/policy.go — policy parse +
+capability checks; nomad/acl.go token resolution)."""
+from nomad_tpu.acl.policy import (
+    ACL,
+    ACLPolicy,
+    ACLToken,
+    CAPABILITIES,
+    parse_policy,
+    required_capability,
+)
+
+__all__ = ["ACL", "ACLPolicy", "ACLToken", "CAPABILITIES",
+           "parse_policy", "required_capability"]

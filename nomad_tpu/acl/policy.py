@@ -1,0 +1,244 @@
+"""ACL policies, tokens, and capability checks.
+
+Reference: acl/policy.go (policy spec: namespace rules with capability
+lists or short-form `policy = "read|write|deny"`; node/agent/operator/
+quota coarse rules) and acl/acl.go (the compiled ACL object with
+`AllowNamespaceOperation`).  Tokens: nomad/structs (ACLToken with
+management|client types) resolved in nomad/acl.go `ResolveToken`.
+
+Policies here are JSON or a minimal HCL subset, e.g.:
+
+    namespace "default" { policy = "write" }
+    namespace "ops"     { capabilities = ["submit-job", "read-job"] }
+    node    { policy = "read" }
+    agent   { policy = "write" }
+    operator { policy = "read" }
+"""
+from __future__ import annotations
+
+import re
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# namespace capabilities (acl/policy.go:17-44)
+CAP_DENY = "deny"
+CAP_LIST_JOBS = "list-jobs"
+CAP_READ_JOB = "read-job"
+CAP_SUBMIT_JOB = "submit-job"
+CAP_DISPATCH_JOB = "dispatch-job"
+CAP_READ_LOGS = "read-logs"
+CAP_READ_FS = "read-fs"
+CAP_ALLOC_EXEC = "alloc-exec"
+CAP_ALLOC_LIFECYCLE = "alloc-lifecycle"
+CAP_CSI_ACCESS = "csi-access"
+CAP_CSI_WRITE_VOLUME = "csi-write-volume"
+CAP_CSI_READ_VOLUME = "csi-read-volume"
+CAP_CSI_LIST_VOLUME = "csi-list-volume"
+CAP_CSI_MOUNT_VOLUME = "csi-mount-volume"
+CAP_LIST_SCALING_POLICIES = "list-scaling-policies"
+CAP_READ_SCALING_POLICY = "read-scaling-policy"
+CAP_READ_JOB_SCALING = "read-job-scaling"
+CAP_SCALE_JOB = "scale-job"
+
+CAPABILITIES = [
+    CAP_DENY, CAP_LIST_JOBS, CAP_READ_JOB, CAP_SUBMIT_JOB,
+    CAP_DISPATCH_JOB, CAP_READ_LOGS, CAP_READ_FS, CAP_ALLOC_EXEC,
+    CAP_ALLOC_LIFECYCLE, CAP_CSI_ACCESS, CAP_CSI_WRITE_VOLUME,
+    CAP_CSI_READ_VOLUME, CAP_CSI_LIST_VOLUME, CAP_CSI_MOUNT_VOLUME,
+    CAP_LIST_SCALING_POLICIES, CAP_READ_SCALING_POLICY,
+    CAP_READ_JOB_SCALING, CAP_SCALE_JOB,
+]
+
+# expansion of short-form `policy = "..."` (acl/policy.go:118-158)
+_POLICY_CAPS = {
+    "read": [CAP_LIST_JOBS, CAP_READ_JOB, CAP_CSI_LIST_VOLUME,
+             CAP_CSI_READ_VOLUME, CAP_READ_JOB_SCALING,
+             CAP_LIST_SCALING_POLICIES, CAP_READ_SCALING_POLICY],
+    "write": [CAP_LIST_JOBS, CAP_READ_JOB, CAP_SUBMIT_JOB,
+              CAP_DISPATCH_JOB, CAP_READ_LOGS, CAP_READ_FS,
+              CAP_ALLOC_EXEC, CAP_ALLOC_LIFECYCLE, CAP_CSI_WRITE_VOLUME,
+              CAP_CSI_MOUNT_VOLUME, CAP_CSI_LIST_VOLUME,
+              CAP_CSI_READ_VOLUME, CAP_READ_JOB_SCALING, CAP_SCALE_JOB,
+              CAP_LIST_SCALING_POLICIES, CAP_READ_SCALING_POLICY],
+    "scale": [CAP_READ_JOB_SCALING, CAP_SCALE_JOB],
+    "deny": [CAP_DENY],
+}
+
+
+@dataclass
+class NamespaceRule:
+    name: str = "default"
+    policy: str = ""
+    capabilities: List[str] = field(default_factory=list)
+
+    def expanded(self) -> List[str]:
+        caps = list(self.capabilities)
+        if self.policy:
+            caps.extend(_POLICY_CAPS.get(self.policy, []))
+        return caps
+
+
+@dataclass
+class ACLPolicy:
+    name: str = ""
+    description: str = ""
+    rules: str = ""                     # source text
+    namespaces: List[NamespaceRule] = field(default_factory=list)
+    node: str = ""                      # "" | read | write | deny
+    agent: str = ""
+    operator: str = ""
+    quota: str = ""
+    plugin: str = ""
+
+
+_BLOCK_RE = re.compile(
+    r'(namespace|host_volume)\s+"([^"]*)"\s*\{([^}]*)\}'
+    r'|(node|agent|operator|quota|plugin)\s*\{([^}]*)\}', re.S)
+_ATTR_RE = re.compile(r'(\w+)\s*=\s*("([^"]*)"|\[([^\]]*)\])')
+
+
+def parse_policy(name: str, rules: str, description: str = "") -> ACLPolicy:
+    """Parse the HCL-subset policy language (acl/policy.go Parse)."""
+    p = ACLPolicy(name=name, description=description, rules=rules)
+    for m in _BLOCK_RE.finditer(rules):
+        if m.group(1) == "namespace":
+            body = m.group(3)
+            rule = NamespaceRule(name=m.group(2))
+            for am in _ATTR_RE.finditer(body):
+                key = am.group(1)
+                if key == "policy" and am.group(3) is not None:
+                    rule.policy = am.group(3)
+                elif key == "capabilities" and am.group(4) is not None:
+                    rule.capabilities = re.findall(r'"([^"]*)"', am.group(4))
+            p.namespaces.append(rule)
+        elif m.group(4):
+            block = m.group(4)
+            pol = ""
+            for am in _ATTR_RE.finditer(m.group(5)):
+                if am.group(1) == "policy" and am.group(3) is not None:
+                    pol = am.group(3)
+            setattr(p, block, pol)
+    if not p.namespaces and not any(
+            getattr(p, b) for b in ("node", "agent", "operator")):
+        raise ValueError(f"policy {name!r}: no rules parsed")
+    return p
+
+
+@dataclass
+class ACLToken:
+    accessor_id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    secret_id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    name: str = ""
+    type: str = "client"                # "client" | "management"
+    policies: List[str] = field(default_factory=list)
+    global_: bool = False
+    create_index: int = 0
+    modify_index: int = 0
+
+
+class ACL:
+    """Compiled ACL: union of policies with deny-overrides + glob
+    namespace matching (acl/acl.go NewACL / AllowNamespaceOperation)."""
+
+    def __init__(self, management: bool = False,
+                 policies: Optional[List[ACLPolicy]] = None):
+        self.management = management
+        self._ns: Dict[str, set] = {}
+        self._coarse: Dict[str, str] = {}
+        for pol in policies or []:
+            for rule in pol.namespaces:
+                caps = self._ns.setdefault(rule.name, set())
+                expanded = rule.expanded()
+                if CAP_DENY in expanded:
+                    caps.clear()
+                    caps.add(CAP_DENY)
+                elif CAP_DENY not in caps:
+                    caps.update(expanded)
+            for block in ("node", "agent", "operator", "quota", "plugin"):
+                val = getattr(pol, block)
+                if not val:
+                    continue
+                prev = self._coarse.get(block)
+                if val == "deny" or prev == "deny":
+                    self._coarse[block] = "deny"
+                elif prev == "write" or val == "write":
+                    self._coarse[block] = "write"
+                else:
+                    self._coarse[block] = val
+
+    def _ns_caps(self, namespace: str) -> set:
+        if namespace in self._ns:
+            return self._ns[namespace]
+        # glob match, longest-prefix wins (acl.go findClosestMatchingGlob)
+        best, best_len = set(), -1
+        for pat, caps in self._ns.items():
+            if "*" in pat:
+                regex = "^" + re.escape(pat).replace(r"\*", ".*") + "$"
+                if re.match(regex, namespace) and len(pat) > best_len:
+                    best, best_len = caps, len(pat)
+        return best
+
+    def allows(self, namespace: Optional[str], capability: str) -> bool:
+        if self.management:
+            return True
+        if capability.startswith(("node:", "agent:", "operator:",
+                                  "quota:", "plugin:")):
+            block, _, level = capability.partition(":")
+            have = self._coarse.get(block, "")
+            if have == "deny":
+                return False
+            if level == "read":
+                return have in ("read", "write")
+            return have == "write"
+        caps = self._ns_caps(namespace or "default")
+        if CAP_DENY in caps:
+            return False
+        return capability in caps
+
+
+# management singleton (acl/acl.go ManagementACL)
+ACL_MANAGEMENT = ACL(management=True)
+
+
+def required_capability(parts: List[str], method: str,
+                        namespace: str = "default") \
+        -> Tuple[Optional[str], Optional[str]]:
+    """Map an HTTP route to the capability it needs (the per-endpoint
+    checks in nomad/*_endpoint.go).  Returns (capability, namespace);
+    (None, None) means anonymous-allowed (status endpoints)."""
+    write = method in ("PUT", "POST", "DELETE")
+    head = parts[0] if parts else ""
+    ns = namespace or "default"
+    if head in ("status", "metrics", "agent"):
+        return (None, None)
+    if head in ("jobs", "job"):
+        if write:
+            cap = CAP_SUBMIT_JOB
+            if len(parts) > 2 and parts[2] == "dispatch":
+                cap = CAP_DISPATCH_JOB
+            return (cap, ns)
+        return (CAP_LIST_JOBS if head == "jobs" else CAP_READ_JOB, ns)
+    if head in ("allocations", "allocation"):
+        return ((CAP_ALLOC_LIFECYCLE if write else CAP_READ_JOB), ns)
+    if head in ("evaluations", "evaluation", "deployments", "deployment"):
+        return ((CAP_SUBMIT_JOB if write else CAP_READ_JOB), ns)
+    if head in ("nodes", "node"):
+        return (f"node:{'write' if write else 'read'}", None)
+    if head == "operator":
+        return (f"operator:{'write' if write else 'read'}", None)
+    if head == "acl":
+        # bootstrap is anonymous by design; a token may always read
+        # itself; everything else is management-only
+        if parts[1:2] == ["bootstrap"]:
+            return (None, None)
+        if parts[1:3] == ["token", "self"] and not write:
+            return (None, None)
+        return ("acl:management", None)
+    if head in ("namespaces", "namespace"):
+        return (f"operator:{'write' if write else 'read'}", None)
+    if head == "search":
+        return (CAP_LIST_JOBS, ns)
+    if head == "event":
+        return (CAP_READ_JOB, ns)
+    return (f"operator:{'write' if write else 'read'}", None)

@@ -1,0 +1,6 @@
+"""Agent layer (reference: command/agent/ — the process that embeds a
+server and/or client and serves the /v1 HTTP API)."""
+from nomad_tpu.agent.agent import Agent, AgentConfig
+from nomad_tpu.agent.http import HTTPServer
+
+__all__ = ["Agent", "AgentConfig", "HTTPServer"]

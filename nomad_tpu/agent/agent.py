@@ -1,0 +1,97 @@
+"""Agent: embeds a Server and/or Client plus the HTTP API
+(reference: command/agent/agent.go — setupServer/setupClient; `-dev`
+mode runs both in one process with in-memory Raft).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from nomad_tpu.core.server import Server, ServerConfig
+
+
+@dataclass
+class AgentConfig:
+    name: str = "agent-1"
+    region: str = "global"
+    datacenter: str = "dc1"
+    server_enabled: bool = True
+    client_enabled: bool = False
+    dev_mode: bool = True
+    http_host: str = "127.0.0.1"
+    http_port: int = 4646                 # reference default port
+    num_schedulers: int = 4
+    enabled_schedulers: List[str] = field(
+        default_factory=lambda: ["service", "batch", "system", "sysbatch"])
+    heartbeat_ttl: float = 10.0
+    data_dir: Optional[str] = None
+    acl_enabled: bool = False
+    node_pool_drivers: List[str] = field(
+        default_factory=lambda: ["mock", "raw_exec"])
+
+
+class Agent:
+    """One process: server (control plane) + optional client (node agent)
+    + HTTP API.  `-dev` = both, in-memory (command/agent/command.go)."""
+
+    def __init__(self, config: Optional[AgentConfig] = None):
+        self.config = config or AgentConfig()
+        self.server: Optional[Server] = None
+        self.client = None
+        self.http: Optional["HTTPServer"] = None
+        self._lock = threading.Lock()
+
+        if self.config.server_enabled:
+            self.server = Server(
+                ServerConfig(
+                    num_schedulers=self.config.num_schedulers,
+                    enabled_schedulers=self.config.enabled_schedulers,
+                    heartbeat_ttl=self.config.heartbeat_ttl,
+                    data_dir=self.config.data_dir),
+                name=self.config.name)
+            if self.config.acl_enabled:
+                self.server.enable_acl()
+        if self.config.client_enabled:
+            try:
+                from nomad_tpu.client import Client, ClientConfig
+            except ImportError as e:
+                raise RuntimeError(
+                    "client_enabled requires the nomad_tpu.client "
+                    "package") from e
+            if self.server is None:
+                raise ValueError("remote-server client requires rpc target")
+            self.client = Client(
+                ClientConfig(node_name=self.config.name + "-client",
+                             datacenter=self.config.datacenter,
+                             drivers=list(self.config.node_pool_drivers)),
+                rpc=self.server.endpoints.handle)
+
+    def start(self) -> None:
+        if self.server is not None:
+            self.server.start()
+        if self.client is not None:
+            self.client.start()
+        from nomad_tpu.agent.http import HTTPServer
+        self.http = HTTPServer(self, host=self.config.http_host,
+                               port=self.config.http_port)
+        self.http.start()
+
+    def stop(self) -> None:
+        if self.http is not None:
+            self.http.stop()
+        if self.client is not None:
+            self.client.stop()
+        if self.server is not None:
+            self.server.stop()
+
+    @property
+    def http_addr(self) -> str:
+        return f"http://{self.http.host}:{self.http.port}"
+
+    def rpc(self, method: str, args: dict):
+        """In-process RPC into the embedded server (the agent's RPC
+        client; reference command/agent/agent.go RPC passthrough)."""
+        if self.server is None:
+            raise RuntimeError("agent has no server")
+        return self.server.rpc_leader(method, args)

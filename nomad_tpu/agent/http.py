@@ -1,0 +1,657 @@
+"""HTTP API server (reference: command/agent/http.go:320-392 — the /v1
+route table over the agent's RPC layer).
+
+Conventions mirrored from the reference:
+ - JSON bodies both ways; struct wire format from nomad_tpu.api.codec.
+ - Blocking queries: `?index=N&wait=SECONDS` on list/get endpoints —
+   the handler waits until the state store advances past N (go-memdb
+   watchsets in the reference; a condition poll here).
+ - `X-Nomad-Index` response header carries the state index.
+ - /v1/event/stream streams NDJSON events with topic filters.
+ - ACL: `X-Nomad-Token` header resolved when ACLs are enabled.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from nomad_tpu.api.codec import from_wire, to_wire
+from nomad_tpu.rpc.endpoints import RpcError
+from nomad_tpu.structs import Job
+from nomad_tpu.telemetry import global_metrics
+
+
+class HTTPError(Exception):
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
+def _parse_wait(val: str) -> float:
+    """`wait` accepts go-style durations ("5s", "100ms") or seconds."""
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", val)
+    if not m:
+        raise HTTPError(400, f"invalid wait duration {val!r}")
+    n = float(m.group(1))
+    return n * {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0,
+                None: 1.0}[m.group(2)]
+
+
+class HTTPServer:
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 0):
+        self.agent = agent
+        self.host = host
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):          # quiet
+                pass
+
+            def _dispatch(self):
+                try:
+                    outer._route(self)
+                except HTTPError as e:
+                    self._reply(e.code, {"error": e.msg})
+                except RpcError as e:
+                    code = {"not_found": 404, "permission_denied": 403,
+                            "unknown_method": 404}.get(e.kind, 500)
+                    self._reply(code, {"error": str(e)})
+                except BrokenPipeError:
+                    pass
+                except Exception as e:                   # noqa: BLE001
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            do_GET = do_PUT = do_POST = do_DELETE = _dispatch
+
+            def _reply(self, code: int, obj, index: Optional[int] = None):
+                body = json.dumps(obj).encode()
+                try:
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    if index is not None:
+                        self.send_header("X-Nomad-Index", str(index))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                if n == 0:
+                    return {}
+                raw = self.rfile.read(n)
+                try:
+                    return json.loads(raw) if raw else {}
+                except json.JSONDecodeError as e:
+                    raise HTTPError(400, f"invalid JSON body: {e}")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="http", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(2.0)
+
+    # ------------------------------------------------------------ routing
+
+    def _route(self, h) -> None:
+        url = urllib.parse.urlparse(h.path)
+        q = {k: v[-1] for k, v in urllib.parse.parse_qs(url.query).items()}
+        parts = [urllib.parse.unquote(p)
+                 for p in url.path.split("/") if p]
+        if not parts or parts[0] != "v1":
+            raise HTTPError(404, f"no handler for {url.path}")
+        parts = parts[1:]
+        method = h.command
+
+        token = h.headers.get("X-Nomad-Token", "") or \
+            q.get("token", "")
+        self._check_acl(parts, method, token,
+                        q.get("namespace", "default"), h)
+
+        store = self.agent.server.store if self.agent.server else None
+        if store is not None and "index" in q:
+            min_index = int(q["index"])
+            wait = _parse_wait(q.get("wait", "5s"))
+            store.wait_for_index(min_index + 1, timeout=min(wait, 600.0))
+
+        m = method.lower()
+        candidates = []
+        if len(parts) >= 2:
+            candidates.append(f"_h_{m}_{parts[0]}_id")
+        candidates.append(f"_h_{m}_{parts[0]}")
+        handler = None
+        for name in candidates:
+            handler = getattr(self, name, None)
+            if handler is not None:
+                break
+        if handler is None:
+            raise HTTPError(404, f"no handler for {method} {url.path}")
+        result = handler(h, parts, q)
+        if result is not _STREAMED:
+            h._reply(200, to_wire(result),
+                     index=store.latest_index if store else None)
+
+    def _rpc(self, method: str, args: dict):
+        return self.agent.rpc(method, args)
+
+    # ------------------------------------------------------------ ACL
+
+    def _check_acl(self, parts, method, token: str,
+                   namespace: str = "default", h=None) -> None:
+        server = self.agent.server
+        if server is None or not getattr(server, "acl_enabled", False):
+            if h is not None:
+                h.acl = None
+            return
+        from nomad_tpu.acl import required_capability
+        cap, ns = required_capability(parts, method, namespace)
+        if cap is None:
+            if h is not None:
+                h.acl = server.resolve_token(token)
+            return
+        acl = server.resolve_token(token)
+        if h is not None:
+            h.acl = acl
+        if acl is None:
+            raise HTTPError(403, "ACL token not found")
+        if not acl.allows(ns, cap):
+            raise HTTPError(403, f"Permission denied: needs {cap}")
+
+    # ------------------------------------------------------------ jobs
+
+    def _h_get_jobs(self, h, parts, q):
+        jobs = self._rpc("Job.List", {"namespace": q.get("namespace")})
+        prefix = q.get("prefix", "")
+        return [_job_stub(j) for j in jobs if j.id.startswith(prefix)]
+
+    def _h_put_jobs(self, h, parts, q):
+        body = h._body()
+        if len(parts) > 1 and parts[1] == "parse":
+            return self._parse_jobspec(body)
+        job = from_wire(Job, body.get("Job") or body.get("job") or body)
+        # the authoritative namespace is the one in the job body — re-check
+        # against it (the URL-level check used the ?namespace= param)
+        acl = getattr(h, "acl", None)
+        if getattr(self.agent.server, "acl_enabled", False):
+            from nomad_tpu.acl.policy import CAP_SUBMIT_JOB
+            if acl is None or not acl.allows(job.namespace, CAP_SUBMIT_JOB):
+                raise HTTPError(
+                    403, f"Permission denied: needs submit-job in "
+                         f"namespace {job.namespace!r}")
+        resp = self._rpc("Job.Register", {"job": job})
+        return {"EvalID": resp["eval_id"],
+                "JobModifyIndex": resp["job_modify_index"]}
+
+    _h_post_jobs = _h_put_jobs
+
+    def _parse_jobspec(self, body):
+        from nomad_tpu.jobspec import parse_job
+        src = body.get("JobHCL") or body.get("job_hcl") or ""
+        if not src:
+            raise HTTPError(400, "JobHCL required")
+        return parse_job(src)
+
+    # sub-resources under /v1/job/<id>/... ; the id itself may contain
+    # slashes (dispatched/periodic children), so scan from the end
+    _JOB_SUBS = {"allocations", "evaluations", "deployments", "deployment",
+                 "summary", "versions", "evaluate", "plan", "dispatch",
+                 "stability", "revert", "force"}
+
+    @classmethod
+    def _job_path(cls, parts):
+        """['job', *id-segments, sub?] -> (job_id, sub)."""
+        segs = parts[1:]
+        if segs and segs[-1] == "force" and len(segs) >= 2 \
+                and segs[-2] == "periodic":
+            return "/".join(segs[:-2]), "periodic/force"
+        if segs and segs[-1] in cls._JOB_SUBS:
+            return "/".join(segs[:-1]), segs[-1]
+        return "/".join(segs), None
+
+    def _h_get_job_id(self, h, parts, q):
+        ns = q.get("namespace", "default")
+        job_id, sub = self._job_path(parts)
+        store = self.agent.server.store
+        if sub is None:
+            job = self._rpc("Job.GetJob", {"namespace": ns, "job_id": job_id})
+            if job is None:
+                raise HTTPError(404, f"job not found: {job_id}")
+            return job
+        if sub == "allocations":
+            return [_alloc_stub(a) for a in self._rpc(
+                "Job.Allocations", {"namespace": ns, "job_id": job_id})]
+        if sub == "evaluations":
+            return self._rpc("Job.Evaluations",
+                             {"namespace": ns, "job_id": job_id})
+        if sub == "deployments":
+            return [d for d in self._rpc("Deployment.List", {})
+                    if d.job_id == job_id and d.namespace == ns]
+        if sub == "deployment":
+            return store.latest_deployment_by_job_id(ns, job_id)
+        if sub == "summary":
+            return store.job_summary(ns, job_id)
+        if sub == "versions":
+            return store.job_versions(ns, job_id)
+        raise HTTPError(404, f"no handler for job/{sub}")
+
+    def _h_put_job_id(self, h, parts, q):
+        ns = q.get("namespace", "default")
+        job_id, sub = self._job_path(parts)
+        if sub is None:                      # update = register
+            return self._h_put_jobs(h, ["jobs"], q)
+        if sub == "evaluate":
+            job = self._rpc("Job.GetJob", {"namespace": ns, "job_id": job_id})
+            if job is None:
+                raise HTTPError(404, f"job not found: {job_id}")
+            from nomad_tpu.structs import Evaluation, EvalStatus
+            from nomad_tpu.structs.evaluation import EvalTrigger
+            ev = Evaluation(namespace=ns, priority=job.priority,
+                            type=job.type, job_id=job_id,
+                            triggered_by=EvalTrigger.JOB_REGISTER,
+                            status=EvalStatus.PENDING)
+            self._rpc("Eval.Create", {"evals": [ev]})
+            return {"EvalID": ev.id}
+        if sub == "plan":
+            body = h._body()
+            job = from_wire(Job, body.get("Job") or body.get("job") or {})
+            return self._rpc("Job.Plan", {"job": job,
+                                          "diff": body.get("Diff", True)})
+        if sub == "periodic/force":
+            return self._force_periodic(ns, job_id)
+        if sub == "dispatch":
+            body = h._body()
+            return self._rpc("Job.Dispatch", {
+                "namespace": ns, "job_id": job_id,
+                "payload": body.get("Payload", ""),
+                "meta": body.get("Meta") or {}})
+        if sub == "stability":
+            body = h._body()
+            self._rpc("Job.Stability", {
+                "namespace": ns, "job_id": job_id,
+                "version": body.get("JobVersion", 0),
+                "stable": body.get("Stable", True)})
+            return {}
+        if sub == "revert":
+            body = h._body()
+            return self._rpc("Job.Revert", {
+                "namespace": ns, "job_id": job_id,
+                "version": body.get("JobVersion", 0)})
+        raise HTTPError(404, f"no handler for job/{sub}")
+
+    _h_post_job_id = _h_put_job_id
+
+    def _force_periodic(self, ns, job_id):
+        server = self.agent.server
+        job = server.store.job_by_id(ns, job_id)
+        if job is None or not job.is_periodic():
+            raise HTTPError(404, f"periodic job not found: {job_id}")
+        child_id = server.periodic._launch(job, time.time())
+        return {"DispatchedJobID": child_id}
+
+    def _h_delete_job_id(self, h, parts, q):
+        job_id, _ = self._job_path(parts)
+        resp = self._rpc("Job.Deregister", {
+            "namespace": q.get("namespace", "default"), "job_id": job_id,
+            "purge": q.get("purge", "").lower() == "true"})
+        return {"EvalID": resp["eval_id"]}
+
+    # ------------------------------------------------------------ nodes
+
+    def _h_get_nodes(self, h, parts, q):
+        prefix = q.get("prefix", "")
+        return [_node_stub(n) for n in self._rpc("Node.List", {})
+                if n.id.startswith(prefix)]
+
+    def _h_get_node_id(self, h, parts, q):
+        sub = parts[2] if len(parts) > 2 else None
+        if sub == "allocations":
+            return self._rpc("Node.GetAllocs", {"node_id": parts[1]})
+        node = self._rpc("Node.GetNode", {"node_id": parts[1]})
+        if node is None:
+            raise HTTPError(404, f"node not found: {parts[1]}")
+        return node
+
+    def _h_put_node_id(self, h, parts, q):
+        sub = parts[2] if len(parts) > 2 else None
+        body = h._body()
+        if sub == "drain":
+            spec = body.get("DrainSpec") or {}
+            enable = bool(spec) or body.get("Enable", False)
+            if enable:
+                self._rpc("Node.UpdateDrain", {
+                    "node_id": parts[1],
+                    "deadline_s": float(spec.get("Deadline", 3600.0)),
+                    "ignore_system_jobs": spec.get("IgnoreSystemJobs",
+                                                   False)})
+            return {}
+        if sub == "eligibility":
+            self._rpc("Node.UpdateEligibility", {
+                "node_id": parts[1],
+                "eligibility": body.get("Eligibility", "eligible")})
+            return {}
+        if sub == "purge":
+            self._rpc("Node.Deregister", {"node_id": parts[1]})
+            return {}
+        raise HTTPError(404, f"no handler for node/{sub}")
+
+    _h_post_node_id = _h_put_node_id
+
+    # ------------------------------------------------------------ evals/allocs
+
+    def _h_get_evaluations(self, h, parts, q):
+        prefix = q.get("prefix", "")
+        return [e for e in self._rpc("Eval.List", {})
+                if e.id.startswith(prefix)]
+
+    def _h_get_evaluation_id(self, h, parts, q):
+        sub = parts[2] if len(parts) > 2 else None
+        if sub == "allocations":
+            allocs = self._rpc("Alloc.List", {})
+            return [a for a in allocs if a.eval_id == parts[1]]
+        ev = self._rpc("Eval.GetEval", {"eval_id": parts[1]})
+        if ev is None:
+            raise HTTPError(404, f"eval not found: {parts[1]}")
+        return ev
+
+    def _h_get_allocations(self, h, parts, q):
+        prefix = q.get("prefix", "")
+        return [_alloc_stub(a) for a in self._rpc("Alloc.List", {})
+                if a.id.startswith(prefix)]
+
+    def _h_get_allocation_id(self, h, parts, q):
+        a = self._rpc("Alloc.GetAlloc", {"alloc_id": parts[1]})
+        if a is None:
+            raise HTTPError(404, f"alloc not found: {parts[1]}")
+        return a
+
+    def _h_post_allocation_id(self, h, parts, q):
+        sub = parts[2] if len(parts) > 2 else None
+        if sub == "stop":
+            return self._rpc("Alloc.Stop", {"alloc_id": parts[1]})
+        raise HTTPError(404, f"no handler for allocation/{sub}")
+
+    _h_put_allocation_id = _h_post_allocation_id
+
+    # ------------------------------------------------------------ deployments
+
+    def _h_get_deployments(self, h, parts, q):
+        return self._rpc("Deployment.List", {})
+
+    def _h_get_deployment_id(self, h, parts, q):
+        d = self._rpc("Deployment.GetDeployment",
+                      {"deployment_id": parts[1]})
+        if d is None:
+            raise HTTPError(404, f"deployment not found: {parts[1]}")
+        return d
+
+    def _h_put_deployment_id(self, h, parts, q):
+        # /v1/deployment/<verb>/<id> (reference routing)
+        verb, dep_id = parts[1], parts[2] if len(parts) > 2 else None
+        body = h._body()
+        if verb == "promote":
+            return self._rpc("Deployment.Promote", {
+                "deployment_id": dep_id, "groups": body.get("Groups")})
+        if verb == "fail":
+            return self._rpc("Deployment.Fail", {"deployment_id": dep_id})
+        if verb == "pause":
+            return self._rpc("Deployment.Pause", {
+                "deployment_id": dep_id, "pause": body.get("Pause", True)})
+        raise HTTPError(404, f"no handler for deployment/{verb}")
+
+    _h_post_deployment_id = _h_put_deployment_id
+
+    # ------------------------------------------------------------ operator
+
+    def _h_get_operator(self, h, parts, q):
+        if parts[1:3] == ["scheduler", "configuration"]:
+            cfg = self._rpc("Operator.SchedulerGetConfiguration", {})
+            return {"SchedulerConfig": cfg}
+        raise HTTPError(404, "unknown operator path")
+
+    def _h_put_operator(self, h, parts, q):
+        if parts[1:3] == ["scheduler", "configuration"]:
+            from nomad_tpu.structs.config import SchedulerConfiguration
+            cfg = from_wire(SchedulerConfiguration, h._body())
+            self._rpc("Operator.SchedulerSetConfiguration", {"config": cfg})
+            return {"Updated": True}
+        raise HTTPError(404, "unknown operator path")
+
+    _h_post_operator = _h_put_operator
+
+    # ------------------------------------------------------------ status/agent
+
+    def _h_get_status(self, h, parts, q):
+        if parts[1] == "leader":
+            return self._rpc("Status.Leader", {})
+        if parts[1] == "peers":
+            return self._rpc("Status.Peers", {})
+        raise HTTPError(404, "unknown status path")
+
+    def _h_get_agent(self, h, parts, q):
+        if parts[1] == "self":
+            cfg = self.agent.config
+            return {"config": to_wire(cfg), "member": {"Name": cfg.name},
+                    "stats": {"client": self.agent.client is not None,
+                              "server": self.agent.server is not None}}
+        if parts[1] == "members":
+            return {"Members": [{"Name": n}
+                                for n in self._rpc("Status.Peers", {})]}
+        if parts[1] == "health":
+            return {"server": {"ok": self.agent.server is not None},
+                    "client": {"ok": self.agent.client is not None}}
+        raise HTTPError(404, "unknown agent path")
+
+    # ------------------------------------------------------------ search
+
+    def _h_post_search(self, h, parts, q):
+        """Prefix search across contexts (reference nomad/search_endpoint.go)."""
+        body = h._body()
+        prefix = body.get("Prefix", "")
+        context = body.get("Context", "all")
+        store = self.agent.server.store
+        out = {}
+        truncations = {}
+        def add(name, ids):
+            matches = [i for i in ids if i.startswith(prefix)]
+            truncations[name] = len(matches) > 20
+            out[name] = matches[:20]
+        if context in ("all", "jobs"):
+            add("jobs", [j.id for j in store.jobs()])
+        if context in ("all", "nodes"):
+            add("nodes", [n.id for n in store.nodes()])
+        if context in ("all", "evals"):
+            add("evals", [e.id for e in store.evals()])
+        if context in ("all", "allocs"):
+            add("allocs", [a.id for a in store.allocs()])
+        if context in ("all", "deployment"):
+            add("deployment", [d.id for d in store.deployments()])
+        return {"Matches": out, "Truncations": truncations}
+
+    # ------------------------------------------------------------ metrics
+
+    def _h_get_metrics(self, h, parts, q):
+        if q.get("format") == "prometheus":
+            body = global_metrics.prometheus().encode()
+            h.send_response(200)
+            h.send_header("Content-Type", "text/plain; version=0.0.4")
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+            return _STREAMED
+        return global_metrics.snapshot()
+
+    # ------------------------------------------------------------ events
+
+    def _h_get_event(self, h, parts, q):
+        """/v1/event/stream — NDJSON event stream with ?topic=Topic:Key
+        filters (reference nomad/stream/ndjson.go)."""
+        if len(parts) < 2 or parts[1] != "stream":
+            raise HTTPError(404, "unknown event path")
+        topics: dict = {}
+        raw = urllib.parse.urlparse(h.path).query
+        for k, vals in urllib.parse.parse_qs(raw).items():
+            if k != "topic":
+                continue
+            for v in vals:
+                topic, _, key = v.partition(":")
+                topics.setdefault(topic, []).append(key or "*")
+        if not topics:
+            topics = {"*": ["*"]}
+        sub = self.agent.server.event_broker.subscribe(
+            topics, from_index=int(q.get("index", 0)))
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+            deadline = time.time() + float(q.get("timeout", 5.0))
+            while time.time() < deadline:
+                ev = sub.next(timeout=0.25)
+                if ev is None:
+                    chunk = b"{}\n"         # heartbeat (reference sends {})
+                else:
+                    d = ev.to_dict()
+                    d["Payload"] = to_wire(d["Payload"])
+                    chunk = (json.dumps(
+                        {"Index": ev.index, "Events": [d]}) + "\n").encode()
+                h.wfile.write(hex(len(chunk))[2:].encode() + b"\r\n"
+                              + chunk + b"\r\n")
+                h.wfile.flush()
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            sub.close()
+        return _STREAMED
+
+    # ------------------------------------------------------------ ACL mgmt
+
+    def _h_get_acl(self, h, parts, q):
+        server = self.agent.server
+        if parts[1] == "policies":
+            return [{"Name": p.name, "Description": p.description}
+                    for p in server.acl_policies()]
+        if parts[1] == "policy" and len(parts) > 2:
+            p = server.acl_policy(parts[2])
+            if p is None:
+                raise HTTPError(404, f"policy not found: {parts[2]}")
+            return {"Name": p.name, "Description": p.description,
+                    "Rules": p.rules}
+        if parts[1] == "tokens":
+            return [_token_stub(t) for t in server.acl_tokens()]
+        if parts[1] == "token" and len(parts) > 2:
+            t = server.acl_token(parts[2]) if parts[2] != "self" else \
+                server.acl_token_by_secret(
+                    h.headers.get("X-Nomad-Token", ""))
+            if t is None:
+                raise HTTPError(404, "token not found")
+            return _token_full(t)
+        raise HTTPError(404, "unknown acl path")
+
+    def _h_put_acl(self, h, parts, q):
+        server = self.agent.server
+        body = h._body()
+        if parts[1] == "policy" and len(parts) > 2:
+            server.upsert_acl_policy(
+                parts[2], body.get("Description", ""),
+                body.get("Rules", ""))
+            return {}
+        if parts[1] == "token":
+            t = server.create_acl_token(
+                name=body.get("Name", ""),
+                type_=body.get("Type", "client"),
+                policies=body.get("Policies") or [])
+            return _token_full(t)
+        if parts[1] == "bootstrap":
+            t = server.bootstrap_acl()
+            return _token_full(t)
+        raise HTTPError(404, "unknown acl path")
+
+    _h_post_acl = _h_put_acl
+
+    def _h_delete_acl(self, h, parts, q):
+        server = self.agent.server
+        if parts[1] == "policy" and len(parts) > 2:
+            server.delete_acl_policy(parts[2])
+            return {}
+        if parts[1] == "token" and len(parts) > 2:
+            server.delete_acl_token(parts[2])
+            return {}
+        raise HTTPError(404, "unknown acl path")
+
+    # ------------------------------------------------------------ namespaces
+
+    def _h_get_namespaces(self, h, parts, q):
+        return self.agent.server.namespaces()
+
+    def _h_put_namespaces(self, h, parts, q):
+        body = h._body()
+        self.agent.server.upsert_namespace(body.get("Name", "default"),
+                                           body.get("Description", ""))
+        return {}
+
+    _h_post_namespaces = _h_put_namespaces
+
+    def _h_delete_namespace_id(self, h, parts, q):
+        self.agent.server.delete_namespace(parts[1])
+        return {}
+
+
+_STREAMED = object()
+
+
+def _is_id(s: str) -> bool:
+    return bool(re.fullmatch(r"[0-9a-f-]{8,}", s))
+
+
+def _job_stub(j) -> dict:
+    return {"ID": j.id, "Name": j.name, "Namespace": j.namespace,
+            "Type": j.type, "Priority": j.priority, "Status": j.status,
+            "JobModifyIndex": j.job_modify_index,
+            "ModifyIndex": j.modify_index, "Stop": j.stop}
+
+
+def _node_stub(n) -> dict:
+    return {"ID": n.id, "Name": n.name, "Datacenter": n.datacenter,
+            "Status": n.status, "SchedulingEligibility":
+            n.scheduling_eligibility, "Drain": n.drain_strategy is not None,
+            "NodeClass": n.node_class}
+
+
+def _alloc_stub(a) -> dict:
+    return {"ID": a.id, "Name": a.name, "JobID": a.job_id,
+            "TaskGroup": a.task_group, "NodeID": a.node_id,
+            "EvalID": a.eval_id, "ClientStatus": a.client_status,
+            "DesiredStatus": a.desired_status,
+            "ModifyIndex": a.modify_index}
+
+
+def _token_stub(t) -> dict:
+    return {"AccessorID": t.accessor_id, "Name": t.name, "Type": t.type}
+
+
+def _token_full(t) -> dict:
+    return {"AccessorID": t.accessor_id, "SecretID": t.secret_id,
+            "Name": t.name, "Type": t.type, "Policies": list(t.policies),
+            "Global": t.global_}

@@ -1,0 +1,9 @@
+"""HTTP API layer (reference: api/ Go SDK + command/agent/http.go).
+
+`nomad_tpu.api.codec` — generic dataclass<->JSON wire codec.
+`nomad_tpu.api.client` — typed Python SDK over the agent's /v1 REST API.
+"""
+from nomad_tpu.api.codec import from_wire, to_wire
+from nomad_tpu.api.client import ApiClient, ApiError
+
+__all__ = ["ApiClient", "ApiError", "from_wire", "to_wire"]

@@ -1,0 +1,322 @@
+"""Typed Python SDK over the agent's /v1 HTTP API.
+
+Reference: api/ (api/api.go Client + per-resource wrappers api/jobs.go,
+api/nodes.go, api/evaluations.go, api/allocations.go, api/event_stream.go).
+Uses urllib only — the agent is local/cluster-internal.
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Iterator, List, Optional
+
+from nomad_tpu.api.codec import from_wire, to_wire
+from nomad_tpu.structs import (
+    Allocation,
+    Deployment,
+    Evaluation,
+    Job,
+    Node,
+)
+from nomad_tpu.structs.config import SchedulerConfiguration
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+class ApiClient:
+    def __init__(self, address: str = "http://127.0.0.1:4646",
+                 token: str = "", namespace: str = "default",
+                 timeout: float = 30.0):
+        self.address = address.rstrip("/")
+        self.token = token
+        self.namespace = namespace
+        self.timeout = timeout
+        self.jobs = Jobs(self)
+        self.nodes = Nodes(self)
+        self.evaluations = Evaluations(self)
+        self.allocations = Allocations(self)
+        self.deployments = Deployments(self)
+        self.operator = Operator(self)
+        self.acl = AclApi(self)
+        self.namespaces = Namespaces(self)
+        self.system = SystemApi(self)
+
+    # ------------------------------------------------------------- transport
+
+    def _request(self, method: str, path: str,
+                 params: Optional[Dict[str, str]] = None,
+                 body: Any = None, raw: bool = False):
+        qs = dict(params or {})
+        url = f"{self.address}{path}"
+        if qs:
+            url += "?" + urllib.parse.urlencode(
+                {k: v for k, v in qs.items() if v is not None})
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("X-Nomad-Token", self.token)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+                self.last_index = int(
+                    resp.headers.get("X-Nomad-Index") or 0)
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace"))
+        if raw:
+            return payload
+        return json.loads(payload) if payload else None
+
+    def get(self, path, params=None):
+        return self._request("GET", path, params)
+
+    def put(self, path, body=None, params=None):
+        return self._request("PUT", path, params, body)
+
+    def delete(self, path, params=None):
+        return self._request("DELETE", path, params)
+
+
+class _Section:
+    def __init__(self, client: ApiClient):
+        self.c = client
+
+
+class Jobs(_Section):
+    def list(self, prefix: str = "") -> List[dict]:
+        return self.c.get("/v1/jobs", {"prefix": prefix or None})
+
+    def register(self, job: Job) -> dict:
+        return self.c.put("/v1/jobs", {"Job": to_wire(job)})
+
+    def info(self, job_id: str) -> Job:
+        return from_wire(Job, self.c.get(
+            f"/v1/job/{job_id}", {"namespace": self.c.namespace}))
+
+    def deregister(self, job_id: str, purge: bool = False) -> dict:
+        return self.c.delete(
+            f"/v1/job/{job_id}",
+            {"namespace": self.c.namespace,
+             "purge": "true" if purge else None})
+
+    def allocations(self, job_id: str) -> List[dict]:
+        return self.c.get(f"/v1/job/{job_id}/allocations",
+                          {"namespace": self.c.namespace})
+
+    def evaluations(self, job_id: str) -> List[Evaluation]:
+        return [from_wire(Evaluation, e) for e in self.c.get(
+            f"/v1/job/{job_id}/evaluations",
+            {"namespace": self.c.namespace})]
+
+    def deployments(self, job_id: str) -> List[Deployment]:
+        return [from_wire(Deployment, d) for d in self.c.get(
+            f"/v1/job/{job_id}/deployments",
+            {"namespace": self.c.namespace})]
+
+    def latest_deployment(self, job_id: str) -> Optional[Deployment]:
+        d = self.c.get(f"/v1/job/{job_id}/deployment",
+                       {"namespace": self.c.namespace})
+        return from_wire(Deployment, d) if d else None
+
+    def summary(self, job_id: str) -> dict:
+        return self.c.get(f"/v1/job/{job_id}/summary",
+                          {"namespace": self.c.namespace})
+
+    def plan(self, job: Job, diff: bool = True) -> dict:
+        return self.c.put(f"/v1/job/{job.id}/plan",
+                          {"Job": to_wire(job), "Diff": diff})
+
+    def evaluate(self, job_id: str) -> dict:
+        return self.c.put(f"/v1/job/{job_id}/evaluate", {})
+
+    def dispatch(self, job_id: str, payload: str = "",
+                 meta: Optional[Dict[str, str]] = None) -> dict:
+        return self.c.put(f"/v1/job/{job_id}/dispatch",
+                          {"Payload": payload, "Meta": meta or {}})
+
+    def revert(self, job_id: str, version: int) -> dict:
+        return self.c.put(f"/v1/job/{job_id}/revert",
+                          {"JobVersion": version})
+
+    def periodic_force(self, job_id: str) -> dict:
+        return self.c.put(f"/v1/job/{job_id}/periodic/force", {})
+
+    def parse(self, hcl: str) -> dict:
+        return self.c.put("/v1/jobs/parse", {"JobHCL": hcl})
+
+
+class Nodes(_Section):
+    def list(self, prefix: str = "") -> List[dict]:
+        return self.c.get("/v1/nodes", {"prefix": prefix or None})
+
+    def info(self, node_id: str) -> Node:
+        return from_wire(Node, self.c.get(f"/v1/node/{node_id}"))
+
+    def allocations(self, node_id: str) -> List[Allocation]:
+        return [from_wire(Allocation, a) for a in
+                self.c.get(f"/v1/node/{node_id}/allocations")]
+
+    def drain(self, node_id: str, deadline_s: float = 3600.0,
+              ignore_system_jobs: bool = False) -> dict:
+        return self.c.put(
+            f"/v1/node/{node_id}/drain",
+            {"DrainSpec": {"Deadline": deadline_s,
+                           "IgnoreSystemJobs": ignore_system_jobs}})
+
+    def eligibility(self, node_id: str, eligible: bool) -> dict:
+        return self.c.put(
+            f"/v1/node/{node_id}/eligibility",
+            {"Eligibility": "eligible" if eligible else "ineligible"})
+
+    def purge(self, node_id: str) -> dict:
+        return self.c.put(f"/v1/node/{node_id}/purge", {})
+
+
+class Evaluations(_Section):
+    def list(self, prefix: str = "") -> List[Evaluation]:
+        return [from_wire(Evaluation, e) for e in
+                self.c.get("/v1/evaluations", {"prefix": prefix or None})]
+
+    def info(self, eval_id: str) -> Evaluation:
+        return from_wire(Evaluation, self.c.get(f"/v1/evaluation/{eval_id}"))
+
+    def allocations(self, eval_id: str) -> List[Allocation]:
+        return [from_wire(Allocation, a) for a in
+                self.c.get(f"/v1/evaluation/{eval_id}/allocations")]
+
+
+class Allocations(_Section):
+    def list(self, prefix: str = "") -> List[dict]:
+        return self.c.get("/v1/allocations", {"prefix": prefix or None})
+
+    def info(self, alloc_id: str) -> Allocation:
+        return from_wire(Allocation, self.c.get(f"/v1/allocation/{alloc_id}"))
+
+    def stop(self, alloc_id: str) -> dict:
+        return self.c.put(f"/v1/allocation/{alloc_id}/stop", {})
+
+
+class Deployments(_Section):
+    def list(self) -> List[Deployment]:
+        return [from_wire(Deployment, d) for d in
+                self.c.get("/v1/deployments")]
+
+    def info(self, deployment_id: str) -> Deployment:
+        return from_wire(Deployment,
+                         self.c.get(f"/v1/deployment/{deployment_id}"))
+
+    def promote(self, deployment_id: str,
+                groups: Optional[List[str]] = None) -> dict:
+        return self.c.put(f"/v1/deployment/promote/{deployment_id}",
+                          {"Groups": groups})
+
+    def fail(self, deployment_id: str) -> dict:
+        return self.c.put(f"/v1/deployment/fail/{deployment_id}", {})
+
+    def pause(self, deployment_id: str, pause: bool = True) -> dict:
+        return self.c.put(f"/v1/deployment/pause/{deployment_id}",
+                          {"Pause": pause})
+
+
+class Operator(_Section):
+    def scheduler_get_configuration(self) -> SchedulerConfiguration:
+        resp = self.c.get("/v1/operator/scheduler/configuration")
+        return from_wire(SchedulerConfiguration, resp["SchedulerConfig"])
+
+    def scheduler_set_configuration(self, cfg: SchedulerConfiguration) -> dict:
+        return self.c.put("/v1/operator/scheduler/configuration",
+                          to_wire(cfg))
+
+
+class AclApi(_Section):
+    def bootstrap(self) -> dict:
+        return self.c.put("/v1/acl/bootstrap", {})
+
+    def upsert_policy(self, name: str, rules: str,
+                      description: str = "") -> dict:
+        return self.c.put(f"/v1/acl/policy/{name}",
+                          {"Description": description, "Rules": rules})
+
+    def policies(self) -> List[dict]:
+        return self.c.get("/v1/acl/policies")
+
+    def policy(self, name: str) -> dict:
+        return self.c.get(f"/v1/acl/policy/{name}")
+
+    def delete_policy(self, name: str) -> dict:
+        return self.c.delete(f"/v1/acl/policy/{name}")
+
+    def create_token(self, name: str = "", type_: str = "client",
+                     policies: Optional[List[str]] = None) -> dict:
+        return self.c.put("/v1/acl/token",
+                          {"Name": name, "Type": type_,
+                           "Policies": policies or []})
+
+    def tokens(self) -> List[dict]:
+        return self.c.get("/v1/acl/tokens")
+
+    def self_token(self) -> dict:
+        return self.c.get("/v1/acl/token/self")
+
+    def delete_token(self, accessor_id: str) -> dict:
+        return self.c.delete(f"/v1/acl/token/{accessor_id}")
+
+
+class Namespaces(_Section):
+    def list(self) -> List[dict]:
+        return self.c.get("/v1/namespaces")
+
+    def register(self, name: str, description: str = "") -> dict:
+        return self.c.put("/v1/namespaces",
+                          {"Name": name, "Description": description})
+
+    def delete(self, name: str) -> dict:
+        return self.c.delete(f"/v1/namespace/{name}")
+
+
+class SystemApi(_Section):
+    def leader(self):
+        return self.c.get("/v1/status/leader")
+
+    def peers(self):
+        return self.c.get("/v1/status/peers")
+
+    def metrics(self) -> dict:
+        return self.c.get("/v1/metrics")
+
+    def members(self) -> dict:
+        return self.c.get("/v1/agent/members")
+
+    def agent_self(self) -> dict:
+        return self.c.get("/v1/agent/self")
+
+    def search(self, prefix: str, context: str = "all") -> dict:
+        return self.c._request("POST", "/v1/search", None,
+                               {"Prefix": prefix, "Context": context})
+
+    def event_stream(self, topics: Optional[List[str]] = None,
+                     timeout: float = 5.0) -> Iterator[dict]:
+        """Yield event frames from /v1/event/stream (NDJSON)."""
+        qs = [("timeout", str(timeout))]
+        for t in topics or []:
+            qs.append(("topic", t))
+        url = (f"{self.c.address}/v1/event/stream?"
+               + urllib.parse.urlencode(qs))
+        req = urllib.request.Request(url)
+        if self.c.token:
+            req.add_header("X-Nomad-Token", self.c.token)
+        with urllib.request.urlopen(req, timeout=timeout + 5) as resp:
+            for line in resp:
+                line = line.strip()
+                if not line or line == b"{}":
+                    continue
+                yield json.loads(line)

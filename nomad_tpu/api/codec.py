@@ -1,0 +1,111 @@
+"""Generic dataclass <-> JSON wire codec.
+
+The reference serializes its shared structs (nomad/structs/) with
+msgpack for RPC and JSON for the HTTP API (command/agent/http.go).  Here
+every control-plane object is a plain Python dataclass, so one
+reflection-driven codec covers the whole API surface: `to_wire` walks
+dataclasses/lists/dicts down to JSON-safe primitives (bytes -> base64),
+and `from_wire` rebuilds typed objects from the declared field types.
+
+Unknown keys are ignored on decode (forward compatibility, matching
+the reference's JSON behavior); missing keys take dataclass defaults.
+"""
+from __future__ import annotations
+
+import base64
+import dataclasses
+import sys
+import typing
+from typing import Any, Dict, Optional
+
+_NoneType = type(None)
+
+# cache: dataclass -> {field_name: resolved_type}
+_HINTS: Dict[type, Dict[str, Any]] = {}
+
+
+def _type_hints(cls: type) -> Dict[str, Any]:
+    hints = _HINTS.get(cls)
+    if hints is None:
+        mod = sys.modules.get(cls.__module__)
+        hints = typing.get_type_hints(cls, getattr(mod, "__dict__", None))
+        _HINTS[cls] = hints
+    return hints
+
+
+def to_wire(obj: Any) -> Any:
+    """Recursively convert an object graph to JSON-safe values."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        return {"__bytes__": base64.b64encode(obj).decode("ascii")}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            out[f.name] = to_wire(getattr(obj, f.name))
+        return out
+    if isinstance(obj, dict):
+        return {str(k): to_wire(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [to_wire(v) for v in obj]
+    # numpy scalars and the like
+    if hasattr(obj, "item"):
+        return obj.item()
+    if hasattr(obj, "to_dict"):
+        return to_wire(obj.to_dict())
+    raise TypeError(f"cannot serialize {type(obj).__name__} to wire")
+
+
+def from_wire(typ: Any, data: Any) -> Any:
+    """Rebuild a typed value from wire data based on the declared type."""
+    if data is None:
+        return None
+    origin = typing.get_origin(typ)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(typ) if a is not _NoneType]
+        if len(args) == 1:
+            return from_wire(args[0], data)
+        return data                                   # untyped union
+    if typ in (Any, object) or typ is None:
+        return _from_wire_untyped(data)
+    if typ is bytes:
+        if isinstance(data, dict) and "__bytes__" in data:
+            return base64.b64decode(data["__bytes__"])
+        if isinstance(data, str):
+            return base64.b64decode(data)
+        return bytes(data)
+    if origin in (list, tuple, set, frozenset):
+        args = typing.get_args(typ)
+        elem = args[0] if args else Any
+        vals = [from_wire(elem, v) for v in data]
+        if origin is list:
+            return vals
+        return origin(vals)
+    if origin is dict:
+        args = typing.get_args(typ)
+        vt = args[1] if len(args) == 2 else Any
+        return {k: from_wire(vt, v) for k, v in data.items()}
+    if isinstance(typ, type) and dataclasses.is_dataclass(typ):
+        if not isinstance(data, dict):
+            raise TypeError(f"expected object for {typ.__name__}, "
+                            f"got {type(data).__name__}")
+        hints = _type_hints(typ)
+        kwargs = {}
+        for f in dataclasses.fields(typ):
+            if f.name in data:
+                kwargs[f.name] = from_wire(hints.get(f.name, Any),
+                                           data[f.name])
+        return typ(**kwargs)
+    if typ in (int, float, str, bool):
+        return typ(data)
+    return data
+
+
+def _from_wire_untyped(data: Any) -> Any:
+    if isinstance(data, dict):
+        if "__bytes__" in data and len(data) == 1:
+            return base64.b64decode(data["__bytes__"])
+        return {k: _from_wire_untyped(v) for k, v in data.items()}
+    if isinstance(data, list):
+        return [_from_wire_untyped(v) for v in data]
+    return data

@@ -456,6 +456,93 @@ class Server:
             self.create_evals(evals)
         return evals
 
+    # ------------------------------------------------------------- ACL
+
+    acl_enabled = False
+
+    def enable_acl(self) -> None:
+        """Turn on ACL enforcement (reference acl block in agent config)."""
+        self.acl_enabled = True
+
+    def resolve_token(self, secret_id: str):
+        """SecretID -> compiled ACL (reference nomad/acl.go ResolveToken).
+        Anonymous (empty) tokens get the 'anonymous' policy if present."""
+        from nomad_tpu.acl import ACL, parse_policy
+        if not secret_id:
+            anon = self.store.acl_policy("anonymous")
+            if anon is None:
+                return None
+            return ACL(policies=[anon])
+        token = self.store.acl_token_by_secret(secret_id)
+        if token is None:
+            return None
+        if token.type == "management":
+            return ACL(management=True)
+        policies = [self.store.acl_policy(p) for p in token.policies]
+        return ACL(policies=[p for p in policies if p is not None])
+
+    def bootstrap_acl(self):
+        """One-time management token mint (reference ACL.Bootstrap).
+        The uniqueness invariant is enforced inside the replicated FSM
+        apply (a losing concurrent bootstrap is dropped there), so after
+        the commit we verify our token actually landed."""
+        from nomad_tpu.acl import ACLToken
+        t = ACLToken(name="Bootstrap Token", type="management",
+                     global_=True)
+        index = self.apply(MessageType.ACL_TOKEN_UPSERT,
+                           {"token": t, "bootstrap": True})
+        self.store.wait_for_index(index)
+        if self.store.acl_token(t.accessor_id) is None:
+            raise RuntimeError("ACL already bootstrapped")
+        return t
+
+    def upsert_acl_policy(self, name: str, description: str, rules: str):
+        from nomad_tpu.acl import parse_policy
+        policy = parse_policy(name, rules, description)
+        self.apply(MessageType.ACL_POLICY_UPSERT, {"policy": policy})
+        return policy
+
+    def delete_acl_policy(self, name: str) -> None:
+        self.apply(MessageType.ACL_POLICY_DELETE, {"name": name})
+
+    def acl_policies(self):
+        return self.store.acl_policies()
+
+    def acl_policy(self, name: str):
+        return self.store.acl_policy(name)
+
+    def create_acl_token(self, name: str = "", type_: str = "client",
+                         policies=None):
+        from nomad_tpu.acl import ACLToken
+        t = ACLToken(name=name, type=type_, policies=list(policies or []))
+        self.apply(MessageType.ACL_TOKEN_UPSERT, {"token": t})
+        return t
+
+    def delete_acl_token(self, accessor_id: str) -> None:
+        self.apply(MessageType.ACL_TOKEN_DELETE,
+                   {"accessor_id": accessor_id})
+
+    def acl_tokens(self):
+        return self.store.acl_tokens()
+
+    def acl_token(self, accessor_id: str):
+        return self.store.acl_token(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        return self.store.acl_token_by_secret(secret_id)
+
+    # ------------------------------------------------------------- namespaces
+
+    def namespaces(self):
+        return self.store.namespaces()
+
+    def upsert_namespace(self, name: str, description: str = "") -> None:
+        self.apply(MessageType.NAMESPACE_UPSERT,
+                   {"name": name, "description": description})
+
+    def delete_namespace(self, name: str) -> None:
+        self.apply(MessageType.NAMESPACE_DELETE, {"name": name})
+
     # ------------------------------------------------------------- helpers
 
     def wait_for_idle(self, timeout: float = 10.0) -> bool:

@@ -1,0 +1,12 @@
+"""Job specification parsing (reference: jobspec/ HCL1 + jobspec2/ HCL2).
+
+`nomad_tpu.jobspec.hcl` — a hand-rolled HCL2-subset parser (blocks,
+attributes, strings/numbers/bools/lists/objects, comments, heredocs).
+`nomad_tpu.jobspec.parse` — HCL AST -> Job structs, the jobspec2/parse.go
+equivalent, plus JSON jobspecs.
+"""
+from nomad_tpu.jobspec.hcl import HclBlock, HclParseError, parse_hcl
+from nomad_tpu.jobspec.parse import parse_job, parse_job_file, parse_json_job
+
+__all__ = ["HclBlock", "HclParseError", "parse_hcl", "parse_job",
+           "parse_job_file", "parse_json_job"]

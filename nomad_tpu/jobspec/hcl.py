@@ -1,0 +1,247 @@
+"""Minimal HCL2-subset parser.
+
+Covers the jobspec language surface (reference: jobspec2/ via
+hashicorp/hcl): nested blocks with string labels, attributes with
+string/number/bool/list/object values, line (`#`, `//`) and block
+(`/* */`) comments, heredocs (`<<EOF` / `<<-EOF`), and `${...}`
+interpolations preserved as literal text in strings (the runtime
+interpolates them per-task like the reference's taskenv).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class HclParseError(ValueError):
+    def __init__(self, msg: str, line: int):
+        super().__init__(f"line {line}: {msg}")
+        self.line = line
+
+
+class HclBlock:
+    """A block: `type "label1" "label2" { attrs + child blocks }`."""
+
+    __slots__ = ("type", "labels", "attrs", "blocks", "line")
+
+    def __init__(self, type_: str, labels: List[str], line: int = 0):
+        self.type = type_
+        self.labels = labels
+        self.attrs: Dict[str, Any] = {}
+        self.blocks: List["HclBlock"] = []
+        self.line = line
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.attrs.get(key, default)
+
+    def first(self, type_: str) -> Optional["HclBlock"]:
+        for b in self.blocks:
+            if b.type == type_:
+                return b
+        return None
+
+    def all(self, type_: str) -> List["HclBlock"]:
+        return [b for b in self.blocks if b.type == type_]
+
+    def __repr__(self):
+        return f"HclBlock({self.type!r}, {self.labels!r})"
+
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>[ \t\r]+)
+  | (?P<comment>\#[^\n]*|//[^\n]*)
+  | (?P<bcomment>/\*.*?\*/)
+  | (?P<heredoc><<-?(?P<hd_tag>\w+)\n)
+  | (?P<string>"(?:\\.|[^"\\])*")
+  | (?P<number>-?\d+(?:\.\d+)?(?![\w.]))
+  | (?P<ident>[A-Za-z_][\w.-]*)
+  | (?P<punct>[{}\[\]=,:\n])
+""", re.X | re.S)
+
+
+def _tokenize(src: str) -> List[Tuple[str, Any, int]]:
+    tokens: List[Tuple[str, Any, int]] = []
+    pos, line = 0, 1
+    n = len(src)
+    while pos < n:
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise HclParseError(f"unexpected character {src[pos]!r}", line)
+        kind = m.lastgroup
+        text = m.group(0)
+        if kind == "heredoc":
+            # scan to the terminator line
+            tag = m.group("hd_tag")
+            indent_strip = text.startswith("<<-")
+            line += 1
+            end_re = re.compile(rf"^[ \t]*{re.escape(tag)}[ \t]*$", re.M)
+            em = end_re.search(src, m.end())
+            if em is None:
+                raise HclParseError(f"heredoc {tag} not terminated", line)
+            body = src[m.end():em.start()]
+            if indent_strip:
+                body = "\n".join(l.lstrip() for l in body.split("\n"))
+            if body.endswith("\n"):
+                body = body[:-1]
+            tokens.append(("string", body, line))
+            line += body.count("\n") + 1
+            pos = em.end()
+            continue
+        pos = m.end()
+        if kind in ("ws", "comment"):
+            continue
+        if kind == "bcomment":
+            line += text.count("\n")
+            continue
+        if kind == "punct" and text == "\n":
+            tokens.append(("nl", "\n", line))
+            line += 1
+            continue
+        if kind == "string":
+            val = _unescape(text[1:-1])
+            tokens.append(("string", val, line))
+            line += text.count("\n")
+        elif kind == "number":
+            tokens.append(("number",
+                           float(text) if "." in text else int(text), line))
+        elif kind == "ident":
+            tokens.append(("ident", text, line))
+        else:
+            tokens.append(("punct", text, line))
+    tokens.append(("eof", None, line))
+    return tokens
+
+
+def _unescape(s: str) -> str:
+    out = []
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append({"n": "\n", "t": "\t", '"': '"', "\\": "\\",
+                        "r": "\r"}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self.tokens = tokens
+        self.i = 0
+
+    def peek(self, skip_nl: bool = True):
+        j = self.i
+        while skip_nl and self.tokens[j][0] == "nl":
+            j += 1
+        return self.tokens[j]
+
+    def next(self, skip_nl: bool = True):
+        while skip_nl and self.tokens[self.i][0] == "nl":
+            self.i += 1
+        tok = self.tokens[self.i]
+        self.i += 1
+        return tok
+
+    def expect(self, kind: str, value=None):
+        tok = self.next()
+        if tok[0] != kind or (value is not None and tok[1] != value):
+            raise HclParseError(
+                f"expected {value or kind}, got {tok[1]!r}", tok[2])
+        return tok
+
+    # ---- grammar
+
+    def parse_body(self, block: HclBlock, top: bool = False) -> None:
+        while True:
+            kind, val, line = self.peek()
+            if kind == "eof":
+                if not top:
+                    raise HclParseError("unexpected EOF in block", line)
+                return
+            if kind == "punct" and val == "}":
+                if top:
+                    raise HclParseError("unexpected '}'", line)
+                self.next()
+                return
+            if kind not in ("ident", "string"):
+                raise HclParseError(f"expected identifier, got {val!r}",
+                                    line)
+            self.next()
+            name = val
+            nkind, nval, nline = self.peek(skip_nl=False)
+            # skip non-newline lookahead
+            if nkind == "punct" and nval == "=":
+                self.next()
+                block.attrs[name] = self.parse_value()
+            else:
+                # block: labels then {
+                labels = []
+                while True:
+                    k2, v2, l2 = self.peek()
+                    if k2 == "string" or k2 == "ident" and v2 != "{":
+                        if k2 == "punct":
+                            break
+                        labels.append(str(v2))
+                        self.next()
+                    else:
+                        break
+                    if len(labels) > 8:
+                        raise HclParseError("too many block labels", l2)
+                self.expect("punct", "{")
+                child = HclBlock(name, labels, line)
+                self.parse_body(child)
+                block.blocks.append(child)
+
+    def parse_value(self):
+        kind, val, line = self.next()
+        if kind in ("string", "number"):
+            return val
+        if kind == "ident":
+            if val == "true":
+                return True
+            if val == "false":
+                return False
+            if val == "null":
+                return None
+            return val                       # bare identifier -> string
+        if kind == "punct" and val == "[":
+            items = []
+            while True:
+                k2, v2, l2 = self.peek()
+                if k2 == "punct" and v2 == "]":
+                    self.next()
+                    return items
+                items.append(self.parse_value())
+                k3, v3, l3 = self.peek()
+                if k3 == "punct" and v3 == ",":
+                    self.next()
+        if kind == "punct" and val == "{":
+            obj = {}
+            while True:
+                k2, v2, l2 = self.peek()
+                if k2 == "punct" and v2 == "}":
+                    self.next()
+                    return obj
+                if k2 not in ("ident", "string"):
+                    raise HclParseError(f"expected key, got {v2!r}", l2)
+                self.next()
+                k3, v3, l3 = self.peek()
+                if k3 == "punct" and v3 in ("=", ":"):
+                    self.next()
+                obj[v2] = self.parse_value()
+                k4, v4, l4 = self.peek()
+                if k4 == "punct" and v4 == ",":
+                    self.next()
+        raise HclParseError(f"unexpected value token {val!r}", line)
+
+
+def parse_hcl(src: str) -> HclBlock:
+    """Parse HCL source into a root pseudo-block."""
+    root = HclBlock("__root__", [])
+    p = _Parser(_tokenize(src))
+    p.parse_body(root, top=True)
+    return root

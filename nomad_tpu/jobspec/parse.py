@@ -1,0 +1,354 @@
+"""HCL jobspec -> Job structs (reference: jobspec2/parse.go mapping
+HCL2 to api.Job; block/attribute names follow the public jobspec
+language documented by the reference's website/).
+
+Also accepts JSON jobspecs (`parse_json_job`) — a dict in the same wire
+format the HTTP API uses.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from nomad_tpu.jobspec.hcl import HclBlock, HclParseError, parse_hcl
+from nomad_tpu.structs import (
+    Affinity,
+    Constraint,
+    DispatchPayloadConfig,
+    EphemeralDisk,
+    Job,
+    MigrateStrategy,
+    NetworkPort,
+    NetworkResource,
+    PeriodicConfig,
+    ReschedulePolicy,
+    Resources,
+    RestartPolicy,
+    Spread,
+    SpreadTarget,
+    Task,
+    TaskGroup,
+    UpdateStrategy,
+)
+from nomad_tpu.structs import DeviceRequest
+from nomad_tpu.structs.job import (
+    Lifecycle,
+    ParameterizedJobConfig,
+    Service,
+    VolumeRequest,
+)
+
+_DUR_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h|d)")
+_DUR_UNITS = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+              "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+def parse_duration(val: Any, default: float = 0.0) -> float:
+    """Go-style duration string ("90s", "1h30m") -> seconds."""
+    if val is None:
+        return default
+    if isinstance(val, (int, float)):
+        return float(val)
+    total, matched = 0.0, False
+    for m in _DUR_RE.finditer(str(val)):
+        total += float(m.group(1)) * _DUR_UNITS[m.group(2)]
+        matched = True
+    if not matched:
+        raise HclParseError(f"invalid duration {val!r}", 0)
+    return total
+
+
+def parse_job_file(path: str) -> Job:
+    with open(path) as fh:
+        return parse_job(fh.read())
+
+
+def parse_job(src: str) -> Job:
+    """Parse an HCL jobspec into a canonicalized Job."""
+    root = parse_hcl(src)
+    jb = root.first("job")
+    if jb is None:
+        raise HclParseError("no 'job' block found", 0)
+    return _job_from_block(jb)
+
+
+def parse_json_job(data: dict) -> Job:
+    from nomad_tpu.api.codec import from_wire
+    job = from_wire(Job, data.get("Job") or data.get("job") or data)
+    job.canonicalize()
+    return job
+
+
+# ---------------------------------------------------------------- blocks
+
+def _job_from_block(b: HclBlock) -> Job:
+    job = Job(
+        id=b.labels[0] if b.labels else b.get("id", ""),
+        name=b.get("name", b.labels[0] if b.labels else ""),
+        type=b.get("type", "service"),
+        region=b.get("region", "global"),
+        namespace=b.get("namespace", "default"),
+        priority=int(b.get("priority", 50)),
+        all_at_once=bool(b.get("all_at_once", False)),
+        datacenters=list(b.get("datacenters", ["dc1"])),
+    )
+    job.constraints = [_constraint(c) for c in b.all("constraint")]
+    job.affinities = [_affinity(a) for a in b.all("affinity")]
+    job.spreads = [_spread(s) for s in b.all("spread")]
+    if b.first("update") is not None:
+        job.update = _update(b.first("update"))
+    if b.first("periodic") is not None:
+        job.periodic = _periodic(b.first("periodic"))
+    if b.first("parameterized") is not None:
+        job.parameterized = _parameterized(b.first("parameterized"))
+    if b.first("meta") is not None:
+        job.meta = {k: str(v) for k, v in b.first("meta").attrs.items()}
+    for g in b.all("group"):
+        job.task_groups.append(_group(g))
+    # single top-level task sugar (HCL1 compat): job { task "t" {} }
+    if not job.task_groups and b.all("task"):
+        tg = TaskGroup(name=job.id or "group")
+        for t in b.all("task"):
+            tg.tasks.append(_task(t))
+        job.task_groups = [tg]
+    job.canonicalize()
+    return job
+
+
+def _group(b: HclBlock) -> TaskGroup:
+    tg = TaskGroup(
+        name=b.labels[0] if b.labels else "group",
+        count=int(b.get("count", 1)),
+    )
+    tg.constraints = [_constraint(c) for c in b.all("constraint")]
+    tg.affinities = [_affinity(a) for a in b.all("affinity")]
+    tg.spreads = [_spread(s) for s in b.all("spread")]
+    if b.first("restart") is not None:
+        tg.restart_policy = _restart(b.first("restart"))
+    if b.first("reschedule") is not None:
+        tg.reschedule_policy = _reschedule(b.first("reschedule"))
+    if b.first("migrate") is not None:
+        tg.migrate = _migrate(b.first("migrate"))
+    if b.first("update") is not None:
+        tg.update = _update(b.first("update"))
+    if b.first("ephemeral_disk") is not None:
+        ed = b.first("ephemeral_disk")
+        tg.ephemeral_disk = EphemeralDisk(
+            sticky=bool(ed.get("sticky", False)),
+            size_mb=int(ed.get("size", 300)),
+            migrate=bool(ed.get("migrate", False)))
+    for n in b.all("network"):
+        tg.networks.append(_network(n))
+    for s in b.all("service"):
+        tg.services.append(_service(s))
+    for v in b.all("volume"):
+        name = v.labels[0] if v.labels else "vol"
+        tg.volumes[name] = VolumeRequest(
+            name=name, type=v.get("type", "host"),
+            source=v.get("source", ""),
+            read_only=bool(v.get("read_only", False)),
+            access_mode=v.get("access_mode", ""),
+            attachment_mode=v.get("attachment_mode", ""),
+            per_alloc=bool(v.get("per_alloc", False)))
+    if b.get("max_client_disconnect") is not None:
+        tg.max_client_disconnect_s = parse_duration(
+            b.get("max_client_disconnect"))
+    if b.get("stop_after_client_disconnect") is not None:
+        tg.stop_after_client_disconnect_s = parse_duration(
+            b.get("stop_after_client_disconnect"))
+    if b.first("meta") is not None:
+        tg.meta = {k: str(v) for k, v in b.first("meta").attrs.items()}
+    for t in b.all("task"):
+        tg.tasks.append(_task(t))
+    return tg
+
+
+def _task(b: HclBlock) -> Task:
+    t = Task(
+        name=b.labels[0] if b.labels else "task",
+        driver=b.get("driver", "mock"),
+        kill_timeout_s=parse_duration(b.get("kill_timeout"), 5.0),
+        leader=bool(b.get("leader", False)),
+    )
+    cfg = b.first("config")
+    if cfg is not None:
+        t.config = _block_to_dict(cfg)
+    env = b.first("env")
+    if env is not None:
+        t.env = {k: str(v) for k, v in env.attrs.items()}
+    res = b.first("resources")
+    if res is not None:
+        t.resources = _resources(res)
+    t.constraints = [_constraint(c) for c in b.all("constraint")]
+    t.affinities = [_affinity(a) for a in b.all("affinity")]
+    lc = b.first("lifecycle")
+    if lc is not None:
+        t.lifecycle = Lifecycle(hook=lc.get("hook", ""),
+                                sidecar=bool(lc.get("sidecar", False)))
+    for s in b.all("service"):
+        t.services.append(_service(s))
+    if b.first("meta") is not None:
+        t.meta = {k: str(v) for k, v in b.first("meta").attrs.items()}
+    for a in b.all("artifact"):
+        t.artifacts.append(_block_to_dict(a))
+    for tmpl in b.all("template"):
+        t.templates.append(_block_to_dict(tmpl))
+    v = b.first("vault")
+    if v is not None:
+        t.vault = _block_to_dict(v)
+    dp = b.first("dispatch_payload")
+    if dp is not None:
+        t.dispatch_payload = DispatchPayloadConfig(file=dp.get("file", ""))
+    return t
+
+
+def _resources(b: HclBlock) -> Resources:
+    r = Resources(
+        cpu=int(b.get("cpu", 100)),
+        cores=int(b.get("cores", 0)),
+        memory_mb=int(b.get("memory", 300)),
+        memory_max_mb=int(b.get("memory_max", 0)),
+        disk_mb=int(b.get("disk", 0)),
+    )
+    for n in b.all("network"):
+        r.networks.append(_network(n))
+    for d in b.all("device"):
+        r.devices.append(DeviceRequest(
+            name=d.labels[0] if d.labels else "",
+            count=int(d.get("count", 1)),
+            constraints=[_constraint(c) for c in d.all("constraint")],
+            affinities=[_affinity(a) for a in d.all("affinity")]))
+    return r
+
+
+def _network(b: HclBlock) -> NetworkResource:
+    net = NetworkResource(mode=b.get("mode", "host"),
+                          mbits=int(b.get("mbits", 0)))
+    for p in b.all("port"):
+        label = p.labels[0] if p.labels else ""
+        port = NetworkPort(label=label,
+                           value=int(p.get("static", 0)),
+                           to=int(p.get("to", 0)),
+                           host_network=p.get("host_network", "default"))
+        if port.value:
+            net.reserved_ports.append(port)
+        else:
+            net.dynamic_ports.append(port)
+    return net
+
+
+def _service(b: HclBlock) -> Service:
+    svc = Service(
+        name=b.labels[0] if b.labels else b.get("name", ""),
+        provider=b.get("provider", "consul"),
+        port_label=str(b.get("port", "")),
+        tags=[str(x) for x in b.get("tags", [])],
+    )
+    for c in b.all("check"):
+        svc.checks.append(_block_to_dict(c))
+    return svc
+
+
+def _constraint(b: HclBlock) -> Constraint:
+    if b.get("distinct_hosts") is not None:
+        return Constraint(operand="distinct_hosts")
+    if b.get("distinct_property") is not None:
+        return Constraint(ltarget=str(b.get("distinct_property")),
+                          rtarget=str(b.get("value", "")),
+                          operand="distinct_property")
+    return Constraint(
+        ltarget=str(b.get("attribute", "")),
+        rtarget=str(b.get("value", "")),
+        operand=str(b.get("operator", b.get("op", "="))),
+    )
+
+
+def _affinity(b: HclBlock) -> Affinity:
+    return Affinity(
+        ltarget=str(b.get("attribute", "")),
+        rtarget=str(b.get("value", "")),
+        operand=str(b.get("operator", b.get("op", "="))),
+        weight=int(b.get("weight", 50)),
+    )
+
+
+def _spread(b: HclBlock) -> Spread:
+    targets = tuple(
+        SpreadTarget(value=str(t.labels[0] if t.labels
+                               else t.get("value", "")),
+                     percent=int(t.get("percent", 0)))
+        for t in b.all("target"))
+    return Spread(attribute=str(b.get("attribute", "")),
+                  weight=int(b.get("weight", 50)), targets=targets)
+
+
+def _update(b: HclBlock) -> UpdateStrategy:
+    return UpdateStrategy(
+        stagger_s=parse_duration(b.get("stagger"), 30.0),
+        max_parallel=int(b.get("max_parallel", 1)),
+        health_check=b.get("health_check", "checks"),
+        min_healthy_time_s=parse_duration(b.get("min_healthy_time"), 10.0),
+        healthy_deadline_s=parse_duration(b.get("healthy_deadline"), 300.0),
+        progress_deadline_s=parse_duration(b.get("progress_deadline"),
+                                           600.0),
+        auto_revert=bool(b.get("auto_revert", False)),
+        auto_promote=bool(b.get("auto_promote", False)),
+        canary=int(b.get("canary", 0)),
+    )
+
+
+def _periodic(b: HclBlock) -> PeriodicConfig:
+    return PeriodicConfig(
+        enabled=bool(b.get("enabled", True)),
+        spec=b.get("cron", b.get("crons", "")),
+        prohibit_overlap=bool(b.get("prohibit_overlap", False)),
+        timezone=b.get("time_zone", "UTC"),
+    )
+
+
+def _parameterized(b: HclBlock) -> ParameterizedJobConfig:
+    return ParameterizedJobConfig(
+        payload=b.get("payload", "optional"),
+        meta_required=[str(x) for x in b.get("meta_required", [])],
+        meta_optional=[str(x) for x in b.get("meta_optional", [])],
+    )
+
+
+def _restart(b: HclBlock) -> RestartPolicy:
+    return RestartPolicy(
+        attempts=int(b.get("attempts", 2)),
+        interval_s=parse_duration(b.get("interval"), 1800.0),
+        delay_s=parse_duration(b.get("delay"), 15.0),
+        mode=b.get("mode", "fail"),
+    )
+
+
+def _reschedule(b: HclBlock) -> ReschedulePolicy:
+    return ReschedulePolicy(
+        attempts=int(b.get("attempts", 0)),
+        interval_s=parse_duration(b.get("interval"), 0.0),
+        delay_s=parse_duration(b.get("delay"), 30.0),
+        delay_function=b.get("delay_function", "exponential"),
+        max_delay_s=parse_duration(b.get("max_delay"), 3600.0),
+        unlimited=bool(b.get("unlimited", True)),
+    )
+
+
+def _migrate(b: HclBlock) -> MigrateStrategy:
+    return MigrateStrategy(
+        max_parallel=int(b.get("max_parallel", 1)),
+        health_check=b.get("health_check", "checks"),
+        min_healthy_time_s=parse_duration(b.get("min_healthy_time"), 10.0),
+        healthy_deadline_s=parse_duration(b.get("healthy_deadline"), 300.0),
+    )
+
+
+def _block_to_dict(b: HclBlock) -> Dict[str, Any]:
+    out: Dict[str, Any] = dict(b.attrs)
+    for child in b.blocks:
+        d = _block_to_dict(child)
+        if child.labels:
+            out.setdefault(child.type, {})[child.labels[0]] = d
+        else:
+            out.setdefault(child.type, []).append(d)
+    return out

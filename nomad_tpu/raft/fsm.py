@@ -82,6 +82,12 @@ class NomadFSM:
             MessageType.DEPLOYMENT_UPSERT: self._apply_deployment_upsert,
             MessageType.DEPLOYMENT_DELETE: self._apply_deployment_delete,
             MessageType.SCHEDULER_CONFIG: self._apply_scheduler_config,
+            MessageType.NAMESPACE_UPSERT: self._apply_namespace_upsert,
+            MessageType.NAMESPACE_DELETE: self._apply_namespace_delete,
+            MessageType.ACL_POLICY_UPSERT: self._apply_acl_policy_upsert,
+            MessageType.ACL_POLICY_DELETE: self._apply_acl_policy_delete,
+            MessageType.ACL_TOKEN_UPSERT: self._apply_acl_token_upsert,
+            MessageType.ACL_TOKEN_DELETE: self._apply_acl_token_delete,
             MessageType.NOOP: lambda index, p: None,
         }
         # optional table handlers registered by periphery subsystems
@@ -186,6 +192,37 @@ class NomadFSM:
 
     # ------------------------------------------------------------- snapshot
 
+    # --- namespaces / ACL
+
+    def _apply_namespace_upsert(self, index, p):
+        self.store.upsert_namespace(index, p["name"],
+                                    p.get("description", ""))
+
+    def _apply_namespace_delete(self, index, p):
+        self.store.delete_namespace(index, p["name"])
+
+    def _apply_acl_policy_upsert(self, index, p):
+        self.store.upsert_acl_policy(index, p["policy"])
+
+    def _apply_acl_policy_delete(self, index, p):
+        self.store.delete_acl_policy(index, p["name"])
+
+    def _apply_acl_token_upsert(self, index, p):
+        # replicated one-time-bootstrap invariant: a bootstrap-minted
+        # management token is dropped if one already exists, so the check
+        # is deterministic across the cluster (reference: ACL bootstrap
+        # goes through Raft with a reset index guard)
+        if p.get("bootstrap"):
+            tok = p["token"]
+            if any(t.type == "management"
+                   for t in self.store.acl_tokens()
+                   if t.accessor_id != tok.accessor_id):
+                return
+        self.store.upsert_acl_token(index, p["token"])
+
+    def _apply_acl_token_delete(self, index, p):
+        self.store.delete_acl_token(index, p["accessor_id"])
+
     def snapshot(self) -> bytes:
         """Serialize the full store (reference nomadFSM.Snapshot →
         nomadSnapshot.Persist, nomad/fsm.go)."""
@@ -201,6 +238,9 @@ class NomadFSM:
                 "deployments": list(s._deployments.values()),
                 "job_summaries": dict(s._job_summaries),
                 "scheduler_config": s.scheduler_config,
+                "namespaces": dict(s._namespaces),
+                "acl_policies": dict(s._acl_policies),
+                "acl_tokens": list(s._acl_tokens.values()),
                 "extra": {name: fn() for name, fn in
                           getattr(self, "snapshot_extra", {}).items()},
             }
@@ -230,6 +270,14 @@ class NomadFSM:
             s._deployments = {d.id: d for d in data["deployments"]}
             s._job_summaries = dict(data["job_summaries"])
             s.scheduler_config = data["scheduler_config"]
+            s._namespaces = dict(data.get("namespaces") or {
+                "default": {"name": "default", "description": ""}})
+            s._acl_policies = dict(data.get("acl_policies", {}))
+            s._acl_tokens = {}
+            s._acl_by_secret = {}
+            for t in data.get("acl_tokens", []):
+                s._acl_tokens[t.accessor_id] = t
+                s._acl_by_secret[t.secret_id] = t
             s.matrix = ClusterMatrix()
             for n in data["nodes"]:
                 s.matrix.upsert_node(n)

@@ -25,6 +25,38 @@ class RpcError(Exception):
         self.leader = leader
 
 
+class _DryRunPlanner:
+    """Planner that records plans without committing (reference: the
+    Job.Plan path runs the scheduler with a no-op planner capturing the
+    plan for annotation output)."""
+
+    def __init__(self, store):
+        self.store = store
+        self.plans = []
+        self.evals = []
+
+    def submit_plan(self, plan):
+        from nomad_tpu.structs.plan import PlanResult
+        self.plans.append(plan)
+        return PlanResult(node_update=plan.node_update,
+                          node_allocation=plan.node_allocation,
+                          node_preemptions=plan.node_preemptions,
+                          deployment=plan.deployment,
+                          alloc_index=self.store.latest_index)
+
+    def create_evals(self, evals):
+        self.evals.extend(evals)
+
+    def update_eval(self, ev):
+        pass
+
+    def reblock_eval(self, ev):
+        pass
+
+    def refresh_snapshot(self, min_index: int = 0):
+        return self.store.snapshot()
+
+
 class Endpoints:
     def __init__(self, server):
         self.server = server
@@ -94,6 +126,109 @@ class Endpoints:
         if ns:
             jobs = [j for j in jobs if j.namespace == ns]
         return jobs
+
+    def rpc_Job__Plan(self, args):
+        """Dry-run scheduling (reference Job.Plan, nomad/job_endpoint.go:
+        the scheduler runs against a snapshot with a CapturingPlanner and
+        nothing commits; annotations carry the per-group diff)."""
+        from nomad_tpu.scheduler import factory as sched_factory
+        from nomad_tpu.structs import Evaluation
+        import copy as _copy
+        job = args["job"]
+        server = self.server
+        # store.snapshot() may return a shared memoized snapshot — shallow
+        # copy before overlaying the hypothetical job so concurrent
+        # workers never see the dry-run state
+        snap = _copy.copy(server.store.snapshot())
+        planner = _DryRunPlanner(server.store)
+        snap.jobs = dict(snap.jobs)
+        snap.jobs[(job.namespace, job.id)] = job
+        ev = Evaluation(
+            namespace=job.namespace, priority=job.priority, type=job.type,
+            job_id=job.id, triggered_by=EvalTrigger.JOB_REGISTER,
+            status=EvalStatus.PENDING, annotate_plan=True)
+        sched = sched_factory.new_scheduler(
+            job.type if job.type in ("service", "batch", "system",
+                                     "sysbatch") else "service",
+            snap, planner)
+        sched.process(ev)
+        plan = planner.plans[-1] if planner.plans else None
+        ann = plan.annotations if plan is not None else None
+        return {
+            "annotations": ann,
+            "failed_tg_allocs": getattr(sched, "failed_tg_allocs", None),
+            "placements": sum(len(v) for v in
+                              plan.node_allocation.values()) if plan else 0,
+            "preemptions": sum(len(v) for v in
+                               plan.node_preemptions.values()) if plan else 0,
+            "job_modify_index": job.job_modify_index,
+        }
+
+    def rpc_Job__Dispatch(self, args):
+        """Dispatch a parameterized job instance (reference Job.Dispatch):
+        materialize a child job carrying the payload/meta."""
+        import time as _t
+        import uuid as _uuid
+        ns = args.get("namespace", "default")
+        parent = self.server.store.job_by_id(ns, args["job_id"])
+        if parent is None:
+            raise RpcError("not_found", args["job_id"])
+        if not parent.is_parameterized():
+            raise RpcError("bad_request",
+                           f"job {args['job_id']} is not parameterized")
+        cfg = parent.parameterized
+        payload = args.get("payload") or ""
+        if cfg.payload == "forbidden" and payload:
+            raise RpcError("bad_request", "payload forbidden")
+        if cfg.payload == "required" and not payload:
+            raise RpcError("bad_request", "payload required")
+        meta = dict(args.get("meta") or {})
+        missing = [k for k in cfg.meta_required if k not in meta]
+        if missing:
+            raise RpcError("bad_request", f"missing meta: {missing}")
+        unknown = [k for k in meta if k not in cfg.meta_required
+                   and k not in cfg.meta_optional]
+        if unknown:
+            raise RpcError("bad_request", f"unknown meta: {unknown}")
+        child = parent.copy()
+        child.parent_id = parent.id
+        child.id = (f"{parent.id}/dispatch-{int(_t.time())}-"
+                    f"{str(_uuid.uuid4())[:8]}")
+        child.name = child.id
+        child.parameterized = None
+        child.payload = payload.encode() if isinstance(payload, str) \
+            else payload
+        child.meta = {**(parent.meta or {}), **meta}
+        ev = self.server.register_job(child)
+        return {"dispatched_job_id": child.id, "eval_id": ev.id}
+
+    def rpc_Job__Revert(self, args):
+        """Revert to a prior version (reference Job.Revert): re-register
+        the stored version's job."""
+        ns = args.get("namespace", "default")
+        prior = self.server.store.job_version(
+            ns, args["job_id"], args["version"])
+        if prior is None:
+            raise RpcError(
+                "not_found",
+                f"job {args['job_id']} version {args['version']}")
+        current = self.server.store.job_by_id(ns, args["job_id"])
+        if current is not None and current.version == prior.version:
+            raise RpcError("bad_request",
+                           "cannot revert to the current version")
+        j = prior.copy()
+        ev = self.server.register_job(j)
+        return {"eval_id": ev.id, "job_version": j.version}
+
+    def rpc_Job__Stability(self, args):
+        self.server.set_job_stability(
+            args.get("namespace", "default"), args["job_id"],
+            args["version"], args["stable"])
+        return {}
+
+    def rpc_Job__Summary(self, args):
+        return self.server.store.job_summary(
+            args.get("namespace", "default"), args["job_id"])
 
     def rpc_Job__Allocations(self, args):
         return self.server.store.allocs_by_job(

@@ -130,6 +130,14 @@ class StateStore:
         self._allocs_by_eval: Dict[str, Set[str]] = defaultdict(set)
         self._evals_by_job: Dict[Tuple[str, str], Set[str]] = defaultdict(set)
         self.scheduler_config = SchedulerConfiguration()
+        # namespaces table (reference nomad/state/schema.go namespaces)
+        self._namespaces: Dict[str, dict] = {
+            "default": {"name": "default",
+                        "description": "Default shared namespace"}}
+        # ACL tables (reference schema.go acl_policy / acl_token)
+        self._acl_policies: Dict[str, object] = {}
+        self._acl_tokens: Dict[str, object] = {}       # by accessor_id
+        self._acl_by_secret: Dict[str, object] = {}
         self.matrix = ClusterMatrix()
         self._snapshot_cache: Optional[StateSnapshot] = None
         # watchers: fn(table: str, obj) called after commit, outside hot loops
@@ -316,6 +324,12 @@ class StateStore:
                         self._jobs[key] = u
                     break
             self._bump(index)
+
+    def job_versions(self, namespace: str, job_id: str) -> List[Job]:
+        """All tracked versions, newest first (reference JobVersionsByID)."""
+        with self._lock:
+            return sorted(self._job_versions.get((namespace, job_id), ()),
+                          key=lambda j: j.version, reverse=True)
 
     def job_version(self, namespace: str, job_id: str, version: int) -> Optional[Job]:
         with self._lock:
@@ -531,6 +545,76 @@ class StateStore:
             cfg.modify_index = index
             self.scheduler_config = cfg
             self._bump(index)
+
+    # ------------------------------------------------------------ namespaces
+
+    def upsert_namespace(self, index: int, name: str, description: str = "") -> None:
+        with self._lock:
+            self._namespaces[name] = {"name": name,
+                                      "description": description}
+            self._bump(index)
+
+    def delete_namespace(self, index: int, name: str) -> None:
+        with self._lock:
+            if name == "default":
+                raise ValueError("default namespace cannot be deleted")
+            for ns, _ in self._jobs:
+                if ns == name:
+                    raise ValueError(f"namespace {name!r} has jobs")
+            self._namespaces.pop(name, None)
+            self._bump(index)
+
+    def namespaces(self) -> List[dict]:
+        with self._lock:
+            return list(self._namespaces.values())
+
+    # ------------------------------------------------------------ ACL
+
+    def upsert_acl_policy(self, index: int, policy) -> None:
+        with self._lock:
+            self._acl_policies[policy.name] = policy
+            self._bump(index)
+
+    def delete_acl_policy(self, index: int, name: str) -> None:
+        with self._lock:
+            self._acl_policies.pop(name, None)
+            self._bump(index)
+
+    def acl_policy(self, name: str):
+        with self._lock:
+            return self._acl_policies.get(name)
+
+    def acl_policies(self) -> list:
+        with self._lock:
+            return list(self._acl_policies.values())
+
+    def upsert_acl_token(self, index: int, token) -> None:
+        with self._lock:
+            token.modify_index = index
+            if not token.create_index:
+                token.create_index = index
+            self._acl_tokens[token.accessor_id] = token
+            self._acl_by_secret[token.secret_id] = token
+            self._bump(index)
+
+    def delete_acl_token(self, index: int, accessor_id: str) -> None:
+        with self._lock:
+            t = self._acl_tokens.pop(accessor_id, None)
+            if t is not None:
+                self._acl_by_secret.pop(t.secret_id, None)
+            self._bump(index)
+
+    def acl_token(self, accessor_id: str):
+        with self._lock:
+            return self._acl_tokens.get(accessor_id)
+
+    def acl_token_by_secret(self, secret_id: str):
+        with self._lock:
+            return self._acl_by_secret.get(secret_id)
+
+    def acl_tokens(self) -> list:
+        with self._lock:
+            return list(self._acl_tokens.values())
 
     # ------------------------------------------------------------ plan results
 

@@ -1,0 +1,121 @@
+"""In-process telemetry registry (reference: armon/go-metrics wired in
+command/agent/command.go:1034-1140, exposed at /v1/metrics and documented
+in website/content/docs/operations/metrics-reference.mdx).
+
+Canonical names mirror the reference's scheduler metrics:
+  nomad.plan.evaluate / nomad.plan.submit      (plan_apply.go:185)
+  nomad.worker.invoke_scheduler.<type>         (worker.go:554)
+  nomad.broker.total_ready / total_unacked     (eval_broker metrics)
+plus whatever callers emit.  Counters, gauges, and timing samples with
+mean/max/p99; JSON snapshot for /v1/metrics and Prometheus text
+exposition for /v1/metrics?format=prometheus.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List
+
+
+class _Sample:
+    __slots__ = ("count", "total", "max", "values")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.values: List[float] = []          # bounded reservoir
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        self.max = max(self.max, v)
+        if len(self.values) < 1024:
+            self.values.append(v)
+        else:                                   # reservoir replacement
+            self.values[self.count % 1024] = v
+
+    def summary(self) -> dict:
+        vals = sorted(self.values)
+        p99 = vals[min(len(vals) - 1, int(len(vals) * 0.99))] if vals else 0.0
+        return {"count": self.count,
+                "mean": self.total / self.count if self.count else 0.0,
+                "max": self.max, "p99": p99}
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
+        self._samples: Dict[str, _Sample] = defaultdict(_Sample)
+
+    def incr(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def add_sample(self, name: str, value: float) -> None:
+        with self._lock:
+            self._samples[name].add(value)
+
+    def measure_since(self, name: str, start: float) -> None:
+        self.add_sample(name, (time.time() - start) * 1000.0)  # ms
+
+    class _Timer:
+        __slots__ = ("reg", "name", "start")
+
+        def __init__(self, reg, name):
+            self.reg = reg
+            self.name = name
+
+        def __enter__(self):
+            self.start = time.time()
+            return self
+
+        def __exit__(self, *exc):
+            self.reg.measure_since(self.name, self.start)
+            return False
+
+    def timer(self, name: str) -> "_Timer":
+        return self._Timer(self, name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "Counters": [{"Name": k, "Count": v}
+                             for k, v in sorted(self._counters.items())],
+                "Gauges": [{"Name": k, "Value": v}
+                           for k, v in sorted(self._gauges.items())],
+                "Samples": [dict(Name=k, **s.summary())
+                            for k, s in sorted(self._samples.items())],
+            }
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition (metric names sanitized)."""
+        def san(n):
+            return n.replace(".", "_").replace("-", "_")
+        lines = []
+        with self._lock:
+            for k, v in sorted(self._counters.items()):
+                lines.append(f"# TYPE {san(k)} counter")
+                lines.append(f"{san(k)} {v}")
+            for k, v in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {san(k)} gauge")
+                lines.append(f"{san(k)} {v}")
+            for k, s in sorted(self._samples.items()):
+                m = s.summary()
+                base = san(k)
+                lines.append(f"# TYPE {base} summary")
+                lines.append(f'{base}{{quantile="0.99"}} {m["p99"]}')
+                lines.append(f"{base}_sum {s.total}")
+                lines.append(f"{base}_count {m['count']}")
+        return "\n".join(lines) + "\n"
+
+
+# process-global default registry (the reference's metrics.Default())
+global_metrics = MetricsRegistry()

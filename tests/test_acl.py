@@ -1,0 +1,134 @@
+"""ACL tests (reference analog: acl/acl_test.go, acl/policy_test.go,
+nomad/acl_endpoint_test.go)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.acl import ACL, parse_policy
+from nomad_tpu.acl.policy import (
+    CAP_LIST_JOBS,
+    CAP_READ_JOB,
+    CAP_SUBMIT_JOB,
+)
+
+
+def test_parse_policy_namespace_short_form():
+    p = parse_policy("readonly", 'namespace "default" { policy = "read" }')
+    assert p.namespaces[0].name == "default"
+    assert p.namespaces[0].policy == "read"
+    caps = p.namespaces[0].expanded()
+    assert CAP_LIST_JOBS in caps
+    assert CAP_READ_JOB in caps
+    assert CAP_SUBMIT_JOB not in caps
+
+
+def test_parse_policy_capabilities():
+    p = parse_policy("submitter", '''
+namespace "ops" {
+  capabilities = ["submit-job", "read-job"]
+}
+node { policy = "read" }
+operator { policy = "write" }
+''')
+    assert p.namespaces[0].capabilities == ["submit-job", "read-job"]
+    assert p.node == "read"
+    assert p.operator == "write"
+
+
+def test_parse_policy_rejects_empty():
+    with pytest.raises(ValueError):
+        parse_policy("empty", "# nothing")
+
+
+def test_acl_allows():
+    pol = parse_policy("p", '''
+namespace "default" { policy = "write" }
+namespace "prod-*"  { policy = "read" }
+node { policy = "read" }
+''')
+    acl = ACL(policies=[pol])
+    assert acl.allows("default", CAP_SUBMIT_JOB)
+    assert acl.allows("prod-web", CAP_READ_JOB)
+    assert not acl.allows("prod-web", CAP_SUBMIT_JOB)
+    assert not acl.allows("other", CAP_READ_JOB)
+    assert acl.allows(None, "node:read")
+    assert not acl.allows(None, "node:write")
+    assert not acl.allows(None, "operator:read")
+
+
+def test_acl_deny_overrides():
+    a = parse_policy("allow", 'namespace "secret" { policy = "write" }')
+    d = parse_policy("deny", 'namespace "secret" { policy = "deny" }')
+    acl = ACL(policies=[a, d])
+    assert not acl.allows("secret", CAP_READ_JOB)
+
+
+def test_management_allows_all():
+    acl = ACL(management=True)
+    assert acl.allows("anything", CAP_SUBMIT_JOB)
+    assert acl.allows(None, "operator:write")
+
+
+def test_server_token_resolution():
+    from nomad_tpu.core.server import Server, ServerConfig
+    s = Server(ServerConfig(num_schedulers=0))
+    s.start()
+    try:
+        s.enable_acl()
+        boot = s.bootstrap_acl()
+        assert boot.type == "management"
+        with pytest.raises(RuntimeError):
+            s.bootstrap_acl()          # only once
+        acl = s.resolve_token(boot.secret_id)
+        assert acl.management
+
+        s.upsert_acl_policy("readonly", "",
+                            'namespace "default" { policy = "read" }')
+        tok = s.create_acl_token(name="reader", policies=["readonly"])
+        racl = s.resolve_token(tok.secret_id)
+        assert racl.allows("default", CAP_READ_JOB)
+        assert not racl.allows("default", CAP_SUBMIT_JOB)
+
+        assert s.resolve_token("bogus-secret") is None
+        assert s.resolve_token("") is None      # no anonymous policy
+
+        s.delete_acl_token(tok.accessor_id)
+        assert s.resolve_token(tok.secret_id) is None
+    finally:
+        s.stop()
+
+
+def test_http_acl_enforcement():
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api import ApiClient, ApiError
+
+    a = Agent(AgentConfig(http_port=0, num_schedulers=1,
+                          heartbeat_ttl=60.0))
+    a.start()
+    try:
+        a.server.register_node(mock.node())
+        boot = a.server.bootstrap_acl()
+        a.server.enable_acl()
+
+        anon = ApiClient(a.http_addr)
+        with pytest.raises(ApiError) as e:
+            anon.jobs.list()
+        assert e.value.status == 403
+        # status endpoints stay anonymous
+        assert anon.system.leader() is not None
+
+        mgmt = ApiClient(a.http_addr, token=boot.secret_id)
+        assert mgmt.jobs.list() == []
+        mgmt.acl.upsert_policy(
+            "readonly", 'namespace "default" { policy = "read" }')
+        resp = mgmt.acl.create_token(name="ro", policies=["readonly"])
+
+        ro = ApiClient(a.http_addr, token=resp["SecretID"])
+        assert ro.jobs.list() == []
+        with pytest.raises(ApiError) as e:
+            ro.jobs.register(mock.job())
+        assert e.value.status == 403
+
+        assert mgmt.acl.self_token()["AccessorID"] == boot.accessor_id
+        assert len(mgmt.acl.tokens()) == 2
+    finally:
+        a.stop()

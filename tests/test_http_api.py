@@ -1,0 +1,183 @@
+"""HTTP API + SDK tests (reference analog: command/agent/*_endpoint_test.go
+and api/ tests run against a dev agent)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import ApiClient, ApiError
+from nomad_tpu.api.codec import from_wire, to_wire
+from nomad_tpu.structs import Job
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(http_port=0, num_schedulers=2,
+                          heartbeat_ttl=60.0))
+    a.start()
+    for _ in range(4):
+        a.server.register_node(mock.node())
+    yield a
+    a.stop()
+
+
+@pytest.fixture(scope="module")
+def api(agent):
+    return ApiClient(agent.http_addr)
+
+
+def test_codec_roundtrip():
+    job = mock.job()
+    wire = to_wire(job)
+    back = from_wire(Job, wire)
+    assert back.id == job.id
+    assert back.task_groups[0].tasks[0].resources.cpu == \
+        job.task_groups[0].tasks[0].resources.cpu
+    assert back.task_groups[0].count == job.task_groups[0].count
+
+
+def test_status_and_agent(api):
+    assert api.system.leader() is not None
+    assert api.system.peers()
+    self_info = api.system.agent_self()
+    assert self_info["stats"]["server"] is True
+
+
+def test_node_list_and_info(api):
+    nodes = api.nodes.list()
+    assert len(nodes) == 4
+    info = api.nodes.info(nodes[0]["ID"])
+    assert info.id == nodes[0]["ID"]
+    assert info.status == "ready"
+
+
+def test_job_register_flow(api, agent):
+    job = mock.job()
+    resp = api.jobs.register(job)
+    assert resp["EvalID"]
+    agent.server.wait_for_idle(10.0)
+    got = api.jobs.info(job.id)
+    assert got.id == job.id
+    allocs = api.jobs.allocations(job.id)
+    assert len(allocs) == job.task_groups[0].count
+    evals = api.jobs.evaluations(job.id)
+    assert any(e.status == "complete" for e in evals)
+    # eval detail + allocations
+    ev = api.evaluations.info(resp["EvalID"])
+    assert ev.job_id == job.id
+    # alloc detail + stop
+    alloc = api.allocations.info(allocs[0]["ID"])
+    assert alloc.job_id == job.id
+    stop = api.allocations.stop(alloc.id)
+    assert stop["eval_id"]
+
+
+def test_job_deregister(api, agent):
+    job = mock.job()
+    api.jobs.register(job)
+    agent.server.wait_for_idle(10.0)
+    api.jobs.deregister(job.id)
+    agent.server.wait_for_idle(10.0)
+    got = api.jobs.info(job.id)
+    assert got.stop is True
+
+
+def test_missing_job_404(api):
+    with pytest.raises(ApiError) as e:
+        api.jobs.info("nope-" + "0" * 8)
+    assert e.value.status == 404
+
+
+def test_operator_scheduler_config(api):
+    cfg = api.operator.scheduler_get_configuration()
+    assert cfg.scheduler_algorithm in ("binpack", "spread")
+    cfg.scheduler_algorithm = "spread"
+    api.operator.scheduler_set_configuration(cfg)
+    got = api.operator.scheduler_get_configuration()
+    assert got.scheduler_algorithm == "spread"
+    got.scheduler_algorithm = "binpack"
+    api.operator.scheduler_set_configuration(got)
+
+
+def test_search(api, agent):
+    job = mock.job()
+    api.jobs.register(job)
+    agent.server.wait_for_idle(5.0)
+    res = api.system.search(job.id[:8], "jobs")
+    assert job.id in res["Matches"]["jobs"]
+
+
+def test_namespaces(api):
+    api.namespaces.register("ops", "ops namespace")
+    names = {n["name"] for n in api.namespaces.list()}
+    assert {"default", "ops"} <= names
+    api.namespaces.delete("ops")
+    names = {n["name"] for n in api.namespaces.list()}
+    assert "ops" not in names
+
+
+def test_metrics_endpoint(api):
+    from nomad_tpu.telemetry import global_metrics
+    global_metrics.incr("test.counter")
+    snap = api.system.metrics()
+    assert any(c["Name"] == "test.counter" for c in snap["Counters"])
+
+
+def test_blocking_query_returns_after_index(api, agent):
+    idx = agent.server.store.latest_index
+    t0 = time.time()
+    # a blocking query on a stale index returns immediately
+    api._request("GET", "/v1/jobs", {"index": "0", "wait": "2s"})
+    assert time.time() - t0 < 1.0
+    # on the current index it waits ~the wait time unless something changes
+    t0 = time.time()
+    api._request("GET", "/v1/jobs", {"index": str(idx + 1000), "wait": "300ms"})
+    assert time.time() - t0 >= 0.25
+
+
+def test_job_plan_dry_run(api, agent):
+    job = mock.job()
+    resp = api.jobs.plan(job)
+    assert resp["placements"] == job.task_groups[0].count
+    # nothing was committed
+    with pytest.raises(ApiError):
+        api.jobs.info(job.id)
+
+
+def test_job_dispatch_parameterized(api, agent):
+    job = mock.job()
+    from nomad_tpu.structs.job import ParameterizedJobConfig
+    job.parameterized = ParameterizedJobConfig(
+        payload="optional", meta_required=["env"])
+    api.jobs.register(job)
+    agent.server.wait_for_idle(5.0)
+    resp = api.jobs.dispatch(job.id, payload="aGk=", meta={"env": "prod"})
+    assert resp["dispatched_job_id"].startswith(job.id + "/dispatch-")
+    agent.server.wait_for_idle(5.0)
+    child = api.jobs.info(resp["dispatched_job_id"])
+    assert child.parent_id == job.id
+    # missing required meta rejected
+    with pytest.raises(ApiError):
+        api.jobs.dispatch(job.id, meta={})
+
+
+def test_event_stream(api, agent):
+    seen = []
+    import threading
+
+    def consume():
+        try:
+            for frame in api.system.event_stream(
+                    topics=["Job"], timeout=2.0):
+                seen.extend(frame.get("Events", []))
+        except Exception:
+            pass
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    job = mock.job()
+    api.jobs.register(job)
+    t.join(5.0)
+    assert any(e.get("Key") == job.id for e in seen)

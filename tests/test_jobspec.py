@@ -1,0 +1,243 @@
+"""Jobspec HCL parser tests (reference analog: jobspec2/parse_test.go)."""
+import pytest
+
+from nomad_tpu.jobspec import parse_hcl, parse_job, HclParseError
+from nomad_tpu.jobspec.parse import parse_duration
+
+EXAMPLE = '''
+# An example service job
+job "web" {
+  type        = "service"
+  priority    = 70
+  datacenters = ["dc1", "dc2"]
+
+  constraint {
+    attribute = "${attr.kernel.name}"
+    value     = "linux"
+  }
+
+  update {
+    max_parallel     = 2
+    canary           = 1
+    auto_revert      = true
+    min_healthy_time = "15s"
+    healthy_deadline = "5m"
+  }
+
+  meta {
+    owner = "team-web"
+  }
+
+  group "frontend" {
+    count = 3
+
+    spread {
+      attribute = "${node.datacenter}"
+      weight    = 50
+      target "dc1" { percent = 70 }
+      target "dc2" { percent = 30 }
+    }
+
+    restart {
+      attempts = 3
+      interval = "30m"
+      delay    = "10s"
+      mode     = "delay"
+    }
+
+    ephemeral_disk {
+      size   = 500
+      sticky = true
+    }
+
+    network {
+      mode = "bridge"
+      port "http" { to = 8080 }
+      port "admin" { static = 9090 }
+    }
+
+    volume "data" {
+      type   = "host"
+      source = "data-vol"
+    }
+
+    task "server" {
+      driver = "mock"
+
+      config {
+        image = "nginx:1.21"
+        args  = ["-p", "8080"]
+      }
+
+      env {
+        PORT = "8080"
+        MODE = "production"
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+
+        device "nvidia/gpu" {
+          count = 1
+        }
+      }
+
+      service {
+        name = "web-frontend"
+        port = "http"
+        tags = ["urlprefix-/web"]
+      }
+
+      template {
+        destination = "local/config.json"
+        data        = <<EOF
+{"listen": "${PORT}"}
+EOF
+      }
+
+      kill_timeout = "20s"
+    }
+
+    task "sidecar" {
+      driver = "mock"
+      lifecycle {
+        hook    = "prestart"
+        sidecar = true
+      }
+    }
+  }
+
+  group "batchers" {
+    count = 2
+    reschedule {
+      attempts  = 5
+      unlimited = false
+      interval  = "1h"
+    }
+    task "worker" {
+      driver = "mock"
+    }
+  }
+}
+'''
+
+
+def test_parse_duration():
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("1h30m") == 5400.0
+    assert parse_duration("250ms") == 0.25
+    assert parse_duration(15) == 15.0
+    assert parse_duration(None, 7.5) == 7.5
+    with pytest.raises(HclParseError):
+        parse_duration("bogus")
+
+
+def test_parse_full_job():
+    job = parse_job(EXAMPLE)
+    assert job.id == "web"
+    assert job.type == "service"
+    assert job.priority == 70
+    assert job.datacenters == ["dc1", "dc2"]
+    assert job.constraints[0].ltarget == "${attr.kernel.name}"
+    assert job.constraints[0].rtarget == "linux"
+    assert job.update.max_parallel == 2
+    assert job.update.canary == 1
+    assert job.update.auto_revert is True
+    assert job.update.min_healthy_time_s == 15.0
+    assert job.meta["owner"] == "team-web"
+
+    assert len(job.task_groups) == 2
+    fe = job.task_groups[0]
+    assert fe.name == "frontend"
+    assert fe.count == 3
+    assert fe.spreads[0].attribute == "${node.datacenter}"
+    assert fe.spreads[0].targets[0].value == "dc1"
+    assert fe.spreads[0].targets[0].percent == 70
+    assert fe.restart_policy.attempts == 3
+    assert fe.restart_policy.interval_s == 1800.0
+    assert fe.ephemeral_disk.size_mb == 500
+    assert fe.ephemeral_disk.sticky is True
+    assert fe.networks[0].mode == "bridge"
+    assert fe.networks[0].dynamic_ports[0].label == "http"
+    assert fe.networks[0].dynamic_ports[0].to == 8080
+    assert fe.networks[0].reserved_ports[0].value == 9090
+    assert fe.volumes["data"].source == "data-vol"
+
+    server = fe.tasks[0]
+    assert server.driver == "mock"
+    assert server.config["image"] == "nginx:1.21"
+    assert server.config["args"] == ["-p", "8080"]
+    assert server.env["PORT"] == "8080"
+    assert server.resources.cpu == 500
+    assert server.resources.memory_mb == 256
+    assert server.resources.devices[0].name == "nvidia/gpu"
+    assert server.services[0].name == "web-frontend"
+    assert server.kill_timeout_s == 20.0
+    assert '"listen"' in server.templates[0]["data"]
+
+    sidecar = fe.tasks[1]
+    assert sidecar.lifecycle.hook == "prestart"
+    assert sidecar.lifecycle.sidecar is True
+
+    batch = job.task_groups[1]
+    assert batch.reschedule_policy.attempts == 5
+    assert batch.reschedule_policy.unlimited is False
+
+    # canonicalize propagated the job-level update into the group
+    assert fe.update is not None
+    assert fe.update.canary == 1
+
+
+def test_parse_periodic_and_parameterized():
+    src = '''
+job "cron" {
+  type = "batch"
+  periodic {
+    cron             = "*/15 * * * *"
+    prohibit_overlap = true
+  }
+  group "g" { task "t" { driver = "mock" } }
+}
+'''
+    job = parse_job(src)
+    assert job.periodic.spec == "*/15 * * * *"
+    assert job.periodic.prohibit_overlap is True
+
+    src2 = '''
+job "proc" {
+  type = "batch"
+  parameterized {
+    payload       = "required"
+    meta_required = ["input"]
+  }
+  group "g" { task "t" { driver = "mock" } }
+}
+'''
+    job2 = parse_job(src2)
+    assert job2.parameterized.payload == "required"
+    assert job2.parameterized.meta_required == ["input"]
+
+
+def test_parse_errors():
+    with pytest.raises(HclParseError):
+        parse_job("group {}")          # no job block
+    with pytest.raises(HclParseError):
+        parse_hcl('job "x" {')         # unterminated
+    with pytest.raises(HclParseError):
+        parse_hcl('job = = "x"')
+
+
+def test_comments_and_heredoc():
+    root = parse_hcl('''
+// line comment
+/* block
+   comment */
+a = 1  # trailing
+b = <<EOT
+line1
+line2
+EOT
+''')
+    assert root.attrs["a"] == 1
+    assert root.attrs["b"] == "line1\nline2"
