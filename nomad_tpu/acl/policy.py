@@ -210,8 +210,14 @@ def required_capability(parts: List[str], method: str,
     write = method in ("PUT", "POST", "DELETE")
     head = parts[0] if parts else ""
     ns = namespace or "default"
-    if head in ("status", "metrics", "agent"):
+    if head in ("status", "metrics"):
         return (None, None)
+    if head == "agent":
+        # /v1/agent/health stays unauthenticated (reference agent
+        # health checks); the rest enforce the agent coarse rule
+        if parts[1:2] == ["health"]:
+            return (None, None)
+        return (f"agent:{'write' if write else 'read'}", None)
     if head in ("jobs", "job"):
         if write:
             cap = CAP_SUBMIT_JOB

@@ -178,12 +178,26 @@ class HTTPServer:
         if not acl.allows(ns, cap):
             raise HTTPError(403, f"Permission denied: needs {cap}")
 
+    def _ns_visible(self, h, namespace: str) -> bool:
+        """Namespace-level read filter for list endpoints (the reference
+        scopes every list RPC by the token's namespace grants)."""
+        if not getattr(self.agent.server, "acl_enabled", False):
+            return True
+        acl = getattr(h, "acl", None)
+        if acl is None:
+            return False
+        from nomad_tpu.acl.policy import CAP_LIST_JOBS, CAP_READ_JOB
+        return acl.allows(namespace, CAP_LIST_JOBS) or \
+            acl.allows(namespace, CAP_READ_JOB)
+
     # ------------------------------------------------------------ jobs
 
     def _h_get_jobs(self, h, parts, q):
         jobs = self._rpc("Job.List", {"namespace": q.get("namespace")})
         prefix = q.get("prefix", "")
-        return [_job_stub(j) for j in jobs if j.id.startswith(prefix)]
+        return [_job_stub(j) for j in jobs
+                if j.id.startswith(prefix)
+                and self._ns_visible(h, j.namespace)]
 
     def _h_put_jobs(self, h, parts, q):
         body = h._body()
@@ -336,14 +350,15 @@ class HTTPServer:
         sub = parts[2] if len(parts) > 2 else None
         body = h._body()
         if sub == "drain":
-            spec = body.get("DrainSpec") or {}
-            enable = bool(spec) or body.get("Enable", False)
-            if enable:
+            spec = body.get("DrainSpec")
+            if spec:
                 self._rpc("Node.UpdateDrain", {
                     "node_id": parts[1],
                     "deadline_s": float(spec.get("Deadline", 3600.0)),
                     "ignore_system_jobs": spec.get("IgnoreSystemJobs",
                                                    False)})
+            else:                      # nil spec = cancel (reference API)
+                self._rpc("Node.CancelDrain", {"node_id": parts[1]})
             return {}
         if sub == "eligibility":
             self._rpc("Node.UpdateEligibility", {
@@ -362,7 +377,8 @@ class HTTPServer:
     def _h_get_evaluations(self, h, parts, q):
         prefix = q.get("prefix", "")
         return [e for e in self._rpc("Eval.List", {})
-                if e.id.startswith(prefix)]
+                if e.id.startswith(prefix)
+                and self._ns_visible(h, e.namespace)]
 
     def _h_get_evaluation_id(self, h, parts, q):
         sub = parts[2] if len(parts) > 2 else None
@@ -377,7 +393,8 @@ class HTTPServer:
     def _h_get_allocations(self, h, parts, q):
         prefix = q.get("prefix", "")
         return [_alloc_stub(a) for a in self._rpc("Alloc.List", {})
-                if a.id.startswith(prefix)]
+                if a.id.startswith(prefix)
+                and self._ns_visible(h, a.namespace)]
 
     def _h_get_allocation_id(self, h, parts, q):
         a = self._rpc("Alloc.GetAlloc", {"alloc_id": parts[1]})
@@ -477,15 +494,19 @@ class HTTPServer:
             truncations[name] = len(matches) > 20
             out[name] = matches[:20]
         if context in ("all", "jobs"):
-            add("jobs", [j.id for j in store.jobs()])
+            add("jobs", [j.id for j in store.jobs()
+                         if self._ns_visible(h, j.namespace)])
         if context in ("all", "nodes"):
             add("nodes", [n.id for n in store.nodes()])
         if context in ("all", "evals"):
-            add("evals", [e.id for e in store.evals()])
+            add("evals", [e.id for e in store.evals()
+                          if self._ns_visible(h, e.namespace)])
         if context in ("all", "allocs"):
-            add("allocs", [a.id for a in store.allocs()])
+            add("allocs", [a.id for a in store.allocs()
+                           if self._ns_visible(h, a.namespace)])
         if context in ("all", "deployment"):
-            add("deployment", [d.id for d in store.deployments()])
+            add("deployment", [d.id for d in store.deployments()
+                               if self._ns_visible(h, d.namespace)])
         return {"Matches": out, "Truncations": truncations}
 
     # ------------------------------------------------------------ metrics
@@ -518,6 +539,8 @@ class HTTPServer:
                 topics.setdefault(topic, []).append(key or "*")
         if not topics:
             topics = {"*": ["*"]}
+        h_acl = getattr(h, "acl", None)
+        acl_on = getattr(self.agent.server, "acl_enabled", False)
         sub = self.agent.server.event_broker.subscribe(
             topics, from_index=int(q.get("index", 0)))
         try:
@@ -528,6 +551,9 @@ class HTTPServer:
             deadline = time.time() + float(q.get("timeout", 5.0))
             while time.time() < deadline:
                 ev = sub.next(timeout=0.25)
+                if ev is not None and acl_on and ev.namespace and \
+                        not self._ns_visible(h, ev.namespace):
+                    ev = None               # filtered by namespace grant
                 if ev is None:
                     chunk = b"{}\n"         # heartbeat (reference sends {})
                 else:

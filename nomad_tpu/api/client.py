@@ -38,6 +38,7 @@ class ApiClient:
         self.token = token
         self.namespace = namespace
         self.timeout = timeout
+        self.last_index = 0
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.evaluations = Evaluations(self)

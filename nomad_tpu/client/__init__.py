@@ -1,0 +1,17 @@
+"""Client (node agent) layer.
+
+Reference: client/ — the agent that fingerprints the host, registers the
+node, heartbeats, watches for assigned allocations, and executes them
+through AllocRunner -> TaskRunner -> task driver pipelines
+(client/client.go:169, allocrunner/, taskrunner/, drivers/).
+"""
+from nomad_tpu.client.client import Client, ClientConfig
+from nomad_tpu.client.drivers import (
+    DriverRegistry,
+    MockDriver,
+    RawExecDriver,
+    TaskHandle,
+)
+
+__all__ = ["Client", "ClientConfig", "DriverRegistry", "MockDriver",
+           "RawExecDriver", "TaskHandle"]

@@ -1,0 +1,50 @@
+"""Allocation directory tree (reference: client/allocdir/ — the sandbox
+layout every task sees: a shared alloc/ dir and per-task local/secrets
+dirs)."""
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class AllocDir:
+    """<root>/<alloc_id>/
+         alloc/          shared between tasks
+           data/ logs/ tmp/
+         <task>/
+           local/ secrets/ tmp/
+    (reference client/allocdir/alloc_dir.go)."""
+
+    def __init__(self, root: str, alloc_id: str):
+        self.root = root
+        self.alloc_id = alloc_id
+        self.dir = os.path.join(root, alloc_id)
+        self.shared_dir = os.path.join(self.dir, "alloc")
+
+    def build(self) -> None:
+        for sub in ("data", "logs", "tmp"):
+            os.makedirs(os.path.join(self.shared_dir, sub), exist_ok=True)
+
+    def build_task_dir(self, task_name: str) -> str:
+        task_dir = os.path.join(self.dir, task_name)
+        for sub in ("local", "secrets", "tmp"):
+            os.makedirs(os.path.join(task_dir, sub), exist_ok=True)
+        return task_dir
+
+    def task_dir(self, task_name: str) -> str:
+        return os.path.join(self.dir, task_name)
+
+    def logs_dir(self) -> str:
+        return os.path.join(self.shared_dir, "logs")
+
+    def destroy(self) -> None:
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    def move_from(self, other: "AllocDir") -> None:
+        """Ephemeral-disk migration from a previous alloc's shared data
+        dir (reference client/allocwatcher migration)."""
+        src = os.path.join(other.shared_dir, "data")
+        dst = os.path.join(self.shared_dir, "data")
+        if os.path.isdir(src):
+            shutil.rmtree(dst, ignore_errors=True)
+            shutil.copytree(src, dst)

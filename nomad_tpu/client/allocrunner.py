@@ -1,0 +1,291 @@
+"""AllocRunner (reference: client/allocrunner/alloc_runner.go — the
+per-allocation state machine: hook pipeline (alloc_runner_hooks.go:111),
+lifecycle-ordered task runners (task_hook_coordinator.go), alloc health
+watching (allochealth/), and client-status aggregation).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.taskrunner import TaskRunner
+from nomad_tpu.structs.alloc import AllocClientStatus
+
+
+class AllocRunner:
+    def __init__(self, alloc, driver_registry, root_dir: str,
+                 node=None, on_update: Optional[Callable] = None,
+                 state_db=None, prev_alloc_dir: Optional[AllocDir] = None):
+        self.alloc = alloc
+        self.registry = driver_registry
+        self.node = node
+        self.on_update = on_update or (lambda ar: None)
+        self.state_db = state_db
+        self.alloc_dir = AllocDir(root_dir, alloc.id)
+        self.prev_alloc_dir = prev_alloc_dir
+        self.task_runners: Dict[str, TaskRunner] = {}
+        self.client_status = AllocClientStatus.PENDING
+        self.client_description = ""
+        self._lock = threading.Lock()
+        self._destroyed = False
+        self._thread: Optional[threading.Thread] = None
+        self._health_thread: Optional[threading.Thread] = None
+        self.deployment_healthy: Optional[bool] = None
+
+    def task_group(self):
+        job = self.alloc.job
+        return job.lookup_task_group(self.alloc.task_group) if job else None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def run(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"alloc-{self.alloc.id[:8]}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            # --- alloc prerun hooks (alloc_runner_hooks.go:111):
+            # allocdir -> previous-alloc disk migration -> (network,
+            # services: no-op in the sim) -> health watcher
+            self.alloc_dir.build()
+            tg = self.task_group()
+            if self.prev_alloc_dir is not None and tg is not None \
+                    and tg.ephemeral_disk.migrate:
+                self.alloc_dir.move_from(self.prev_alloc_dir)
+            if tg is None or not tg.tasks:
+                self._set_status(AllocClientStatus.FAILED,
+                                 "no task group in alloc job")
+                return
+
+            ports = self._port_map()
+            for task in tg.tasks:
+                tr = TaskRunner(
+                    self.alloc, task, self.registry.get(task.driver),
+                    self.alloc_dir, node=self.node,
+                    on_state=self._on_task_state, state_db=self.state_db,
+                    ports=ports)
+                self.task_runners[task.name] = tr
+
+            self._start_health_watcher()
+
+            # lifecycle ordering (task_hook_coordinator.go): prestart
+            # (non-sidecar) tasks run to completion first, then main +
+            # sidecars start; poststart after mains are running; poststop
+            # runs after mains exit.
+            prestarts = [t for t in tg.tasks if t.lifecycle is not None
+                         and t.lifecycle.hook == "prestart"
+                         and not t.lifecycle.sidecar]
+            prestart_side = [t for t in tg.tasks if t.lifecycle is not None
+                             and t.lifecycle.hook == "prestart"
+                             and t.lifecycle.sidecar]
+            mains = [t for t in tg.tasks if t.lifecycle is None]
+            poststarts = [t for t in tg.tasks if t.lifecycle is not None
+                          and t.lifecycle.hook == "poststart"]
+            poststops = [t for t in tg.tasks if t.lifecycle is not None
+                         and t.lifecycle.hook == "poststop"]
+
+            for t in prestart_side:
+                self.task_runners[t.name].start()
+            for t in prestarts:
+                tr = self.task_runners[t.name]
+                tr.start()
+                tr.join(timeout=600.0)
+                if tr.state.failed:
+                    self._fail_remaining("prestart task failed")
+                    return
+            for t in mains:
+                self.task_runners[t.name].start()
+            if poststarts:
+                self._wait_any_running([self.task_runners[t.name]
+                                        for t in mains])
+                for t in poststarts:
+                    self.task_runners[t.name].start()
+
+            # wait for main tasks (and poststarts) to finish — service
+            # tasks run indefinitely; block until they actually exit or
+            # the runner is stopped (no arbitrary deadline)
+            for t in mains + poststarts:
+                tr = self.task_runners[t.name]
+                while tr._thread is not None and tr._thread.is_alive() \
+                        and not self._destroyed:
+                    tr._thread.join(1.0)
+            # kill sidecars once mains are done (leader semantics:
+            # any task marked leader dying kills the rest)
+            for t in prestart_side:
+                self.task_runners[t.name].kill()
+            for t in prestart_side:
+                self.task_runners[t.name].join(5.0)
+            for t in poststops:
+                tr = self.task_runners[t.name]
+                tr.start()
+                tr.join(600.0)
+            self._finalize_status()
+        except Exception as e:                       # noqa: BLE001
+            self._set_status(AllocClientStatus.FAILED, str(e))
+
+    def _wait_any_running(self, runners: List[TaskRunner],
+                          timeout: float = 300.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if any(tr.state.state == "running" for tr in runners):
+                return
+            if all(tr.state.state == "dead" for tr in runners):
+                return
+            time.sleep(0.05)
+
+    def _port_map(self) -> Dict[str, int]:
+        ports = {}
+        for net in self.alloc.allocated_resources.shared_networks:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                if p.label:
+                    ports[p.label] = p.value
+        return ports
+
+    # ------------------------------------------------------------ status
+
+    def _on_task_state(self, tr: TaskRunner) -> None:
+        with self._lock:
+            self._aggregate_status()
+        self.on_update(self)
+
+    def _aggregate_status(self) -> None:
+        """Client status from task states (alloc_runner.go
+        getClientStatus)."""
+        states = [tr.state for tr in self.task_runners.values()]
+        if not states:
+            return
+        if any(s.failed for s in states):
+            self.client_status = AllocClientStatus.FAILED
+            self.client_description = "Failed tasks"
+        elif all(s.state == "dead" for s in states):
+            self.client_status = AllocClientStatus.COMPLETE
+            self.client_description = "All tasks have completed"
+        elif any(s.state == "running" for s in states):
+            self.client_status = AllocClientStatus.RUNNING
+            self.client_description = "Tasks are running"
+        else:
+            self.client_status = AllocClientStatus.PENDING
+
+    def _finalize_status(self) -> None:
+        with self._lock:
+            self._aggregate_status()
+            # mains exited and sidecars were killed+joined; only coerce a
+            # still-draining sidecar's "running" to complete when every
+            # non-sidecar task is actually dead
+            if self.client_status == AllocClientStatus.RUNNING:
+                tg = self.task_group()
+                mains_dead = all(
+                    tr.state.state == "dead"
+                    for t in (tg.tasks if tg else [])
+                    if t.lifecycle is None
+                    for tr in [self.task_runners.get(t.name)] if tr)
+                if mains_dead:
+                    self.client_status = AllocClientStatus.COMPLETE
+        self.on_update(self)
+
+    def _fail_remaining(self, desc: str) -> None:
+        for tr in self.task_runners.values():
+            tr.kill()
+        self._set_status(AllocClientStatus.FAILED, desc)
+
+    def _set_status(self, status: str, desc: str = "") -> None:
+        with self._lock:
+            self.client_status = status
+            self.client_description = desc
+        self.on_update(self)
+
+    def task_states(self):
+        return {name: tr.state for name, tr in self.task_runners.items()}
+
+    # ------------------------------------------------------------ health
+
+    def _start_health_watcher(self) -> None:
+        """Deployment health: healthy once all tasks are running for
+        min_healthy_time (reference client/allocrunner/allochealth/
+        tracker.go; feeds the deployment watcher)."""
+        if not self.alloc.deployment_id:
+            return
+        tg = self.task_group()
+        update = tg.update if tg else None
+        min_healthy = update.min_healthy_time_s if update else 10.0
+        deadline = update.healthy_deadline_s if update else 300.0
+
+        def watch():
+            start = time.time()
+            healthy_since = None
+            while not self._destroyed:
+                now = time.time()
+                states = [tr.state for tr in self.task_runners.values()]
+                if any(s.failed for s in states):
+                    self._set_health(False)
+                    return
+                mains_running = states and all(
+                    s.state == "running" or (s.state == "dead"
+                                             and not s.failed)
+                    for s in states) and any(
+                    s.state == "running" for s in states)
+                if mains_running:
+                    if healthy_since is None:
+                        healthy_since = now
+                    elif now - healthy_since >= min_healthy:
+                        self._set_health(True)
+                        return
+                else:
+                    healthy_since = None
+                if now - start > deadline:
+                    self._set_health(False)
+                    return
+                time.sleep(0.05)
+
+        self._health_thread = threading.Thread(target=watch, daemon=True)
+        self._health_thread.start()
+
+    def _set_health(self, healthy: bool) -> None:
+        self.deployment_healthy = healthy
+        self.on_update(self)
+
+    # ------------------------------------------------------------ teardown
+
+    def stop(self, timeout_s: Optional[float] = None) -> None:
+        """Kill all tasks (desired_status=stop path)."""
+        for tr in self.task_runners.values():
+            tr.kill(timeout_s)
+
+    def destroy(self) -> None:
+        self._destroyed = True
+        self.stop(0.5)
+        for tr in self.task_runners.values():
+            tr.join(2.0)
+            if tr.handle is not None:
+                tr.driver.destroy_task(tr.handle)
+        self.alloc_dir.destroy()
+        if self.state_db is not None:
+            self.state_db.delete_alloc(self.alloc.id)
+
+    def restore(self) -> None:
+        """Reattach task runners from the state DB after client restart
+        (client restore path, client.go:1290 restoreState)."""
+        if self.state_db is None:
+            return
+        tg = self.task_group()
+        if tg is None:
+            return
+        self.alloc_dir.build()
+        ports = self._port_map()
+        saved = self.state_db.get_task_states(self.alloc.id)
+        for task in tg.tasks:
+            tr = TaskRunner(
+                self.alloc, task, self.registry.get(task.driver),
+                self.alloc_dir, node=self.node,
+                on_state=self._on_task_state, state_db=self.state_db,
+                ports=ports)
+            self.task_runners[task.name] = tr
+            if task.name in saved:
+                state, failed, restarts, handle = saved[task.name]
+                tr.recover(state, failed, restarts, handle)
+        with self._lock:
+            self._aggregate_status()

@@ -1,0 +1,101 @@
+"""Host fingerprinting (reference: client/fingerprint/ — arch, cpu,
+memory, kernel, hostname, storage, nomad-version fingerprinters populating
+Node.attributes and Node.node_resources).
+"""
+from __future__ import annotations
+
+import os
+import platform
+import shutil
+import socket
+from typing import Dict, Tuple
+
+from nomad_tpu.structs.node import (
+    NodeCpuResources,
+    NodeResources,
+)
+
+
+def fingerprint_arch() -> Dict[str, str]:
+    m = platform.machine()
+    return {"cpu.arch": {"x86_64": "amd64", "aarch64": "arm64"}.get(m, m),
+            "arch": {"x86_64": "amd64", "aarch64": "arm64"}.get(m, m)}
+
+
+def fingerprint_kernel() -> Dict[str, str]:
+    return {"kernel.name": platform.system().lower(),
+            "kernel.version": platform.release(),
+            "os.name": platform.system().lower(),
+            "os.version": platform.release()}
+
+
+def fingerprint_host() -> Dict[str, str]:
+    host = socket.gethostname()
+    return {"unique.hostname": host,
+            "unique.network.ip-address": "127.0.0.1"}
+
+
+def fingerprint_cpu() -> Tuple[Dict[str, str], NodeCpuResources]:
+    cores = os.cpu_count() or 1
+    mhz = 1000.0
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.lower().startswith("cpu mhz"):
+                    mhz = float(line.split(":")[1])
+                    break
+    except (OSError, ValueError):
+        pass
+    total = int(cores * mhz)
+    attrs = {"cpu.numcores": str(cores), "cpu.frequency": str(int(mhz)),
+             "cpu.totalcompute": str(total)}
+    return attrs, NodeCpuResources(cpu_shares=total,
+                                   total_core_count=cores,
+                                   reservable_cores=list(range(cores)))
+
+
+def fingerprint_memory() -> Tuple[Dict[str, str], int]:
+    total_mb = 1024
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemTotal"):
+                    total_mb = int(line.split()[1]) // 1024
+                    break
+    except (OSError, ValueError):
+        pass
+    return {"memory.totalbytes": str(total_mb * 1024 * 1024)}, total_mb
+
+
+def fingerprint_storage(path: str = "/") -> Tuple[Dict[str, str], int]:
+    try:
+        usage = shutil.disk_usage(path)
+        free_mb = usage.free // (1024 * 1024)
+    except OSError:
+        free_mb = 10 * 1024
+    return {"unique.storage.volume": path,
+            "unique.storage.bytesfree": str(free_mb * 1024 * 1024)}, free_mb
+
+
+def fingerprint_node(node, drivers: Dict[str, dict],
+                     version: str = "0.1.0") -> None:
+    """Populate a Node in place with host attributes + resources
+    (the fingerprint_manager run, client/fingerprint_manager.go)."""
+    attrs = {}
+    attrs.update(fingerprint_arch())
+    attrs.update(fingerprint_kernel())
+    attrs.update(fingerprint_host())
+    cpu_attrs, cpu_res = fingerprint_cpu()
+    attrs.update(cpu_attrs)
+    mem_attrs, mem_mb = fingerprint_memory()
+    attrs.update(mem_attrs)
+    sto_attrs, disk_mb = fingerprint_storage()
+    attrs.update(sto_attrs)
+    attrs["nomad.version"] = version
+    for name, health in drivers.items():
+        if health.get("detected"):
+            attrs[f"driver.{name}"] = "1"
+    node.attributes.update(attrs)
+    node.node_resources = NodeResources(
+        cpu=cpu_res, memory_mb=mem_mb, disk_mb=disk_mb)
+    node.drivers = dict(drivers)

@@ -1,0 +1,101 @@
+"""Client state database (reference: client/state/state_database.go —
+BoltDB persistence of alloc/task-runner state and driver task handles so
+a restarted client can recover running tasks via RecoverTask).
+
+sqlite3 (stdlib, a real embedded native DB) replaces BoltDB.  Schema
+versioned for upgrade handling (client/state/upgrade.go).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from dataclasses import asdict
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.client.drivers import TaskHandle
+
+SCHEMA_VERSION = 1
+
+
+class ClientStateDB:
+    """Thread-safe persistent store for alloc + task runner state."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._init_schema()
+
+    def _init_schema(self) -> None:
+        with self._lock, self._db:
+            self._db.execute("""CREATE TABLE IF NOT EXISTS meta
+                (key TEXT PRIMARY KEY, value TEXT)""")
+            self._db.execute("""CREATE TABLE IF NOT EXISTS allocs
+                (alloc_id TEXT PRIMARY KEY, blob TEXT NOT NULL)""")
+            self._db.execute("""CREATE TABLE IF NOT EXISTS task_state
+                (alloc_id TEXT, task TEXT, state TEXT, failed INTEGER,
+                 restarts INTEGER, handle TEXT,
+                 PRIMARY KEY (alloc_id, task))""")
+            cur = self._db.execute(
+                "SELECT value FROM meta WHERE key='schema_version'")
+            row = cur.fetchone()
+            if row is None:
+                self._db.execute(
+                    "INSERT INTO meta VALUES ('schema_version', ?)",
+                    (str(SCHEMA_VERSION),))
+            elif int(row[0]) > SCHEMA_VERSION:
+                raise RuntimeError(
+                    f"client state schema {row[0]} is newer than "
+                    f"supported {SCHEMA_VERSION}")
+
+    # ------------------------------------------------------------ allocs
+
+    def put_alloc(self, alloc_id: str, summary: dict) -> None:
+        with self._lock, self._db:
+            self._db.execute(
+                "INSERT OR REPLACE INTO allocs VALUES (?, ?)",
+                (alloc_id, json.dumps(summary)))
+
+    def get_allocs(self) -> Dict[str, dict]:
+        with self._lock:
+            cur = self._db.execute("SELECT alloc_id, blob FROM allocs")
+            return {aid: json.loads(blob) for aid, blob in cur.fetchall()}
+
+    def delete_alloc(self, alloc_id: str) -> None:
+        with self._lock, self._db:
+            self._db.execute("DELETE FROM allocs WHERE alloc_id=?",
+                             (alloc_id,))
+            self._db.execute("DELETE FROM task_state WHERE alloc_id=?",
+                             (alloc_id,))
+
+    # ------------------------------------------------------------ tasks
+
+    def put_task_state(self, alloc_id: str, task: str, state: str,
+                       failed: bool, restarts: int,
+                       handle: Optional[TaskHandle]) -> None:
+        with self._lock, self._db:
+            self._db.execute(
+                "INSERT OR REPLACE INTO task_state VALUES (?,?,?,?,?,?)",
+                (alloc_id, task, state, int(failed), restarts,
+                 json.dumps(asdict(handle)) if handle else None))
+
+    def get_task_states(self, alloc_id: str) \
+            -> Dict[str, Tuple[str, bool, int, Optional[TaskHandle]]]:
+        with self._lock:
+            cur = self._db.execute(
+                "SELECT task, state, failed, restarts, handle "
+                "FROM task_state WHERE alloc_id=?", (alloc_id,))
+            out = {}
+            for task, state, failed, restarts, handle in cur.fetchall():
+                th = None
+                if handle:
+                    th = TaskHandle(**json.loads(handle))
+                out[task] = (state, bool(failed), restarts, th)
+            return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

@@ -1,0 +1,90 @@
+"""Task environment builder (reference: client/taskenv/ — the env-var
+builder that exposes NOMAD_* variables and interpolates ${...} references
+in task config/env/templates)."""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+
+def build_task_env(alloc, task, node, task_dir: str = "",
+                   ports: Optional[Dict[str, int]] = None) -> Dict[str, str]:
+    """The NOMAD_* environment (client/taskenv/env.go Builder)."""
+    job = alloc.job
+    env = {
+        "NOMAD_ALLOC_ID": alloc.id,
+        "NOMAD_SHORT_ALLOC_ID": alloc.id[:8],
+        "NOMAD_ALLOC_NAME": alloc.name,
+        "NOMAD_ALLOC_INDEX": str(_alloc_index(alloc.name)),
+        "NOMAD_TASK_NAME": task.name,
+        "NOMAD_GROUP_NAME": alloc.task_group,
+        "NOMAD_JOB_ID": alloc.job_id,
+        "NOMAD_JOB_NAME": job.name if job else alloc.job_id,
+        "NOMAD_NAMESPACE": alloc.namespace,
+        "NOMAD_REGION": job.region if job else "global",
+        "NOMAD_DC": node.datacenter if node else "dc1",
+        "NOMAD_CPU_LIMIT": str(task.resources.cpu),
+        "NOMAD_MEMORY_LIMIT": str(task.resources.memory_mb),
+    }
+    if node is not None:
+        env["NOMAD_NODE_ID"] = node.id
+        env["NOMAD_NODE_NAME"] = node.name
+    if task_dir:
+        env["NOMAD_TASK_DIR"] = f"{task_dir}/local"
+        env["NOMAD_SECRETS_DIR"] = f"{task_dir}/secrets"
+        env["NOMAD_ALLOC_DIR"] = f"{task_dir}/../alloc"
+    for label, value in (ports or {}).items():
+        up = label.upper().replace("-", "_")
+        env[f"NOMAD_PORT_{up}"] = str(value)
+        env[f"NOMAD_HOST_PORT_{up}"] = str(value)
+        env[f"NOMAD_ADDR_{up}"] = f"127.0.0.1:{value}"
+    # job/group/task meta as NOMAD_META_<key> (uppercased)
+    metas = {}
+    if job is not None:
+        metas.update(job.meta or {})
+        tg = job.lookup_task_group(alloc.task_group)
+        if tg is not None:
+            metas.update(tg.meta or {})
+    metas.update(task.meta or {})
+    for k, v in metas.items():
+        env[f"NOMAD_META_{k.upper().replace('-', '_')}"] = str(v)
+        env[f"NOMAD_META_{k}"] = str(v)
+    # user-declared env wins, after interpolation against the base env
+    for k, v in (task.env or {}).items():
+        env[k] = interpolate(str(v), env, node, metas)
+    return env
+
+
+_REF_RE = re.compile(r"\$\{([^}]+)\}")
+
+
+def interpolate(s: str, env: Dict[str, str], node=None,
+                meta: Optional[Dict[str, str]] = None) -> str:
+    """Resolve ${env.X} / ${meta.X} / ${attr.X} / ${node.X} / ${NOMAD_*}
+    references (reference client/taskenv/env.go ReplaceEnv)."""
+    def sub(m: re.Match) -> str:
+        ref = m.group(1).strip()
+        if ref.startswith("env."):
+            return env.get(ref[4:], "")
+        if ref.startswith("meta."):
+            return str((meta or {}).get(ref[5:], ""))
+        if node is not None:
+            if ref.startswith("attr."):
+                return str(node.attributes.get(ref[5:], ""))
+            if ref.startswith("node."):
+                key = ref[5:]
+                return str({
+                    "unique.id": node.id, "unique.name": node.name,
+                    "datacenter": node.datacenter, "class": node.node_class,
+                    "region": "global",
+                }.get(key, getattr(node, key, "")))
+        if ref in env:
+            return env[ref]
+        return m.group(0)            # leave unknown refs literal
+    return _REF_RE.sub(sub, s)
+
+
+def _alloc_index(name: str) -> int:
+    """'job.group[3]' -> 3 (reference structs AllocIndex)."""
+    m = re.search(r"\[(\d+)\]$", name or "")
+    return int(m.group(1)) if m else 0

@@ -1,0 +1,243 @@
+"""TaskRunner (reference: client/allocrunner/taskrunner/task_runner.go +
+task_runner_hooks.go:49-110 — the per-task lifecycle: hook pipeline,
+driver start, wait loop, restart tracking, state events pushed up).
+
+Hook pipeline here: validate -> taskdir -> dispatch_payload -> taskenv ->
+artifacts(no-op stub) -> templates (rendered with env interpolation) ->
+driver start.  Restart logic: client/allocrunner/taskrunner/restarts/.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from nomad_tpu.client.drivers import (
+    Driver,
+    DriverError,
+    ExitResult,
+    TaskHandle,
+)
+from nomad_tpu.client.taskenv import build_task_env, interpolate
+from nomad_tpu.structs import RestartPolicy
+from nomad_tpu.structs.alloc import TaskState
+
+
+class RestartTracker:
+    """Decides between restart / delay-restart / fail
+    (client/allocrunner/taskrunner/restarts/restarts.go)."""
+
+    def __init__(self, policy: RestartPolicy):
+        self.policy = policy
+        self.count = 0
+        self.window_start = 0.0
+
+    def next(self, exit_result: ExitResult, now: Optional[float] = None):
+        """-> ("restart", delay_s) | ("exit", None)  for batch-style
+        success; failures consult the policy."""
+        now = now or time.time()
+        if self.window_start == 0.0 or \
+                now - self.window_start > self.policy.interval_s:
+            self.window_start = now
+            self.count = 0
+        self.count += 1
+        if self.count > self.policy.attempts:
+            if self.policy.mode == "delay":
+                # wait out the rest of the interval, then a fresh window
+                delay = self.policy.interval_s - (now - self.window_start) \
+                    + self.policy.delay_s
+                self.window_start = 0.0
+                self.count = 0
+                return ("restart", max(delay, self.policy.delay_s))
+            return ("fail", None)
+        return ("restart", self.policy.delay_s)
+
+
+class TaskRunner:
+    def __init__(self, alloc, task, driver: Driver, alloc_dir,
+                 node=None, on_state: Optional[Callable] = None,
+                 state_db=None, ports: Optional[Dict[str, int]] = None):
+        self.alloc = alloc
+        self.task = task
+        self.driver = driver
+        self.alloc_dir = alloc_dir
+        self.node = node
+        self.on_state = on_state or (lambda *a: None)
+        self.state_db = state_db
+        self.ports = ports or {}
+        self.state = TaskState()
+        self.handle: Optional[TaskHandle] = None
+        self.restart_tracker = RestartTracker(
+            self._restart_policy())
+        self._kill = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.env: Dict[str, str] = {}
+
+    def _restart_policy(self) -> RestartPolicy:
+        job = self.alloc.job
+        if job is not None:
+            tg = job.lookup_task_group(self.alloc.task_group)
+            if tg is not None:
+                return tg.restart_policy
+        return RestartPolicy()
+
+    # ------------------------------------------------------------ events
+
+    def _emit(self, type_: str, detail: str = "") -> None:
+        self.state.events.append(
+            {"type": type_, "time": time.time(), "detail": detail})
+        self._persist()
+        self.on_state(self)
+
+    def _set_state(self, state: str, failed: bool = False) -> None:
+        self.state.state = state
+        self.state.failed = failed
+        if state == "running" and not self.state.started_at:
+            self.state.started_at = time.time()
+        if state == "dead":
+            self.state.finished_at = time.time()
+        self._persist()
+        self.on_state(self)
+
+    def _persist(self) -> None:
+        if self.state_db is not None:
+            self.state_db.put_task_state(
+                self.alloc.id, self.task.name, self.state.state,
+                self.state.failed, self.state.restarts, self.handle)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, daemon=True,
+            name=f"task-{self.alloc.id[:8]}-{self.task.name}")
+        self._thread.start()
+
+    def run(self) -> None:
+        try:
+            self._run()
+        except Exception as e:                       # noqa: BLE001
+            self._emit("Task hook failed", str(e))
+            self._set_state("dead", failed=True)
+
+    def _run(self) -> None:
+        # --- prestart hooks (task_runner_hooks.go:49)
+        self._emit("Received", "Task received by client")
+        task_dir = self.alloc_dir.build_task_dir(self.task.name)
+        self._dispatch_payload_hook(task_dir)
+        self.env = build_task_env(self.alloc, self.task, self.node,
+                                  task_dir, self.ports)
+        self._template_hook(task_dir)
+
+        while not self._kill.is_set():
+            self.handle = TaskHandle(driver=self.driver.name,
+                                     task_name=self.task.name,
+                                     alloc_id=self.alloc.id,
+                                     config=dict(self.task.config or {}))
+            try:
+                self.driver.start_task(self.handle, self.task, self.env,
+                                       task_dir)
+            except DriverError as e:
+                self._emit("Driver Failure", str(e))
+                verdict, delay = self.restart_tracker.next(
+                    ExitResult(exit_code=-1, err=str(e)))
+                if verdict == "restart" and not self._kill.is_set():
+                    self.state.restarts += 1
+                    self._emit("Restarting",
+                               f"Task restarting in {delay:.1f}s")
+                    if self._kill.wait(delay):
+                        break
+                    continue
+                self._set_state("dead", failed=True)
+                return
+            self._persist()
+            self._emit("Started", "Task started by client")
+            self._set_state("running")
+
+            result = self.driver.wait_task(self.handle)
+            if self._kill.is_set():
+                self._emit("Killed", "Task killed by client")
+                break
+            if result.successful():
+                self._emit("Terminated", "Exit Code: 0")
+                self._set_state("dead", failed=False)
+                return
+            self._emit("Terminated",
+                       f"Exit Code: {result.exit_code}"
+                       + (f", Err: {result.err}" if result.err else ""))
+            verdict, delay = self.restart_tracker.next(result)
+            if verdict == "fail" or self._kill.is_set():
+                self._emit("Not Restarting",
+                           "Exceeded allowed attempts")
+                self._set_state("dead", failed=True)
+                return
+            self.state.restarts += 1
+            self._emit("Restarting", f"Task restarting in {delay:.1f}s")
+            if self._kill.wait(delay):
+                break
+        self._set_state("dead", failed=False)
+
+    def kill(self, timeout_s: Optional[float] = None) -> None:
+        self._kill.set()
+        if self.handle is not None:
+            self.driver.stop_task(
+                self.handle,
+                timeout_s if timeout_s is not None
+                else self.task.kill_timeout_s)
+
+    def join(self, timeout: float = 10.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def recover(self, prev_state: str, failed: bool, restarts: int,
+                handle: Optional[TaskHandle]) -> bool:
+        """Reattach to a running task after client restart
+        (plugins/drivers RecoverTask; client/state restore)."""
+        self.state.restarts = restarts
+        if prev_state != "running" or handle is None:
+            return False
+        if not self.driver.recover_task(handle):
+            self._emit("Terminated", "task not recoverable after restart")
+            self._set_state("dead", failed=True)
+            return False
+        self.handle = handle
+        self._set_state("running")
+        self._thread = threading.Thread(
+            target=self._wait_recovered, daemon=True,
+            name=f"task-recovered-{self.task.name}")
+        self._thread.start()
+        return True
+
+    def _wait_recovered(self) -> None:
+        result = self.driver.wait_task(self.handle)
+        if result.successful():
+            self._set_state("dead", failed=False)
+        else:
+            self._set_state("dead", failed=True)
+
+    # ------------------------------------------------------------ hooks
+
+    def _dispatch_payload_hook(self, task_dir: str) -> None:
+        """Write the dispatch payload file (taskrunner dispatch_hook)."""
+        dp = self.task.dispatch_payload
+        job = self.alloc.job
+        if dp is None or job is None or not job.payload:
+            return
+        dest = os.path.join(task_dir, "local", dp.file or "payload")
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        with open(dest, "wb") as fh:
+            fh.write(job.payload)
+
+    def _template_hook(self, task_dir: str) -> None:
+        """Render inline templates with env interpolation (the reference
+        uses consul-template; env/meta refs are the subset covered)."""
+        for tmpl in self.task.templates or []:
+            data = tmpl.get("data", "")
+            dest = tmpl.get("destination", "local/template.out")
+            rendered = interpolate(data, self.env, self.node,
+                                   self.task.meta)
+            path = os.path.join(task_dir, dest)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as fh:
+                fh.write(rendered)

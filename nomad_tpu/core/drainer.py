@@ -70,6 +70,13 @@ class NodeDrainer:
                      {"node_id": node_id, "drain_strategy": strategy})
         self._dirty.set()
 
+    def cancel_drain(self, node_id: str) -> None:
+        """Node.UpdateDrain with a nil spec: stop draining and restore
+        eligibility (reference Node.UpdateDrain cancel form)."""
+        self.server.apply(MessageType.NODE_UPDATE_DRAIN,
+                          {"node_id": node_id, "drain_strategy": None,
+                           "mark_eligible": True})
+
     # ------------------------------------------------------------- logic
 
     def tick(self, now: Optional[float] = None) -> None:

@@ -107,7 +107,12 @@ class NomadFSM:
     # --- nodes
 
     def _apply_node_register(self, index, p):
-        self.store.upsert_node(index, p["node"])
+        # copy at the consensus boundary: in cluster mode the payload
+        # arrives pickled, but dev mode shares objects with the caller —
+        # a caller later mutating its Node must not bypass the FSM
+        # (the aliasing would desync the dense matrix from the store)
+        import copy as _copy
+        self.store.upsert_node(index, _copy.deepcopy(p["node"]))
         hooks = self.hooks
         if hooks is not None and getattr(hooks, "leader", False):
             # TTL timers live on the leader (nomad/heartbeat.go:56); track

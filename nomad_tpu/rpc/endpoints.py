@@ -196,8 +196,16 @@ class Endpoints:
                     f"{str(_uuid.uuid4())[:8]}")
         child.name = child.id
         child.parameterized = None
-        child.payload = payload.encode() if isinstance(payload, str) \
-            else payload
+        # wire payloads are base64 (matching the reference's []byte JSON
+        # encoding); store the decoded bytes
+        if isinstance(payload, str):
+            import base64 as _b64
+            try:
+                child.payload = _b64.b64decode(payload, validate=True)
+            except Exception:              # noqa: BLE001
+                raise RpcError("bad_request", "payload must be base64")
+        else:
+            child.payload = payload
         child.meta = {**(parent.meta or {}), **meta}
         ev = self.server.register_job(child)
         return {"dispatched_job_id": child.id, "eval_id": ev.id}
@@ -245,11 +253,22 @@ class Endpoints:
         return {"heartbeat_ttl": self.server.config.heartbeat_ttl}
 
     def rpc_Node__UpdateStatus(self, args):
-        if args.get("status") == "ready" or args.get("heartbeat"):
+        """Heartbeats reset the TTL; an explicit status is a real
+        transition (init->ready included) that triggers node evals
+        (reference Node.UpdateStatus, node_endpoint.go:396)."""
+        if args.get("heartbeat") and not args.get("status"):
             ttl = self.server.node_heartbeat(args["node_id"])
             return {"heartbeat_ttl": ttl}
-        evals = self.server.update_node_status(args["node_id"], args["status"])
-        return {"eval_ids": [e.id for e in evals]}
+        node = self.server.store.node_by_id(args["node_id"])
+        status = args["status"]
+        if node is not None and node.status == status:
+            # no-op transition; still counts as liveness
+            ttl = self.server.node_heartbeat(args["node_id"])
+            return {"heartbeat_ttl": ttl, "eval_ids": []}
+        evals = self.server.update_node_status(args["node_id"], status)
+        ttl = self.server.heartbeats.heartbeat(args["node_id"]) \
+            if self.server.leader else self.server.config.heartbeat_ttl
+        return {"eval_ids": [e.id for e in evals], "heartbeat_ttl": ttl}
 
     def rpc_Node__List(self, args):
         return self.server.store.nodes()
@@ -266,6 +285,10 @@ class Endpoints:
             ignore_system_jobs=args.get("ignore_system_jobs", False))
         return {}
 
+    def rpc_Node__CancelDrain(self, args):
+        self.server.drainer.cancel_drain(args["node_id"])
+        return {}
+
     def rpc_Node__UpdateEligibility(self, args):
         self.server.apply(MessageType.NODE_UPDATE_ELIGIBILITY,
                           {"node_id": args["node_id"],
@@ -273,10 +296,45 @@ class Endpoints:
         return {}
 
     def rpc_Node__UpdateAlloc(self, args):
-        """Client pushes task/alloc state (reference Node.UpdateAlloc)."""
+        """Client pushes task/alloc state (reference Node.UpdateAlloc,
+        node_endpoint.go:1073: failed allocs trigger reschedule evals)."""
+        updates = args["allocs"]
         self.server.apply(MessageType.ALLOC_CLIENT_UPDATE,
-                          {"allocs": args["allocs"]})
-        return {}
+                          {"allocs": updates})
+        evals = []
+        seen_jobs = set()
+        for u in updates:
+            if u.client_status != "failed":
+                continue
+            stored = self.server.store.alloc_by_id(u.id)
+            if stored is None:
+                continue
+            key = (stored.namespace, stored.job_id)
+            if key in seen_jobs:
+                continue
+            seen_jobs.add(key)
+            job = stored.job or self.server.store.job_by_id(*key)
+            if job is None or job.stopped():
+                continue
+            evals.append(Evaluation(
+                namespace=stored.namespace, priority=job.priority,
+                type=job.type, job_id=job.id,
+                triggered_by=EvalTrigger.RETRY_FAILED_ALLOC,
+                status=EvalStatus.PENDING))
+        if evals:
+            self.server.create_evals(evals)
+        return {"eval_ids": [e.id for e in evals]}
+
+    def rpc_Node__GetClientAllocs(self, args):
+        """Blocking query for a node's allocations (reference
+        Node.GetClientAllocs, node_endpoint.go: clients long-poll with
+        their last seen index)."""
+        store = self.server.store
+        min_index = args.get("min_index", 0)
+        timeout = min(args.get("timeout", 2.0), 30.0)
+        store.wait_for_index(min_index + 1, timeout=timeout)
+        return {"index": store.latest_index,
+                "allocs": store.allocs_by_node(args["node_id"])}
 
     def rpc_Node__Deregister(self, args):
         self.server.apply(MessageType.NODE_DEREGISTER,
