@@ -1,0 +1,7 @@
+"""`python -m nomad_tpu` — the CLI entry point (reference: main.go)."""
+import sys
+
+from nomad_tpu.command import main
+
+if __name__ == "__main__":
+    sys.exit(main())

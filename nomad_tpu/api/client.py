@@ -132,6 +132,10 @@ class Jobs(_Section):
         return self.c.get(f"/v1/job/{job_id}/summary",
                           {"namespace": self.c.namespace})
 
+    def versions(self, job_id: str) -> List[dict]:
+        return self.c.get(f"/v1/job/{job_id}/versions",
+                          {"namespace": self.c.namespace})
+
     def plan(self, job: Job, diff: bool = True) -> dict:
         return self.c.put(f"/v1/job/{job.id}/plan",
                           {"Job": to_wire(job), "Diff": diff})
@@ -172,6 +176,9 @@ class Nodes(_Section):
             f"/v1/node/{node_id}/drain",
             {"DrainSpec": {"Deadline": deadline_s,
                            "IgnoreSystemJobs": ignore_system_jobs}})
+
+    def drain_disable(self, node_id: str) -> dict:
+        return self.c.put(f"/v1/node/{node_id}/drain", {"DrainSpec": None})
 
     def eligibility(self, node_id: str, eligible: bool) -> dict:
         return self.c.put(

@@ -1,0 +1,6 @@
+"""CLI (reference: command/ — mitchellh/cli subcommands registered in
+command/commands.go; `nomad agent`, `nomad job run`, `nomad node status`,
+...).  argparse-based; talks to the agent over the HTTP SDK."""
+from nomad_tpu.command.cli import main
+
+__all__ = ["main"]

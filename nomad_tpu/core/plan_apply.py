@@ -97,38 +97,41 @@ class PlanApplier:
             freed[node_id] = vec
             freed_ports[node_id] = ports
 
-        rejected: List[str] = []
-        for node_id, placements in plan.node_allocation.items():
+        # batched per-node validation — the reference fans this across an
+        # EvaluatePool (plan_apply_pool.go); here it is ONE native call
+        # over all touched nodes (nomad_tpu.native.validate_plan, C++)
+        from nomad_tpu import native as _native
+        node_ids = list(plan.node_allocation.keys())
+        g = len(node_ids)
+        rows = np.full(g, -1, np.int32)
+        demand = np.zeros((g, 3), np.float32)
+        freed_vecs = np.zeros((g, 3), np.float32)
+        group_ports: List[List[int]] = []
+        group_freed: List[List[int]] = []
+        for i, node_id in enumerate(node_ids):
             node = store._nodes.get(node_id)
             row = cm.row_of.get(node_id)
-            if not self._node_ok_for_placement(node) or row is None:
-                rejected.append(node_id)
-                continue
-            demand = np.zeros(3, np.float32)
-            claimed: Set[int] = set()
-            port_collision = False
-            for a in placements:
+            ports: List[int] = []
+            if self._node_ok_for_placement(node) and row is not None:
+                rows[i] = row
+            for a in plan.node_allocation[node_id]:
                 cr = a.comparable_resources()
-                demand += (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
-                for p in _alloc_ports(a):
-                    if p in claimed:
-                        port_collision = True
-                    claimed.add(p)
-            used = cm.used[row] + demand - freed.get(node_id, 0.0)
-            if not np.all(used <= cm.capacity[row] + 1e-6):
+                demand[i] += (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                ports.extend(_alloc_ports(a))
+            freed_vecs[i] = freed.get(node_id, 0.0)
+            group_ports.append(ports)
+            group_freed.append(sorted(freed_ports.get(node_id, ())))
+        ok = _native.validate_plan(
+            cm.capacity, cm.used, cm.port_words, rows, demand,
+            freed_vecs, group_ports, group_freed) if g else []
+
+        rejected: List[str] = []
+        for i, node_id in enumerate(node_ids):
+            if ok[i]:
+                result.node_allocation[node_id] = \
+                    list(plan.node_allocation[node_id])
+            else:
                 rejected.append(node_id)
-                continue
-            if not port_collision:
-                free_from_stops = freed_ports.get(node_id, set())
-                for p in claimed:
-                    bit = (cm.port_words[row, p >> 5] >> np.uint32(p & 31)) & 1
-                    if bit and p not in free_from_stops:
-                        port_collision = True
-                        break
-            if port_collision:
-                rejected.append(node_id)
-                continue
-            result.node_allocation[node_id] = list(placements)
 
         if rejected and plan.all_at_once:
             # the reference nils updates, placements, preemptions AND the

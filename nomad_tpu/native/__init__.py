@@ -1,0 +1,234 @@
+"""Native host-runtime kernels: build + ctypes binding.
+
+Compiles native/nomad_native.cpp with g++ on first use (cached by source
+mtime under native/build/), exposing:
+
+  allocs_fit(capacity, used, demand) -> bool[N]
+  score_fit(capacity, used, demand, spread=False) -> f32[N]
+  ports_check(port_words, row, ports, freed) -> bool
+  ports_set(port_words, row, ports, value)
+  scatter_add(used, rows, deltas)
+  validate_plan(...) -> bool[G]     (the EvaluatePool equivalent)
+
+Falls back to numpy implementations when no C++ toolchain is available
+(`NATIVE_AVAILABLE` tells you which path is live).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "native",
+                                     "nomad_native.cpp"))
+_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libnomad_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+NATIVE_AVAILABLE = False
+
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build() -> Optional[str]:
+    if not os.path.exists(_SRC):
+        return None
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if os.path.exists(_LIB_PATH) and \
+            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+        return _LIB_PATH
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _LIB_PATH + ".tmp", _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
+    return _LIB_PATH
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, NATIVE_AVAILABLE
+    with _lock:
+        if _lib is not None:
+            return _lib
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.nomad_native_abi_version.restype = ctypes.c_int32
+        if lib.nomad_native_abi_version() != 1:
+            return None
+        lib.allocs_fit_dense.argtypes = [
+            _f32p, _f32p, _f32p, ctypes.c_int, ctypes.c_int, _u8p]
+        lib.score_fit_dense.argtypes = [
+            _f32p, _f32p, _f32p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, _f32p]
+        lib.ports_check.restype = ctypes.c_int32
+        lib.ports_check.argtypes = [
+            _u32p, ctypes.c_int, ctypes.c_int, _i32p, ctypes.c_int,
+            _i32p, ctypes.c_int]
+        lib.ports_set.argtypes = [
+            _u32p, ctypes.c_int, ctypes.c_int, _i32p, ctypes.c_int,
+            ctypes.c_int]
+        lib.scatter_add.argtypes = [
+            _f32p, ctypes.c_int, _i32p, _f32p, ctypes.c_int]
+        lib.validate_plan.argtypes = [
+            _f32p, _f32p, _u32p, ctypes.c_int, ctypes.c_int,
+            _i32p, _f32p, _f32p, _i32p, _i32p, _i32p, _i32p,
+            ctypes.c_int, _u8p]
+        _lib = lib
+        NATIVE_AVAILABLE = True
+        return lib
+
+
+_EMPTY_I32 = np.zeros(0, np.int32)
+
+
+def allocs_fit(capacity: np.ndarray, used: np.ndarray,
+               demand: np.ndarray) -> np.ndarray:
+    """bool[N]: demand fits in capacity-used per row
+    (structs.AllocsFit over the node axis)."""
+    capacity = np.ascontiguousarray(capacity, np.float32)
+    used = np.ascontiguousarray(used, np.float32)
+    demand = np.ascontiguousarray(demand, np.float32)
+    lib = _load()
+    if lib is None:
+        return np.all(used + demand <= capacity + 1e-6, axis=1)
+    out = np.empty(capacity.shape[0], np.uint8)
+    lib.allocs_fit_dense(capacity, used, demand,
+                         capacity.shape[0], capacity.shape[1], out)
+    return out.astype(bool)
+
+
+def score_fit(capacity: np.ndarray, used: np.ndarray,
+              demand: np.ndarray, spread: bool = False) -> np.ndarray:
+    """f32[N] binpack/spread score (structs.ScoreFitBinPack/Spread)."""
+    capacity = np.ascontiguousarray(capacity, np.float32)
+    used = np.ascontiguousarray(used, np.float32)
+    demand = np.ascontiguousarray(demand, np.float32)
+    lib = _load()
+    if lib is None:
+        cap = np.maximum(capacity[:, :2], 1e-9)
+        free = np.clip((cap - (used[:, :2] + demand[:2])) / cap, 0.0, 1.0)
+        exp = 1.0 - free if spread else free
+        total = np.power(10.0, exp).sum(axis=1)
+        total = np.where((capacity[:, :2] <= 0).any(axis=1), 40.0, total)
+        return np.clip((20.0 - total) / 18.0, 0.0, 1.0).astype(np.float32)
+    out = np.empty(capacity.shape[0], np.float32)
+    lib.score_fit_dense(capacity, used, demand, capacity.shape[0],
+                        capacity.shape[1], int(spread), out)
+    return out
+
+
+def ports_check(port_words: np.ndarray, row: int,
+                ports: Sequence[int],
+                freed: Sequence[int] = ()) -> bool:
+    """All `ports` free on `row` (ports in `freed` count as free)?"""
+    ports_a = np.asarray(list(ports), np.int32)
+    freed_a = np.asarray(list(freed), np.int32)
+    lib = _load()
+    if lib is None:
+        seen = set()
+        for p in ports_a:
+            p = int(p)
+            if p in seen:
+                return False
+            seen.add(p)
+            if p < 0 or (p >> 5) >= port_words.shape[1]:
+                return False
+            if (port_words[row, p >> 5] >> np.uint32(p & 31)) & 1:
+                if p not in set(int(x) for x in freed_a):
+                    return False
+        return True
+    port_words = np.ascontiguousarray(port_words, np.uint32)
+    return bool(lib.ports_check(port_words, port_words.shape[1], row,
+                                ports_a, len(ports_a),
+                                freed_a, len(freed_a)))
+
+
+def ports_set(port_words: np.ndarray, row: int,
+              ports: Sequence[int], value: bool) -> None:
+    ports_a = np.asarray(list(ports), np.int32)
+    lib = _load()
+    if lib is None or not port_words.flags["C_CONTIGUOUS"]:
+        for p in ports_a:
+            p = int(p)
+            if p < 0 or (p >> 5) >= port_words.shape[1]:
+                continue
+            if value:
+                port_words[row, p >> 5] |= np.uint32(1 << (p & 31))
+            else:
+                port_words[row, p >> 5] &= ~np.uint32(1 << (p & 31))
+        return
+    lib.ports_set(port_words, port_words.shape[1], row,
+                  ports_a, len(ports_a), int(value))
+
+
+def scatter_add(used: np.ndarray, rows: Sequence[int],
+                deltas: np.ndarray) -> None:
+    """used[rows[k]] += deltas[k] in place."""
+    rows_a = np.asarray(list(rows), np.int32)
+    deltas = np.ascontiguousarray(deltas, np.float32)
+    lib = _load()
+    if lib is None or not used.flags["C_CONTIGUOUS"]:
+        np.add.at(used, rows_a, deltas)
+        return
+    lib.scatter_add(used, used.shape[1], rows_a, deltas, len(rows_a))
+
+
+def validate_plan(capacity: np.ndarray, used: np.ndarray,
+                  port_words: np.ndarray,
+                  rows: Sequence[int],
+                  demand: np.ndarray, freed: np.ndarray,
+                  group_ports: List[Sequence[int]],
+                  group_freed_ports: List[Sequence[int]]) -> np.ndarray:
+    """bool[G]: per placement-group validation (fit + ports), the
+    EvaluatePool fan-out as one native call."""
+    g = len(rows)
+    rows_a = np.asarray(list(rows), np.int32)
+    demand = np.ascontiguousarray(demand, np.float32)
+    freed = np.ascontiguousarray(freed, np.float32)
+    ports_off = np.zeros(g + 1, np.int32)
+    freed_off = np.zeros(g + 1, np.int32)
+    flat_ports: List[int] = []
+    flat_freed: List[int] = []
+    for i in range(g):
+        flat_ports.extend(int(p) for p in group_ports[i])
+        flat_freed.extend(int(p) for p in group_freed_ports[i])
+        ports_off[i + 1] = len(flat_ports)
+        freed_off[i + 1] = len(flat_freed)
+    ports_a = np.asarray(flat_ports, np.int32) if flat_ports else _EMPTY_I32
+    freed_a = np.asarray(flat_freed, np.int32) if flat_freed else _EMPTY_I32
+    lib = _load()
+    if lib is None:
+        out = np.zeros(g, bool)
+        for i in range(g):
+            r = int(rows_a[i])
+            if r < 0:
+                continue
+            fits = np.all(used[r] + demand[i] - freed[i]
+                          <= capacity[r] + 1e-6)
+            out[i] = fits and ports_check(
+                port_words, r, group_ports[i], group_freed_ports[i])
+        return out
+    capacity = np.ascontiguousarray(capacity, np.float32)
+    used = np.ascontiguousarray(used, np.float32)
+    port_words = np.ascontiguousarray(port_words, np.uint32)
+    out = np.empty(g, np.uint8)
+    lib.validate_plan(capacity, used, port_words, port_words.shape[1],
+                      capacity.shape[1], rows_a, demand, freed,
+                      ports_a, ports_off, freed_a, freed_off, g, out)
+    return out.astype(bool)

@@ -53,6 +53,13 @@ class JobSummary:
             "queued": 0, "complete": 0, "failed": 0,
             "running": 0, "starting": 0, "lost": 0, "unknown": 0})
 
+    def to_dict(self) -> dict:
+        return {"job_id": self.job_id, "namespace": self.namespace,
+                "summary": {k: dict(v) for k, v in self.summary.items()},
+                "children": dict(self.children),
+                "create_index": self.create_index,
+                "modify_index": self.modify_index}
+
 
 class StateSnapshot:
     """A consistent read-only view at one index."""

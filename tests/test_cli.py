@@ -1,0 +1,166 @@
+"""CLI tests (reference analog: command/*_test.go run against a dev
+agent)."""
+import io
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.command.cli import main
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(http_port=0, num_schedulers=2,
+                          heartbeat_ttl=60.0))
+    a.start()
+    for _ in range(3):
+        a.server.register_node(mock.node())
+    yield a
+    a.stop()
+
+
+def run_cli(agent, *argv):
+    out = io.StringIO()
+    code = main(["-address", agent.http_addr, *argv], out=out)
+    return code, out.getvalue()
+
+
+JOBSPEC = '''
+job "cli-demo" {
+  type = "service"
+  group "web" {
+    count = 2
+    task "t" {
+      driver = "exec"
+      config { command = "/bin/true" }
+      resources { cpu = 100  memory = 64 }
+    }
+  }
+}
+'''
+
+
+@pytest.fixture(scope="module")
+def jobfile(tmp_path_factory):
+    p = tmp_path_factory.mktemp("specs") / "demo.nomad"
+    p.write_text(JOBSPEC)
+    return str(p)
+
+
+def test_job_validate(agent, jobfile):
+    code, out = run_cli(agent, "job", "validate", jobfile)
+    assert code == 0
+    assert "successful" in out
+
+
+def test_job_plan(agent, jobfile):
+    code, out = run_cli(agent, "job", "plan", jobfile)
+    assert code == 0
+    assert "Placements: 2" in out
+
+
+def test_job_run_and_status(agent, jobfile):
+    code, out = run_cli(agent, "job", "run", jobfile)
+    assert code == 0, out
+    assert "finished with status \"complete\"" in out
+    agent.server.wait_for_idle(10)
+
+    code, out = run_cli(agent, "job", "status", "cli-demo")
+    assert code == 0
+    assert "ID            = cli-demo" in out
+    assert "web" in out
+
+    code, out = run_cli(agent, "job", "status")
+    assert "cli-demo" in out
+
+    code, out = run_cli(agent, "node", "status")
+    assert code == 0
+    assert "ready" in out
+
+    # eval + alloc drill-down
+    evs = [l for l in out.splitlines()]
+    allocs = agent.server.store.allocs_by_job("default", "cli-demo")
+    code, out = run_cli(agent, "alloc", "status", allocs[0].id,
+                        "-verbose")
+    assert code == 0
+    assert "Client Status" in out
+
+    code, out = run_cli(agent, "eval", "status", allocs[0].eval_id)
+    assert code == 0
+    assert "complete" in out
+
+
+def test_job_inspect(agent, jobfile):
+    code, out = run_cli(agent, "job", "inspect", "cli-demo")
+    assert code == 0
+    import json
+    data = json.loads(out)
+    assert data["id"] == "cli-demo"
+
+
+def test_node_eligibility_and_drain(agent):
+    node_id = agent.server.store.nodes()[0].id
+    code, out = run_cli(agent, "node", "eligibility", node_id, "-disable")
+    assert code == 0
+    assert agent.server.store.node_by_id(node_id) \
+        .scheduling_eligibility == "ineligible"
+    code, out = run_cli(agent, "node", "eligibility", node_id, "-enable")
+    assert agent.server.store.node_by_id(node_id) \
+        .scheduling_eligibility == "eligible"
+    code, out = run_cli(agent, "node", "drain", node_id,
+                        "-deadline", "60")
+    assert code == 0
+    time.sleep(0.3)
+    code, out = run_cli(agent, "node", "drain", node_id, "-disable")
+    assert code == 0
+    time.sleep(0.3)
+    assert agent.server.store.node_by_id(node_id) \
+        .scheduling_eligibility == "eligible"
+
+
+def test_operator_scheduler_config(agent):
+    code, out = run_cli(agent, "operator", "scheduler", "get-config")
+    assert code == 0
+    assert "Scheduler Algorithm" in out
+    code, out = run_cli(agent, "operator", "scheduler", "set-config",
+                        "-scheduler-algorithm", "spread")
+    assert code == 0
+    code, out = run_cli(agent, "operator", "scheduler", "get-config")
+    assert "spread" in out
+    run_cli(agent, "operator", "scheduler", "set-config",
+            "-scheduler-algorithm", "binpack")
+
+
+def test_server_members_and_version(agent):
+    code, out = run_cli(agent, "server", "members")
+    assert code == 0
+    assert "leader" in out
+    code, out = run_cli(agent, "version")
+    assert code == 0
+    assert "nomad-tpu" in out
+
+
+def test_namespace_cmds(agent):
+    code, _ = run_cli(agent, "namespace", "apply", "team-x")
+    assert code == 0
+    code, out = run_cli(agent, "namespace", "list")
+    assert "team-x" in out
+    code, _ = run_cli(agent, "namespace", "delete", "team-x")
+    assert code == 0
+
+
+def test_job_stop(agent):
+    code, out = run_cli(agent, "job", "stop", "-detach", "cli-demo")
+    assert code == 0
+    agent.server.wait_for_idle(10)
+    job = agent.server.store.job_by_id("default", "cli-demo")
+    assert job.stop is True
+
+
+def test_error_paths(agent):
+    code, _ = run_cli(agent, "job", "status", "no-such-job")
+    assert code == 1
+    code, _ = run_cli(agent, "alloc", "status", "bogus")
+    assert code == 1
