@@ -178,6 +178,23 @@ class HTTPServer:
         if not acl.allows(ns, cap):
             raise HTTPError(403, f"Permission denied: needs {cap}")
 
+    def _require_ns_cap(self, h, namespace: str, cap: str) -> None:
+        """Authorize `cap` against the *object's own* namespace after
+        fetching it by ID (the reference checks alloc.Namespace in
+        alloc_endpoint.go, not the caller-supplied ?namespace= param —
+        otherwise a token with the capability in any one namespace could
+        act on objects in all of them)."""
+        if not getattr(self.agent.server, "acl_enabled", False):
+            return
+        acl = getattr(h, "acl", None)
+        if acl is None or not acl.allows(namespace, cap):
+            raise HTTPError(403, f"Permission denied: needs {cap} in "
+                                 f"namespace {namespace!r}")
+
+    def _require_ns_read(self, h, namespace: str) -> None:
+        from nomad_tpu.acl.policy import CAP_READ_JOB
+        self._require_ns_cap(h, namespace, CAP_READ_JOB)
+
     def _ns_visible(self, h, namespace: str) -> bool:
         """Namespace-level read filter for list endpoints (the reference
         scopes every list RPC by the token's namespace grants)."""
@@ -383,11 +400,15 @@ class HTTPServer:
     def _h_get_evaluation_id(self, h, parts, q):
         sub = parts[2] if len(parts) > 2 else None
         if sub == "allocations":
-            allocs = self._rpc("Alloc.List", {})
-            return [a for a in allocs if a.eval_id == parts[1]]
+            allocs = [a for a in self._rpc("Alloc.List", {})
+                      if a.eval_id == parts[1]]
+            for a in allocs:
+                self._require_ns_read(h, a.namespace)
+            return allocs
         ev = self._rpc("Eval.GetEval", {"eval_id": parts[1]})
         if ev is None:
             raise HTTPError(404, f"eval not found: {parts[1]}")
+        self._require_ns_read(h, ev.namespace)
         return ev
 
     def _h_get_allocations(self, h, parts, q):
@@ -400,11 +421,17 @@ class HTTPServer:
         a = self._rpc("Alloc.GetAlloc", {"alloc_id": parts[1]})
         if a is None:
             raise HTTPError(404, f"alloc not found: {parts[1]}")
+        self._require_ns_read(h, a.namespace)
         return a
 
     def _h_post_allocation_id(self, h, parts, q):
         sub = parts[2] if len(parts) > 2 else None
         if sub == "stop":
+            a = self._rpc("Alloc.GetAlloc", {"alloc_id": parts[1]})
+            if a is None:
+                raise HTTPError(404, f"alloc not found: {parts[1]}")
+            from nomad_tpu.acl.policy import CAP_ALLOC_LIFECYCLE
+            self._require_ns_cap(h, a.namespace, CAP_ALLOC_LIFECYCLE)
             return self._rpc("Alloc.Stop", {"alloc_id": parts[1]})
         raise HTTPError(404, f"no handler for allocation/{sub}")
 
@@ -413,13 +440,15 @@ class HTTPServer:
     # ------------------------------------------------------------ deployments
 
     def _h_get_deployments(self, h, parts, q):
-        return self._rpc("Deployment.List", {})
+        return [d for d in self._rpc("Deployment.List", {})
+                if self._ns_visible(h, d.namespace)]
 
     def _h_get_deployment_id(self, h, parts, q):
         d = self._rpc("Deployment.GetDeployment",
                       {"deployment_id": parts[1]})
         if d is None:
             raise HTTPError(404, f"deployment not found: {parts[1]}")
+        self._require_ns_read(h, d.namespace)
         return d
 
     def _h_put_deployment_id(self, h, parts, q):

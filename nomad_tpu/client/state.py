@@ -25,6 +25,7 @@ class ClientStateDB:
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._lock = threading.Lock()
+        self._closed = False
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._init_schema()
@@ -54,37 +55,53 @@ class ClientStateDB:
     # ------------------------------------------------------------ allocs
 
     def put_alloc(self, alloc_id: str, summary: dict) -> None:
-        with self._lock, self._db:
-            self._db.execute(
-                "INSERT OR REPLACE INTO allocs VALUES (?, ?)",
-                (alloc_id, json.dumps(summary)))
+        with self._lock:
+            if self._closed:
+                return
+            with self._db:
+                self._db.execute(
+                    "INSERT OR REPLACE INTO allocs VALUES (?, ?)",
+                    (alloc_id, json.dumps(summary)))
 
     def get_allocs(self) -> Dict[str, dict]:
         with self._lock:
+            if self._closed:
+                return {}
             cur = self._db.execute("SELECT alloc_id, blob FROM allocs")
             return {aid: json.loads(blob) for aid, blob in cur.fetchall()}
 
     def delete_alloc(self, alloc_id: str) -> None:
-        with self._lock, self._db:
-            self._db.execute("DELETE FROM allocs WHERE alloc_id=?",
-                             (alloc_id,))
-            self._db.execute("DELETE FROM task_state WHERE alloc_id=?",
-                             (alloc_id,))
+        with self._lock:
+            if self._closed:
+                return
+            with self._db:
+                self._db.execute("DELETE FROM allocs WHERE alloc_id=?",
+                                 (alloc_id,))
+                self._db.execute("DELETE FROM task_state WHERE alloc_id=?",
+                                 (alloc_id,))
 
     # ------------------------------------------------------------ tasks
 
     def put_task_state(self, alloc_id: str, task: str, state: str,
                        failed: bool, restarts: int,
                        handle: Optional[TaskHandle]) -> None:
-        with self._lock, self._db:
-            self._db.execute(
-                "INSERT OR REPLACE INTO task_state VALUES (?,?,?,?,?,?)",
-                (alloc_id, task, state, int(failed), restarts,
-                 json.dumps(asdict(handle)) if handle else None))
+        with self._lock:
+            # writer threads (task runners, heartbeats) may race close()
+            # during client shutdown; a write after close is a no-op, not
+            # an unhandled thread exception
+            if self._closed:
+                return
+            with self._db:
+                self._db.execute(
+                    "INSERT OR REPLACE INTO task_state VALUES (?,?,?,?,?,?)",
+                    (alloc_id, task, state, int(failed), restarts,
+                     json.dumps(asdict(handle)) if handle else None))
 
     def get_task_states(self, alloc_id: str) \
             -> Dict[str, Tuple[str, bool, int, Optional[TaskHandle]]]:
         with self._lock:
+            if self._closed:
+                return {}
             cur = self._db.execute(
                 "SELECT task, state, failed, restarts, handle "
                 "FROM task_state WHERE alloc_id=?", (alloc_id,))
@@ -98,4 +115,6 @@ class ClientStateDB:
 
     def close(self) -> None:
         with self._lock:
-            self._db.close()
+            if not self._closed:
+                self._closed = True
+                self._db.close()

@@ -124,12 +124,19 @@ class TaskRunner:
     def _run(self) -> None:
         # --- prestart hooks (task_runner_hooks.go:49)
         self._emit("Received", "Task received by client")
+        self._prestart()
+        self._run_loop()
+
+    def _prestart(self) -> None:
         task_dir = self.alloc_dir.build_task_dir(self.task.name)
         self._dispatch_payload_hook(task_dir)
         self.env = build_task_env(self.alloc, self.task, self.node,
                                   task_dir, self.ports)
         self._template_hook(task_dir)
+        self._task_dir = task_dir
 
+    def _run_loop(self) -> None:
+        task_dir = self._task_dir
         while not self._kill.is_set():
             self.handle = TaskHandle(driver=self.driver.name,
                                      task_name=self.task.name,
@@ -161,8 +168,28 @@ class TaskRunner:
                 break
             if result.successful():
                 self._emit("Terminated", "Exit Code: 0")
-                self._set_state("dead", failed=False)
-                return
+                # batch/sysbatch tasks complete on success; service/system
+                # tasks restart per policy even on a clean exit (reference
+                # restarts.go:handleWaitResult distinguishes job types)
+                job_type = getattr(self.alloc.job, "type", "service") \
+                    if self.alloc.job is not None else "service"
+                if job_type in ("batch", "sysbatch"):
+                    self._set_state("dead", failed=False)
+                    return
+                verdict, delay = self.restart_tracker.next(result)
+                if verdict == "fail":
+                    # a service that may not restart is a failure even on
+                    # exit 0 (restarts.go TaskNotRestarting SetFailsTask),
+                    # so the scheduler reschedules it
+                    self._emit("Not Restarting",
+                               "Exceeded allowed attempts")
+                    self._set_state("dead", failed=True)
+                    return
+                self.state.restarts += 1
+                self._emit("Restarting", f"Task restarting in {delay:.1f}s")
+                if self._kill.wait(delay):
+                    break
+                continue
             self._emit("Terminated",
                        f"Exit Code: {result.exit_code}"
                        + (f", Err: {result.err}" if result.err else ""))
@@ -210,10 +237,36 @@ class TaskRunner:
         return True
 
     def _wait_recovered(self) -> None:
-        result = self.driver.wait_task(self.handle)
-        if result.successful():
-            self._set_state("dead", failed=False)
-        else:
+        """Watch a reattached task; once it exits, apply the SAME restart
+        policy as the normal run loop (recovery must not change restart
+        semantics)."""
+        try:
+            result = self.driver.wait_task(self.handle)
+            if self._kill.is_set():
+                self._emit("Killed", "Task killed by client")
+                self._set_state("dead", failed=False)
+                return
+            job_type = getattr(self.alloc.job, "type", "service") \
+                if self.alloc.job is not None else "service"
+            if result.successful() and job_type in ("batch", "sysbatch"):
+                self._emit("Terminated", "Exit Code: 0")
+                self._set_state("dead", failed=False)
+                return
+            self._emit("Terminated", f"Exit Code: {result.exit_code}")
+            verdict, delay = self.restart_tracker.next(result)
+            if verdict == "fail":
+                self._emit("Not Restarting", "Exceeded allowed attempts")
+                self._set_state("dead", failed=True)
+                return
+            self.state.restarts += 1
+            self._emit("Restarting", f"Task restarting in {delay:.1f}s")
+            if self._kill.wait(delay):
+                self._set_state("dead", failed=False)
+                return
+            self._prestart()
+            self._run_loop()
+        except Exception as e:                       # noqa: BLE001
+            self._emit("Task hook failed", str(e))
             self._set_state("dead", failed=True)
 
     # ------------------------------------------------------------ hooks

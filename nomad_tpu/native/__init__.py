@@ -16,6 +16,7 @@ Falls back to numpy implementations when no C++ toolchain is available
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -27,7 +28,6 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "native",
                                      "nomad_native.cpp"))
 _BUILD_DIR = os.path.join(os.path.dirname(_SRC), "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libnomad_native.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -40,20 +40,33 @@ _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 
 
 def _build() -> Optional[str]:
+    """Compile the native library, cached by source *content hash* (an
+    mtime check could silently prefer a stale or foreign-toolchain binary
+    after a checkout)."""
     if not os.path.exists(_SRC):
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    if os.path.exists(_LIB_PATH) and \
-            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
-        return _LIB_PATH
+    with open(_SRC, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    lib_path = os.path.join(_BUILD_DIR, f"libnomad_native-{digest}.so")
+    if os.path.exists(lib_path):
+        return lib_path
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-           "-o", _LIB_PATH + ".tmp", _SRC]
+           "-o", lib_path + ".tmp", _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (OSError, subprocess.SubprocessError):
         return None
-    os.replace(_LIB_PATH + ".tmp", _LIB_PATH)
-    return _LIB_PATH
+    os.replace(lib_path + ".tmp", lib_path)
+    # prune superseded digests so the build dir doesn't grow unboundedly
+    for name in os.listdir(_BUILD_DIR):
+        if name.startswith("libnomad_native") and name.endswith(".so") \
+                and name != os.path.basename(lib_path):
+            try:
+                os.remove(os.path.join(_BUILD_DIR, name))
+            except OSError:
+                pass
+    return lib_path
 
 
 def _load() -> Optional[ctypes.CDLL]:

@@ -133,8 +133,9 @@ def allocs_fit_host(node, allocs, check_devices: bool = False):
 
     Returns (fit: bool, dimension: str, used: ComparableResources).
     `node` is a structs.Node; `allocs` iterable of Allocation (terminal ones
-    are ignored).  Port/bandwidth accounting is delegated to
-    nomad_tpu.core.network.NetworkIndex by callers that need it.
+    are ignored).  Port accounting lives in the dense path: per-node port
+    bitsets in nomad_tpu.encode.matrixizer.ClusterMatrix and host claim
+    assignment in nomad_tpu.scheduler.placement.PortClaims.
     """
     used = ComparableResources()
     seen_cores: set = set()
@@ -180,10 +181,17 @@ def _free_ratio(used: float, capacity: float) -> float:
 
 
 def _free_percentages(node, util: ComparableResources) -> Tuple[float, float]:
-    reserved = node.comparable_reserved_resources()
-    res = node.comparable_resources()
-    node_cpu = float(res.cpu_shares) - float(reserved.cpu_shares)
-    node_mem = float(res.memory_mb) - float(reserved.memory_mb)
+    """`node` is either a structs.Node or a bare ComparableResources of
+    usable capacity (funcs.go ScoreFit takes *ComparableResources — direct
+    callers pass reservation-adjusted totals themselves)."""
+    if hasattr(node, "comparable_reserved_resources"):
+        reserved = node.comparable_reserved_resources()
+        res = node.comparable_resources()
+        node_cpu = float(res.cpu_shares) - float(reserved.cpu_shares)
+        node_mem = float(res.memory_mb) - float(reserved.memory_mb)
+    else:
+        node_cpu = float(node.cpu_shares)
+        node_mem = float(node.memory_mb)
     return (_free_ratio(float(util.cpu_shares), node_cpu),
             _free_ratio(float(util.memory_mb), node_mem))
 

@@ -40,7 +40,8 @@ def test_score_fit_matches_host_reference():
     got = native.score_fit(cap, used, demand)[0]
     node = ComparableResources(cpu_shares=4000, memory_mb=8192)
     util = ComparableResources(cpu_shares=1500, memory_mb=3072)
-    want = score_fit_binpack_host(node, util)
+    # native.score_fit returns the /18-normalized score in [0, 1]
+    want = score_fit_binpack_host(node, util) / 18.0
     assert got == pytest.approx(want, abs=1e-4)
 
 
