@@ -200,7 +200,89 @@ def place_eval_jit(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceRes
                        top_nodes=top_n, top_scores=top_s, used=used)
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class EvalBatch:
+    """Per-eval placement inputs for a *chained* batch dispatch, every
+    field with a leading E (eval) axis.  `capacity`/`used` are NOT here:
+    they are shared across the batch (one basis matrix), and each eval's
+    usage adjustments (plan stops freeing resources, sticky-disk
+    pre-placements consuming them) ride as a sparse delta:
+    `delta_rows[e, d]` = node row (== N for inactive slots, dropped by the
+    scatter), `delta_vals[e, d]` = f32[R] resource adjustment.
+    """
+    feasible: jax.Array        # bool[E, G, N]
+    affinity: jax.Array        # f32[E, G, N]
+    has_affinity: jax.Array    # bool[E, G]
+    desired_count: jax.Array   # i32[E, G]
+    penalty: jax.Array         # bool[E, G, N]
+    tg_count: jax.Array        # i32[E, G, N]
+    spread_vidx: jax.Array     # i32[E, G, K, N]
+    spread_desired: jax.Array  # f32[E, G, K, V+1]
+    spread_targeted: jax.Array # bool[E, G, K]
+    spread_wfrac: jax.Array    # f32[E, G, K]
+    spread_counts: jax.Array   # f32[E, G, K, V+1]
+    spread_active: jax.Array   # bool[E, G, K]
+    demand: jax.Array          # f32[E, S, R]
+    slot_tg: jax.Array         # i32[E, S]
+    slot_active: jax.Array     # bool[E, S]
+    delta_rows: jax.Array      # i32[E, D]
+    delta_vals: jax.Array      # f32[E, D, R]
+
+
+@functools.partial(jax.jit, static_argnames=("spread_algorithm",))
+def place_batch_jit(capacity: jax.Array, used0: jax.Array, batch: EvalBatch,
+                    spread_algorithm: bool = False):
+    """Place a batch of E evaluations in one dispatch, chaining the
+    proposed-usage matrix across them.
+
+    Chaining (a `lax.scan` over the eval axis, carrying f32[N, R] usage)
+    makes the batch exactly equivalent to sequential worker processing:
+    eval e+1 scores against usage that includes eval e's placements, so
+    concurrently submitted plans never conflict on resources — any commit
+    order of the resulting plans fits, because chained usage is cumulative.
+    This replaces the reference's optimistic-conflict-then-retry dance
+    (nomad/worker.go:81-85 concurrent workers + plan_apply.go partial
+    commit) with a conflict-free device-side pipeline; the serialized plan
+    applier still re-validates as defense in depth.
+
+    Returns per-eval stacked PlaceResult fields (without `used`) plus the
+    final usage matrix (left device-resident).
+    """
+    def eval_step(used, ev: EvalBatch):
+        used = used.at[ev.delta_rows].add(ev.delta_vals, mode="drop")
+        inp = PlaceInputs(
+            capacity=capacity, used=used, feasible=ev.feasible,
+            affinity=ev.affinity, has_affinity=ev.has_affinity,
+            desired_count=ev.desired_count, penalty=ev.penalty,
+            tg_count=ev.tg_count, spread_vidx=ev.spread_vidx,
+            spread_desired=ev.spread_desired,
+            spread_targeted=ev.spread_targeted,
+            spread_wfrac=ev.spread_wfrac, spread_counts=ev.spread_counts,
+            spread_active=ev.spread_active, demand=ev.demand,
+            slot_tg=ev.slot_tg, slot_active=ev.slot_active)
+        S = ev.demand.shape[0]
+        carry0 = (used, ev.tg_count, ev.spread_counts)
+        step = functools.partial(_place_step, inp, spread_algorithm)
+        (used_f, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
+        return used_f, outs
+
+    used_final, outs = jax.lax.scan(eval_step, used0, batch)
+    return outs, used_final
+
+
 def place_eval(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceResult:
-    """Convenience host wrapper returning numpy-backed results."""
+    """Convenience host wrapper returning numpy-backed results.
+
+    All small outputs come back in ONE batched D2H transfer
+    (`jax.device_get`); the f32[N, R] `used` matrix stays device-resident
+    (no caller reads it on host — transferring it per eval dominated e2e
+    wall time on high-latency runtimes).
+    """
     res = place_eval_jit(inp, spread_algorithm=spread_algorithm)
-    return jax.tree_util.tree_map(np.asarray, res)
+    node, score, fit_s, n_eval, n_exh, top_n, top_s = jax.device_get(
+        (res.node, res.score, res.fit_score, res.nodes_evaluated,
+         res.nodes_exhausted, res.top_nodes, res.top_scores))
+    return PlaceResult(node=node, score=score, fit_score=fit_s,
+                       nodes_evaluated=n_eval, nodes_exhausted=n_exh,
+                       top_nodes=top_n, top_scores=top_s, used=res.used)

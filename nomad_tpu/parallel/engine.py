@@ -1,0 +1,314 @@
+"""PlacementEngine: adaptive batching dispatcher for the dense kernels.
+
+The north-star serving path (reference nomad/worker.go:81-85 — N scheduler
+workers processing evals concurrently — and BASELINE.json "pmap across
+evaluations in the EvalBroker queue"): scheduler workers block in
+`place()`, a single dispatcher thread coalesces every request that arrived
+while the previous dispatch was in flight into ONE device call
+(`ops.place.place_batch_jit`, a chained `lax.scan` over the eval axis),
+ships the batch with one host->device transfer and fetches all results
+with one device->host transfer.
+
+Why chained instead of independent (vmap/pmap): evals scored against the
+same usage basis all argmax onto the same best nodes, so independent
+batching turns into plan-applier conflicts and retries; the chained scan
+threads the proposed-usage matrix through the batch, making results
+identical to sequential worker processing while paying one transfer
+round-trip per *batch* instead of per *eval*.  On high-latency runtimes
+(TPU behind a network tunnel: ~20-120 ms per transfer) this is the
+difference between ~7 evals/s and hundreds.
+
+Batching is adaptive with no artificial delay window: an idle engine
+dispatches a lone request immediately (batch of 1, via the same
+single-eval jit cache `place_eval` uses), and the in-flight device time is
+the window in which the next batch accumulates.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS, pad_to_bucket
+from nomad_tpu.ops.place import (
+    EvalBatch,
+    PlaceInputs,
+    PlaceResult,
+    place_batch_jit,
+    place_eval,
+)
+
+# fields of PlaceInputs that ride per-eval in an EvalBatch (everything
+# except the shared capacity/used basis)
+_PER_EVAL_FIELDS = (
+    "feasible", "affinity", "has_affinity", "desired_count", "penalty",
+    "tg_count", "spread_vidx", "spread_desired", "spread_targeted",
+    "spread_wfrac", "spread_counts", "spread_active", "demand", "slot_tg",
+    "slot_active",
+)
+
+_DELTA_BUCKET_MIN = 8
+
+
+@dataclass
+class _Request:
+    cm: object                      # ClusterMatrix the inputs were built from
+    inputs: PlaceInputs             # numpy-backed; .used already has deltas applied
+    deltas: List[Tuple[int, np.ndarray]]   # (row, f32[R]) sparse usage deltas
+    spread_algorithm: bool
+    future: Future
+
+    def shape_key(self):
+        i = self.inputs
+        return (id(self.cm), self.spread_algorithm, i.feasible.shape,
+                i.spread_vidx.shape, i.spread_desired.shape,
+                i.demand.shape)
+
+
+class PlacementEngine:
+    """One per process.  Thread-safe; callers block in `place()`.
+
+    In-flight usage overlay: the basis each dispatch starts from is
+    `cm.used + overlay`, where the overlay sums the placements (and
+    sticky pre-placement adds) of every eval whose plan has not yet
+    committed.  Without it, batch N+1 would score against state that
+    misses batch N's still-uncommitted plans and pile onto the same
+    best-fit nodes (the reference pays for this optimism with plan-applier
+    partial commits + scheduler retries, worker.go:81-85 /
+    plan_apply.go:400).  Callers release their contribution via
+    `complete(ticket)` once their plan has been applied (or abandoned) —
+    the scheduler does this right after Planner.SubmitPlan returns."""
+
+    def __init__(self, max_batch: int = 16):
+        self.max_batch = max_batch
+        self._queue: List[_Request] = []
+        self._cv = threading.Condition()
+        self._stop = False
+        self._overlay_lock = threading.Lock()
+        self._overlays: Dict[int, np.ndarray] = {}   # id(cm) -> f32[N, R]
+        self._tickets: Dict[int, Tuple[int, List[Tuple[int, np.ndarray]]]] = {}
+        self._next_ticket = 1
+        self.stats = {"dispatches": 0, "batched_evals": 0, "single_evals": 0,
+                      "max_batch_seen": 0, "tickets_open": 0}
+        self._thread = threading.Thread(
+            target=self._run, name="placement-engine", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- public
+
+    def place(self, cm, inputs: PlaceInputs,
+              deltas: Optional[Sequence[Tuple[int, np.ndarray]]] = None,
+              spread_algorithm: bool = False) -> Tuple[PlaceResult, int]:
+        """Returns (result, ticket).  The caller MUST call
+        `complete(ticket)` once the resulting plan has been submitted (or
+        will never be), releasing its in-flight usage contribution."""
+        req = _Request(cm=cm, inputs=inputs, deltas=list(deltas or ()),
+                       spread_algorithm=spread_algorithm, future=Future())
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("placement engine stopped")
+            self._queue.append(req)
+            self._cv.notify()
+        return req.future.result()
+
+    def complete(self, ticket: int) -> None:
+        """Release a placement's in-flight usage (its plan is now either
+        committed into cm.used or abandoned)."""
+        with self._overlay_lock:
+            entry = self._tickets.pop(ticket, None)
+            if entry is None:
+                return
+            cm_key, contrib = entry
+            overlay = self._overlays.get(cm_key)
+            if overlay is None:
+                return
+            for row, vec in contrib:
+                if row < overlay.shape[0]:
+                    overlay[row] -= vec
+            self.stats["tickets_open"] = len(self._tickets)
+            if not self._tickets:
+                # nothing in flight: drop overlays entirely so numerical
+                # residue never accumulates
+                self._overlays.clear()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify()
+        self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------- overlay
+
+    def _basis_for(self, cm) -> np.ndarray:
+        """cm.used + in-flight overlay (copy), under the overlay lock."""
+        with self._overlay_lock:
+            overlay = self._overlays.get(id(cm))
+            used = np.array(cm.used, dtype=np.float32)
+            if overlay is not None:
+                n = min(overlay.shape[0], used.shape[0])
+                used[:n] += overlay[:n]
+            return used
+
+    def _register(self, req: _Request, result: PlaceResult) -> int:
+        """Record an eval's in-flight usage contribution; returns ticket."""
+        contrib: List[Tuple[int, np.ndarray]] = []
+        S = req.inputs.demand.shape[0]
+        for si in range(S):
+            row = int(result.node[si])
+            if row >= 0:
+                contrib.append((row, req.inputs.demand[si]))
+        for row, vec in req.deltas:
+            if vec.max(initial=0.0) > 0.0 and (vec >= 0.0).all():
+                contrib.append((row, vec))    # sticky pre-placement adds
+        with self._overlay_lock:
+            key = id(req.cm)
+            overlay = self._overlays.get(key)
+            n = req.cm.used.shape[0]
+            if overlay is None or overlay.shape[0] < n:
+                grown = np.zeros((n, NUM_RESOURCE_DIMS), np.float32)
+                if overlay is not None:
+                    grown[:overlay.shape[0]] = overlay
+                overlay = self._overlays[key] = grown
+            for row, vec in contrib:
+                if row < overlay.shape[0]:
+                    overlay[row] += vec
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._tickets[ticket] = (key, contrib)
+            self.stats["tickets_open"] = len(self._tickets)
+        return ticket
+
+    # ------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait()
+                if self._stop and not self._queue:
+                    return
+                batch, self._queue = (self._queue[:self.max_batch],
+                                      self._queue[self.max_batch:])
+            try:
+                self._dispatch(batch)
+            except Exception as e:              # noqa: BLE001
+                for r in batch:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        import jax
+
+        groups: Dict[tuple, List[_Request]] = {}
+        for r in batch:
+            groups.setdefault(r.shape_key(), []).append(r)
+        self.stats["dispatches"] += 1
+        self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"],
+                                           len(batch))
+
+        pending = []   # (requests, device result tuple)
+        for reqs in groups.values():
+            # single path also when the matrix has grown (re-bucketed)
+            # since these inputs were built: the dispatch-time basis no
+            # longer matches the padded node axis
+            if (len(reqs) == 1 or
+                    reqs[0].cm.capacity.shape[0] != reqs[0].inputs.capacity.shape[0]):
+                for r in reqs:
+                    self._run_single(r)
+                self.stats["single_evals"] += len(reqs)
+                continue
+            pending.append((reqs, self._dispatch_group(reqs)))
+            self.stats["batched_evals"] += len(reqs)
+
+        if not pending:
+            return
+        # ONE batched D2H transfer for every group dispatched this round
+        fetched = jax.device_get([outs for _, outs in pending])
+        for (reqs, _), outs in zip(pending, fetched):
+            node, score, fit_s, n_eval, n_exh, top_n, top_s = outs
+            for i, r in enumerate(reqs):
+                res = PlaceResult(
+                    node=node[i], score=score[i], fit_score=fit_s[i],
+                    nodes_evaluated=n_eval[i], nodes_exhausted=n_exh[i],
+                    top_nodes=top_n[i], top_scores=top_s[i], used=None)
+                ticket = self._register(r, res)
+                r.future.set_result((res, ticket))
+
+    def _run_single(self, r: _Request) -> None:
+        """Lone request: single-eval path sharing place_eval's jit cache
+        (no scan-over-evals compile for serial callers).  Still scores
+        against the in-flight overlay basis so concurrent-but-unbatched
+        evals don't collide."""
+        try:
+            if r.cm.used.shape[0] == r.inputs.used.shape[0]:
+                u = self._basis_for(r.cm)
+                for row, vec in r.deltas:
+                    u[row] += vec
+                r.inputs.used = u
+            res = place_eval(r.inputs, r.spread_algorithm)
+            ticket = self._register(r, res)
+            r.future.set_result((res, ticket))
+        except Exception as e:                  # noqa: BLE001
+            r.future.set_exception(e)
+
+    def _dispatch_group(self, reqs: List[_Request]):
+        """Stack one shape-group, pad the eval axis to a bucket, ship with
+        one device_put, dispatch the chained kernel.  Returns the
+        device-side output tuple (fetch happens batched in _dispatch)."""
+        import jax
+
+        # one compiled batch shape per input-shape group: always pad the
+        # eval axis to max_batch (padding costs only wasted scan steps;
+        # another E bucket would cost a full XLA compile)
+        E = self.max_batch
+        cm = reqs[0].cm
+        N = reqs[0].inputs.capacity.shape[0]
+        R = NUM_RESOURCE_DIMS
+        D = pad_to_bucket(max([len(r.deltas) for r in reqs] + [1]),
+                          minimum=_DELTA_BUCKET_MIN)
+
+        stacked = {}
+        for f in _PER_EVAL_FIELDS:
+            first = getattr(reqs[0].inputs, f)
+            arrs = [getattr(r.inputs, f) for r in reqs]
+            if E > len(reqs):
+                arrs += [np.zeros_like(first)] * (E - len(reqs))
+            stacked[f] = np.stack(arrs)
+        delta_rows = np.full((E, D), N, np.int32)      # N = dropped
+        delta_vals = np.zeros((E, D, R), np.float32)
+        for i, r in enumerate(reqs):
+            for d, (row, vec) in enumerate(r.deltas):
+                delta_rows[i, d] = row
+                delta_vals[i, d] = vec
+        eb = EvalBatch(delta_rows=delta_rows, delta_vals=delta_vals,
+                       **stacked)
+
+        # basis read at dispatch time (latest commits + in-flight overlay);
+        # copies guard against the applier mutating cm.used mid-transfer
+        basis = (np.ascontiguousarray(cm.capacity), self._basis_for(cm))
+        (capacity, used0), eb = jax.device_put((basis, eb))
+        outs, _used_final = place_batch_jit(
+            capacity, used0, eb,
+            spread_algorithm=reqs[0].spread_algorithm)
+        return outs
+
+
+_engine: Optional[PlacementEngine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Optional[PlacementEngine]:
+    """Process-wide engine; disable with NOMAD_TPU_ENGINE=0."""
+    global _engine
+    if os.environ.get("NOMAD_TPU_ENGINE", "1") == "0":
+        return None
+    with _engine_lock:
+        if _engine is None:
+            _engine = PlacementEngine()
+        return _engine

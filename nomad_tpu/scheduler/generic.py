@@ -162,15 +162,22 @@ class GenericScheduler:
             self.queued_allocs[pr.task_group] = \
                 self.queued_allocs.get(pr.task_group, 0) + 1
 
-        if not stopped and results.place:
-            self._compute_placements(results.place, results.stop +
-                                     results.destructive_stop, allocs)
+        try:
+            if not stopped and results.place:
+                self._compute_placements(results.place, results.stop +
+                                         results.destructive_stop, allocs)
 
-        if self.plan.is_no_op():
-            self._finish_eval()
-            return True, False
+            if self.plan.is_no_op():
+                self._finish_eval()
+                return True, False
 
-        self.plan_result = self.planner.submit_plan(self.plan)
+            self.plan_result = self.planner.submit_plan(self.plan)
+        finally:
+            # release the in-flight usage overlay: the plan is now either
+            # committed into the cluster matrix or abandoned
+            if getattr(self, "_stack", None) is not None:
+                self._stack.release()
+                self._stack = None
         adjust_queued_allocations(self.plan_result, self.queued_allocs)
 
         full, expected, actual = self.plan_result.full_commit(self.plan)
@@ -236,14 +243,17 @@ class GenericScheduler:
                             stops, all_allocs: List[Allocation]) -> None:
         cm = self.state.matrix
         stack = DenseStack(cm, self.state.scheduler_config)
+        self._stack = stack
         job = self.job
         tg_index = {tg.name: i for i, tg in enumerate(job.task_groups)}
         groups = [stack.compile_group(job, tg) for tg in job.task_groups]
         self._last_feasible_union = np.any(
             np.stack([g.feasible for g in groups]), axis=0)
 
-        # proposed-usage basis: committed usage minus what this plan stops
+        # proposed-usage basis: committed usage minus what this plan stops;
+        # `deltas` mirrors every adjustment sparsely for the batching engine
         used = cm.used.copy()
+        deltas: List[Tuple[int, np.ndarray]] = []
         freed_ports: Dict[int, Set[int]] = {}
         stopped_ids: Set[str] = set()
         for sr in stops:
@@ -253,7 +263,10 @@ class GenericScheduler:
             if row is None:
                 continue
             cr = a.comparable_resources()
-            used[row] -= (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+            vec = np.array([cr.cpu_shares, cr.memory_mb, cr.disk_mb],
+                           np.float32)
+            used[row] -= vec
+            deltas.append((row, -vec))
             from nomad_tpu.core.plan_apply import _alloc_ports
             freed_ports.setdefault(row, set()).update(_alloc_ports(a))
 
@@ -284,6 +297,7 @@ class GenericScheduler:
                     d = groups[gi].demand
                     if np.all(used[row] + d <= cm.capacity[row]):
                         used[row] += d
+                        deltas.append((row, d.astype(np.float32)))
                         preplaced.append((pr, row))
                         continue
             slot_requests.append(pr)
@@ -294,7 +308,7 @@ class GenericScheduler:
             inputs = stack.build_inputs(
                 job, groups, slots, allocs_by_tg,
                 penalty_nodes=penalty_nodes, used_override=used)
-            result = stack.place(inputs)
+            result = stack.place(inputs, deltas)
 
         ports = PortClaims(cm)
         now = _time.time()

@@ -290,5 +290,32 @@ class DenseStack:
             demand=demand, slot_tg=slot_tg, slot_active=slot_active,
         )
 
-    def place(self, inputs: PlaceInputs) -> PlaceResult:
+    def place(self, inputs: PlaceInputs, deltas=None) -> PlaceResult:
+        """Run the placement kernel.  Routed through the process-wide
+        PlacementEngine so concurrent evals coalesce into one device
+        dispatch; `deltas` is the sparse (row, f32[R]) usage-adjustment
+        list already applied to inputs.used (the engine re-applies it to a
+        dispatch-time basis in the batched path).
+
+        Sets `self.last_ticket`: the caller must hand it back to
+        `engine.complete()` once the resulting plan is submitted (the
+        generic scheduler does), releasing the in-flight usage overlay."""
+        from nomad_tpu.parallel.engine import get_engine
+        eng = get_engine()
+        if eng is not None:
+            result, self.last_ticket = eng.place(
+                self.cm, inputs, deltas,
+                spread_algorithm=self.spread_algorithm)
+            return result
+        self.last_ticket = None
         return place_eval(inputs, spread_algorithm=self.spread_algorithm)
+
+    def release(self) -> None:
+        """Release the in-flight usage contribution of the last place()."""
+        ticket = getattr(self, "last_ticket", None)
+        if ticket is not None:
+            from nomad_tpu.parallel.engine import get_engine
+            eng = get_engine()
+            if eng is not None:
+                eng.complete(ticket)
+            self.last_ticket = None

@@ -1,0 +1,157 @@
+"""PlacementEngine batch-path parity: a chained batch dispatch must be
+exactly equivalent to sequential single-eval processing (same node picks,
+same scores), including sparse usage deltas, and concurrent callers must
+coalesce through the public API without changing results."""
+import threading
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.encode import ClusterMatrix
+from nomad_tpu.ops.place import place_eval
+from nomad_tpu.parallel.engine import PlacementEngine, _Request
+from nomad_tpu.scheduler.stack import DenseStack
+from concurrent.futures import Future
+
+
+def _world(n_nodes=16):
+    cm = ClusterMatrix(initial_rows=n_nodes)
+    for i in range(n_nodes):
+        n = mock.node()
+        n.attributes["rack"] = f"r{i % 4}"
+        cm.upsert_node(n)
+    return cm
+
+
+def _request(cm, count=5, deltas=()):
+    job = mock.batch_job()
+    job.task_groups[0].count = count
+    stack = DenseStack(cm)
+    groups = [stack.compile_group(job, tg) for tg in job.task_groups]
+    used = cm.used.copy()
+    for row, vec in deltas:
+        used[row] += vec
+    inputs = stack.build_inputs(job, groups, [0] * count, {},
+                                used_override=used)
+    return _Request(cm=cm, inputs=inputs, deltas=list(deltas),
+                    spread_algorithm=False, future=Future())
+
+
+def _serial_reference(cm, reqs):
+    """Sequential processing with the chained-usage semantics the batch
+    kernel implements: each eval starts from the usage left by the last."""
+    used = cm.used.copy()
+    results = []
+    for r in reqs:
+        u = used.copy()
+        for row, vec in r.deltas:
+            u[row] += vec
+        inp = r.inputs
+        inp.used = u
+        res = place_eval(inp, r.spread_algorithm)
+        results.append(res)
+        used = u
+        for si in range(inp.demand.shape[0]):
+            row = int(res.node[si])
+            if row >= 0:
+                used[row] += inp.demand[si]
+    return results
+
+
+def test_batch_matches_serial_chained():
+    cm = _world()
+    engine = PlacementEngine()
+    try:
+        reqs = [_request(cm, count=3) for _ in range(4)]
+        expected = _serial_reference(cm, [_request(cm, count=3)
+                                          for _ in range(4)])
+        engine._dispatch(reqs)
+        for r, exp in zip(reqs, expected):
+            got, ticket = r.future.result(timeout=30)
+            np.testing.assert_array_equal(got.node[:3], exp.node[:3])
+            np.testing.assert_allclose(got.score[:3], exp.score[:3],
+                                       rtol=1e-5)
+            assert int(got.nodes_evaluated[0]) == int(exp.nodes_evaluated[0])
+            engine.complete(ticket)
+        assert engine.stats["batched_evals"] == 4
+        # all tickets released -> overlay fully drained
+        assert not engine._tickets and not engine._overlays
+    finally:
+        engine.stop()
+
+
+def test_batch_applies_deltas():
+    cm = _world(n_nodes=8)
+    engine = PlacementEngine()
+    try:
+        # free a full node's worth on row 0, consume most of row 1
+        free = np.array([-2000.0, -2000.0, 0.0], np.float32)
+        eat = np.array([3500.0, 7500.0, 0.0], np.float32)
+        reqs = [_request(cm, count=2, deltas=[(0, free)]),
+                _request(cm, count=2, deltas=[(1, eat)])]
+        expected = _serial_reference(
+            cm, [_request(cm, count=2, deltas=[(0, free)]),
+                 _request(cm, count=2, deltas=[(1, eat)])])
+        engine._dispatch(reqs)
+        for r, exp in zip(reqs, expected):
+            got, ticket = r.future.result(timeout=30)
+            np.testing.assert_array_equal(got.node[:2], exp.node[:2])
+            np.testing.assert_allclose(got.score[:2], exp.score[:2],
+                                       rtol=1e-5)
+            engine.complete(ticket)
+    finally:
+        engine.stop()
+
+
+def test_concurrent_callers_coalesce():
+    cm = _world()
+    engine = PlacementEngine()
+    try:
+        # hold the dispatcher busy with one request so the rest queue up
+        # and form a batch
+        n_callers = 6
+        barrier = threading.Barrier(n_callers)
+        results = [None] * n_callers
+        errors = []
+
+        tickets = []
+
+        def call(i):
+            try:
+                r = _request(cm, count=3)
+                barrier.wait()
+                res, ticket = engine.place(cm, r.inputs, r.deltas,
+                                           r.spread_algorithm)
+                results[i] = res
+                tickets.append(ticket)
+            except Exception as e:              # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(n_callers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        # in the real flow a ticket is released only after its plan
+        # commits into cm.used; here nothing commits, so release at the
+        # end to keep every in-flight contribution visible to later
+        # batches
+        for t_ in tickets:
+            engine.complete(t_)
+        assert not errors
+        assert all(r is not None for r in results)
+        # every caller placed all 3 allocs somewhere valid
+        for r in results:
+            assert (r.node[:3] >= 0).all()
+        # chained usage: total demand across callers must fit --
+        # reconstruct usage and check no node is over capacity
+        total = cm.used.copy()
+        demand = _request(cm, count=3).inputs.demand
+        for r in results:
+            for si in range(3):
+                total[int(r.node[si])] += demand[si]
+        assert (total <= cm.capacity + 1e-3).all()
+    finally:
+        engine.stop()
