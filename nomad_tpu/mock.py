@@ -6,6 +6,8 @@ from __future__ import annotations
 import itertools
 import uuid
 
+from nomad_tpu.utils import generate_uuid
+
 from nomad_tpu.structs import (
     Allocation,
     AllocClientStatus,
@@ -30,7 +32,7 @@ _seq = itertools.count(1)
 
 
 def _uuid() -> str:
-    return str(uuid.uuid4())
+    return generate_uuid()
 
 
 def node(**overrides) -> Node:

@@ -186,6 +186,45 @@ def _place_step(inp: PlaceInputs, spread_algorithm: bool, carry, slot):
     return (used, tg_count, spread_counts), out
 
 
+def _pack_outputs(node, score, fit_s, n_eval, n_exh, top_n, top_s) -> jax.Array:
+    """Pack the per-slot outputs into ONE f32 array [..., S, 5 + 2*TOP_K]
+    (ints bitcast to f32) so the host fetches a single leaf — on
+    high-latency runtimes every device->host leaf is a ~20-35 ms round
+    trip, so 7 leaves vs 1 is the difference between ~240 ms and ~25 ms
+    per dispatch."""
+    as_f = lambda x: jax.lax.bitcast_convert_type(x.astype(jnp.int32),
+                                                  jnp.float32)
+    return jnp.concatenate([
+        as_f(node)[..., None], score[..., None], fit_s[..., None],
+        as_f(n_eval)[..., None], as_f(n_exh)[..., None],
+        as_f(top_n), top_s], axis=-1)
+
+
+def unpack_outputs(packed: np.ndarray):
+    """Host-side inverse of _pack_outputs (numpy views, no copies of the
+    float parts).  packed: f32[..., S, 5 + 2*TOP_K]."""
+    as_i = lambda x: np.ascontiguousarray(x).view(np.int32)
+    node = as_i(packed[..., 0])
+    score = packed[..., 1]
+    fit_s = packed[..., 2]
+    n_eval = as_i(packed[..., 3])
+    n_exh = as_i(packed[..., 4])
+    top_n = as_i(packed[..., 5:5 + TOP_K])
+    top_s = packed[..., 5 + TOP_K:5 + 2 * TOP_K]
+    return node, score, fit_s, n_eval, n_exh, top_n, top_s
+
+
+@functools.partial(jax.jit, static_argnames=("spread_algorithm",))
+def place_eval_packed_jit(inp: PlaceInputs, spread_algorithm: bool = False):
+    """Single-eval kernel with packed output: returns (f32[S, 5+2K]
+    packed outputs, f32[N, R] final usage)."""
+    S = inp.demand.shape[0]
+    carry0 = (inp.used, inp.tg_count, inp.spread_counts)
+    step = functools.partial(_place_step, inp, spread_algorithm)
+    (used, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
+    return _pack_outputs(*outs), used
+
+
 @functools.partial(jax.jit, static_argnames=("spread_algorithm",))
 def place_eval_jit(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceResult:
     """Place all slots of one evaluation.  Shapes are static; callers bucket
@@ -234,7 +273,9 @@ class EvalBatch:
 def place_batch_jit(capacity: jax.Array, used0: jax.Array, batch: EvalBatch,
                     spread_algorithm: bool = False):
     """Place a batch of E evaluations in one dispatch, chaining the
-    proposed-usage matrix across them.
+    proposed-usage matrix across them.  Returns (packed outputs
+    f32[E, S, 5+2K] — see _pack_outputs/unpack_outputs — and the final
+    usage matrix, left device-resident).
 
     Chaining (a `lax.scan` over the eval axis, carrying f32[N, R] usage)
     makes the batch exactly equivalent to sequential worker processing:
@@ -245,9 +286,6 @@ def place_batch_jit(capacity: jax.Array, used0: jax.Array, batch: EvalBatch,
     (nomad/worker.go:81-85 concurrent workers + plan_apply.go partial
     commit) with a conflict-free device-side pipeline; the serialized plan
     applier still re-validates as defense in depth.
-
-    Returns per-eval stacked PlaceResult fields (without `used`) plus the
-    final usage matrix (left device-resident).
     """
     def eval_step(used, ev: EvalBatch):
         used = used.at[ev.delta_rows].add(ev.delta_vals, mode="drop")
@@ -265,24 +303,24 @@ def place_batch_jit(capacity: jax.Array, used0: jax.Array, batch: EvalBatch,
         carry0 = (used, ev.tg_count, ev.spread_counts)
         step = functools.partial(_place_step, inp, spread_algorithm)
         (used_f, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
-        return used_f, outs
+        return used_f, _pack_outputs(*outs)
 
-    used_final, outs = jax.lax.scan(eval_step, used0, batch)
-    return outs, used_final
+    used_final, packed = jax.lax.scan(eval_step, used0, batch)
+    return packed, used_final
 
 
 def place_eval(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceResult:
     """Convenience host wrapper returning numpy-backed results.
 
-    All small outputs come back in ONE batched D2H transfer
-    (`jax.device_get`); the f32[N, R] `used` matrix stays device-resident
-    (no caller reads it on host — transferring it per eval dominated e2e
-    wall time on high-latency runtimes).
+    All outputs come back in ONE single-leaf D2H transfer (the packed
+    output array); the f32[N, R] `used` matrix stays device-resident (no
+    caller reads it on host — transferring it per eval dominated e2e wall
+    time on high-latency runtimes).
     """
-    res = place_eval_jit(inp, spread_algorithm=spread_algorithm)
-    node, score, fit_s, n_eval, n_exh, top_n, top_s = jax.device_get(
-        (res.node, res.score, res.fit_score, res.nodes_evaluated,
-         res.nodes_exhausted, res.top_nodes, res.top_scores))
+    packed, used = place_eval_packed_jit(inp,
+                                         spread_algorithm=spread_algorithm)
+    node, score, fit_s, n_eval, n_exh, top_n, top_s = unpack_outputs(
+        jax.device_get(packed))
     return PlaceResult(node=node, score=score, fit_score=fit_s,
                        nodes_evaluated=n_eval, nodes_exhausted=n_exh,
-                       top_nodes=top_n, top_scores=top_s, used=res.used)
+                       top_nodes=top_n, top_scores=top_s, used=used)

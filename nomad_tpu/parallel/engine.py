@@ -40,6 +40,7 @@ from nomad_tpu.ops.place import (
     PlaceResult,
     place_batch_jit,
     place_eval,
+    unpack_outputs,
 )
 
 # fields of PlaceInputs that ride per-eval in an EvalBatch (everything
@@ -228,10 +229,11 @@ class PlacementEngine:
 
         if not pending:
             return
-        # ONE batched D2H transfer for every group dispatched this round
-        fetched = jax.device_get([outs for _, outs in pending])
-        for (reqs, _), outs in zip(pending, fetched):
-            node, score, fit_s, n_eval, n_exh, top_n, top_s = outs
+        # one D2H transfer per group (usually one group -> one leaf)
+        fetched = jax.device_get([packed for _, packed in pending])
+        for (reqs, _), packed in zip(pending, fetched):
+            node, score, fit_s, n_eval, n_exh, top_n, top_s = \
+                unpack_outputs(packed)
             for i, r in enumerate(reqs):
                 res = PlaceResult(
                     node=node[i], score=score[i], fit_score=fit_s[i],
@@ -293,10 +295,10 @@ class PlacementEngine:
         # copies guard against the applier mutating cm.used mid-transfer
         basis = (np.ascontiguousarray(cm.capacity), self._basis_for(cm))
         (capacity, used0), eb = jax.device_put((basis, eb))
-        outs, _used_final = place_batch_jit(
+        packed, _used_final = place_batch_jit(
             capacity, used0, eb,
             spread_algorithm=reqs[0].spread_algorithm)
-        return outs
+        return packed
 
 
 _engine: Optional[PlacementEngine] = None

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import time as _time
 import uuid
+
+from nomad_tpu.utils import generate_uuid
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -202,7 +204,7 @@ class GenericScheduler:
         ev = self.eval
         classes, escaped = self._class_eligibility()
         return Evaluation(
-            id=str(uuid.uuid4()),
+            id=generate_uuid(),
             namespace=ev.namespace,
             priority=ev.priority,
             type=ev.type,

@@ -8,6 +8,8 @@ reference split where the plan applier re-validates).
 from __future__ import annotations
 
 import uuid
+
+from nomad_tpu.utils import generate_uuid
 from typing import Dict, List, Optional, Set
 
 import numpy as np
@@ -98,7 +100,7 @@ def build_allocation(
         shared_ports.extend(m.reserved_ports + m.dynamic_ports)
 
     alloc = Allocation(
-        id=str(uuid.uuid4()),
+        id=generate_uuid(),
         namespace=job.namespace,
         eval_id=eval_id,
         name=name,

@@ -16,6 +16,8 @@ from __future__ import annotations
 import math
 import time as _time
 import uuid
+
+from nomad_tpu.utils import generate_uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -330,7 +332,7 @@ class AllocReconciler:
             u.client_status = AllocClientStatus.UNKNOWN
             u.desired_description = ALLOC_UNKNOWN
             timeout_eval = Evaluation(
-                id=str(uuid.uuid4()), namespace=a.namespace, priority=self.eval_priority,
+                id=generate_uuid(), namespace=a.namespace, priority=self.eval_priority,
                 type=self.job.type, triggered_by=EvalTrigger.MAX_DISCONNECT_TIMEOUT,
                 job_id=self.job_id, status=EvalStatus.PENDING,
                 wait_until=self.now + (tg.max_client_disconnect_s or 0.0))
@@ -388,7 +390,7 @@ class AllocReconciler:
         # --- delayed reschedule followup evals
         for a, wait_until in reschedule_later:
             ev = Evaluation(
-                id=str(uuid.uuid4()), namespace=a.namespace,
+                id=generate_uuid(), namespace=a.namespace,
                 priority=self.eval_priority, type=self.job.type,
                 triggered_by=EvalTrigger.RETRY_FAILED_ALLOC, job_id=self.job_id,
                 status=EvalStatus.PENDING, wait_until=wait_until)

@@ -195,7 +195,16 @@ class Allocation:
         return self.desired_transition.should_migrate()
 
     def comparable_resources(self) -> ComparableResources:
-        return self.allocated_resources.comparable()
+        # memoized per allocated_resources object (called several times
+        # per alloc in the placement/apply hot path); the cache key is the
+        # object identity, so replacing allocated_resources invalidates it
+        ar = self.allocated_resources
+        cached = getattr(self, "_cmp_cache", None)
+        if cached is not None and cached[0] is ar:
+            return cached[1]
+        c = ar.comparable()
+        self._cmp_cache = (ar, c)
+        return c
 
     def index(self) -> int:
         """Parse the bracketed index out of the alloc name."""

@@ -4,6 +4,8 @@ DeploymentState, used by scheduler/reconcile.go and deploymentwatcher/).
 from __future__ import annotations
 
 import uuid
+
+from nomad_tpu.utils import generate_uuid
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -48,7 +50,7 @@ class DeploymentState:
 
 @dataclass
 class Deployment:
-    id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    id: str = field(default_factory=generate_uuid)
     namespace: str = "default"
     job_id: str = ""
     job_version: int = 0

@@ -2,6 +2,8 @@
 from __future__ import annotations
 
 import uuid
+
+from nomad_tpu.utils import generate_uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -40,7 +42,7 @@ class EvalTrigger:
 
 @dataclass
 class Evaluation:
-    id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    id: str = field(default_factory=generate_uuid)
     namespace: str = "default"
     priority: int = 50
     type: str = "service"             # scheduler type
