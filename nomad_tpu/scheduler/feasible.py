@@ -79,6 +79,12 @@ def constraint_mask(cm: ClusterMatrix, c: Constraint) -> np.ndarray:
     """bool[N] satisfaction mask for one constraint over all rows."""
     n = cm.n_rows
     op = c.operand
+    # equality aliases (reference checkConstraint, feasible.go:808-814:
+    # "=", "==" and "is" are one operator; "!=" and "not" likewise)
+    if op in ("==", "is"):
+        op = Operand.EQ
+    elif op == "not":
+        op = Operand.NEQ
 
     # distinct_hosts / distinct_property are not node-static; handled by the
     # stack against proposed allocations (checkConstraint returns true here,

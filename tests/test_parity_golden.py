@@ -1,0 +1,330 @@
+"""Golden parity suite: concrete inputs AND expected outputs ported from
+the Go reference's own tests, so a failure here distinguishes "kernel
+diverges from reference semantics" from "host twin and kernel share a bug"
+(both would pass the self-referential twin tests in test_ops.py).
+
+Sources (expected values copied from the reference assertions):
+- /root/reference/nomad/structs/funcs_test.go:692-760  (TestScoreFitBinPack)
+- /root/reference/scheduler/rank_test.go:34-139   (BinPackIterator_NoExistingAlloc)
+- /root/reference/scheduler/rank_test.go:1843-1921 (JobAntiAffinity_PlannedAlloc)
+- /root/reference/scheduler/rank_test.go:1923-1957 (NodeAntiAffinity_PenaltyNodes)
+- /root/reference/scheduler/rank_test.go:1959-2022 (ScoreNormalizationIterator)
+- /root/reference/scheduler/rank_test.go:2024-2101 (NodeAffinityIterator)
+- /root/reference/scheduler/spread_test.go:19-177  (SpreadIterator_SingleAttribute)
+- /root/reference/scheduler/spread_test.go:561-584 (evenSpreadScoreBoost)
+- /root/reference/scheduler/preemption_test.go:16-146 (TestResourceDistance)
+"""
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.encode import ClusterMatrix
+from nomad_tpu.ops.fit import score_fit
+from nomad_tpu.ops.place import place_eval
+from nomad_tpu.ops.preempt import _distance
+from nomad_tpu.scheduler.stack import DenseStack
+from nomad_tpu.structs.job import Affinity, Operand, Spread, SpreadTarget
+from nomad_tpu.structs.node import (
+    NodeCpuResources,
+    NodeReservedResources,
+    NodeResources,
+)
+
+
+def _node(cpu, mem, res_cpu=0, res_mem=0, disk=100_000, **over):
+    n = mock.node(**over)
+    n.node_resources = NodeResources(
+        cpu=NodeCpuResources(cpu_shares=cpu, total_core_count=4,
+                             reservable_cores=[0, 1, 2, 3]),
+        memory_mb=mem, disk_mb=disk)
+    n.reserved_resources = NodeReservedResources(
+        cpu_shares=res_cpu, memory_mb=res_mem)
+    return n
+
+
+def _world(nodes):
+    cm = ClusterMatrix(initial_rows=len(nodes))
+    rows = [cm.upsert_node(n) for n in nodes]
+    return cm, rows
+
+
+def _place_one(cm, job, allocs_by_tg=None, penalty_nodes=None):
+    """One placement slot through the real stack + kernel; returns
+    (selected row, selected score, {row: score} from the top-K meta)."""
+    stack = DenseStack(cm)
+    groups = [stack.compile_group(job, tg) for tg in job.task_groups]
+    inp = stack.build_inputs(job, groups, [0], allocs_by_tg or {},
+                             penalty_nodes=penalty_nodes)
+    res = place_eval(inp)
+    scores = {int(r): float(s)
+              for r, s in zip(res.top_nodes[0], res.top_scores[0])
+              if s > -np.inf}
+    return int(res.node[0]), float(res.score[0]), scores
+
+
+# --------------------------------------------------------------- score_fit
+# funcs_test.go:692-760: node 4096/8192 with 2048/4096 reserved
+# => comparable capacity 2048 cpu / 4096 mem.
+
+FIT_CASES = [
+    # (util_cpu, util_mem, binpack, spread)  -- exact reference values
+    (2048, 4096, 18.0, 0.0),     # "almost filled node, just enough hole"
+    (0, 0, 0.0, 18.0),           # "unutilized node"
+    (1024, 2048, 13.675, 4.325), # "mid-case scenario"
+]
+
+
+@pytest.mark.parametrize("cpu,mem,binpack,spread", FIT_CASES)
+def test_score_fit_binpack_golden(cpu, mem, binpack, spread):
+    capacity = np.array([[2048.0, 4096.0, 0.0]], np.float32)
+    util = np.array([[cpu, mem, 0.0]], np.float32)
+    got_bp = float(np.asarray(score_fit(capacity, util, False))[0])
+    got_sp = float(np.asarray(score_fit(capacity, util, True))[0])
+    assert got_bp == pytest.approx(binpack, abs=1e-3)
+    assert got_sp == pytest.approx(spread, abs=1e-3)
+    assert got_bp + got_sp == pytest.approx(18.0, abs=1e-3)
+
+
+def test_binpack_iterator_no_existing_alloc():
+    """rank_test.go:34-139.  Three nodes (after reserved subtraction:
+    1024/1024, 512/512, 3072/3072), task demand 1024 cpu / 1024 mem:
+    node0 is a perfect fit (score 1.0), node1 is overloaded (filtered),
+    node2 scores in (0.50, 0.60)."""
+    n0 = _node(2048, 2048, 1024, 1024)
+    n1 = _node(1024, 1024, 512, 512)
+    n2 = _node(4096, 4096, 1024, 1024)
+    cm, rows = _world([n0, n1, n2])
+
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 1024
+    job.task_groups[0].tasks[0].resources.memory_mb = 1024
+    job.task_groups[0].ephemeral_disk.size_mb = 0
+
+    sel, score, scores = _place_one(cm, job)
+    assert sel == rows[0]
+    assert score == pytest.approx(1.0, abs=1e-3)
+    assert rows[1] not in scores          # overloaded node filtered out
+    assert 0.50 < scores[rows[2]] < 0.60
+
+
+def test_binpack_mixed_reserve_equivalence():
+    """rank_test.go:139-254 (MixedReserve): a node with reserved resources
+    scores exactly as if it simply had less capacity."""
+    n_reserved = _node(2048, 2048, 1024, 1024)
+    n_smaller = _node(1024, 1024, 0, 0)
+    cm, rows = _world([n_reserved, n_smaller])
+
+    job = mock.job()
+    job.task_groups[0].count = 1
+    job.task_groups[0].tasks[0].resources.cpu = 512
+    job.task_groups[0].tasks[0].resources.memory_mb = 512
+    job.task_groups[0].ephemeral_disk.size_mb = 0
+
+    _, _, scores = _place_one(cm, job)
+    assert scores[rows[0]] == pytest.approx(scores[rows[1]], abs=1e-6)
+
+
+# ------------------------------------------------------- scoring iterators
+# The reference tests isolate one scoring iterator behind
+# ScoreNormalization; the dense kernel always composes fit + active
+# scorers and divides by the number that ran (rank.go:781-795), so the
+# expected composites below are  (fit + iterator_golden) / n_scorers  with
+# fit hand-derived from the funcs.go formula.
+
+def _fit_for(cap_cpu, cap_mem, util_cpu, util_mem):
+    """ScoreFitBinPack(funcs.go:259-279)/18, hand-computed."""
+    free_cpu = 1.0 - util_cpu / cap_cpu
+    free_mem = 1.0 - util_mem / cap_mem
+    return (20.0 - 10.0 ** free_cpu - 10.0 ** free_mem) / 18.0
+
+
+def test_job_anti_affinity_golden():
+    """rank_test.go:1843-1921: two planned/existing allocs of the same
+    (job, tg) on node0, desired count 4 => anti-affinity score -(2+1)/4 =
+    -0.75 on node0 (reference asserts exactly -0.75), 0 on node1.
+    Composite: node0 = (fit - 0.75)/2, node1 = fit (single scorer)."""
+    n0 = _node(4000, 8192)
+    n1 = _node(4000, 8192)
+    cm, rows = _world([n0, n1])
+
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 4
+    tg.tasks[0].resources.cpu = 1000
+    tg.tasks[0].resources.memory_mb = 2048
+    tg.ephemeral_disk.size_mb = 0
+
+    a1 = mock.alloc_for(job, node_id=n0.id)
+    a2 = mock.alloc_for(job, node_id=n0.id, index=1)
+    cm.upsert_alloc(a1)
+    cm.upsert_alloc(a2)
+    _, _, scores = _place_one(cm, job, {tg.name: [a1, a2]})
+
+    # node0 carries two existing allocs of this tg -> its usage includes
+    # them (2000 cpu / 4096 mem) before the new demand
+    fit0 = _fit_for(4000, 8192, 3000, 6144)
+    fit1 = _fit_for(4000, 8192, 1000, 2048)
+    assert scores[rows[0]] == pytest.approx((fit0 - 0.75) / 2.0, abs=1e-3)
+    assert scores[rows[1]] == pytest.approx(fit1, abs=1e-3)
+
+
+def test_penalty_nodes_golden():
+    """rank_test.go:1923-1957: rescheduling-penalty node scores -1.0 on
+    that iterator; composite = (fit - 1.0)/2 vs plain fit."""
+    n0 = _node(4000, 8192)
+    n1 = _node(4000, 8192)
+    cm, rows = _world([n0, n1])
+
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.cpu = 1000
+    tg.tasks[0].resources.memory_mb = 2048
+    tg.ephemeral_disk.size_mb = 0
+
+    _, _, scores = _place_one(cm, job,
+                              penalty_nodes={tg.name: {n0.id}})
+    fit = _fit_for(4000, 8192, 1000, 2048)
+    assert scores[rows[0]] == pytest.approx((fit - 1.0) / 2.0, abs=1e-3)
+    assert scores[rows[1]] == pytest.approx(fit, abs=1e-3)
+
+
+def test_score_normalization_golden():
+    """rank_test.go:1959-2022: anti-affinity (-0.75) AND penalty (-1.0)
+    on node0 average to -0.875 over those two scorers; with the fit
+    scorer the dense composite is (fit - 0.75 - 1.0)/3."""
+    n0 = _node(4000, 8192)
+    n1 = _node(4000, 8192)
+    cm, rows = _world([n0, n1])
+
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 4
+    tg.tasks[0].resources.cpu = 1000
+    tg.tasks[0].resources.memory_mb = 2048
+    tg.ephemeral_disk.size_mb = 0
+
+    a1 = mock.alloc_for(job, node_id=n0.id)
+    a2 = mock.alloc_for(job, node_id=n0.id, index=1)
+    cm.upsert_alloc(a1)
+    cm.upsert_alloc(a2)
+    _, _, scores = _place_one(cm, job, {tg.name: [a1, a2]},
+                              penalty_nodes={tg.name: {n0.id}})
+    fit0 = _fit_for(4000, 8192, 3000, 6144)
+    assert scores[rows[0]] == pytest.approx((fit0 - 0.75 - 1.0) / 3.0,
+                                            abs=1e-3)
+
+
+def test_node_affinity_golden():
+    """rank_test.go:2024-2101: four affinities with weights 100/-100/50/50
+    (total 300).  Expected affinity scores: node0 (dc1 + kernel 4.9) 0.5,
+    node1 (dc2) -1/3, node2 (dc2 + class large) -1/6, node3 (dc1) 1/3."""
+    n0 = mock.node()
+    n0.attributes["kernel.version"] = "4.9"
+    n1 = mock.node(datacenter="dc2")
+    n2 = mock.node(datacenter="dc2", node_class="large")
+    n3 = mock.node()
+    cm, rows = _world([n0, n1, n2, n3])
+
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    tg = job.task_groups[0]
+    tg.affinities = [
+        Affinity("${node.datacenter}", "dc1", "=", 100),
+        Affinity("${node.datacenter}", "dc2", "=", -100),
+        Affinity("${attr.kernel.version}", ">4.0", "version", 50),
+        Affinity("${node.class}", "large", "is", 50),
+    ]
+
+    stack = DenseStack(cm)
+    g = stack.compile_group(job, tg)
+    expected = [0.5, -1.0 / 3.0, -1.0 / 6.0, 1.0 / 3.0]
+    for row, want in zip(rows, expected):
+        assert g.affinity[row] == pytest.approx(want, abs=1e-6), row
+
+
+# ------------------------------------------------------------------ spread
+
+def test_spread_single_attribute_golden():
+    """spread_test.go:19-96: dcs [dc1,dc2,dc1,dc1], count 10, existing
+    allocs on nodes 0 and 2 (both dc1), target 80% dc1 (implicit 20%
+    dc2).  Reference spread boosts: dc1 nodes 0.625 = (8-(2+1))/8, dc2
+    node 0.5 = (2-(0+1))/2."""
+    nodes = [mock.node(datacenter=dc) for dc in ("dc1", "dc2", "dc1", "dc1")]
+    cm, rows = _world(nodes)
+
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    tg = job.task_groups[0]
+    tg.count = 10
+    tg.tasks[0].resources.cpu = 100
+    tg.tasks[0].resources.memory_mb = 100
+    tg.ephemeral_disk.size_mb = 0
+    tg.spreads = [Spread("${node.datacenter}", 100,
+                         (SpreadTarget("dc1", 80),))]
+
+    a0 = mock.alloc(job=job, node_id=nodes[0].id)
+    a2 = mock.alloc(job=job, node_id=nodes[2].id)
+    allocs = {tg.name: [a0, a2]}
+
+    stack = DenseStack(cm)
+    groups = [stack.compile_group(job, tg)]
+    inp = stack.build_inputs(job, groups, [0], allocs)
+
+    # evaluate the spread boost tensor directly (the reference test
+    # isolates SpreadIterator the same way)
+    import jax
+    from nomad_tpu.ops.place import _spread_boost
+    boost = np.asarray(jax.jit(_spread_boost)(
+        jax.device_put(inp), 0, inp.spread_counts[0]))
+    assert boost[rows[0]] == pytest.approx(0.625, abs=1e-6)
+    assert boost[rows[2]] == pytest.approx(0.625, abs=1e-6)
+    assert boost[rows[3]] == pytest.approx(0.625, abs=1e-6)
+    assert boost[rows[1]] == pytest.approx(0.5, abs=1e-6)
+
+
+def test_even_spread_boost_golden():
+    """spread_test.go:561-584 (evenSpreadScoreBoost): with combined
+    counts {dc1: 1, dc2: 0}, a dc2 node gets boost exactly 1.0 =
+    (minCount - ownCount)/minCount... reference asserts 1.0 and finite."""
+    nodes = [mock.node(datacenter="dc1"), mock.node(datacenter="dc2")]
+    cm, rows = _world(nodes)
+
+    job = mock.job()
+    job.datacenters = ["dc1", "dc2"]
+    tg = job.task_groups[0]
+    tg.count = 10
+    tg.ephemeral_disk.size_mb = 0
+    tg.spreads = [Spread("${node.datacenter}", 100, ())]   # even spread
+
+    a0 = mock.alloc(job=job, node_id=nodes[0].id)
+    stack = DenseStack(cm)
+    groups = [stack.compile_group(job, tg)]
+    inp = stack.build_inputs(job, groups, [0], {tg.name: [a0]})
+
+    import jax
+    from nomad_tpu.ops.place import _spread_boost
+    boost = np.asarray(jax.jit(_spread_boost)(
+        jax.device_put(inp), 0, inp.spread_counts[0]))
+    assert np.isfinite(boost[rows[1]])
+    assert boost[rows[1]] == pytest.approx(1.0, abs=1e-6)
+
+
+# ------------------------------------------------------------- preemption
+
+def test_resource_distance_golden():
+    """preemption_test.go:16-146 (basicResourceDistance): ask
+    cpu=2048/mem=512/disk=4096; expected distances (reference asserts the
+    3-decimal strings) over the cpu/mem/disk dimensions."""
+    ask = np.array([2048.0, 512.0, 4096.0], np.float32)
+    cands = np.array([
+        [2048.0, 512.0, 4096.0],
+        [1024.0, 400.0, 1024.0],
+        [8192.0, 200.0, 1024.0],
+        [2048.0, 500.0, 4096.0],
+    ], np.float32)
+    import jax
+    d = np.asarray(jax.jit(_distance)(ask, cands))
+    for got, want in zip(d, (0.000, 0.928, 3.152, 0.023)):
+        assert f"{got:.3f}" == f"{want:.3f}"
