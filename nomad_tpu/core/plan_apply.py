@@ -16,6 +16,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from nomad_tpu.encode.matrixizer import comparable_vec, NUM_RESOURCE_DIMS
+
 from nomad_tpu.state.store import AppliedPlanResults, StateStore
 from nomad_tpu.structs import Allocation, Node
 from nomad_tpu.structs.node import NodeStatus
@@ -84,7 +86,7 @@ class PlanApplier:
         freed_ports: Dict[str, Set[int]] = {}
         for node_id, stops in list(plan.node_update.items()) + \
                 list(plan.node_preemptions.items()):
-            vec = np.zeros(3, np.float32)
+            vec = np.zeros(NUM_RESOURCE_DIMS, np.float32)
             ports: Set[int] = set()
             for a in stops:
                 live = store.alloc_by_id(a.id)
@@ -92,7 +94,7 @@ class PlanApplier:
                 if live is not None and live.terminal_status():
                     continue   # already free in committed state
                 cr = src.comparable_resources()
-                vec += (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                vec += comparable_vec(cr)
                 ports.update(_alloc_ports(src))
             freed[node_id] = vec
             freed_ports[node_id] = ports
@@ -104,8 +106,8 @@ class PlanApplier:
         node_ids = list(plan.node_allocation.keys())
         g = len(node_ids)
         rows = np.full(g, -1, np.int32)
-        demand = np.zeros((g, 3), np.float32)
-        freed_vecs = np.zeros((g, 3), np.float32)
+        demand = np.zeros((g, NUM_RESOURCE_DIMS), np.float32)
+        freed_vecs = np.zeros((g, NUM_RESOURCE_DIMS), np.float32)
         group_ports: List[List[int]] = []
         group_freed: List[List[int]] = []
         for i, node_id in enumerate(node_ids):
@@ -116,7 +118,7 @@ class PlanApplier:
                 rows[i] = row
             for a in plan.node_allocation[node_id]:
                 cr = a.comparable_resources()
-                demand[i] += (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                demand[i] += comparable_vec(cr)
                 ports.extend(_alloc_ports(a))
             freed_vecs[i] = freed.get(node_id, 0.0)
             group_ports.append(ports)

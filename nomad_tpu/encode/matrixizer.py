@@ -20,9 +20,21 @@ import numpy as np
 
 from nomad_tpu.encode.attrs import AttrTable
 
-# Resource dimension layout of the dense matrices.
-RES_CPU, RES_MEM, RES_DISK = 0, 1, 2
-NUM_RESOURCE_DIMS = 3
+# Resource dimension layout of the dense matrices.  Network bandwidth is a
+# first-class dimension: where the reference accounts MBits inside
+# NetworkIndex (structs/network.go:39,178), the dense design folds it into
+# the same capacity/used matrices so fit checks, plan validation and the
+# preemption kernel all cover bandwidth for free (ScoreFitBinPack still
+# scores cpu+mem only, matching funcs.go:259-279).
+RES_CPU, RES_MEM, RES_DISK, RES_NET = 0, 1, 2, 3
+NUM_RESOURCE_DIMS = 4
+
+
+def comparable_vec(cr) -> "np.ndarray":
+    """f32[R] dense resource vector of a ComparableResources."""
+    return np.array(
+        [cr.cpu_shares, cr.memory_mb, cr.disk_mb,
+         sum(n.mbits for n in cr.networks)], dtype=np.float32)
 
 _PORT_WORDS = 65536 // 32
 
@@ -105,6 +117,7 @@ class ClusterMatrix:
         self.capacity[row, RES_CPU] = res.cpu.cpu_shares - rr.cpu_shares
         self.capacity[row, RES_MEM] = res.memory_mb - rr.memory_mb
         self.capacity[row, RES_DISK] = res.disk_mb - rr.disk_mb
+        self.capacity[row, RES_NET] = sum(n.mbits for n in res.networks)
         self.ready[row] = node.ready()
         self.attrs.set_node_row(row, node)
         # drivers become attr columns like the reference's driver.<name> attrs
@@ -159,8 +172,7 @@ class ClusterMatrix:
 
     @staticmethod
     def _alloc_res_vec(alloc) -> np.ndarray:
-        cr = alloc.comparable_resources()
-        return np.array([cr.cpu_shares, cr.memory_mb, cr.disk_mb], dtype=np.float32)
+        return comparable_vec(alloc.comparable_resources())
 
     @staticmethod
     def _alloc_ports(alloc) -> Tuple[int, ...]:

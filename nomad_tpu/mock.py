@@ -25,6 +25,7 @@ from nomad_tpu.structs import (
 )
 from nomad_tpu.structs.alloc import AllocatedResources, AllocatedTaskResources, alloc_name
 from nomad_tpu.structs.job import Constraint, Operand
+from nomad_tpu.structs.resources import NetworkResource
 from nomad_tpu.structs.node import NodeCpuResources, NodeResources, compute_node_class
 from nomad_tpu.structs.resources import Resources
 
@@ -55,6 +56,9 @@ def node(**overrides) -> Node:
                                  reservable_cores=[0, 1, 2, 3]),
             memory_mb=8192,
             disk_mb=100 * 1024,
+            # reference mock.Node: one eth0 device with 1000 MBits
+            networks=[NetworkResource(device="eth0", cidr="192.168.0.100/32",
+                                      mbits=1000)],
         ),
         drivers={"exec": {"detected": True, "healthy": True},
                  "mock_driver": {"detected": True, "healthy": True}},

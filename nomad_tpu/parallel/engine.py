@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time as _time
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -94,7 +95,9 @@ class PlacementEngine:
         self._tickets: Dict[int, Tuple[int, List[Tuple[int, np.ndarray]]]] = {}
         self._next_ticket = 1
         self.stats = {"dispatches": 0, "batched_evals": 0, "single_evals": 0,
-                      "max_batch_seen": 0, "tickets_open": 0}
+                      "max_batch_seen": 0, "tickets_open": 0,
+                      "stack_s": 0.0, "put_s": 0.0, "device_s": 0.0,
+                      "resolve_s": 0.0}
         self._thread = threading.Thread(
             target=self._run, name="placement-engine", daemon=True)
         self._thread.start()
@@ -230,7 +233,10 @@ class PlacementEngine:
         if not pending:
             return
         # one D2H transfer per group (usually one group -> one leaf)
+        t0 = _time.time()
         fetched = jax.device_get([packed for _, packed in pending])
+        self.stats["device_s"] += _time.time() - t0
+        t0 = _time.time()
         for (reqs, _), packed in zip(pending, fetched):
             node, score, fit_s, n_eval, n_exh, top_n, top_s = \
                 unpack_outputs(packed)
@@ -241,6 +247,7 @@ class PlacementEngine:
                     top_nodes=top_n[i], top_scores=top_s[i], used=None)
                 ticket = self._register(r, res)
                 r.future.set_result((res, ticket))
+        self.stats["resolve_s"] += _time.time() - t0
 
     def _run_single(self, r: _Request) -> None:
         """Lone request: single-eval path sharing place_eval's jit cache
@@ -275,6 +282,7 @@ class PlacementEngine:
         D = pad_to_bucket(max([len(r.deltas) for r in reqs] + [1]),
                           minimum=_DELTA_BUCKET_MIN)
 
+        t0 = _time.time()
         stacked = {}
         for f in _PER_EVAL_FIELDS:
             first = getattr(reqs[0].inputs, f)
@@ -294,10 +302,13 @@ class PlacementEngine:
         # basis read at dispatch time (latest commits + in-flight overlay);
         # copies guard against the applier mutating cm.used mid-transfer
         basis = (np.ascontiguousarray(cm.capacity), self._basis_for(cm))
+        self.stats["stack_s"] += _time.time() - t0
+        t0 = _time.time()
         (capacity, used0), eb = jax.device_put((basis, eb))
         packed, _used_final = place_batch_jit(
             capacity, used0, eb,
             spread_algorithm=reqs[0].spread_algorithm)
+        self.stats["put_s"] += _time.time() - t0
         return packed
 
 

@@ -16,6 +16,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from nomad_tpu.encode.matrixizer import comparable_vec
+
 from nomad_tpu.scheduler import factory
 from nomad_tpu.scheduler.placement import PortClaims, build_allocation
 from nomad_tpu.scheduler.reconcile import AllocReconciler, PlacementRequest
@@ -265,8 +267,7 @@ class GenericScheduler:
             if row is None:
                 continue
             cr = a.comparable_resources()
-            vec = np.array([cr.cpu_shares, cr.memory_mb, cr.disk_mb],
-                           np.float32)
+            vec = comparable_vec(cr)
             used[row] -= vec
             deltas.append((row, -vec))
             from nomad_tpu.core.plan_apply import _alloc_ports
@@ -332,8 +333,49 @@ class GenericScheduler:
             m.allocation_time_s = 0.0
             return m
 
+        def assign_devices(pr, tg, node, row, preempted) -> Optional[Dict]:
+            """Assign device instances for every device request of the
+            group (scheduler/device.go AllocateDevice), attempting device
+            preemption (PreemptForDevice) when instances are exhausted.
+            Returns {task: [assignment dicts]} or None on failure; appends
+            extra evictions to `preempted` in place."""
+            wants = [(t, req) for t in tg.tasks for req in t.resources.devices]
+            if not wants:
+                return {}
+            from nomad_tpu.scheduler.devices import assign_device_instances
+            node_allocs = [a for a in self.state.allocs_by_node(node.id)
+                           if not a.terminal_status()]
+            node_allocs += self.plan.node_allocation.get(node.id, [])
+            # allocs this plan already stops or preempts no longer hold
+            # their device instances
+            evicted_ids = {a.id for a in preempted}
+            evicted_ids |= stopped_ids
+            evicted_ids |= {a.id for a in
+                            self.plan.node_preemptions.get(node.id, [])}
+            out: Dict[str, List[dict]] = {}
+            for t, req in wants:
+                live = [a for a in node_allocs if a.id not in evicted_ids]
+                got = assign_device_instances(node, live, req)
+                if got is None and preemption_on:
+                    nonlocal preemptor
+                    if preemptor is None:
+                        from nomad_tpu.scheduler.preemption import Preemptor
+                        preemptor = Preemptor(self.state, job.priority)
+                    extra = preemptor.preempt_for_device(
+                        node, live, req, exclude=evicted_ids)
+                    if extra:
+                        preempted.extend(extra)
+                        evicted_ids.update(a.id for a in extra)
+                        live = [a for a in node_allocs
+                                if a.id not in evicted_ids]
+                        got = assign_device_instances(node, live, req)
+                if got is None:
+                    return None
+                out.setdefault(t.name, []).append(got)
+            return out
+
         def place_on(pr: PlacementRequest, row: int, metric: AllocMetric,
-                     preempted=None) -> None:
+                     preempted=None, extra_freed=None) -> bool:
             gi = tg_index[pr.task_group]
             tg = job.task_groups[gi]
             node_id = cm.node_ids[row]
@@ -341,16 +383,26 @@ class GenericScheduler:
             dep_id = ""
             if deployment is not None and tg.name in deployment.task_groups:
                 dep_id = deployment.id
+            preempted = list(preempted or [])
+            devices = assign_devices(pr, tg, node, row, preempted) \
+                if node is not None else {}
+            if devices is None:
+                self._fail_placement(pr, metric, "devices exhausted")
+                return False
+            freed = set(freed_ports.get(row, set()))
+            if extra_freed:
+                freed |= extra_freed
             alloc = build_allocation(
                 job=job, tg=tg, name=pr.name, node_id=node_id,
                 node_name=node.name if node else "", eval_id=self.eval.id,
-                row=row, ports=ports, freed_ports=freed_ports.get(row, set()),
+                row=row, ports=ports, freed_ports=freed,
                 metric=metric, previous=pr.previous_alloc,
                 deployment_id=dep_id, is_canary=pr.is_canary,
-                is_rescheduling=pr.is_rescheduling, now=now)
+                is_rescheduling=pr.is_rescheduling, now=now,
+                task_devices=devices)
             if alloc is None:
                 self._fail_placement(pr, metric, "ports exhausted")
-                return
+                return False
             if pr.previous_alloc is not None:
                 pr.previous_alloc.next_allocation = alloc.id
             if preempted:
@@ -363,6 +415,7 @@ class GenericScheduler:
                 state = self.plan.deployment.task_groups.get(tg.name)
                 if state is not None:
                     state.placed_canaries.append(alloc.id)
+            return True
 
         # preemption for failed slots (BinPackIterator's evict path,
         # rank.go:500-530; gated by SchedulerConfiguration like the
@@ -380,16 +433,29 @@ class GenericScheduler:
                 from nomad_tpu.scheduler.preemption import Preemptor
                 preemptor = Preemptor(self.state, job.priority)
             gi = tg_index[pr.task_group]
-            found = preemptor.find(groups[gi].feasible,
-                                   groups[gi].demand, used)
+            found = preemptor.find(
+                groups[gi].feasible, groups[gi].demand, used,
+                static_ports=groups[gi].static_ports,
+                feasible_pre_ports=groups[gi].feasible_pre_ports)
             if found is None:
                 return False
             row, evicted = found
+            # ports held by the evicted allocs become claimable — but only
+            # commit that (and the usage adjustments) if the placement
+            # actually lands, else later placements would claim ports of
+            # allocs that keep running
+            from nomad_tpu.core.plan_apply import _alloc_ports
+            evicted_ports = set()
+            for a in evicted:
+                evicted_ports.update(_alloc_ports(a))
             metric = metric_for(i)
-            place_on(pr, row, metric, preempted=evicted)
+            if not place_on(pr, row, metric, preempted=evicted,
+                            extra_freed=evicted_ports):
+                return True   # failure already recorded by place_on
+            freed_ports.setdefault(row, set()).update(evicted_ports)
             for a in evicted:
                 cr = a.comparable_resources()
-                used[row] -= (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                used[row] -= comparable_vec(cr)
             used[row] += groups[gi].demand
             preemptor.invalidate({a.id for a in evicted})
             return True

@@ -49,13 +49,37 @@ class PortClaims:
         return True
 
     def assign_dynamic(self, row: int, freed: Set[int]) -> Optional[int]:
+        """First free port in the node's dynamic range, via a vectorized
+        scan of the port bitset words (the naive per-port loop was O(range)
+        per assignment in the placement hot path)."""
         lo = int(self.cm.dyn_port_lo[row])
         hi = int(self.cm.dyn_port_hi[row])
-        for p in range(lo, hi + 1):
-            if self._is_free(row, p, freed):
-                self.claimed.setdefault(row, set()).add(p)
-                return p
-        return None
+        w0, w1 = lo >> 5, (hi >> 5) + 1
+        words = self.cm.port_words[row, w0:w1].copy()
+        # freed ports clear first, plan-local claims override after — a
+        # port both freed (by a stop/eviction) and already claimed by this
+        # plan must stay used (mirrors _is_free's claimed-first ordering)
+        for p in freed:
+            if lo <= p <= hi:
+                words[(p >> 5) - w0] &= ~np.uint32(1 << (p & 31))
+        for p in self.claimed.get(row, ()):
+            if lo <= p <= hi:
+                words[(p >> 5) - w0] |= np.uint32(1 << (p & 31))
+        # mask bits outside [lo, hi] as used
+        words[0] |= ~(np.uint32(0xFFFFFFFF) << np.uint32(lo & 31))
+        hi_bit = hi & 31
+        last_mask = np.uint32(
+            (np.uint64(1) << np.uint64(hi_bit + 1)) - np.uint64(1))
+        words[-1] |= ~last_mask
+        free = np.flatnonzero(words != np.uint32(0xFFFFFFFF))
+        if len(free) == 0:
+            return None
+        w = int(free[0])
+        inv = int(~words[w] & np.uint32(0xFFFFFFFF))
+        bit = (inv & -inv).bit_length() - 1   # lowest free bit
+        p = ((w0 + w) << 5) + bit
+        self.claimed.setdefault(row, set()).add(p)
+        return p
 
 
 def build_allocation(
@@ -74,9 +98,12 @@ def build_allocation(
     is_canary: bool = False,
     is_rescheduling: bool = False,
     now: float = 0.0,
+    task_devices: Optional[Dict[str, List[dict]]] = None,
 ) -> Optional[Allocation]:
     """Construct the Allocation for one selected placement; returns None if
-    port assignment fails (caller treats as exhausted node)."""
+    port assignment fails (caller treats as exhausted node).
+    `task_devices` carries pre-assigned device instances per task name
+    (scheduler/device.go AllocateDevice output)."""
     tasks: Dict[str, AllocatedTaskResources] = {}
     for t in tg.tasks:
         nets = []
@@ -89,6 +116,7 @@ def build_allocation(
             memory_mb=t.resources.memory_mb,
             memory_max_mb=t.resources.memory_max_mb,
             networks=[n for n in nets if n is not None],
+            devices=list((task_devices or {}).get(t.name, ())),
         )
     shared_nets = []
     shared_ports: List[NetworkPort] = []

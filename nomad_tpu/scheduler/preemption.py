@@ -7,8 +7,18 @@ nodes (fit score after preemption + logistic preemption score, mirroring
 PreemptionScoringIterator rank.go:817-868), and applies the reference's
 final superset-filter pass (preemption.go:702-732) to the chosen node.
 
-Not yet modeled: per-job migrate max_parallel scoring penalty and the
-network/device-bandwidth preemption variants (PreemptForNetwork/Device).
+Network preemption (PreemptForNetwork, preemption.go:270-454): bandwidth
+rides the RES_NET resource dimension, so the same greedy distance kernel
+frees MBits; static-port conflicts are resolved here by force-evicting the
+preemptible holders of the asked ports (ports held by non-preemptible
+allocs make the node ineligible, mirroring filteredReservedPorts).
+
+Device preemption (PreemptForDevice, preemption.go:472-555): per-node
+instance-count preemption in preempt_for_device() — group matching allocs
+by device group, take lowest-priority first until free+preempted instances
+cover the ask.
+
+Not yet modeled: per-job migrate max_parallel scoring penalty.
 """
 from __future__ import annotations
 
@@ -16,7 +26,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from nomad_tpu.encode.matrixizer import pad_to_bucket
+from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS, comparable_vec, pad_to_bucket
 from nomad_tpu.ops.preempt import (
     net_priority,
     preempt_for_task_group,
@@ -51,13 +61,13 @@ class Preemptor:
                 per_node[row].append(a)
         A = pad_to_bucket(max([len(x) for x in per_node] + [1]), minimum=4)
         self.cand_allocs = per_node
-        self.cand_res = np.zeros((N, A, 3), np.float32)
+        self.cand_res = np.zeros((N, A, NUM_RESOURCE_DIMS), np.float32)
         self.cand_prio = np.zeros((N, A), np.int32)
         self.cand_valid = np.zeros((N, A), bool)
         for row, allocs in enumerate(per_node):
             for i, a in enumerate(allocs):
                 cr = a.comparable_resources()
-                self.cand_res[row, i] = (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                self.cand_res[row, i] = comparable_vec(cr)
                 self.cand_prio[row, i] = a.job.priority if a.job else 50
                 self.cand_valid[row, i] = True
         self.max_steps = min(A, 32)
@@ -72,28 +82,90 @@ class Preemptor:
                 if a.id in alloc_ids:
                     self.cand_valid[row, i] = False
 
+    # ------------------------------------------------------------- ports
+
+    def _port_forced_evictions(self, static_ports: List[int],
+                               rows: np.ndarray):
+        """For each port-conflicted row: which preemptible candidates hold
+        the asked ports.  Returns {row: set(cand idx)} for eligible rows;
+        rows where an asked port is held by a NON-preemptible alloc are
+        excluded (reference filteredReservedPorts, preemption.go:290-323).
+        """
+        want = set(static_ports)
+        out: Dict[int, Set[int]] = {}
+        for row in rows:
+            holders: Set[int] = set()
+            eligible = True
+            conflicted = {
+                p for p in want
+                if (self.cm.port_words[row, p >> 5] >> np.uint32(p & 31)) & 1}
+            if not conflicted:
+                continue
+            cand_port_sets = [
+                set(self.cm._alloc_ports(a)) for a in self.cand_allocs[row]]
+            for p in conflicted:
+                held_by = [i for i, ps in enumerate(cand_port_sets)
+                           if p in ps and self.cand_valid[row, i]]
+                if not held_by:
+                    eligible = False   # a higher-priority alloc owns it
+                    break
+                holders.update(held_by)
+            if eligible:
+                out[int(row)] = holders
+        return out
+
     # ------------------------------------------------------------- find
 
     def find(self, feasible: np.ndarray, demand: np.ndarray,
-             used: np.ndarray) -> Optional[Tuple[int, List]]:
+             used: np.ndarray,
+             static_ports: Optional[List[int]] = None,
+             feasible_pre_ports: Optional[np.ndarray] = None,
+             ) -> Optional[Tuple[int, List]]:
         """-> (node row, allocs to preempt) or None.
 
         `used` is the eval's current proposed usage matrix; remaining =
-        capacity - used per node."""
+        capacity - used per node.  When `static_ports` is given,
+        `feasible_pre_ports` is the mask before the port-availability
+        filter: port-conflicted nodes become eligible by force-evicting
+        the preemptible holders of the asked ports."""
         if not self._built:
             self._build()
         cm = self.cm
         remaining = cm.capacity - used
+
+        forced: Dict[int, Set[int]] = {}
+        feasible = np.asarray(feasible).copy()
+        if static_ports and feasible_pre_ports is not None:
+            port_rows = np.flatnonzero(feasible_pre_ports & ~feasible)
+            forced = self._port_forced_evictions(static_ports, port_rows)
+            for row in forced:
+                feasible[row] = True   # eligible again via eviction
+
         met, picked, avail_after = preempt_for_task_group(
             self.cand_res, self.cand_prio, self.cand_valid,
             remaining.astype(np.float32), demand.astype(np.float32),
             max_steps=self.max_steps)
         met = np.asarray(met) & feasible
-        # nodes that fit without eviction are not preemption targets
-        met &= ~np.all(remaining >= demand, axis=-1)
+        # nodes that fit without eviction are not preemption targets --
+        # unless a port eviction is what makes them usable
+        fits_plain = np.all(remaining >= demand, axis=-1)
+        no_ports_needed = np.array(
+            [r not in forced for r in range(len(fits_plain))])
+        met &= ~(fits_plain & no_ports_needed)
+        # port rows that fit resource-wise still need their forced evictions
+        met |= (np.array([r in forced for r in range(len(fits_plain))])
+                & fits_plain & feasible)
+        picked = np.asarray(picked).copy()
+        # fold the forced port evictions into each row's pick set, and
+        # re-check resource sufficiency with the combined freed set (the
+        # kernel ran without knowing about the forced frees)
+        for row, holders in forced.items():
+            for i in holders:
+                picked[row, i] = True
+            freed = self.cand_res[row][picked[row]].sum(axis=0)
+            met[row] = bool(np.all(remaining[row] + freed >= demand))
         if not met.any():
             return None
-        picked = np.asarray(picked)
 
         # rank eligible nodes: mean of (binpack fit after preemption) and
         # the logistic preemption score of the evicted set
@@ -113,29 +185,104 @@ class Preemptor:
             if score > best_score:
                 best_score, best_row = score, int(row)
 
+        protected = {self.cand_allocs[best_row][i].id
+                     for i in forced.get(best_row, ())}
         evicted = [self.cand_allocs[best_row][i]
                    for i in np.flatnonzero(picked[best_row])]
         evicted = self._superset_filter(
-            evicted, remaining[best_row], demand)
+            evicted, remaining[best_row], demand, protected)
         return best_row, evicted
+
+    # ------------------------------------------------------------- devices
+
+    def preempt_for_device(self, node, allocs, request,
+                           exclude: Optional[Set[str]] = None
+                           ) -> Optional[List]:
+        """PreemptForDevice (preemption.go:472-555) for one node: find the
+        lowest-priority allocs holding instances of a device group matching
+        `request` so that free + preempted instances cover request.count.
+        Returns the allocs to evict, or None."""
+        exclude = exclude or set()
+        from nomad_tpu.scheduler.devices import _used_instances
+
+        live = [a for a in allocs
+                if not a.terminal_status() and a.id not in exclude]
+        used_by_group = _used_instances(live)   # gid -> set(instance ids)
+
+        best: Optional[Tuple[int, List]] = None   # (net_priority, allocs)
+        for dev in node.node_resources.devices:
+            if not dev.matches(request.name):
+                continue
+            # per-alloc instance counts on this device group (deduped view
+            # shared with assign_device_instances via _used_instances)
+            holders: List[Tuple[object, int]] = []
+            for a in live:
+                n_inst = 0
+                for tr in a.allocated_resources.tasks.values():
+                    for d in tr.devices:
+                        gid = f"{d['vendor']}/{d['type']}/{d['name']}"
+                        if gid == dev.id:
+                            n_inst += len(d.get("device_ids", []))
+                if n_inst == 0:
+                    continue
+                prio = a.job.priority if a.job is not None else 50
+                if self.job_priority - prio < PRIORITY_DELTA:
+                    continue
+                holders.append((a, n_inst))
+            free = len(dev.instance_ids) - len(used_by_group.get(dev.id, ()))
+            if free >= request.count:
+                return []          # no preemption needed on this group
+            # lowest priority first into the option, then the reference's
+            # refinement pass: sort picks by instance count descending and
+            # keep only what's needed (selectBestAllocs, preemption.go:556+)
+            holders.sort(key=lambda t: (
+                t[0].job.priority if t[0].job else 50, t[1]))
+            picked, got = [], free
+            for a, n_inst in holders:
+                picked.append((a, n_inst))
+                got += n_inst
+                if got >= request.count:
+                    break
+            if got < request.count:
+                continue
+            picked.sort(key=lambda t: -t[1])
+            filtered, covered = [], free
+            for a, n_inst in picked:
+                if covered >= request.count:
+                    break
+                filtered.append(a)
+                covered += n_inst
+            # net priority = sum of UNIQUE priorities in the option
+            # (selectBestAllocs, preemption.go:557-558); lowest wins
+            prios = {p.job.priority if p.job else 50 for p in filtered}
+            cand = (int(sum(prios)), filtered)
+            if best is None or cand[0] < best[0]:
+                best = cand
+        return best[1] if best is not None else None
 
     # ------------------------------------------------------------- filter
 
     def _superset_filter(self, picks: List, remaining: np.ndarray,
-                         ask: np.ndarray) -> List:
+                         ask: np.ndarray,
+                         protected: Optional[Set[str]] = None) -> List:
         """Drop picks whose resources are already covered by the rest
         (reference filterSuperset: iterate largest-first, keep only while
-        the remainder no longer satisfies the ask)."""
+        the remainder no longer satisfies the ask).  Allocs in `protected`
+        (port holders) are never dropped."""
+        protected = protected or set()
+
         def vec(a):
             cr = a.comparable_resources()
-            return np.array([cr.cpu_shares, cr.memory_mb, cr.disk_mb], np.float32)
+            return comparable_vec(cr)
 
         picks = sorted(picks, key=lambda a: -vec(a).sum())
         kept = list(picks)
         for a in picks:
+            if a.id in protected:
+                continue
             trial = [x for x in kept if x.id != a.id]
             avail = remaining + sum((vec(x) for x in trial),
-                                    np.zeros(3, np.float32))
+                                    np.zeros(NUM_RESOURCE_DIMS, np.float32))
             if np.all(avail >= ask) and trial:
                 kept = trial
         return kept

@@ -22,6 +22,7 @@ from nomad_tpu.encode.matrixizer import (
     RES_CPU,
     RES_DISK,
     RES_MEM,
+    RES_NET,
     pad_to_bucket,
 )
 from nomad_tpu.ops.place import PlaceInputs, PlaceResult, place_eval
@@ -41,7 +42,9 @@ def group_demand(tg: TaskGroup) -> np.ndarray:
     for t in tg.tasks:
         d[RES_CPU] += t.resources.cpu
         d[RES_MEM] += t.resources.memory_mb
+        d[RES_NET] += sum(n.mbits for n in t.resources.networks)
     d[RES_DISK] = tg.ephemeral_disk.size_mb
+    d[RES_NET] += sum(n.mbits for n in tg.networks)
     return d
 
 
@@ -73,6 +76,10 @@ class CompiledGroup:
     distinct_hosts_job: bool
     distinct_hosts_tg: bool
     distinct_property: List[Tuple[str, int, bool]]  # (target, limit, job-level)
+    # for port-aware preemption: the mask before port-availability filters,
+    # and the static ports the group asks for
+    feasible_pre_ports: Optional[np.ndarray] = None   # bool[N]
+    static_ports: List[int] = field(default_factory=list)
 
 
 class DenseStack:
@@ -119,6 +126,7 @@ class DenseStack:
         mask &= fz.driver_mask(cm, drivers)
         mask &= fz.host_volume_mask(cm, tg.volumes)
 
+        feasible_pre_ports = mask.copy()
         static_ports = group_static_ports(tg)
         if static_ports:
             mask &= cm.static_ports_free(static_ports)
@@ -143,7 +151,9 @@ class DenseStack:
                              spreads=spreads,
                              distinct_hosts_job=distinct_hosts_job,
                              distinct_hosts_tg=distinct_hosts_tg,
-                             distinct_property=distinct_property)
+                             distinct_property=distinct_property,
+                             feasible_pre_ports=feasible_pre_ports,
+                             static_ports=static_ports)
 
     # ------------------------------------------------------------- assemble
 

@@ -13,6 +13,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from nomad_tpu.encode.matrixizer import comparable_vec
+
 from nomad_tpu.scheduler.placement import PortClaims, build_allocation
 from nomad_tpu.scheduler.reconcile import tasks_updated
 from nomad_tpu.scheduler.stack import DenseStack
@@ -85,7 +87,7 @@ class SystemScheduler:
                 row = cm.row_of.get(a.node_id)
                 if row is not None:
                     cr = a.comparable_resources()
-                    used[row] -= (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                    used[row] -= comparable_vec(cr)
 
         for gi, tg in enumerate(job.task_groups):
             g = groups[gi]
@@ -109,7 +111,7 @@ class SystemScheduler:
                             plan.append_stopped_alloc(
                                 cur, "alloc not needed due to job update")
                             cr = cur.comparable_resources()
-                            used[row] -= (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                            used[row] -= comparable_vec(cr)
                             self._try_place(plan, job, tg, name, node_id, row,
                                             used, d, ports, now)
                     continue
@@ -153,7 +155,7 @@ class SystemScheduler:
             for a in preempted:
                 plan.append_preempted_alloc(a, alloc.id)
                 cr = a.comparable_resources()
-                used[row] -= (cr.cpu_shares, cr.memory_mb, cr.disk_mb)
+                used[row] -= comparable_vec(cr)
         used[row] += d
         plan.append_alloc(alloc, None)
 

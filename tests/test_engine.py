@@ -86,8 +86,8 @@ def test_batch_applies_deltas():
     engine = PlacementEngine()
     try:
         # free a full node's worth on row 0, consume most of row 1
-        free = np.array([-2000.0, -2000.0, 0.0], np.float32)
-        eat = np.array([3500.0, 7500.0, 0.0], np.float32)
+        free = np.array([-2000.0, -2000.0, 0.0, 0.0], np.float32)
+        eat = np.array([3500.0, 7500.0, 0.0, 0.0], np.float32)
         reqs = [_request(cm, count=2, deltas=[(0, free)]),
                 _request(cm, count=2, deltas=[(1, eat)])]
         expected = _serial_reference(

@@ -44,9 +44,9 @@ def test_score_fit_zero_capacity_rows():
 def test_fits_after_and_validate():
     cm, nodes = _matrix(2)
     r = cm.row_of[nodes[0].id]
-    d = np.array([4000.0, 8192.0, 0.0], np.float32)
+    d = np.array([4000.0, 8192.0, 0.0, 0.0], np.float32)
     f = np.asarray(fits_after(cm.capacity, cm.used, d))
     assert f[r]
     used = cm.used.copy()
-    used[r] = [4001, 0, 0]
+    used[r] = [4001, 0, 0, 0]
     assert not np.asarray(validate_capacity(cm.capacity, used))[r]

@@ -1,0 +1,190 @@
+"""Network (bandwidth + static ports) and device preemption
+(reference scheduler/preemption.go PreemptForNetwork:270-454,
+PreemptForDevice:472-555), plus device instance assignment in the
+placement path (scheduler/device.go AllocateDevice)."""
+import numpy as np
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs.resources import (
+    DeviceRequest,
+    NetworkPort,
+    NetworkResource,
+    NodeDevice,
+)
+
+
+def _harness(n_nodes=3, node_fn=None):
+    h = Harness()
+    nodes = []
+    for _ in range(n_nodes):
+        n = mock.node()
+        if node_fn:
+            node_fn(n)
+        h.store.upsert_node(h.next_index(), n)
+        nodes.append(n)
+    return h, nodes
+
+
+def _enable_preemption(h):
+    cfg = h.store.scheduler_config
+    cfg.preemption_config.service_scheduler_enabled = True
+    cfg.preemption_config.batch_scheduler_enabled = True
+
+
+def _run_job(h, job):
+    ev = mock.eval(job_id=job.id, type=job.type, priority=job.priority)
+    h.store.upsert_job(h.next_index(), job)
+    h.process(job.type, ev)
+    return h.store.allocs_by_job("default", job.id)
+
+
+def test_bandwidth_preemption_via_net_dimension():
+    """Low-priority allocs saturating a node's MBits are evicted for a
+    higher-priority job that needs the bandwidth (PreemptForNetwork's
+    bandwidth dimension rides RES_NET in the dense design)."""
+    h, nodes = _harness(n_nodes=1)
+    _enable_preemption(h)
+
+    low = mock.job()
+    low.priority = 20
+    tg = low.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.cpu = 100
+    tg.tasks[0].resources.memory_mb = 100
+    tg.tasks[0].resources.networks = [NetworkResource(mbits=900)]
+    assert len(_run_job(h, low)) == 1
+
+    high = mock.job()
+    high.priority = 70
+    tg = high.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.cpu = 100
+    tg.tasks[0].resources.memory_mb = 100
+    tg.tasks[0].resources.networks = [NetworkResource(mbits=500)]
+    allocs = _run_job(h, high)
+    assert len(allocs) == 1
+    assert allocs[0].preempted_allocations, \
+        "high-priority job should preempt the bandwidth hog"
+
+
+def test_static_port_preemption():
+    """A static-port conflict with a lower-priority alloc is resolved by
+    evicting the port holder (PreemptForNetwork reserved-port path)."""
+    h, nodes = _harness(n_nodes=1)
+    _enable_preemption(h)
+
+    low = mock.job()
+    low.priority = 20
+    tg = low.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.networks = [NetworkResource(
+        reserved_ports=[NetworkPort(label="http", value=8080)])]
+    assert len(_run_job(h, low)) == 1
+
+    high = mock.job()
+    high.priority = 70
+    tg = high.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.networks = [NetworkResource(
+        reserved_ports=[NetworkPort(label="http", value=8080)])]
+    allocs = _run_job(h, high)
+    assert len(allocs) == 1
+    evicted = allocs[0].preempted_allocations
+    assert evicted, "port holder should be preempted"
+
+
+def test_static_port_held_by_higher_priority_not_preempted():
+    """Ports held by non-preemptible (similar priority) allocs make the
+    node ineligible (filteredReservedPorts semantics)."""
+    h, nodes = _harness(n_nodes=1)
+    _enable_preemption(h)
+
+    first = mock.job()
+    first.priority = 65
+    tg = first.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.networks = [NetworkResource(
+        reserved_ports=[NetworkPort(label="http", value=8080)])]
+    assert len(_run_job(h, first)) == 1
+
+    second = mock.job()
+    second.priority = 70    # delta < 10: not preemptible
+    tg = second.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.networks = [NetworkResource(
+        reserved_ports=[NetworkPort(label="http", value=8080)])]
+    allocs = _run_job(h, second)
+    assert len(allocs) == 0
+    sched = h.last_scheduler
+    assert sched.failed_tg_allocs, "placement must fail, not preempt"
+
+
+def _gpu_node(n):
+    n.node_resources.devices = [NodeDevice(
+        vendor="nvidia", type="gpu", name="1080ti",
+        instance_ids=["gpu0", "gpu1"])]
+
+
+def test_device_instance_assignment():
+    """Placements carry concrete device instance ids."""
+    h, nodes = _harness(n_nodes=1, node_fn=_gpu_node)
+
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].resources.devices = [DeviceRequest(name="gpu", count=1)]
+    allocs = _run_job(h, job)
+    assert len(allocs) == 2
+    got = set()
+    for a in allocs:
+        devs = a.allocated_resources.tasks["web"].devices
+        assert len(devs) == 1 and devs[0]["vendor"] == "nvidia"
+        got.update(devs[0]["device_ids"])
+    assert got == {"gpu0", "gpu1"}, "each alloc gets a distinct instance"
+
+
+def test_device_preemption():
+    """When all instances are claimed by a lower-priority job, a
+    higher-priority job preempts enough allocs to free instances
+    (PreemptForDevice)."""
+    h, nodes = _harness(n_nodes=1, node_fn=_gpu_node)
+    _enable_preemption(h)
+
+    low = mock.job()
+    low.priority = 20
+    tg = low.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].resources.devices = [DeviceRequest(name="gpu", count=1)]
+    assert len(_run_job(h, low)) == 2
+
+    high = mock.job()
+    high.priority = 70
+    tg = high.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.devices = [DeviceRequest(name="gpu", count=1)]
+    allocs = _run_job(h, high)
+    assert len(allocs) == 1
+    assert allocs[0].preempted_allocations
+    devs = allocs[0].allocated_resources.tasks["web"].devices
+    assert devs and devs[0]["device_ids"]
+
+
+def test_device_exhausted_without_preemption_fails():
+    h, nodes = _harness(n_nodes=1, node_fn=_gpu_node)
+
+    low = mock.job()
+    low.priority = 50
+    tg = low.task_groups[0]
+    tg.count = 2
+    tg.tasks[0].resources.devices = [DeviceRequest(name="gpu", count=1)]
+    assert len(_run_job(h, low)) == 2
+
+    nxt = mock.job()
+    nxt.priority = 50
+    tg = nxt.task_groups[0]
+    tg.count = 1
+    tg.tasks[0].resources.devices = [DeviceRequest(name="gpu", count=1)]
+    allocs = _run_job(h, nxt)
+    assert len(allocs) == 0
+    assert h.last_scheduler.failed_tg_allocs
