@@ -241,6 +241,13 @@ def required_capability(parts: List[str], method: str,
         if parts[1:3] == ["token", "self"] and not write:
             return (None, None)
         return ("acl:management", None)
+    if head in ("volumes", "volume"):
+        if write:
+            return (CAP_CSI_WRITE_VOLUME, ns)
+        return ((CAP_CSI_LIST_VOLUME if head == "volumes"
+                 else CAP_CSI_READ_VOLUME), ns)
+    if head in ("plugins", "plugin"):
+        return (f"plugin:{'write' if write else 'read'}", None)
     if head in ("namespaces", "namespace"):
         return (f"operator:{'write' if write else 'read'}", None)
     if head == "search":

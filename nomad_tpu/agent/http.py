@@ -672,6 +672,66 @@ class HTTPServer:
         self.agent.server.delete_namespace(parts[1])
         return {}
 
+    # ------------------------------------------------------------ CSI
+    # (reference command/agent/csi_endpoint.go: /v1/volumes,
+    #  /v1/volume/csi/<id>, /v1/plugins, /v1/plugin/csi/<id>)
+
+    def _h_get_volumes(self, h, parts, q):
+        ns = q.get("namespace", "default")
+        return self._rpc("CSIVolume.List", {"namespace": ns})
+
+    def _h_get_volume_id(self, h, parts, q):
+        # /v1/volume/csi/<id>
+        vol_id = parts[2] if len(parts) > 2 else parts[1]
+        vol = self._rpc("CSIVolume.Get", {
+            "namespace": q.get("namespace", "default"),
+            "volume_id": vol_id})
+        out = vol.stub()
+        out["ReadAllocs"] = sorted(vol.read_claims)
+        out["WriteAllocs"] = sorted(vol.write_claims)
+        return out
+
+    def _h_put_volume_id(self, h, parts, q):
+        body = h._body()
+        from nomad_tpu.structs.csi import CSIVolume
+        vols = body.get("Volumes") or [body.get("Volume", body)]
+        for v in vols:
+            if isinstance(v, dict):
+                v = CSIVolume(
+                    id=v.get("ID", ""),
+                    namespace=v.get("Namespace",
+                                    q.get("namespace", "default")),
+                    name=v.get("Name", ""),
+                    plugin_id=v.get("PluginID", ""),
+                    access_mode=v.get("AccessMode", ""),
+                    attachment_mode=v.get("AttachmentMode", ""),
+                    requested_capabilities=v.get(
+                        "RequestedCapabilities", []),
+                )
+            # re-check against the body's authoritative namespace (the
+            # route gate only saw ?namespace=; mirrors _h_put_jobs)
+            from nomad_tpu.acl.policy import CAP_CSI_WRITE_VOLUME
+            self._require_ns_cap(h, v.namespace, CAP_CSI_WRITE_VOLUME)
+            self._rpc("CSIVolume.Register", {"volume": v})
+        return {}
+
+    _h_post_volume_id = _h_put_volume_id
+
+    def _h_delete_volume_id(self, h, parts, q):
+        vol_id = parts[2] if len(parts) > 2 else parts[1]
+        self._rpc("CSIVolume.Deregister", {
+            "namespace": q.get("namespace", "default"),
+            "volume_id": vol_id,
+            "force": q.get("force", "") == "true"})
+        return {}
+
+    def _h_get_plugins(self, h, parts, q):
+        return self._rpc("CSIPlugin.List", {})
+
+    def _h_get_plugin_id(self, h, parts, q):
+        plugin_id = parts[2] if len(parts) > 2 else parts[1]
+        return self._rpc("CSIPlugin.Get", {"plugin_id": plugin_id})
+
 
 _STREAMED = object()
 

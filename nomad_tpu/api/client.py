@@ -47,6 +47,8 @@ class ApiClient:
         self.operator = Operator(self)
         self.acl = AclApi(self)
         self.namespaces = Namespaces(self)
+        self.volumes = Volumes(self)
+        self.plugins = Plugins(self)
         self.system = SystemApi(self)
 
     # ------------------------------------------------------------- transport
@@ -289,6 +291,33 @@ class Namespaces(_Section):
 
     def delete(self, name: str) -> dict:
         return self.c.delete(f"/v1/namespace/{name}")
+
+
+class Volumes(_Section):
+    """CSI volumes (reference api/csi.go CSIVolumes)."""
+    def list(self, namespace: str = "default") -> List[dict]:
+        return self.c.get(f"/v1/volumes?namespace={namespace}")
+
+    def info(self, vol_id: str, namespace: str = "default") -> dict:
+        return self.c.get(f"/v1/volume/csi/{vol_id}?namespace={namespace}")
+
+    def register(self, volume: dict, namespace: str = "default") -> dict:
+        return self.c.put(f"/v1/volume/csi/{volume.get('ID', '')}"
+                          f"?namespace={namespace}", {"Volume": volume})
+
+    def deregister(self, vol_id: str, namespace: str = "default",
+                   force: bool = False) -> dict:
+        f = "true" if force else "false"
+        return self.c.delete(
+            f"/v1/volume/csi/{vol_id}?namespace={namespace}&force={f}")
+
+
+class Plugins(_Section):
+    def list(self) -> List[dict]:
+        return self.c.get("/v1/plugins")
+
+    def info(self, plugin_id: str) -> dict:
+        return self.c.get(f"/v1/plugin/csi/{plugin_id}")
 
 
 class SystemApi(_Section):

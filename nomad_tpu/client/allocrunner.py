@@ -17,7 +17,8 @@ from nomad_tpu.structs.alloc import AllocClientStatus
 class AllocRunner:
     def __init__(self, alloc, driver_registry, root_dir: str,
                  node=None, on_update: Optional[Callable] = None,
-                 state_db=None, prev_alloc_dir: Optional[AllocDir] = None):
+                 state_db=None, prev_alloc_dir: Optional[AllocDir] = None,
+                 csi_plugins=None):
         self.alloc = alloc
         self.registry = driver_registry
         self.node = node
@@ -33,6 +34,9 @@ class AllocRunner:
         self._thread: Optional[threading.Thread] = None
         self._health_thread: Optional[threading.Thread] = None
         self.deployment_healthy: Optional[bool] = None
+        from nomad_tpu.client.csi import CSIHook
+        self.csi_hook = CSIHook(alloc, self.alloc_dir.dir,
+                                plugins=csi_plugins)
 
     def task_group(self):
         job = self.alloc.job
@@ -47,11 +51,16 @@ class AllocRunner:
         self._thread.start()
 
     def _run(self) -> None:
+        csi_staged = False
         try:
             # --- alloc prerun hooks (alloc_runner_hooks.go:111):
             # allocdir -> previous-alloc disk migration -> (network,
             # services: no-op in the sim) -> health watcher
             self.alloc_dir.build()
+            # CSI volumes stage+publish before any task starts
+            # (alloc_runner_hooks.go csi_hook Prerun)
+            csi_mounts = self.csi_hook.prerun()
+            csi_staged = True
             tg = self.task_group()
             if self.prev_alloc_dir is not None and tg is not None \
                     and tg.ephemeral_disk.migrate:
@@ -67,7 +76,7 @@ class AllocRunner:
                     self.alloc, task, self.registry.get(task.driver),
                     self.alloc_dir, node=self.node,
                     on_state=self._on_task_state, state_db=self.state_db,
-                    ports=ports)
+                    ports=ports, volumes=csi_mounts)
                 self.task_runners[task.name] = tr
 
             self._start_health_watcher()
@@ -126,6 +135,14 @@ class AllocRunner:
             self._finalize_status()
         except Exception as e:                       # noqa: BLE001
             self._set_status(AllocClientStatus.FAILED, str(e))
+        finally:
+            # unpublish/unstage regardless of how the alloc ended, so
+            # failed allocs don't leak staged CSI mounts
+            if csi_staged:
+                try:
+                    self.csi_hook.postrun()
+                except Exception:                    # noqa: BLE001
+                    pass
 
     def _wait_any_running(self, runners: List[TaskRunner],
                           timeout: float = 300.0) -> None:

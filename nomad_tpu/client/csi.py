@@ -1,0 +1,114 @@
+"""Client-side CSI volume manager (reference
+client/pluginmanager/csimanager/ + plugins/csi/).
+
+A `CSIPluginClient` speaks the CSI node-service verbs the reference
+drives over gRPC (NodeStageVolume / NodePublishVolume and their inverse);
+`FakeCSIPlugin` is the in-process implementation used by tests and the
+dev client (reference plugins/csi/fake), materializing a bind-mount as a
+directory under the alloc dir.  The `CSIHook` runs in the alloc runner's
+prerun/postrun phases (reference alloc_runner_hooks.go csi_hook.go):
+stage + publish every CSI volume of the task group before tasks start,
+unpublish + unstage after they stop.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, List, Optional
+
+
+class CSIPluginClient:
+    """Node-service surface of a CSI plugin (plugins/csi/client.go)."""
+
+    def node_stage_volume(self, volume_id: str, staging_path: str,
+                          attachment_mode: str, access_mode: str) -> None:
+        raise NotImplementedError
+
+    def node_unstage_volume(self, volume_id: str, staging_path: str) -> None:
+        raise NotImplementedError
+
+    def node_publish_volume(self, volume_id: str, staging_path: str,
+                            target_path: str, read_only: bool) -> None:
+        raise NotImplementedError
+
+    def node_unpublish_volume(self, volume_id: str, target_path: str) -> None:
+        raise NotImplementedError
+
+
+class FakeCSIPlugin(CSIPluginClient):
+    """In-process plugin: staging/publish become real directories (the
+    reference's fake client records calls; making directories additionally
+    gives tasks a live mount path to write into)."""
+
+    def __init__(self):
+        self.calls: List[tuple] = []
+
+    def node_stage_volume(self, volume_id, staging_path, attachment_mode,
+                          access_mode) -> None:
+        os.makedirs(staging_path, exist_ok=True)
+        self.calls.append(("stage", volume_id, staging_path))
+
+    def node_unstage_volume(self, volume_id, staging_path) -> None:
+        shutil.rmtree(staging_path, ignore_errors=True)
+        self.calls.append(("unstage", volume_id, staging_path))
+
+    def node_publish_volume(self, volume_id, staging_path, target_path,
+                            read_only) -> None:
+        os.makedirs(target_path, exist_ok=True)
+        marker = os.path.join(target_path, ".csi_published")
+        with open(marker, "w") as f:
+            f.write(f"{volume_id} ro={read_only}\n")
+        self.calls.append(("publish", volume_id, target_path))
+
+    def node_unpublish_volume(self, volume_id, target_path) -> None:
+        shutil.rmtree(target_path, ignore_errors=True)
+        self.calls.append(("unpublish", volume_id, target_path))
+
+
+class CSIHook:
+    """Per-alloc stage/publish lifecycle (client/allocrunner/csi_hook.go)."""
+
+    def __init__(self, alloc, alloc_dir_path: str,
+                 plugins: Optional[Dict[str, CSIPluginClient]] = None):
+        self.alloc = alloc
+        self.base = alloc_dir_path
+        self.plugins = plugins if plugins is not None else {}
+        self.mounts: Dict[str, str] = {}    # volume alias -> publish path
+
+    def _requests(self):
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        if tg is None:
+            return {}
+        return {alias: req for alias, req in tg.volumes.items()
+                if req.type == "csi"}
+
+    def prerun(self) -> Dict[str, str]:
+        """Stage+publish all CSI volumes; returns alias -> mount path."""
+        for alias, req in self._requests().items():
+            plugin = self.plugins.get("*") or \
+                self.plugins.get(req.source)
+            if plugin is None:
+                plugin = self.plugins.setdefault("*", FakeCSIPlugin())
+            staging = os.path.join(self.base, "csi", "staging", req.source)
+            target = os.path.join(self.base, "csi", "per-alloc",
+                                  self.alloc.id, alias)
+            plugin.node_stage_volume(req.source, staging,
+                                     req.attachment_mode, req.access_mode)
+            plugin.node_publish_volume(req.source, staging, target,
+                                       req.read_only)
+            self.mounts[alias] = target
+        return dict(self.mounts)
+
+    def postrun(self) -> None:
+        """Unpublish + unstage (csi_hook.go Postrun)."""
+        for alias, req in self._requests().items():
+            plugin = self.plugins.get("*") or self.plugins.get(req.source)
+            if plugin is None:
+                continue
+            target = self.mounts.get(alias)
+            if target:
+                plugin.node_unpublish_volume(req.source, target)
+            staging = os.path.join(self.base, "csi", "staging", req.source)
+            plugin.node_unstage_volume(req.source, staging)
+        self.mounts.clear()

@@ -8,7 +8,8 @@ from typing import Dict, Optional
 
 
 def build_task_env(alloc, task, node, task_dir: str = "",
-                   ports: Optional[Dict[str, int]] = None) -> Dict[str, str]:
+                   ports: Optional[Dict[str, int]] = None,
+                   volumes: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     """The NOMAD_* environment (client/taskenv/env.go Builder)."""
     job = alloc.job
     env = {
@@ -38,6 +39,10 @@ def build_task_env(alloc, task, node, task_dir: str = "",
         env[f"NOMAD_PORT_{up}"] = str(value)
         env[f"NOMAD_HOST_PORT_{up}"] = str(value)
         env[f"NOMAD_ADDR_{up}"] = f"127.0.0.1:{value}"
+    # CSI volume mount paths per alias (the csi_hook's published targets)
+    for alias, path in (volumes or {}).items():
+        up = alias.upper().replace("-", "_")
+        env[f"NOMAD_VOLUME_{up}"] = path
     # job/group/task meta as NOMAD_META_<key> (uppercased)
     metas = {}
     if job is not None:

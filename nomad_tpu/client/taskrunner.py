@@ -57,7 +57,8 @@ class RestartTracker:
 class TaskRunner:
     def __init__(self, alloc, task, driver: Driver, alloc_dir,
                  node=None, on_state: Optional[Callable] = None,
-                 state_db=None, ports: Optional[Dict[str, int]] = None):
+                 state_db=None, ports: Optional[Dict[str, int]] = None,
+                 volumes: Optional[Dict[str, str]] = None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -66,6 +67,7 @@ class TaskRunner:
         self.on_state = on_state or (lambda *a: None)
         self.state_db = state_db
         self.ports = ports or {}
+        self.volumes = volumes or {}    # CSI alias -> host mount path
         self.state = TaskState()
         self.handle: Optional[TaskHandle] = None
         self.restart_tracker = RestartTracker(
@@ -131,7 +133,8 @@ class TaskRunner:
         task_dir = self.alloc_dir.build_task_dir(self.task.name)
         self._dispatch_payload_hook(task_dir)
         self.env = build_task_env(self.alloc, self.task, self.node,
-                                  task_dir, self.ports)
+                                  task_dir, self.ports,
+                                  volumes=self.volumes)
         self._template_hook(task_dir)
         self._task_dir = task_dir
 

@@ -449,6 +449,60 @@ class Cli:
         self.p(f"Successfully deleted namespace \"{args.name}\"!")
         return 0
 
+    def cmd_volume_register(self, args) -> int:
+        import json as _json
+        with open(args.file) as f:
+            text = f.read()
+        try:
+            vol = _json.loads(text)
+        except ValueError:
+            from nomad_tpu.jobspec.hcl import parse_hcl
+            body = parse_hcl(text)
+            b = body.first("volume") or body
+            vol = {
+                "ID": b.get("id", ""), "Name": b.get("name", ""),
+                "PluginID": b.get("plugin_id", ""),
+                "AccessMode": b.get("access_mode", ""),
+                "AttachmentMode": b.get("attachment_mode", ""),
+            }
+        self.api.volumes.register(vol, namespace=args.namespace)
+        self.p(f"Successfully registered volume \"{vol.get('ID', '')}\"!")
+        return 0
+
+    def cmd_volume_status(self, args) -> int:
+        if args.vol_id:
+            v = self.api.volumes.info(args.vol_id, namespace=args.namespace)
+            for k in ("ID", "Name", "PluginID", "AccessMode", "Schedulable",
+                      "CurrentReaders", "CurrentWriters", "NodesHealthy",
+                      "NodesExpected"):
+                self.p(f"{k:<18} = {v.get(k)}")
+        else:
+            self.p("ID\tPlugin\tSchedulable\tAccess")
+            for v in self.api.volumes.list(namespace=args.namespace):
+                self.p(f"{v['ID']}\t{v['PluginID']}\t"
+                       f"{v['Schedulable']}\t{v['AccessMode'] or '<none>'}")
+        return 0
+
+    def cmd_volume_deregister(self, args) -> int:
+        self.api.volumes.deregister(args.vol_id, namespace=args.namespace,
+                                    force=args.force)
+        self.p(f"Successfully deregistered volume \"{args.vol_id}\"!")
+        return 0
+
+    def cmd_plugin_status(self, args) -> int:
+        if args.plugin_id:
+            v = self.api.plugins.info(args.plugin_id)
+            for k in ("ID", "Provider", "ControllersHealthy",
+                      "ControllersExpected", "NodesHealthy", "NodesExpected"):
+                self.p(f"{k:<20} = {v.get(k)}")
+        else:
+            self.p("ID\tProvider\tControllers Healthy\tNodes Healthy")
+            for v in self.api.plugins.list():
+                self.p(f"{v['ID']}\t{v.get('Provider', '')}\t"
+                       f"{v['ControllersHealthy']}/{v['ControllersExpected']}\t"
+                       f"{v['NodesHealthy']}/{v['NodesExpected']}")
+        return 0
+
     def cmd_version(self, args) -> int:
         from nomad_tpu import __version__
         self.p(f"nomad-tpu v{__version__}")
@@ -626,6 +680,30 @@ def build_parser() -> argparse.ArgumentParser:
     c = ns.add_parser("delete")
     c.add_argument("name")
     c.set_defaults(fn="cmd_namespace_delete")
+
+    vol = sub.add_parser("volume",
+                         help="CSI volume commands").add_subparsers(
+        dest="sub", required=True)
+    c = vol.add_parser("register")
+    c.add_argument("file")
+    c.add_argument("-namespace", default="default")
+    c.set_defaults(fn="cmd_volume_register")
+    c = vol.add_parser("status")
+    c.add_argument("vol_id", nargs="?")
+    c.add_argument("-namespace", default="default")
+    c.set_defaults(fn="cmd_volume_status")
+    c = vol.add_parser("deregister")
+    c.add_argument("vol_id")
+    c.add_argument("-namespace", default="default")
+    c.add_argument("-force", action="store_true")
+    c.set_defaults(fn="cmd_volume_deregister")
+
+    plug = sub.add_parser("plugin",
+                          help="CSI plugin commands").add_subparsers(
+        dest="sub", required=True)
+    c = plug.add_parser("status")
+    c.add_argument("plugin_id", nargs="?")
+    c.set_defaults(fn="cmd_plugin_status")
 
     v = sub.add_parser("version")
     v.set_defaults(fn="cmd_version")

@@ -89,6 +89,8 @@ class Server:
         self.event_broker = EventBroker()
         self.heartbeats = HeartbeatTracker(self, ttl=self.config.heartbeat_ttl)
         self.deployment_watcher = DeploymentWatcher(self)
+        from nomad_tpu.core.volumes import VolumeWatcher
+        self.volume_watcher = VolumeWatcher(self)
         self.drainer = NodeDrainer(self)
         self.periodic = PeriodicDispatcher(self)
         self.core_scheduler = CoreScheduler(self)
@@ -216,6 +218,7 @@ class Server:
                 if not node.terminal_status():
                     self.heartbeats.heartbeat(node.id)
             self.deployment_watcher.start()
+            self.volume_watcher.start()
             self.drainer.start()
             self.periodic.start()
             gc_t = threading.Thread(target=self._gc_loop, args=(stop,),
@@ -233,6 +236,7 @@ class Server:
             self._leader_stop.set()
             self.heartbeats.stop()
             self.deployment_watcher.stop()
+            self.volume_watcher.stop()
             self.drainer.stop()
             self.periodic.stop()
             for w in self.workers:

@@ -129,6 +129,10 @@ class ClusterMatrix:
         for name, vol in node.host_volumes.items():
             self.attrs.column(f"hostvol.{name}").set(
                 row, "ro" if vol.get("read_only") else "rw")
+        # CSI node plugins: column per plugin id, "1" = healthy
+        for pid, info in node.csi_node_plugins.items():
+            self.attrs.column(f"csiplugin.{pid}").set(
+                row, "1" if info.get("healthy") else None)
         # device capacity: numeric count column per device-group id (clear
         # stale groups first — re-registration may drop devices)
         for col in self.device_caps.values():

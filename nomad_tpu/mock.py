@@ -113,6 +113,30 @@ def batch_job(**overrides) -> Job:
     return j
 
 
+def csi_node(plugin_id: str = "ebs-plugin", healthy: bool = True,
+             max_volumes: int = 3, controller: bool = False, **overrides):
+    """Node fingerprinting a CSI node plugin (reference mock.Node +
+    CSINodePlugins fixtures in csi_endpoint_test.go)."""
+    n = node(**overrides)
+    n.csi_node_plugins = {plugin_id: {
+        "healthy": healthy, "max_volumes": max_volumes,
+        "provider": "com.test.csi"}}
+    if controller:
+        n.csi_controller_plugins = {plugin_id: {"healthy": healthy}}
+    return n
+
+
+def csi_volume(vol_id: str = "", plugin_id: str = "ebs-plugin",
+               access_mode: str = "", **overrides):
+    from nomad_tpu.structs.csi import CSIVolume
+    v = CSIVolume(id=vol_id or f"vol-{_uuid()[:8]}", namespace="default",
+                  name="test-volume", plugin_id=plugin_id,
+                  access_mode=access_mode)
+    for k, val in overrides.items():
+        setattr(v, k, val)
+    return v
+
+
 def system_job(**overrides) -> Job:
     j = job(**overrides)
     j.type = JobType.SYSTEM

@@ -82,6 +82,9 @@ class NomadFSM:
             MessageType.DEPLOYMENT_UPSERT: self._apply_deployment_upsert,
             MessageType.DEPLOYMENT_DELETE: self._apply_deployment_delete,
             MessageType.SCHEDULER_CONFIG: self._apply_scheduler_config,
+            MessageType.CSI_VOLUME_REGISTER: self._apply_csi_volume_register,
+            MessageType.CSI_VOLUME_DEREGISTER: self._apply_csi_volume_deregister,
+            MessageType.CSI_VOLUME_CLAIM: self._apply_csi_volume_claim,
             MessageType.NAMESPACE_UPSERT: self._apply_namespace_upsert,
             MessageType.NAMESPACE_DELETE: self._apply_namespace_delete,
             MessageType.ACL_POLICY_UPSERT: self._apply_acl_policy_upsert,
@@ -91,7 +94,6 @@ class NomadFSM:
             MessageType.NOOP: lambda index, p: None,
         }
         # optional table handlers registered by periphery subsystems
-        # (CSI volumes, namespaces, ACL) once those stores exist
         self.extra: Dict[str, callable] = {}
         self.snapshot_extra: Dict[str, callable] = {}
         self.restore_extra: Dict[str, callable] = {}
@@ -199,6 +201,17 @@ class NomadFSM:
 
     # --- namespaces / ACL
 
+    def _apply_csi_volume_register(self, index, p):
+        self.store.upsert_csi_volume(index, p["volume"])
+
+    def _apply_csi_volume_deregister(self, index, p):
+        self.store.deregister_csi_volume(
+            index, p["namespace"], p["volume_id"], p.get("force", False))
+
+    def _apply_csi_volume_claim(self, index, p):
+        self.store.csi_volume_claim(
+            index, p["namespace"], p["volume_id"], p["claim"])
+
     def _apply_namespace_upsert(self, index, p):
         self.store.upsert_namespace(index, p["name"],
                                     p.get("description", ""))
@@ -246,6 +259,8 @@ class NomadFSM:
                 "namespaces": dict(s._namespaces),
                 "acl_policies": dict(s._acl_policies),
                 "acl_tokens": list(s._acl_tokens.values()),
+                "csi_volumes": dict(s._csi_volumes),
+                "csi_plugins": dict(s._csi_plugins),
                 "extra": {name: fn() for name, fn in
                           getattr(self, "snapshot_extra", {}).items()},
             }
@@ -283,6 +298,8 @@ class NomadFSM:
             for t in data.get("acl_tokens", []):
                 s._acl_tokens[t.accessor_id] = t
                 s._acl_by_secret[t.secret_id] = t
+            s._csi_volumes = dict(data.get("csi_volumes", {}))
+            s._csi_plugins = dict(data.get("csi_plugins", {}))
             s.matrix = ClusterMatrix()
             for n in data["nodes"]:
                 s.matrix.upsert_node(n)

@@ -432,6 +432,49 @@ class Endpoints:
 
     # ------------------------------------------------------------- operator
 
+    # --- CSI volumes / plugins (reference nomad/csi_endpoint.go)
+
+    def rpc_CSIVolume__List(self, args):
+        ns = args.get("namespace")
+        return [v.stub() for v in self.server.store.csi_volumes(ns)]
+
+    def rpc_CSIVolume__Get(self, args):
+        vol = self.server.store.csi_volume_by_id(
+            args.get("namespace", "default"), args["volume_id"])
+        if vol is None:
+            raise RpcError(f"volume {args['volume_id']} not found")
+        return vol
+
+    def rpc_CSIVolume__Register(self, args):
+        from nomad_tpu.raft.fsm import MessageType as MT
+        self.server.apply(MT.CSI_VOLUME_REGISTER, {"volume": args["volume"]})
+        return {}
+
+    def rpc_CSIVolume__Deregister(self, args):
+        from nomad_tpu.raft.fsm import MessageType as MT
+        self.server.apply(MT.CSI_VOLUME_DEREGISTER, {
+            "namespace": args.get("namespace", "default"),
+            "volume_id": args["volume_id"],
+            "force": args.get("force", False)})
+        return {}
+
+    def rpc_CSIVolume__Claim(self, args):
+        from nomad_tpu.raft.fsm import MessageType as MT
+        self.server.apply(MT.CSI_VOLUME_CLAIM, {
+            "namespace": args.get("namespace", "default"),
+            "volume_id": args["volume_id"],
+            "claim": args["claim"]})
+        return {}
+
+    def rpc_CSIPlugin__List(self, args):
+        return [p.stub() for p in self.server.store.csi_plugins()]
+
+    def rpc_CSIPlugin__Get(self, args):
+        plug = self.server.store.csi_plugin_by_id(args["plugin_id"])
+        if plug is None:
+            raise RpcError(f"plugin {args['plugin_id']} not found")
+        return plug
+
     def rpc_Operator__SchedulerGetConfiguration(self, args):
         return self.server.store.scheduler_config
 

@@ -54,14 +54,20 @@ def device_accounter_fits(node, allocs) -> bool:
     return True
 
 
-def assign_device_instances(node, allocs, request) -> Optional[dict]:
+def assign_device_instances(node, allocs, request,
+                            extra_used=None) -> Optional[dict]:
     """Pick `request.count` free instance ids from a matching, constraint-
     satisfying device group (reference scheduler/device.go:32-131
     AllocateDevice).  Returns {vendor,type,name,device_ids} or None.
-    Constraint/affinity evaluation over device attributes is handled by the
-    caller via nomad_tpu.scheduler.feasible.check_operand on dev.attributes.
+    `extra_used` ({group id -> set(instance ids)}) carries grants already
+    made to other requests of the same in-flight allocation, so two tasks
+    in one group never share an instance.  Constraint/affinity evaluation
+    over device attributes is handled by the caller via
+    nomad_tpu.scheduler.feasible.check_operand on dev.attributes.
     """
     used = _used_instances(allocs)
+    for gid, ids in (extra_used or {}).items():
+        used.setdefault(gid, set()).update(ids)
     for dev in node.node_resources.devices:
         if not dev.matches(request.name):
             continue

@@ -214,6 +214,59 @@ def driver_mask(cm: ClusterMatrix, drivers: List[str]) -> np.ndarray:
     return mask
 
 
+def csi_volume_mask(cm: ClusterMatrix, snapshot, namespace: str,
+                    job_id: str, volumes) -> np.ndarray:
+    """CSIVolumeChecker (feasible.go:212-358), dense: the volume-level
+    gates (exists, schedulable, free claims — with the same-job
+    write-claim exception) are scalars broadcast over the mask; the
+    node-level gates (healthy node plugin, MaxVolumes) use the
+    fingerprint column and one bulk claim-count pass."""
+    reqs = [r for r in volumes.values() if r.type == "csi"]
+    if not reqs:
+        return np.ones(cm.n_rows, dtype=bool)
+    if snapshot is None:
+        return np.zeros(cm.n_rows, dtype=bool)
+    mask = np.ones(cm.n_rows, dtype=bool)
+    counts = snapshot._store.csi_volume_counts_by_node() \
+        if hasattr(snapshot, "_store") else {}
+    for req in reqs:
+        vol = snapshot.csi_volume_by_id(namespace, req.source)
+        if vol is None:
+            return np.zeros(cm.n_rows, dtype=bool)
+        if req.read_only:
+            if not (vol.read_schedulable() and vol.has_free_read_claims()):
+                return np.zeros(cm.n_rows, dtype=bool)
+        else:
+            if not vol.write_schedulable():
+                return np.zeros(cm.n_rows, dtype=bool)
+            if not vol.has_free_write_claims():
+                # blocking write claims owned by this very job are fine
+                # (feasible.go:336-358); GC'd or foreign claims block
+                for alloc_id in vol.write_claims:
+                    a = snapshot.allocs.get(alloc_id) \
+                        if hasattr(snapshot, "allocs") else None
+                    if a is None or a.namespace != namespace \
+                            or a.job_id != job_id:
+                        return np.zeros(cm.n_rows, dtype=bool)
+        # node plugin healthy (fingerprint column)
+        col = cm.attrs.columns.get(f"csiplugin.{vol.plugin_id}")
+        if col is None:
+            return np.zeros(cm.n_rows, dtype=bool)
+        mask &= col.hash_codes == hash_code("1")
+        # MaxVolumes per node plugin
+        plug = snapshot.csi_plugin_by_id(vol.plugin_id)
+        if plug is not None:
+            for node_id, row in cm.row_of.items():
+                info = plug.nodes.get(node_id)
+                if info is None:
+                    continue
+                maxv = info.get("max_volumes", 0)
+                if maxv and counts.get(node_id, {}).get(
+                        vol.plugin_id, 0) >= maxv:
+                    mask[row] = False
+    return mask
+
+
 def host_volume_mask(cm: ClusterMatrix, volumes) -> np.ndarray:
     """HostVolumeChecker (feasible.go:133): every requested host volume must
     exist; a read-only node volume only satisfies read-only requests."""

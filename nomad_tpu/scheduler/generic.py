@@ -246,7 +246,8 @@ class GenericScheduler:
     def _compute_placements(self, places: List[PlacementRequest],
                             stops, all_allocs: List[Allocation]) -> None:
         cm = self.state.matrix
-        stack = DenseStack(cm, self.state.scheduler_config)
+        stack = DenseStack(cm, self.state.scheduler_config,
+                           snapshot=self.state)
         self._stack = stack
         job = self.job
         tg_index = {tg.name: i for i, tg in enumerate(job.task_groups)}
@@ -353,9 +354,11 @@ class GenericScheduler:
             evicted_ids |= {a.id for a in
                             self.plan.node_preemptions.get(node.id, [])}
             out: Dict[str, List[dict]] = {}
+            granted: Dict[str, set] = {}   # in-flight grants of THIS alloc
             for t, req in wants:
                 live = [a for a in node_allocs if a.id not in evicted_ids]
-                got = assign_device_instances(node, live, req)
+                got = assign_device_instances(node, live, req,
+                                              extra_used=granted)
                 if got is None and preemption_on:
                     nonlocal preemptor
                     if preemptor is None:
@@ -368,9 +371,12 @@ class GenericScheduler:
                         evicted_ids.update(a.id for a in extra)
                         live = [a for a in node_allocs
                                 if a.id not in evicted_ids]
-                        got = assign_device_instances(node, live, req)
+                        got = assign_device_instances(node, live, req,
+                                                      extra_used=granted)
                 if got is None:
                     return None
+                gid = f"{got['vendor']}/{got['type']}/{got['name']}"
+                granted.setdefault(gid, set()).update(got["device_ids"])
                 out.setdefault(t.name, []).append(got)
             return out
 
@@ -383,7 +389,10 @@ class GenericScheduler:
             dep_id = ""
             if deployment is not None and tg.name in deployment.task_groups:
                 dep_id = deployment.id
-            preempted = list(preempted or [])
+            # no copy: device-preemption evictions appended by
+            # assign_devices must stay visible to the caller for
+            # usage/invalidate bookkeeping
+            preempted = preempted if preempted is not None else []
             devices = assign_devices(pr, tg, node, row, preempted) \
                 if node is not None else {}
             if devices is None:
@@ -452,16 +461,35 @@ class GenericScheduler:
             if not place_on(pr, row, metric, preempted=evicted,
                             extra_freed=evicted_ports):
                 return True   # failure already recorded by place_on
-            freed_ports.setdefault(row, set()).update(evicted_ports)
+            # `evicted` may have grown inside place_on (device
+            # preemption); account for everything it now holds
             for a in evicted:
+                evicted_ports.update(_alloc_ports(a))
                 cr = a.comparable_resources()
                 used[row] -= comparable_vec(cr)
+            freed_ports.setdefault(row, set()).update(evicted_ports)
             used[row] += groups[gi].demand
             preemptor.invalidate({a.id for a in evicted})
             return True
 
+        def account_device_evictions(row, extra) -> None:
+            """Device-preemption evictions made inside place_on on a
+            non-preemption path still free usage and must not be chosen
+            again by later slots."""
+            if not extra:
+                return
+            for a in extra:
+                used[row] -= comparable_vec(a.comparable_resources())
+                freed_ports.setdefault(row, set()).update(_alloc_ports_fn(a))
+            if preemptor is not None:
+                preemptor.invalidate({a.id for a in extra})
+
+        from nomad_tpu.core.plan_apply import _alloc_ports as _alloc_ports_fn
+
         for pr, row in preplaced:
-            place_on(pr, row, metric_for(None))
+            extra = []
+            place_on(pr, row, metric_for(None), preempted=extra)
+            account_device_evictions(row, extra)
         if result is not None:
             for i, pr in enumerate(slot_requests):
                 row = int(result.node[i])
@@ -469,7 +497,9 @@ class GenericScheduler:
                     if not try_preempt(pr, i):
                         self._fail_placement(pr, metric_for(i), "exhausted")
                 else:
-                    place_on(pr, row, metric_for(i))
+                    extra = []
+                    place_on(pr, row, metric_for(i), preempted=extra)
+                    account_device_evictions(row, extra)
 
     def _fail_placement(self, pr: PlacementRequest, metric: AllocMetric,
                         reason: str) -> None:

@@ -85,9 +85,11 @@ class CompiledGroup:
 class DenseStack:
     """Compiles one job against one ClusterMatrix generation."""
 
-    def __init__(self, cm: ClusterMatrix, config: Optional[SchedulerConfiguration] = None):
+    def __init__(self, cm: ClusterMatrix, config: Optional[SchedulerConfiguration] = None,
+                 snapshot=None):
         self.cm = cm
         self.config = config or SchedulerConfiguration()
+        self.snapshot = snapshot   # state view for CSI volume/claim reads
         self.spread_algorithm = (
             self.config.effective_scheduler_algorithm() == SCHEDULER_ALGORITHM_SPREAD)
 
@@ -125,6 +127,9 @@ class DenseStack:
         mask &= fz.constraints_mask(cm, constraints)
         mask &= fz.driver_mask(cm, drivers)
         mask &= fz.host_volume_mask(cm, tg.volumes)
+        if any(v.type == "csi" for v in tg.volumes.values()):
+            mask &= fz.csi_volume_mask(cm, self.snapshot, job.namespace,
+                                       job.id, tg.volumes)
 
         feasible_pre_ports = mask.copy()
         static_ports = group_static_ports(tg)

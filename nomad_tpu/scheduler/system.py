@@ -65,7 +65,8 @@ class SystemScheduler:
 
         tainted = tainted_nodes(self.state, allocs)
 
-        stack = DenseStack(cm, self.state.scheduler_config)
+        stack = DenseStack(cm, self.state.scheduler_config,
+                           snapshot=self.state)
         groups = [stack.compile_group(job, tg) for tg in job.task_groups]
         used = cm.used.copy()
         ports = PortClaims(cm)

@@ -77,6 +77,7 @@ class StateSnapshot:
         # the matrix is shared (incremental); schedulers use it read-only
         # together with per-eval used_override deltas
         self.matrix = store.matrix
+        self._store = store
 
     # --- read API mirroring the reference's State interface
     # (scheduler/scheduler.go:67-116)
@@ -119,6 +120,16 @@ class StateSnapshot:
     def eval_by_id(self, eval_id: str) -> Optional[Evaluation]:
         return self.evals.get(eval_id)
 
+    # CSI reads go through the live store: claims move through the
+    # serialized applier/FSM, so the checker wants the freshest view
+    # (the reference checker also re-reads state inside the worker's
+    # snapshot, feasible.go:276-300)
+    def csi_volume_by_id(self, namespace: str, vol_id: str):
+        return self._store.csi_volume_by_id(namespace, vol_id)
+
+    def csi_plugin_by_id(self, plugin_id: str):
+        return self._store.csi_plugin_by_id(plugin_id)
+
 
 class StateStore:
     def __init__(self):
@@ -145,6 +156,9 @@ class StateStore:
         self._acl_policies: Dict[str, object] = {}
         self._acl_tokens: Dict[str, object] = {}       # by accessor_id
         self._acl_by_secret: Dict[str, object] = {}
+        # CSI tables (reference schema.go csi_volumes / csi_plugins)
+        self._csi_volumes: Dict[Tuple[str, str], object] = {}   # (ns, id)
+        self._csi_plugins: Dict[str, object] = {}
         self.matrix = ClusterMatrix()
         self._snapshot_cache: Optional[StateSnapshot] = None
         # watchers: fn(table: str, obj) called after commit, outside hot loops
@@ -199,6 +213,7 @@ class StateStore:
                 node.computed_class = compute_node_class(node)
             self._nodes[node.id] = node
             self.matrix.upsert_node(node)
+            self._update_csi_plugins_for_node(index, node)
             self._bump(index)
         self._notify("nodes", node)
 
@@ -206,9 +221,51 @@ class StateStore:
         with self._lock:
             node = self._nodes.pop(node_id, None)
             self.matrix.remove_node(node_id)
+            for plug in list(self._csi_plugins.values()):
+                plug.nodes.pop(node_id, None)
+                plug.controllers.pop(node_id, None)
+                if not plug.nodes and not plug.controllers:
+                    del self._csi_plugins[plug.id]
             self._bump(index)
         if node:
             self._notify("nodes", node)
+
+    def _update_csi_plugins_for_node(self, index: int, node: Node) -> None:
+        """Derive csi_plugins rows from node fingerprints (reference
+        state_store.go updateNodeCSIPlugins)."""
+        from nomad_tpu.structs.csi import CSIPlugin
+        seen = set()
+        for pid, info in node.csi_node_plugins.items():
+            plug = self._csi_plugins.get(pid)
+            if plug is None:
+                plug = self._csi_plugins[pid] = CSIPlugin(
+                    id=pid, provider=info.get("provider", ""),
+                    create_index=index)
+            plug.nodes[node.id] = {
+                "healthy": bool(info.get("healthy", False)),
+                "max_volumes": int(info.get("max_volumes", 0) or 0),
+            }
+            plug.modify_index = index
+            seen.add(pid)
+        for pid, info in node.csi_controller_plugins.items():
+            plug = self._csi_plugins.get(pid)
+            if plug is None:
+                plug = self._csi_plugins[pid] = CSIPlugin(
+                    id=pid, provider=info.get("provider", ""),
+                    create_index=index)
+            plug.controllers[node.id] = {
+                "healthy": bool(info.get("healthy", False))}
+            plug.controller_required = True
+            plug.modify_index = index
+            seen.add(pid)
+        # plugin rows this node no longer fingerprints
+        for pid, plug in list(self._csi_plugins.items()):
+            if pid in seen:
+                continue
+            plug.nodes.pop(node.id, None)
+            plug.controllers.pop(node.id, None)
+            if not plug.nodes and not plug.controllers:
+                del self._csi_plugins[pid]
 
     def update_node_status(self, index: int, node_id: str, status: str,
                            updated_at: float = 0.0) -> None:
@@ -625,6 +682,139 @@ class StateStore:
 
     # ------------------------------------------------------------ plan results
 
+    # ------------------------------------------------------------- CSI
+
+    def upsert_csi_volume(self, index: int, vol) -> None:
+        with self._lock:
+            key = (vol.namespace, vol.id)
+            if key not in self._csi_volumes:
+                vol.create_index = index
+            vol.modify_index = index
+            self._csi_volumes[key] = vol
+            self._refresh_volume_health(vol)
+            self._bump(index)
+        self._notify("csi_volumes", vol)
+
+    def deregister_csi_volume(self, index: int, namespace: str,
+                              vol_id: str, force: bool = False) -> None:
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, vol_id))
+            if vol is None:
+                raise KeyError(f"volume {vol_id} not found")
+            if vol.in_use() and not force:
+                raise ValueError(f"volume {vol_id} in use")
+            del self._csi_volumes[(namespace, vol_id)]
+            self._bump(index)
+        self._notify("csi_volumes", vol)
+
+    def csi_volume_by_id(self, namespace: str, vol_id: str):
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, vol_id))
+            if vol is not None:
+                self._refresh_volume_health(vol)
+            return vol
+
+    def csi_volumes(self, namespace: Optional[str] = None) -> List:
+        with self._lock:
+            vols = [v for (ns, _), v in sorted(self._csi_volumes.items())
+                    if namespace in (None, ns)]
+            for v in vols:
+                self._refresh_volume_health(v)
+            return vols
+
+    def csi_volumes_by_plugin(self, plugin_id: str) -> List:
+        with self._lock:
+            return [v for v in self._csi_volumes.values()
+                    if v.plugin_id == plugin_id]
+
+    def csi_plugin_by_id(self, plugin_id: str):
+        with self._lock:
+            return self._csi_plugins.get(plugin_id)
+
+    def csi_plugins(self) -> List:
+        with self._lock:
+            return [self._csi_plugins[k]
+                    for k in sorted(self._csi_plugins)]
+
+    def csi_volume_claim(self, index: int, namespace: str, vol_id: str,
+                         claim) -> None:
+        """Take or release a claim (reference CSIVolumeClaim FSM apply).
+        A claim whose state is past 'taken' is a release step; fully
+        released claims leave the claim maps."""
+        from nomad_tpu.structs import csi as csistructs
+        with self._lock:
+            vol = self._csi_volumes.get((namespace, vol_id))
+            if vol is None:
+                raise KeyError(f"volume {vol_id} not found")
+            if claim.state == csistructs.CLAIM_STATE_TAKEN:
+                vol.claim(claim)
+            else:
+                vol.release(claim.alloc_id)
+            vol.modify_index = index
+            self._bump(index)
+        self._notify("csi_volumes", vol)
+
+    def csi_volume_counts_by_node(self) -> Dict[str, Dict[str, int]]:
+        """node_id -> {plugin id -> live-claim volume count}, one pass
+        over the volumes table (dense-checker bulk variant of
+        node_csi_volume_count)."""
+        counts: Dict[str, Dict[str, int]] = {}
+        with self._lock:
+            for vol in self._csi_volumes.values():
+                nodes = {c.node_id
+                         for c in list(vol.read_claims.values()) +
+                         list(vol.write_claims.values())}
+                for nid in nodes:
+                    per = counts.setdefault(nid, {})
+                    per[vol.plugin_id] = per.get(vol.plugin_id, 0) + 1
+        return counts
+
+    def _refresh_volume_health(self, vol) -> None:
+        """Denormalize plugin health onto the volume (reference
+        CSIVolumeDenormalizePlugins): schedulable tracks node-plugin
+        health, plus controller health when controllers are required."""
+        plug = self._csi_plugins.get(vol.plugin_id)
+        if plug is None:
+            vol.schedulable = False
+            vol.nodes_healthy = 0
+            vol.controllers_healthy = 0
+            return
+        vol.nodes_healthy = plug.nodes_healthy
+        vol.nodes_expected = len(plug.nodes)
+        vol.controllers_healthy = plug.controllers_healthy
+        vol.controllers_expected = len(plug.controllers)
+        vol.controller_required = plug.controller_required
+        ok = vol.nodes_healthy > 0
+        if plug.controller_required:
+            ok = ok and vol.controllers_healthy > 0
+        vol.schedulable = ok
+
+    def _take_csi_claims_for_alloc(self, index: int, alloc) -> None:
+        """Claims for a placed allocation's CSI volume requests (the
+        reference claims from the client csi_hook via the
+        CSIVolume.Claim RPC; here the commit path takes them so the
+        scheduler's view is updated atomically with the plan)."""
+        from nomad_tpu.structs import csi as csistructs
+        job = alloc.job
+        if job is None:
+            return
+        tg = next((t for t in job.task_groups
+                   if t.name == alloc.task_group), None)
+        if tg is None:
+            return
+        for req in tg.volumes.values():
+            if req.type != "csi":
+                continue
+            vol = self._csi_volumes.get((job.namespace, req.source))
+            if vol is None:
+                continue
+            mode = csistructs.CLAIM_READ if req.read_only \
+                else csistructs.CLAIM_WRITE
+            vol.claim(csistructs.CSIVolumeClaim(
+                alloc_id=alloc.id, node_id=alloc.node_id, mode=mode,
+                state=csistructs.CLAIM_STATE_TAKEN))
+            vol.modify_index = index
+
     def upsert_plan_results(self, index: int, result: "AppliedPlanResults") -> None:
         """Apply a committed plan (reference UpsertPlanResults,
         state_store.go:337): denormalize stopped/preempted allocs, insert
@@ -639,6 +829,7 @@ class StateStore:
                 touched.append(a)
             for a in result.allocs_to_place:    # placements
                 self._insert_alloc(index, a)
+                self._take_csi_claims_for_alloc(index, a)
                 touched.append(a)
             for a in result.allocs_preempted:
                 existing = self._allocs.get(a.id)

@@ -1,0 +1,259 @@
+"""CSI subsystem end-to-end: plugin derivation from node fingerprints,
+volume registration/claims, the dense CSIVolumeChecker, claim taking on
+plan commit, and the volume watcher releasing claims of dead allocs
+(reference scheduler/feasible.go:212-358, nomad/structs/csi.go,
+nomad/volumewatcher/)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.testing import Harness
+from nomad_tpu.structs import csi as csistructs
+from nomad_tpu.structs.csi import CSIVolume, CSIVolumeClaim
+from nomad_tpu.structs.job import VolumeRequest
+
+
+def _csi_job(vol_id, read_only=False, count=1):
+    j = mock.job()
+    tg = j.task_groups[0]
+    tg.count = count
+    tg.volumes = {"vol": VolumeRequest(
+        name="vol", type="csi", source=vol_id, read_only=read_only)}
+    return j
+
+
+def _run(h, job):
+    h.store.upsert_job(h.next_index(), job)
+    h.process(job.type, mock.eval(job_id=job.id, type=job.type))
+    return h.store.allocs_by_job("default", job.id)
+
+
+# --------------------------------------------------------------- store
+
+def test_plugin_derived_from_node_fingerprint():
+    h = Harness()
+    n1 = mock.csi_node(healthy=True)
+    n2 = mock.csi_node(healthy=False)
+    h.store.upsert_node(h.next_index(), n1)
+    h.store.upsert_node(h.next_index(), n2)
+    plug = h.store.csi_plugin_by_id("ebs-plugin")
+    assert plug is not None
+    assert plug.nodes_healthy == 1 and len(plug.nodes) == 2
+
+    # node drops the plugin -> plugin row updates
+    n1.csi_node_plugins = {}
+    h.store.upsert_node(h.next_index(), n1)
+    plug = h.store.csi_plugin_by_id("ebs-plugin")
+    assert len(plug.nodes) == 1 and plug.nodes_healthy == 0
+
+
+def test_volume_schedulability_denormalized():
+    h = Harness()
+    vol = mock.csi_volume("v1")
+    h.store.upsert_csi_volume(h.next_index(), vol)
+    assert not h.store.csi_volume_by_id("default", "v1").schedulable
+
+    h.store.upsert_node(h.next_index(), mock.csi_node())
+    assert h.store.csi_volume_by_id("default", "v1").schedulable
+
+
+def test_claim_lifecycle_single_writer():
+    vol = CSIVolume(id="v", plugin_id="p")
+    vol.claim(CSIVolumeClaim(alloc_id="a1", node_id="n1",
+                             mode=csistructs.CLAIM_WRITE))
+    assert vol.access_mode == csistructs.ACCESS_SINGLE_WRITER
+    assert not vol.has_free_write_claims()
+    assert vol.in_use()
+    vol.release("a1")
+    assert vol.has_free_write_claims()
+    assert vol.access_mode == csistructs.ACCESS_UNKNOWN
+    assert not vol.in_use()
+
+
+# ----------------------------------------------------------- scheduling
+
+def test_csi_job_places_only_on_plugin_nodes():
+    h = Harness()
+    plain = [mock.node() for _ in range(3)]
+    plugged = mock.csi_node()
+    for n in plain + [plugged]:
+        h.store.upsert_node(h.next_index(), n)
+    h.store.upsert_csi_volume(h.next_index(), mock.csi_volume("v1"))
+
+    allocs = _run(h, _csi_job("v1"))
+    assert len(allocs) == 1
+    assert allocs[0].node_id == plugged.id
+
+    # the commit took a write claim for the alloc
+    vol = h.store.csi_volume_by_id("default", "v1")
+    assert allocs[0].id in vol.write_claims
+    assert vol.write_claims[allocs[0].id].node_id == plugged.id
+
+
+def test_single_writer_blocks_second_job():
+    h = Harness()
+    h.store.upsert_node(h.next_index(), mock.csi_node())
+    h.store.upsert_csi_volume(h.next_index(), mock.csi_volume("v1"))
+
+    assert len(_run(h, _csi_job("v1"))) == 1
+    second = _csi_job("v1")
+    allocs = _run(h, second)
+    assert len(allocs) == 0
+    assert h.last_scheduler.failed_tg_allocs
+
+    # readers are still fine on a multi-reader volume
+    h.store.upsert_csi_volume(h.next_index(), mock.csi_volume(
+        "v2", access_mode=csistructs.ACCESS_MULTI_READER))
+    assert len(_run(h, _csi_job("v2", read_only=True))) == 1
+    assert len(_run(h, _csi_job("v2", read_only=True))) == 1
+
+
+def test_unhealthy_plugin_infeasible():
+    h = Harness()
+    h.store.upsert_node(h.next_index(), mock.csi_node(healthy=False))
+    h.store.upsert_csi_volume(h.next_index(), mock.csi_volume("v1"))
+    assert len(_run(h, _csi_job("v1"))) == 0
+
+
+def test_max_volumes_enforced():
+    h = Harness()
+    node = mock.csi_node(max_volumes=1)
+    h.store.upsert_node(h.next_index(), node)
+    h.store.upsert_csi_volume(h.next_index(), mock.csi_volume("v1"))
+    h.store.upsert_csi_volume(h.next_index(), mock.csi_volume("v2"))
+
+    assert len(_run(h, _csi_job("v1"))) == 1
+    # second volume on the same node exceeds the plugin's MaxVolumes
+    assert len(_run(h, _csi_job("v2"))) == 0
+
+
+# ------------------------------------------------------- volume watcher
+
+def test_volume_watcher_releases_claims_of_dead_allocs():
+    from nomad_tpu.core.server import Server, ServerConfig
+    from nomad_tpu.raft.fsm import MessageType
+
+    s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=3600.0,
+                            gc_interval=3600.0))
+    s.start()
+    try:
+        s.register_node(mock.csi_node())
+        s.apply(MessageType.CSI_VOLUME_REGISTER,
+                {"volume": mock.csi_volume("v1")})
+
+        job = _csi_job("v1")
+        s.register_job(job)
+        deadline = time.time() + 10
+        allocs = []
+        while time.time() < deadline:
+            allocs = s.store.allocs_by_job("default", job.id)
+            if allocs:
+                break
+            time.sleep(0.05)
+        assert allocs, "alloc never placed"
+        vol = s.store.csi_volume_by_id("default", "v1")
+        assert allocs[0].id in vol.write_claims
+
+        # client reports the alloc complete -> watcher releases the claim
+        a = allocs[0].copy()
+        a.client_status = "complete"
+        s.apply(MessageType.ALLOC_CLIENT_UPDATE, {"allocs": [a]})
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            vol = s.store.csi_volume_by_id("default", "v1")
+            if not vol.write_claims:
+                break
+            time.sleep(0.05)
+        assert not vol.write_claims, "claim not released by volume watcher"
+        assert vol.access_mode == csistructs.ACCESS_UNKNOWN
+
+        # volume is immediately writable by a new job
+        job2 = _csi_job("v1")
+        s.register_job(job2)
+        deadline = time.time() + 10
+        got = []
+        while time.time() < deadline:
+            got = s.store.allocs_by_job("default", job2.id)
+            if got:
+                break
+            time.sleep(0.05)
+        assert got, "released volume not schedulable again"
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------------ HTTP/CLI
+
+def test_volume_http_and_cli_surface():
+    import io
+
+    from nomad_tpu.agent.agent import Agent, AgentConfig
+    from nomad_tpu.command import cli
+
+    agent = Agent(AgentConfig(http_port=0, num_schedulers=1,
+                              heartbeat_ttl=3600.0))
+    agent.start()
+    try:
+        addr = agent.http_addr
+        agent.server.register_node(mock.csi_node())
+
+        out = io.StringIO()
+        rc = cli.main(["-address", addr, "volume", "status"], out=out)
+        assert rc == 0
+
+        import json as _json
+        import tempfile
+        vol = {"ID": "web-data", "Name": "web-data",
+               "PluginID": "ebs-plugin"}
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            _json.dump(vol, f)
+            path = f.name
+        out = io.StringIO()
+        rc = cli.main(["-address", addr, "volume", "register", path],
+                      out=out)
+        assert rc == 0
+
+        out = io.StringIO()
+        rc = cli.main(["-address", addr, "volume", "status", "web-data"],
+                      out=out)
+        assert rc == 0 and "web-data" in out.getvalue()
+
+        out = io.StringIO()
+        rc = cli.main(["-address", addr, "plugin", "status"], out=out)
+        assert rc == 0 and "ebs-plugin" in out.getvalue()
+
+        out = io.StringIO()
+        rc = cli.main(["-address", addr, "volume", "deregister",
+                       "web-data"], out=out)
+        assert rc == 0
+
+        out = io.StringIO()
+        rc = cli.main(["-address", addr, "volume", "status"], out=out)
+        assert "web-data" not in out.getvalue()
+    finally:
+        agent.stop()
+
+
+# ---------------------------------------------------------- client hook
+
+def test_csi_hook_stage_publish_lifecycle(tmp_path):
+    from nomad_tpu.client.csi import CSIHook, FakeCSIPlugin
+
+    job = _csi_job("v1")
+    alloc = mock.alloc_for(job, node_id="n1")
+    plugin = FakeCSIPlugin()
+    hook = CSIHook(alloc, str(tmp_path), plugins={"*": plugin})
+
+    mounts = hook.prerun()
+    assert "vol" in mounts
+    import os
+    assert os.path.isdir(mounts["vol"])
+    assert os.path.exists(os.path.join(mounts["vol"], ".csi_published"))
+    assert ("stage", "v1", os.path.join(str(tmp_path), "csi", "staging",
+                                        "v1")) in plugin.calls
+
+    hook.postrun()
+    assert not os.path.exists(mounts["vol"])
+    assert any(c[0] == "unstage" for c in plugin.calls)
