@@ -730,7 +730,8 @@ class HTTPServer:
 
     def _h_get_plugin_id(self, h, parts, q):
         plugin_id = parts[2] if len(parts) > 2 else parts[1]
-        return self._rpc("CSIPlugin.Get", {"plugin_id": plugin_id})
+        plug = self._rpc("CSIPlugin.Get", {"plugin_id": plugin_id})
+        return plug.stub()
 
 
 _STREAMED = object()

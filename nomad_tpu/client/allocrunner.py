@@ -59,8 +59,8 @@ class AllocRunner:
             self.alloc_dir.build()
             # CSI volumes stage+publish before any task starts
             # (alloc_runner_hooks.go csi_hook Prerun)
-            csi_mounts = self.csi_hook.prerun()
-            csi_staged = True
+            csi_staged = True   # before prerun: a mid-prerun failure must
+            csi_mounts = self.csi_hook.prerun()   # still unwind in finally
             tg = self.task_group()
             if self.prev_alloc_dir is not None and tg is not None \
                     and tg.ephemeral_disk.migrate:

@@ -36,7 +36,15 @@ class PlanApplier:
         # write (the scheduler Harness mode, testing.go:180)
         self._commit_fn = commit_fn
         self._lock = threading.Lock()
-        self.stats = {"applied": 0, "rejected_nodes": 0, "partial": 0}
+        # pipelining overlay: accepted-but-not-yet-committed plan effects,
+        # keyed by plan eval token/id (reference plan_apply.go:71-178
+        # evaluates plan N+1 against a snapshot with plan N applied while
+        # N's raft.Apply is still in flight)
+        self._overlay_lock = threading.Lock()
+        self._overlay: Dict[int, tuple] = {}
+        self._overlay_seq = 0
+        self.stats = {"applied": 0, "rejected_nodes": 0, "partial": 0,
+                      "pipelined": 0}
 
     # ------------------------------------------------------------- public
 
@@ -47,15 +55,96 @@ class PlanApplier:
             return result
 
     def run_loop(self, queue, stop_event: threading.Event) -> None:
-        """Leader plan-apply loop draining the PlanQueue."""
+        """Leader plan-apply loop draining the PlanQueue.
+
+        Pipelined (plan_apply.go:71-178): while plan N's commit (raft
+        apply) is in flight on a background thread, plan N+1 is already
+        being evaluated against committed state + the in-flight overlay.
+        Commits stay strictly ordered — the next commit starts only after
+        the previous one finishes."""
+        commit_t: Optional[threading.Thread] = None
         while not stop_event.is_set():
             pending = queue.dequeue(timeout=0.1)
             if pending is None:
                 continue
             try:
-                pending.future.set_result(self.apply(pending.plan))
+                result = self._evaluate(pending.plan)
+                token = self._overlay_add(pending.plan, result)
             except Exception as e:            # noqa: BLE001
                 pending.future.set_exception(e)
+                continue
+            if commit_t is not None:
+                commit_t.join()
+                self.stats["pipelined"] += 1
+            commit_t = threading.Thread(
+                target=self._commit_and_resolve,
+                args=(pending, result, token),
+                name="plan-commit", daemon=True)
+            commit_t.start()
+        if commit_t is not None:
+            commit_t.join()
+
+    def _commit_and_resolve(self, pending, result: PlanResult,
+                            token: int) -> None:
+        try:
+            self._commit(pending.plan, result)
+            pending.future.set_result(result)
+        except Exception as e:                # noqa: BLE001
+            pending.future.set_exception(e)
+        finally:
+            with self._overlay_lock:
+                self._overlay.pop(token, None)
+
+    # ------------------------------------------------------------- overlay
+
+    def _overlay_add(self, plan: Plan, result: PlanResult) -> int:
+        """Record the accepted plan's usage/port effects so the next
+        evaluation sees them before the commit lands."""
+        cm = self.store.matrix
+        used_delta: Dict[int, np.ndarray] = {}
+        port_claim: Dict[int, Set[int]] = {}
+        port_free: Dict[int, Set[int]] = {}
+        for node_id, allocs in result.node_allocation.items():
+            row = cm.row_of.get(node_id)
+            if row is None:
+                continue
+            vec = np.zeros(NUM_RESOURCE_DIMS, np.float32)
+            for a in allocs:
+                vec += comparable_vec(a.comparable_resources())
+                port_claim.setdefault(row, set()).update(_alloc_ports(a))
+            used_delta[row] = used_delta.get(
+                row, np.zeros(NUM_RESOURCE_DIMS, np.float32)) + vec
+        # NOTE: stops/preemptions are deliberately NOT overlaid.  The
+        # overlay lives until the commit thread pops it *after* the store
+        # write, so during that window effects would be counted twice.
+        # Double-counted placements only over-reserve (spurious rejection
+        # -> scheduler retry, safe); double-counted frees would validate
+        # overcommitting plans.  Untracked in-flight frees merely delay
+        # reuse of the space by one commit.
+        with self._overlay_lock:
+            self._overlay_seq += 1
+            token = self._overlay_seq
+            self._overlay[token] = (used_delta, port_claim, port_free)
+        return token
+
+    def _overlay_views(self, cm):
+        """(used, port_words) with any in-flight overlay applied.  Copies
+        are taken under the store lock so a concurrent commit thread
+        cannot tear the matrices mid-read."""
+        with self._overlay_lock:
+            if not self._overlay:
+                return cm.used, cm.port_words
+            with self.store._lock:
+                used = cm.used.copy()
+                port_words = cm.port_words.copy()
+            for used_delta, port_claim, port_free in self._overlay.values():
+                for row, vec in used_delta.items():
+                    if row < used.shape[0]:
+                        used[row] += vec
+                for row, ports in port_claim.items():
+                    for p in ports:
+                        port_words[row, p >> 5] |= np.uint32(1 << (p & 31))
+            return used, port_words
 
     # ------------------------------------------------------------- evaluate
 
@@ -123,11 +212,21 @@ class PlanApplier:
             freed_vecs[i] = freed.get(node_id, 0.0)
             group_ports.append(ports)
             group_freed.append(sorted(freed_ports.get(node_id, ())))
+        used_eff, port_words_eff = self._overlay_views(cm)
         ok = _native.validate_plan(
-            cm.capacity, cm.used, cm.port_words, rows, demand,
+            cm.capacity, used_eff, port_words_eff, rows, demand,
             freed_vecs, group_ports, group_freed) if g else []
 
         rejected: List[str] = []
+        # csi write-claim exclusion across concurrent plans (the reference
+        # rejects the claim at the state store, csi.go ClaimWrite; here the
+        # serialized applier is the authority): (ns, vol) -> job ids that
+        # claimed a write in THIS plan evaluation
+        pending_writers: Dict[Tuple[str, str], Set[str]] = {}
+        for i, node_id in enumerate(node_ids):
+            if ok[i] and not self._csi_claims_ok(
+                    plan.node_allocation[node_id], pending_writers):
+                ok[i] = False
         for i, node_id in enumerate(node_ids):
             if ok[i]:
                 result.node_allocation[node_id] = \
@@ -149,6 +248,39 @@ class PlanApplier:
             self.stats["partial"] += 1
             self.stats["rejected_nodes"] += len(rejected)
         return result
+
+    def _csi_claims_ok(self, allocs: List[Allocation],
+                       pending_writers: Dict[Tuple[str, str], Set[str]]
+                       ) -> bool:
+        """Write-claim feasibility for a node's placements: existing write
+        claims may only be held by the same job (the checker's own
+        exception, feasible.go:336-358 — covers destructive updates);
+        write claims taken earlier in this same plan pass by another job
+        reject the node."""
+        for a in allocs:
+            job = a.job
+            tg = job.lookup_task_group(a.task_group) if job else None
+            if tg is None:
+                continue
+            for req in tg.volumes.values():
+                if req.type != "csi" or req.read_only:
+                    continue
+                key = (job.namespace, req.source)
+                vol = self.store.csi_volume_by_id(*key)
+                if vol is None:
+                    return False
+                others = pending_writers.get(key, set()) - {job.id}
+                if others:
+                    return False
+                if not vol.has_free_write_claims():
+                    for alloc_id in vol.write_claims:
+                        holder = self.store.alloc_by_id(alloc_id)
+                        if holder is None or \
+                                holder.namespace != job.namespace or \
+                                holder.job_id != job.id:
+                            return False
+                pending_writers.setdefault(key, set()).add(job.id)
+        return True
 
     # ------------------------------------------------------------- commit
 

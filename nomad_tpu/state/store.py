@@ -687,8 +687,18 @@ class StateStore:
     def upsert_csi_volume(self, index: int, vol) -> None:
         with self._lock:
             key = (vol.namespace, vol.id)
-            if key not in self._csi_volumes:
+            existing = self._csi_volumes.get(key)
+            if existing is None:
                 vol.create_index = index
+            elif existing.in_use():
+                # re-registering an in-use volume must not drop its live
+                # claims (the reference register path preserves claims;
+                # losing them would admit a second writer immediately)
+                vol.read_claims = existing.read_claims
+                vol.write_claims = existing.write_claims
+                vol.past_claims = existing.past_claims
+                vol.access_mode = existing.access_mode or vol.access_mode
+                vol.create_index = existing.create_index
             vol.modify_index = index
             self._csi_volumes[key] = vol
             self._refresh_volume_health(vol)

@@ -257,3 +257,49 @@ def test_csi_hook_stage_publish_lifecycle(tmp_path):
     hook.postrun()
     assert not os.path.exists(mounts["vol"])
     assert any(c[0] == "unstage" for c in plugin.calls)
+
+
+def test_applier_rejects_concurrent_single_writer_claims():
+    """Two plans claiming the same single-writer volume: the serialized
+    applier admits the first and rejects the second, even though both
+    passed the scheduler's checker against pre-claim state."""
+    from nomad_tpu.core.plan_apply import PlanApplier
+    from nomad_tpu.state.store import StateStore
+    from nomad_tpu.structs.plan import Plan
+
+    store = StateStore()
+    node = mock.csi_node()
+    store.upsert_node(1, node)
+    store.upsert_csi_volume(2, mock.csi_volume("v1"))
+    applier = PlanApplier(store)
+
+    def plan_for(job):
+        tg = job.task_groups[0]
+        tg.volumes = {"vol": VolumeRequest(name="vol", type="csi",
+                                           source="v1")}
+        alloc = mock.alloc_for(job, node_id=node.id)
+        p = Plan(eval_id=mock._uuid(), job=job)
+        p.append_alloc(alloc, job)
+        return p
+
+    r1 = applier.apply(plan_for(mock.job()))
+    assert r1.node_allocation and not r1.rejected_nodes
+    r2 = applier.apply(plan_for(mock.job()))
+    assert r2.rejected_nodes == [node.id]
+    vol = store.csi_volume_by_id("default", "v1")
+    assert len(vol.write_claims) == 1
+
+
+def test_reregister_preserves_live_claims():
+    h = Harness()
+    h.store.upsert_node(h.next_index(), mock.csi_node())
+    h.store.upsert_csi_volume(h.next_index(), mock.csi_volume("v1"))
+    assert len(_run(h, _csi_job("v1"))) == 1
+    before = h.store.csi_volume_by_id("default", "v1")
+    assert before.write_claims
+
+    # operator re-registers the same volume id
+    h.store.upsert_csi_volume(h.next_index(), mock.csi_volume("v1"))
+    after = h.store.csi_volume_by_id("default", "v1")
+    assert after.write_claims == before.write_claims
+    assert after.access_mode == csistructs.ACCESS_SINGLE_WRITER

@@ -153,11 +153,11 @@ def test_remote_workers_on_followers_schedule():
         leader.register_job(job)
         assert _wait(lambda: len(
             leader.store.allocs_by_job("default", job.id)) == 3, 15)
-        # follower workers did the scheduling
-        follower_processed = sum(
+        # follower workers did the scheduling (stats tick after the ack
+        # round-trips, which trail the alloc commit — wait, don't sample)
+        assert _wait(lambda: sum(
             w.stats["processed"]
-            for f in c.followers() for w in f.remote_workers)
-        assert follower_processed >= 1
+            for f in c.followers() for w in f.remote_workers) >= 1, 5)
     finally:
         c.stop()
 
