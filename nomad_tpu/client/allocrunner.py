@@ -172,6 +172,10 @@ class AllocRunner:
     def _aggregate_status(self) -> None:
         """Client status from task states (alloc_runner.go
         getClientStatus)."""
+        if getattr(self, "_disconnect_stopped", False):
+            self.client_status = AllocClientStatus.LOST
+            self.client_description = "stopped after client disconnect"
+            return
         states = [tr.state for tr in self.task_runners.values()]
         if not states:
             return
@@ -271,6 +275,17 @@ class AllocRunner:
         """Kill all tasks (desired_status=stop path)."""
         for tr in self.task_runners.values():
             tr.kill(timeout_s)
+
+    def stop_for_disconnect(self) -> None:
+        """stop_after_client_disconnect elapsed while the client could
+        not heartbeat (heartbeatstop.go): kill the tasks and mark the
+        alloc lost so the server's view converges on reconnect.  The flag
+        is sticky: task-death aggregation must not flip the alloc back to
+        complete."""
+        self._disconnect_stopped = True
+        self.stop(1.0)
+        self._set_status(AllocClientStatus.LOST,
+                         "stopped after client disconnect")
 
     def destroy(self) -> None:
         self._destroyed = True

@@ -89,6 +89,7 @@ class Client:
         self.rpc("Node.UpdateStatus",
                  {"node_id": self.node.id, "status": "ready"})
         for target, name in ((self._heartbeat_loop, "hb"),
+                             (self._heartbeat_stop_loop, "hb-stop"),
                              (self._watch_allocations, "alloc-watch"),
                              (self._update_pusher, "alloc-update")):
             t = threading.Thread(target=target, daemon=True,
@@ -122,14 +123,43 @@ class Client:
                                  "heartbeat": True})
                 self._heartbeat_ttl = resp.get("heartbeat_ttl",
                                                self._heartbeat_ttl)
+                self._disconnected_since = None
             except Exception:                       # noqa: BLE001
                 # server unreachable: keep trying; the server marks us
                 # down/disconnected on TTL expiry (heartbeat.go:135)
+                if getattr(self, "_disconnected_since", None) is None:
+                    self._disconnected_since = time.time()
                 log.debug("heartbeat failed", exc_info=True)
                 try:
                     self.rpc("Node.Register", {"node": self.node})
                 except Exception:                   # noqa: BLE001
                     pass
+
+    def _heartbeat_stop_loop(self) -> None:
+        """heartbeatstop (client/heartbeatstop.go:158): while the client
+        cannot reach a server, allocations whose task group sets
+        stop_after_client_disconnect are stopped locally once that
+        duration elapses past the last successful heartbeat."""
+        while not self._stop.is_set():
+            if self._stop.wait(1.0):
+                return
+            since = getattr(self, "_disconnected_since", None)
+            if since is None:
+                continue
+            behind = time.time() - since
+            with self._ar_lock:
+                runners = list(self.alloc_runners.values())
+            for ar in runners:
+                tg = ar.task_group()
+                if tg is None or tg.stop_after_client_disconnect_s is None:
+                    continue
+                if behind <= tg.stop_after_client_disconnect_s:
+                    continue
+                if ar.client_status in ("complete", "failed", "lost"):
+                    continue
+                log.info("stopping alloc %s: client disconnected > %.0fs",
+                         ar.alloc.id[:8], tg.stop_after_client_disconnect_s)
+                ar.stop_for_disconnect()
 
     # ------------------------------------------------------------ allocs
 

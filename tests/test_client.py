@@ -293,3 +293,51 @@ def test_client_restart_recovery(tmp_path):
             os.kill(pid, _sig.SIGKILL)
         except ProcessLookupError:
             pass
+
+
+def test_stop_after_client_disconnect(tmp_path):
+    """heartbeatstop (client/heartbeatstop.go:158): a disconnected client
+    stops allocs whose group sets stop_after_client_disconnect once the
+    deadline passes the last successful heartbeat."""
+    server = Server(ServerConfig(num_schedulers=2, heartbeat_ttl=2.0))
+    server.start()
+    rpc_ok = {"v": True}
+
+    def gated_rpc(method, args):
+        if not rpc_ok["v"]:
+            raise ConnectionError("network partitioned")
+        return server.endpoints.handle(method, args)
+
+    client = Client(
+        ClientConfig(node_name="c-dc", data_dir=str(tmp_path / "c"),
+                     watch_interval=0.05),
+        rpc=gated_rpc)
+    client.start()
+    try:
+        job = Job(id="svc-dc", name="svc", type="service",
+                  task_groups=[TaskGroup(
+                      name="g", count=1,
+                      stop_after_client_disconnect_s=1.0,
+                      tasks=[Task(name="t", driver="mock_driver",
+                                  config={"run_for": 0})])])
+        job.canonicalize()
+        server.register_job(job)
+        assert _wait(lambda: any(
+            ar.client_status == "running"
+            for ar in client.alloc_runners.values()), 15.0)
+
+        # partition the client from the server
+        rpc_ok["v"] = False
+        assert _wait(lambda: any(
+            ar.client_status == "lost"
+            for ar in client.alloc_runners.values()), 15.0), \
+            [(ar.client_status, ar.client_description)
+             for ar in client.alloc_runners.values()]
+        ar = next(iter(client.alloc_runners.values()))
+        assert "client disconnect" in ar.client_description
+        assert all(tr.state.state == "dead"
+                   for tr in ar.task_runners.values())
+    finally:
+        rpc_ok["v"] = True
+        client.stop()
+        server.stop()
