@@ -501,8 +501,10 @@ class HTTPServer:
                     "stats": {"client": self.agent.client is not None,
                               "server": self.agent.server is not None}}
         if parts[1] == "members":
-            return {"Members": [{"Name": n}
-                                for n in self._rpc("Status.Peers", {})]}
+            return {"Members": [
+                {"Name": m["name"], "Status": m["status"],
+                 "Addr": m["addr"]}
+                for m in self._rpc("Status.Members", {})]}
         if parts[1] == "health":
             return {"server": {"ok": self.agent.server is not None},
                     "client": {"ok": self.agent.client is not None}}

@@ -1,0 +1,204 @@
+"""Gossip membership (reference: nomad/serf.go — hashicorp/serf's SWIM
+gossip giving member discovery, failure detection and leave events).
+
+SWIM-lite over the cluster transport: each member keeps a table
+{name -> (addr, incarnation, status, heard_at)} and periodically syncs it
+with one random live peer (push-pull, the dominant convergence mechanism
+in SWIM); an unreachable peer is marked suspect after `suspect_after`
+without contact and failed after `fail_after`.  A member that learns it
+is suspected refutes by bumping its own incarnation (SWIM's refutation).
+Addresses learned from the table feed the transport's address book, so a
+member only needs ONE seed address to join a cluster.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+ALIVE, SUSPECT, FAILED, LEFT = "alive", "suspect", "failed", "left"
+
+
+@dataclass
+class Member:
+    name: str
+    addr: Tuple[str, int]
+    incarnation: int = 0
+    status: str = ALIVE
+    heard_at: float = field(default_factory=time.monotonic)
+
+    def wire(self) -> dict:
+        return {"name": self.name, "addr": tuple(self.addr),
+                "incarnation": self.incarnation, "status": self.status}
+
+
+class Membership:
+    def __init__(self, transport, name: str, addr: Tuple[str, int],
+                 interval: float = 0.2, suspect_after: float = 1.0,
+                 fail_after: float = 3.0,
+                 on_change: Optional[Callable[[Member], None]] = None):
+        self.transport = transport
+        self.name = name
+        self.interval = interval
+        self.suspect_after = suspect_after
+        self.fail_after = fail_after
+        self.on_change = on_change or (lambda m: None)
+        self._lock = threading.Lock()
+        self.members: Dict[str, Member] = {
+            name: Member(name=name, addr=tuple(addr))}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        transport.register(f"gossip:{name}", self._handle)
+
+    # ------------------------------------------------------------- admin
+
+    def join(self, seeds: List[Tuple[str, Tuple[str, int]]]) -> None:
+        """Seed the member table with (name, addr) pairs and sync once."""
+        with self._lock:
+            for name, addr in seeds:
+                if name != self.name and name not in self.members:
+                    self.members[name] = Member(name=name, addr=tuple(addr))
+                if hasattr(self.transport, "add_peer"):
+                    self.transport.add_peer(name, addr)
+        self._gossip_once()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"gossip-{self.name}")
+        self._thread.start()
+
+    def leave(self) -> None:
+        """Graceful leave: bump incarnation, broadcast LEFT, stop."""
+        with self._lock:
+            me = self.members[self.name]
+            me.incarnation += 1
+            me.status = LEFT
+        for peer in self._peers():
+            try:
+                self.transport.call(self.name, f"gossip:{peer.name}",
+                                    "sync", {"table": self._wire_table()})
+            except Exception:                       # noqa: BLE001
+                pass
+        self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(1.0)
+        self.transport.deregister(f"gossip:{self.name}")
+
+    def alive_members(self) -> List[Member]:
+        with self._lock:
+            return [m for m in self.members.values() if m.status == ALIVE]
+
+    def member_list(self) -> List[dict]:
+        with self._lock:
+            return [m.wire() for m in
+                    sorted(self.members.values(), key=lambda m: m.name)]
+
+    # ------------------------------------------------------------- loop
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._gossip_once()
+                self._sweep()
+            except Exception:                       # noqa: BLE001
+                log.debug("gossip round failed", exc_info=True)
+
+    def _peers(self) -> List[Member]:
+        with self._lock:
+            return [m for m in self.members.values()
+                    if m.name != self.name and m.status in (ALIVE, SUSPECT)]
+
+    def _gossip_once(self) -> None:
+        peers = self._peers()
+        if not peers:
+            return
+        peer = random.choice(peers)
+        try:
+            resp = self.transport.call(
+                self.name, f"gossip:{peer.name}", "sync",
+                {"table": self._wire_table()})
+            self._merge(resp.get("table", []))
+            with self._lock:
+                m = self.members.get(peer.name)
+                if m is not None:
+                    m.heard_at = time.monotonic()
+                    if m.status == SUSPECT:
+                        self._set_status(m, ALIVE)
+        except Exception:                           # noqa: BLE001
+            pass   # the sweep drives suspicion from silence
+
+    def _sweep(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for m in self.members.values():
+                if m.name == self.name or m.status in (FAILED, LEFT):
+                    continue
+                silent = now - m.heard_at
+                if m.status == ALIVE and silent > self.suspect_after:
+                    self._set_status(m, SUSPECT)
+                elif m.status == SUSPECT and silent > self.fail_after:
+                    self._set_status(m, FAILED)
+
+    # ------------------------------------------------------------- merge
+
+    def _handle(self, method: str, args: dict) -> dict:
+        if method != "sync":
+            raise ValueError(f"unknown gossip method {method}")
+        self._merge(args.get("table", []))
+        return {"table": self._wire_table()}
+
+    def _wire_table(self) -> List[dict]:
+        with self._lock:
+            return [m.wire() for m in self.members.values()]
+
+    def _merge(self, table: List[dict]) -> None:
+        with self._lock:
+            for entry in table:
+                name = entry["name"]
+                inc = entry["incarnation"]
+                status = entry["status"]
+                if name == self.name:
+                    # SWIM refutation: someone thinks we're gone — bump
+                    # our incarnation so ALIVE outranks their claim
+                    me = self.members[self.name]
+                    if status in (SUSPECT, FAILED) and inc >= me.incarnation:
+                        me.incarnation = inc + 1
+                    continue
+                cur = self.members.get(name)
+                if cur is None:
+                    cur = self.members[name] = Member(
+                        name=name, addr=tuple(entry["addr"]),
+                        incarnation=inc, status=status)
+                    if hasattr(self.transport, "add_peer"):
+                        self.transport.add_peer(name, cur.addr)
+                    self.on_change(cur)
+                    continue
+                # higher incarnation always wins; same incarnation:
+                # dead-ish states override alive (SWIM precedence)
+                rank = {ALIVE: 0, SUSPECT: 1, FAILED: 2, LEFT: 3}
+                if inc > cur.incarnation or (
+                        inc == cur.incarnation
+                        and rank[status] > rank[cur.status]):
+                    cur.incarnation = inc
+                    cur.addr = tuple(entry["addr"])
+                    if status != cur.status:
+                        self._set_status(cur, status)
+                    if status == ALIVE:
+                        cur.heard_at = time.monotonic()
+
+    def _set_status(self, m: Member, status: str) -> None:
+        m.status = status
+        if status == ALIVE:
+            m.heard_at = time.monotonic()
+        try:
+            self.on_change(m)
+        except Exception:                           # noqa: BLE001
+            log.debug("membership on_change failed", exc_info=True)

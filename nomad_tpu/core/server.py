@@ -70,7 +70,8 @@ class Server:
                  name: str = "server-1",
                  peers: Optional[List[str]] = None,
                  raft_transport=None,
-                 raft_config=None):
+                 raft_config=None,
+                 membership=None):
         self.config = config or ServerConfig()
         self.name = name
         self.store = StateStore()
@@ -105,6 +106,7 @@ class Server:
         self._transport = raft_transport
         from nomad_tpu.rpc.endpoints import Endpoints
         self.endpoints = Endpoints(self)
+        self.membership = membership   # gossip (core.membership), optional
         if raft_transport is not None:
             raft_transport.register(f"rpc:{name}", self.endpoints.handle)
             data_dir = self.config.data_dir
@@ -169,6 +171,8 @@ class Server:
     # ------------------------------------------------------------- lifecycle
 
     def start(self) -> None:
+        if self.membership is not None:
+            self.membership.start()
         if self.raft is not None:
             # every server runs schedulers against its replicated snapshot,
             # RPCing the leader for dequeue/ack/plan-submit (reference:
@@ -252,6 +256,12 @@ class Server:
                 self._plan_thread = None
 
     def stop(self) -> None:
+        if self.membership is not None:
+            try:
+                self.membership.leave()
+            except Exception:                      # noqa: BLE001
+                pass
+            self.membership = None
         self._stop.set()
         for w in self.remote_workers:
             w.stop()

@@ -91,6 +91,15 @@ class Endpoints:
             return s.name if s.leader else None
         return s.raft.leader_id
 
+    def rpc_Status__Members(self, args):
+        """Serf-style member listing (reference nomad/serf.go members)."""
+        s = self.server
+        if s.membership is not None:
+            return s.membership.member_list()
+        peers = [s.name] if s.raft is None else [s.name] + list(s.raft.peers)
+        return [{"name": n, "addr": None, "incarnation": 0,
+                 "status": "alive"} for n in sorted(set(peers))]
+
     def rpc_Status__Peers(self, args):
         s = self.server
         if s.raft is None:

@@ -1,0 +1,167 @@
+"""Raft + RPC over real TCP sockets and gossip membership
+(reference nomad/raft_rpc.go, nomad/rpc.go, nomad/serf.go): a 3-server
+cluster on loopback elects a leader, replicates scheduling state, survives
+a hard leader kill, and gossips membership from a single seed."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.membership import ALIVE, FAILED, LEFT, Membership
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.raft import RaftConfig
+from nomad_tpu.raft.transport import TcpTransport
+
+
+def _wait(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TcpCluster:
+    def __init__(self, n=3):
+        self.names = [f"tcp-{i}" for i in range(n)]
+        self.transports = [TcpTransport() for _ in range(n)]
+        cfg = RaftConfig(heartbeat_interval=0.03, election_timeout=0.15)
+        self.servers = []
+        for i, nm in enumerate(self.names):
+            srv = Server(ServerConfig(num_schedulers=2), name=nm,
+                         peers=self.names, raft_transport=self.transports[i],
+                         raft_config=cfg)
+            self.servers.append(srv)
+        # every member seeds every address (the gossip test exercises
+        # single-seed discovery separately)
+        for i, t in enumerate(self.transports):
+            for j, nm in enumerate(self.names):
+                if i != j:
+                    t.add_peer(nm, self.transports[j].address)
+
+    def start(self):
+        for s in self.servers:
+            s.start()
+
+    def stop(self):
+        for s in self.servers:
+            try:
+                s.stop()
+            except Exception:       # noqa: BLE001
+                pass
+        for t in self.transports:
+            t.close()
+
+    def leader(self, timeout=8.0, among=None):
+        servers = among or self.servers
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            leaders = [s for s in servers
+                       if s.raft is not None and s.raft.is_leader
+                       and s._established]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.02)
+        raise TimeoutError("no leader over TCP")
+
+
+def test_tcp_cluster_schedules_and_survives_leader_kill():
+    c = TcpCluster(3)
+    c.start()
+    try:
+        leader = c.leader()
+        for _ in range(3):
+            leader.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        leader.register_job(job)
+        assert _wait(lambda: len(
+            leader.store.allocs_by_job("default", job.id)) == 2, 15)
+
+        # replication reached followers over real sockets
+        idx = leader.store.latest_index
+        others = [s for s in c.servers if s is not leader]
+        assert _wait(lambda: all(
+            s.store.latest_index >= idx for s in others), 10)
+
+        # hard-kill the leader: listener closed AND raft stopped, like a
+        # process death (outbound heartbeats must cease or no election
+        # would ever start)
+        dead = leader
+        i = c.servers.index(dead)
+        c.transports[i].close()
+        dead.raft.stop()
+        dead._stop.set()
+        dead._revoke_leadership()
+
+        survivor = c.leader(among=others, timeout=10.0)
+        job2 = mock.job()
+        job2.task_groups[0].count = 2
+        survivor.register_job(job2)
+        assert _wait(lambda: len(
+            survivor.store.allocs_by_job("default", job2.id)) == 2, 15), \
+            "new leader must keep scheduling after the kill"
+    finally:
+        c.stop()
+
+
+def test_gossip_single_seed_convergence_and_failure_detection():
+    transports = [TcpTransport() for _ in range(3)]
+    names = ["g-0", "g-1", "g-2"]
+    members = [Membership(t, nm, t.address, interval=0.05,
+                          suspect_after=0.3, fail_after=0.8)
+               for t, nm in zip(transports, names)]
+    try:
+        # g-1 and g-2 know ONLY the seed g-0; g-0 knows nobody
+        members[1].join([("g-0", transports[0].address)])
+        members[2].join([("g-0", transports[0].address)])
+        for m in members:
+            m.start()
+
+        # full convergence: everyone sees all three alive
+        assert _wait(lambda: all(
+            len(m.alive_members()) == 3 for m in members), 10), \
+            [[e["name"] for e in m.member_list()] for m in members]
+        # addresses were learned transitively (g-1 knows g-2's addr)
+        assert transports[1].peer_addr("g-2") == transports[2].address
+
+        # graceful leave propagates as LEFT
+        members[2].leave()
+        assert _wait(lambda: all(
+            any(e["name"] == "g-2" and e["status"] == LEFT
+                for e in m.member_list())
+            for m in members[:2]), 10)
+
+        # hard kill g-1: close its transport; g-0 marks it failed
+        transports[1].close()
+        members[1].stop()
+        assert _wait(lambda: any(
+            e["name"] == "g-1" and e["status"] == FAILED
+            for e in members[0].member_list()), 10), \
+            members[0].member_list()
+    finally:
+        for m in members:
+            try:
+                m.stop()
+            except Exception:       # noqa: BLE001
+                pass
+        for t in transports:
+            try:
+                t.close()
+            except Exception:       # noqa: BLE001
+                pass
+
+
+def test_members_rpc_reports_gossip_table():
+    t = TcpTransport()
+    srv = Server(ServerConfig(num_schedulers=1), name="solo")
+    srv.membership = Membership(t, "solo", t.address, interval=0.1)
+    srv.start()
+    try:
+        out = srv.endpoints.handle("Status.Members", {})
+        assert out and out[0]["name"] == "solo"
+        assert out[0]["status"] == ALIVE
+    finally:
+        srv.stop()
+        t.close()
