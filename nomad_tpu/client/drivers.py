@@ -258,10 +258,10 @@ class DriverRegistry:
     def __init__(self, names: Optional[List[str]] = None):
         self._drivers: Dict[str, Driver] = {}
         available = {"mock_driver": MockDriver, "raw_exec": RawExecDriver,
-                     # exec/java/docker/qemu execute like raw_exec here:
-                     # there is no container runtime in the test rig, and
-                     # the driver boundary is what matters for parity
-                     "exec": RawExecDriver, "mock": MockDriver}
+                     # exec = cgroup-isolated execution via the separate
+                     # executor process (ExecDriver below); java/docker/
+                     # qemu have no runtime in this rig
+                     "exec": ExecDriver, "mock": MockDriver}
         for name in names or ["mock_driver", "raw_exec", "exec", "mock"]:
             cls = available.get(name)
             if cls is not None:
@@ -281,3 +281,140 @@ class DriverRegistry:
     def fingerprints(self) -> Dict[str, dict]:
         return {name: drv.fingerprint()
                 for name, drv in self._drivers.items()}
+
+
+class ExecDriver(Driver):
+    """Isolated task execution through a separate executor process
+    (reference drivers/exec/ + drivers/shared/executor/): the driver
+    launches `python -m nomad_tpu.client.executor`, which creates a
+    cgroup with the task's cpu/memory limits, starts the task inside it,
+    and serves wait/stop/signal/stats/destroy on a unix socket.  The
+    socket path rides in the TaskHandle, so a restarted client's driver
+    REATTACHES to the still-running executor — the reference's go-plugin
+    reattach semantics, with the executor as the process boundary.
+    """
+
+    name = "exec"
+
+    def fingerprint(self) -> dict:
+        import sys
+        healthy = os.access("/sys/fs/cgroup", os.W_OK)
+        return {"detected": True, "healthy": healthy,
+                "attributes": {"driver.exec.cgroups": "1" if healthy
+                               else "0"}}
+
+    # ------------------------------------------------------------- rpc
+
+    def _connect(self, handle, timeout=5.0):
+        import socket as _socket
+        sock_path = handle.config.get("socket")
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+                s.settimeout(600.0)
+                s.connect(sock_path)
+                return s
+            except OSError as e:
+                last = e
+                time.sleep(0.05)
+        raise DriverError(f"executor socket unavailable: {last}")
+
+    def _rpc(self, handle, req: dict, timeout=5.0) -> dict:
+        import json
+        s = self._connect(handle, timeout)
+        try:
+            s.sendall((json.dumps(req) + "\n").encode())
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    raise DriverError("executor closed connection")
+                buf += chunk
+            return json.loads(buf)
+        finally:
+            s.close()
+
+    # ------------------------------------------------------------- api
+
+    def start_task(self, handle, task, env, task_dir):
+        import json
+        import sys
+        cfg = task.config or {}
+        command = cfg.get("command")
+        if not command:
+            raise DriverError("exec requires config.command")
+        logs_dir = os.path.join(os.path.dirname(task_dir), "alloc", "logs")
+        os.makedirs(logs_dir, exist_ok=True)
+        run_dir = os.path.join(os.path.dirname(task_dir), "exec")
+        os.makedirs(run_dir, exist_ok=True)
+        spec = {
+            "id": handle.id[:8],
+            "command": str(command),
+            "args": [str(a) for a in cfg.get("args", [])],
+            "env": dict(env),
+            "cwd": task_dir,
+            "stdout": os.path.join(logs_dir, f"{task.name}.stdout"),
+            "stderr": os.path.join(logs_dir, f"{task.name}.stderr"),
+            "cpu_shares": task.resources.cpu if task.resources else 0,
+            "memory_mb": task.resources.memory_mb if task.resources else 0,
+            "socket": os.path.join(run_dir, f"{handle.id[:8]}.sock"),
+        }
+        spec_path = os.path.join(run_dir, f"{handle.id[:8]}.json")
+        with open(spec_path, "w") as f:
+            json.dump(spec, f)
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "nomad_tpu.client.executor",
+                 spec_path],
+                start_new_session=True,     # survives the client process
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        except OSError as e:
+            raise DriverError(f"failed to launch executor: {e}")
+        # readiness: executor writes <spec>.ready once serving
+        deadline = time.time() + 10.0
+        while not os.path.exists(spec_path + ".ready"):
+            if proc.poll() is not None:
+                raise DriverError("executor died during startup")
+            if time.time() > deadline:
+                raise DriverError("executor startup timeout")
+            time.sleep(0.02)
+        handle.pid = proc.pid
+        handle.started_at = time.time()
+        handle.config = {**dict(handle.config or {}),
+                         "socket": spec["socket"], "spec": spec_path}
+
+    def wait_task(self, handle) -> ExitResult:
+        res = self._rpc(handle, {"op": "wait"}, timeout=10.0)
+        return ExitResult(exit_code=int(res.get("exit_code", -1)),
+                          signal=int(res.get("signal", 0)),
+                          oom_killed=bool(res.get("oom_killed")))
+
+    def stop_task(self, handle, timeout_s: float = 5.0):
+        try:
+            self._rpc(handle, {"op": "stop", "timeout": timeout_s},
+                      timeout=timeout_s + 10.0)
+        except DriverError:
+            pass
+
+    def destroy_task(self, handle):
+        try:
+            self._rpc(handle, {"op": "destroy"})
+        except DriverError:
+            pass
+
+    def signal_task(self, handle, sig: int):
+        self._rpc(handle, {"op": "signal", "sig": int(sig)})
+
+    def inspect_task(self, handle) -> dict:
+        return self._rpc(handle, {"op": "stats"})
+
+    def recover_task(self, handle) -> bool:
+        """Reattach over the unix socket (the executor outlives the
+        client, plugins/drivers/driver.go RecoverTask)."""
+        try:
+            resp = self._rpc(handle, {"op": "ping"}, timeout=1.0)
+            return bool(resp.get("ok"))
+        except DriverError:
+            return False

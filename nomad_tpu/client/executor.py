@@ -1,0 +1,223 @@
+"""Task executor subprocess (reference drivers/shared/executor/ — the
+separate supervisor process that go-plugin drivers launch and REATTACH to
+over RPC, executor_linux.go for the cgroup/namespace isolation).
+
+Runs as `python -m nomad_tpu.client.executor <spec.json>`, stdlib-only:
+
+- creates a cgroup (v1 cpu+memory or v2) and applies cpu share / memory
+  limits from the spec, then starts the task in its own session inside it
+- serves a JSON-lines protocol on a unix socket: wait / stop / signal /
+  stats / destroy — the driver (and a restarted client's driver, via the
+  socket path persisted in the TaskHandle) talks to the task only through
+  this boundary, exactly like the reference's gRPC-served executor
+- survives the client: killing the nomad client leaves the executor and
+  its task running; RecoverTask reconnects to the socket
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+
+CG_ROOT_V1 = "/sys/fs/cgroup"
+CG_V2 = "/sys/fs/cgroup/unified"
+
+
+class Cgroup:
+    """Minimal cgroup v1 (cpu, memory) with a v2 fallback; no-op when the
+    hierarchy is not writable (non-root / unsupported host)."""
+
+    def __init__(self, name: str, cpu_shares: int = 0, memory_mb: int = 0):
+        self.paths = []
+        self.enabled = False
+        v1_cpu = os.path.join(CG_ROOT_V1, "cpu", "nomad_tpu", name)
+        v1_mem = os.path.join(CG_ROOT_V1, "memory", "nomad_tpu", name)
+        try:
+            os.makedirs(v1_cpu, exist_ok=True)
+            os.makedirs(v1_mem, exist_ok=True)
+            if cpu_shares > 0:
+                _write(os.path.join(v1_cpu, "cpu.shares"),
+                       str(max(2, cpu_shares)))
+            if memory_mb > 0:
+                _write(os.path.join(v1_mem, "memory.limit_in_bytes"),
+                       str(memory_mb * 1024 * 1024))
+            self.paths = [v1_cpu, v1_mem]
+            self.enabled = True
+        except OSError:
+            self.paths = []
+
+    def add_pid(self, pid: int) -> None:
+        for p in self.paths:
+            try:
+                _write(os.path.join(p, "tasks"), str(pid))
+            except OSError:
+                pass
+
+    def oom_killed(self) -> bool:
+        for p in self.paths:
+            if "/memory/" not in p:
+                continue
+            try:
+                with open(os.path.join(p, "memory.oom_control")) as f:
+                    for line in f:
+                        if line.startswith("oom_kill ") and \
+                                int(line.split()[1]) > 0:
+                            return True
+            except OSError:
+                pass
+        return False
+
+    def destroy(self) -> None:
+        for p in self.paths:
+            try:
+                os.rmdir(p)
+            except OSError:
+                pass
+
+
+def _write(path: str, value: str) -> None:
+    with open(path, "w") as f:
+        f.write(value)
+
+
+class Executor:
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.result = None           # {exit_code, signal, oom_killed}
+        self._exit = threading.Event()
+        self.cg = Cgroup(spec.get("id", str(os.getpid())),
+                         int(spec.get("cpu_shares", 0) or 0),
+                         int(spec.get("memory_mb", 0) or 0))
+        stdout = open(spec["stdout"], "ab") if spec.get("stdout") else None
+        stderr = open(spec["stderr"], "ab") if spec.get("stderr") else None
+        env = dict(spec.get("env") or {})
+        cg = self.cg
+
+        def _enter_cgroup():
+            # in the child after fork, before exec: the task's very first
+            # instruction already runs inside the limits (the reference
+            # enters the cgroup via libcontainer pre-exec)
+            os.setsid()
+            cg.add_pid(os.getpid())
+
+        self.proc = subprocess.Popen(
+            [spec["command"], *[str(a) for a in spec.get("args", [])]],
+            cwd=spec.get("cwd") or None,
+            env={**os.environ, **env},
+            stdout=stdout, stderr=stderr,
+            preexec_fn=_enter_cgroup)
+        if stdout:
+            stdout.close()
+        if stderr:
+            stderr.close()
+        threading.Thread(target=self._reap, daemon=True).start()
+
+    def _reap(self) -> None:
+        code = self.proc.wait()
+        res = {"exit_code": code if code >= 0 else 128 - code,
+               "signal": -code if code < 0 else 0,
+               "oom_killed": self.cg.oom_killed()}
+        self.result = res
+        self._exit.set()
+
+    # ------------------------------------------------------------- ops
+
+    def op_wait(self, req):
+        self._exit.wait()
+        return self.result
+
+    def op_signal(self, req):
+        sig = int(req.get("sig", signal.SIGTERM))
+        try:
+            os.killpg(os.getpgid(self.proc.pid), sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+        return {"ok": True}
+
+    def op_stop(self, req):
+        timeout = float(req.get("timeout", 5.0))
+        self.op_signal({"sig": signal.SIGTERM})
+        if not self._exit.wait(timeout):
+            self.op_signal({"sig": signal.SIGKILL})
+            self._exit.wait(5.0)
+        return self.result or {"exit_code": -1, "signal": 9,
+                               "oom_killed": False}
+
+    def op_stats(self, req):
+        mem = 0
+        for p in self.cg.paths:
+            if "/memory/" in p:
+                try:
+                    with open(os.path.join(p,
+                                           "memory.usage_in_bytes")) as f:
+                        mem = int(f.read().strip())
+                except OSError:
+                    pass
+        return {"pid": self.proc.pid, "running": self.result is None,
+                "memory_bytes": mem, "cgroup": self.cg.enabled}
+
+    def op_destroy(self, req):
+        self.op_stop({"timeout": 0.5})
+        self.cg.destroy()
+        # unlink the socket first so reattach attempts fail immediately,
+        # then exit after the response flushes
+        try:
+            os.unlink(self.spec["socket"])
+        except OSError:
+            pass
+        threading.Thread(target=lambda: (time.sleep(0.2),
+                                         os._exit(0)), daemon=True).start()
+        return {"ok": True}
+
+    def op_ping(self, req):
+        return {"ok": True, "pid": self.proc.pid,
+                "running": self.result is None}
+
+
+def serve(spec_path: str) -> None:
+    with open(spec_path) as f:
+        spec = json.load(f)
+    ex = Executor(spec)
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            while True:
+                line = self.rfile.readline()
+                if not line:
+                    return
+                try:
+                    req = json.loads(line)
+                    fn = getattr(ex, f"op_{req.get('op')}", None)
+                    resp = fn(req) if fn else {"error": "unknown op"}
+                except Exception as e:          # noqa: BLE001
+                    resp = {"error": str(e)}
+                try:
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+                except (BrokenPipeError, OSError):
+                    return
+
+    class Srv(socketserver.ThreadingUnixStreamServer):
+        daemon_threads = True
+
+    sock_path = spec["socket"]
+    if os.path.exists(sock_path):
+        os.unlink(sock_path)
+    srv = Srv(sock_path, Handler)
+    # signal readiness: the driver waits for this file
+    _write(spec_path + ".ready", str(os.getpid()))
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    # live as long as someone may still wait on the task result
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    serve(sys.argv[1])

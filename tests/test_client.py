@@ -341,3 +341,81 @@ def test_stop_after_client_disconnect(tmp_path):
         rpc_ok["v"] = True
         client.stop()
         server.stop()
+
+
+# ------------------------------------------------------------ exec driver
+
+def _exec_task(command, args=None, cpu=100, mem=64):
+    from nomad_tpu.structs.resources import Resources
+    return Task(name="e", driver="exec",
+                config={"command": command, "args": args or []},
+                resources=Resources(cpu=cpu, memory_mb=mem))
+
+
+def test_exec_driver_runs_in_cgroup(tmp_path):
+    from nomad_tpu.client.drivers import ExecDriver, TaskHandle
+
+    drv = ExecDriver()
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    h = TaskHandle(driver="exec", task_name="e")
+    task = _exec_task("/bin/sh", ["-c", "cat /proc/self/cgroup > out.txt"])
+    drv.start_task(h, task, {}, str(task_dir))
+    res = drv.wait_task(h)
+    assert res.exit_code == 0
+    cg = (task_dir / "out.txt").read_text()
+    if os.access("/sys/fs/cgroup/memory", os.W_OK):
+        assert "nomad_tpu" in cg, cg
+    stats = drv.inspect_task(h)
+    assert stats["cgroup"] == os.access("/sys/fs/cgroup/memory", os.W_OK)
+    drv.destroy_task(h)
+
+
+def test_exec_driver_stop_and_signal(tmp_path):
+    from nomad_tpu.client.drivers import ExecDriver, TaskHandle
+
+    drv = ExecDriver()
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    h = TaskHandle(driver="exec", task_name="e")
+    drv.start_task(h, _exec_task("/bin/sleep", ["300"]), {}, str(task_dir))
+    t0 = time.time()
+    done = {}
+
+    def waiter():
+        done["res"] = drv.wait_task(h)
+
+    import threading
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    drv.stop_task(h, timeout_s=2.0)
+    t.join(10.0)
+    assert "res" in done and done["res"].signal in (15, 9)
+    assert time.time() - t0 < 10
+    drv.destroy_task(h)
+
+
+def test_exec_driver_reattach_after_driver_restart(tmp_path):
+    """The executor process outlives the driver object: a brand-new
+    driver instance recovers the task from the handle's socket path and
+    still observes its exit (go-plugin reattach semantics)."""
+    from nomad_tpu.client.drivers import ExecDriver, TaskHandle
+
+    drv1 = ExecDriver()
+    task_dir = tmp_path / "task"
+    task_dir.mkdir()
+    h = TaskHandle(driver="exec", task_name="e")
+    proof = task_dir / "done.txt"
+    drv1.start_task(
+        h, _exec_task("/bin/sh", ["-c", f"sleep 0.5; echo ok > {proof}"]),
+        {}, str(task_dir))
+    del drv1                          # "client restart"
+
+    drv2 = ExecDriver()
+    assert drv2.recover_task(h), "reattach over the socket failed"
+    res = drv2.wait_task(h)
+    assert res.exit_code == 0
+    assert proof.read_text().strip() == "ok"
+    drv2.destroy_task(h)
+    assert not drv2.recover_task(h)
