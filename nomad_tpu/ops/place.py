@@ -34,6 +34,7 @@ import numpy as np
 from nomad_tpu.ops.fit import score_fit
 
 TOP_K = 5  # score_meta entries kept per placement (structs.go:10341 kheap)
+_FILL_GRID = 256   # m-grid for the bulk kernel's exact fill-run length
 
 
 @jax.tree_util.register_dataclass
@@ -307,6 +308,149 @@ def place_batch_jit(capacity: jax.Array, used0: jax.Array, batch: EvalBatch,
 
     used_final, packed = jax.lax.scan(eval_step, used0, batch)
     return packed, used_final
+
+
+def _bulk_scores(capacity, used, demand, feasible, affinity, has_affinity,
+                 desired, penalty, coll, spread_algorithm: bool):
+    """Composite per-node score for one task group with spreads inactive —
+    exactly _place_step's scoring stack minus the spread scorer."""
+    util = used + demand
+    fits = jnp.all(util <= capacity, axis=-1) & feasible
+    fit = score_fit(capacity, util, spread_algorithm) / 18.0
+    total = fit
+    n_scorers = jnp.ones_like(fit)
+    anti = -(coll.astype(jnp.float32) + 1.0) / jnp.maximum(
+        jnp.float32(desired), 1.0)
+    has_coll = coll > 0
+    total = total + jnp.where(has_coll, anti, 0.0)
+    n_scorers = n_scorers + has_coll
+    total = total - penalty
+    n_scorers = n_scorers + penalty
+    aff_on = has_affinity & (affinity != 0.0)
+    total = total + jnp.where(aff_on, affinity, 0.0)
+    n_scorers = n_scorers + aff_on
+    final = total / n_scorers
+    return jnp.where(fits, final, -jnp.inf), fits
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("spread_algorithm", "max_waves"))
+def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
+                   used0: jax.Array,       # f32[N, R]
+                   feasible: jax.Array,    # bool[N]
+                   affinity: jax.Array,    # f32[N]
+                   has_affinity: bool,
+                   desired: jax.Array,     # i32 scalar (tg count)
+                   penalty: jax.Array,     # bool[N]
+                   coll0: jax.Array,       # i32[N] existing co-placements
+                   demand: jax.Array,      # f32[R]
+                   count: jax.Array,       # i32 scalar: instances to place
+                   spread_algorithm: bool = False,
+                   max_waves: int = 4096):
+    """Bulk placement of `count` IDENTICAL slots of one task group
+    (spreads inactive) in O(waves) device steps instead of O(count) scan
+    steps — the C2M-scale path (SURVEY.md §7 "slot-batching smarter than
+    a 100K-step scan").
+
+    Exactness vs the sequential scan: each wave places one instance on
+    every node whose current score strictly exceeds s* = the best
+    post-placement score any node could have — sequential greedy would
+    pick exactly those nodes (in score order) before ever returning to a
+    node it already used this wave, because scores are row-independent.
+    When the wave is a single node that still beats everyone after its
+    own placement (the binpack filling regime), the node is filled with
+    as many instances as fit / remain in one step.  Ties at s* fall back
+    to single placements, preserving the lowest-row tie-break.
+
+    Returns (assign i32[N] — instances per node, placed i32,
+    nodes_evaluated i32, nodes_exhausted i32, final_scores f32[N],
+    used_final f32[N, R]).
+    """
+    N = capacity.shape[0]
+    rows = jnp.arange(N)
+    pos = demand > 0.0
+
+    def cond(c):
+        used, coll, placed, assign, stuck, waves = c
+        return (placed < count) & ~stuck & (waves < max_waves)
+
+    def body(c):
+        used, coll, placed, assign, stuck, waves = c
+        cur, fits = _bulk_scores(capacity, used, demand, feasible,
+                                 affinity, has_affinity, desired, penalty,
+                                 coll, spread_algorithm)
+        any_fit = jnp.any(fits)
+        # post-placement score of every node (row-independent, so adding
+        # the demand to every row evaluates each node's own "+1" world)
+        nxt, fits2 = _bulk_scores(capacity, used + demand, demand,
+                                  feasible, affinity, has_affinity,
+                                  desired, penalty, coll + 1,
+                                  spread_algorithm)
+        s_star = jnp.max(jnp.where(fits2, nxt, -jnp.inf))
+
+        wave = fits & (cur > s_star)
+        best = jnp.argmax(cur)              # lowest row among equals
+        singleton = (rows == best) & any_fit
+        wave = jnp.where(jnp.any(wave), wave, singleton)
+
+        remaining = count - placed
+        # cap the wave at `remaining`, best scores first (rank via argsort)
+        order = jnp.argsort(jnp.where(wave, -cur, jnp.inf))
+        rank = jnp.zeros(N, jnp.int32).at[order].set(rows.astype(jnp.int32))
+        wave = wave & (rank < remaining)
+
+        # singleton filling regime: compute the exact run length —
+        # sequential greedy keeps picking `best` while its score after the
+        # m-th instance stays strictly above the runner-up.  Score(m) is
+        # evaluated in closed form on a vectorized m-grid (anti-affinity
+        # decays linearly, binpack fit rises as the node fills, so the
+        # run ends at the first crossing; non-monotone dips are honored
+        # because the run counts LEADING m's only).
+        second = jnp.max(jnp.where(rows == best, -jnp.inf,
+                                   jnp.where(fits, cur, -jnp.inf)))
+        M = _FILL_GRID
+        ms = jnp.arange(1, M + 1, dtype=jnp.float32)          # m-th inst
+        util_m = used[best][None, :] + ms[:, None] * demand   # [M, R]
+        fits_m = jnp.all(util_m <= capacity[best][None, :], axis=-1)
+        cap_m = jnp.broadcast_to(capacity[best], (M, capacity.shape[1]))
+        fit_m = score_fit(cap_m, util_m, spread_algorithm) / 18.0
+        coll_m = coll[best].astype(jnp.float32) + ms - 1.0
+        total_m = fit_m
+        n_sc = jnp.ones(M)
+        anti_m = -(coll_m + 1.0) / jnp.maximum(jnp.float32(desired), 1.0)
+        has_coll_m = coll_m > 0.0
+        total_m = total_m + jnp.where(has_coll_m, anti_m, 0.0)
+        n_sc = n_sc + has_coll_m
+        total_m = total_m - penalty[best]
+        n_sc = n_sc + penalty[best]
+        aff_on_b = has_affinity & (affinity[best] != 0.0)
+        total_m = total_m + jnp.where(aff_on_b, affinity[best], 0.0)
+        n_sc = n_sc + aff_on_b
+        score_m = total_m / n_sc
+        ok_m = fits_m & (score_m > second)
+        run = jnp.sum(jnp.cumprod(ok_m.astype(jnp.int32))).astype(jnp.int32)
+
+        fill_mode = (jnp.sum(wave) == 1) & wave[best]
+        fill_n = jnp.clip(jnp.maximum(run, 1), 1, remaining)
+        per_node = jnp.where(wave, 1, 0) + jnp.where(
+            fill_mode & (rows == best), fill_n - 1, 0)
+
+        used = used + per_node[:, None].astype(jnp.float32) * demand
+        coll = coll + per_node
+        assign = assign + per_node
+        placed = placed + jnp.sum(per_node)
+        stuck = ~any_fit
+        return (used, coll, placed, assign, stuck, waves + 1)
+
+    c0 = (used0, coll0, jnp.int32(0), jnp.zeros(N, jnp.int32),
+          jnp.array(False), jnp.int32(0))
+    used_f, coll_f, placed, assign, _, _ = jax.lax.while_loop(cond, body, c0)
+    final_scores, fits_f = _bulk_scores(capacity, used_f, demand, feasible,
+                                        affinity, has_affinity, desired,
+                                        penalty, coll_f, spread_algorithm)
+    n_eval = jnp.sum(feasible).astype(jnp.int32)
+    n_exh = jnp.sum(feasible & ~fits_f).astype(jnp.int32)
+    return assign, placed, n_eval, n_exh, final_scores, used_f
 
 
 def place_eval(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceResult:

@@ -306,6 +306,41 @@ class GenericScheduler:
                         continue
             slot_requests.append(pr)
 
+        # --- bulk path: groups with many identical slots and no
+        # placement-coupled constraints (spreads / distinct_*) place via
+        # the wavefront kernel in O(waves) steps instead of an
+        # O(slots) scan — the C2M-scale path (ops.place.place_bulk_jit)
+        BULK_MIN = 32
+        by_group: Dict[int, List[PlacementRequest]] = {}
+        for pr in slot_requests:
+            by_group.setdefault(tg_index[pr.task_group], []).append(pr)
+        bulk_results: List[Tuple[int, List[PlacementRequest], object]] = []
+        scan_requests: List[PlacementRequest] = []
+        for gi, prs in by_group.items():
+            g = groups[gi]
+            from nomad_tpu.scheduler.stack import group_dynamic_port_count
+            eligible = (len(prs) >= BULK_MIN and not g.spreads
+                        and not g.distinct_hosts_job
+                        and not g.distinct_hosts_tg
+                        and not g.distinct_property
+                        and not g.static_ports
+                        and group_dynamic_port_count(g.tg) == 0
+                        and not any(t.resources.devices
+                                    for t in g.tg.tasks))
+            if not eligible:
+                scan_requests.extend(prs)
+                continue
+            bulk = self._place_bulk(cm, job, g, prs, allocs_by_tg,
+                                    penalty_nodes, used, stack)
+            bulk_results.append((gi, prs, bulk))
+            # subsequent groups (and the engine) see this usage
+            assign, _placed, _ne, _nx, _scores, used = bulk
+            rows_used = np.flatnonzero(assign)
+            for row in rows_used:
+                deltas.append((int(row),
+                               g.demand * float(assign[row])))
+        slot_requests = scan_requests
+
         slots = [tg_index[pr.task_group] for pr in slot_requests]
         result = None
         if slots:
@@ -490,6 +525,28 @@ class GenericScheduler:
             extra = []
             place_on(pr, row, metric_for(None), preempted=extra)
             account_device_evictions(row, extra)
+
+        # bulk-kernel placements: expand per-node counts onto requests
+        for gi, prs, bulk in bulk_results:
+            assign, placed, n_eval, n_exh, bscores, _used_f = bulk
+            target_rows: List[int] = []
+            for row in np.flatnonzero(assign):
+                target_rows.extend([int(row)] * int(assign[row]))
+            for pr, row in zip(prs, target_rows):
+                m = AllocMetric()
+                m.nodes_evaluated = n_eval
+                m.nodes_exhausted = n_exh
+                if cm.node_ids[row]:
+                    m.populate_score_meta([{
+                        "node_id": cm.node_ids[row],
+                        "norm_score": round(float(bscores[row]), 6)}])
+                place_on(pr, row, m)
+            for pr in prs[len(target_rows):]:
+                m = AllocMetric()
+                m.nodes_evaluated = n_eval
+                m.nodes_exhausted = n_exh
+                if not try_preempt(pr, None):
+                    self._fail_placement(pr, m, "exhausted")
         if result is not None:
             for i, pr in enumerate(slot_requests):
                 row = int(result.node[i])
@@ -500,6 +557,38 @@ class GenericScheduler:
                     extra = []
                     place_on(pr, row, metric_for(i), preempted=extra)
                     account_device_evictions(row, extra)
+
+    def _place_bulk(self, cm, job, g, prs, allocs_by_tg, penalty_nodes,
+                    used, stack):
+        """One wavefront-kernel call placing len(prs) identical slots of
+        group `g` (ops.place.place_bulk_jit).  Returns (assign i32[N],
+        placed, nodes_evaluated, nodes_exhausted, scores f32[N],
+        used_after f32[N, R]) as host arrays."""
+        import jax
+
+        from nomad_tpu.ops.place import place_bulk_jit
+
+        N = cm.n_rows
+        penalty = np.zeros(N, bool)
+        for nid in (penalty_nodes or {}).get(g.tg.name, ()):
+            row = cm.row_of.get(nid)
+            if row is not None:
+                penalty[row] = True
+        coll0 = np.zeros(N, np.int32)
+        for a in allocs_by_tg.get(g.tg.name, []):
+            row = cm.row_of.get(a.node_id)
+            if row is not None:
+                coll0[row] += 1
+        out = place_bulk_jit(
+            np.ascontiguousarray(cm.capacity),
+            np.ascontiguousarray(used.astype(np.float32)),
+            g.feasible, g.affinity.astype(np.float32),
+            bool(g.has_affinity), np.int32(max(g.tg.count, 1)), penalty,
+            coll0, g.demand.astype(np.float32), np.int32(len(prs)),
+            spread_algorithm=stack.spread_algorithm)
+        assign, placed, n_eval, n_exh, scores, used_f = jax.device_get(out)
+        return (np.asarray(assign), int(placed), int(n_eval), int(n_exh),
+                np.asarray(scores), np.asarray(used_f))
 
     def _fail_placement(self, pr: PlacementRequest, metric: AllocMetric,
                         reason: str) -> None:
