@@ -82,6 +82,187 @@ def bench_e2e_spine(n_nodes=1000, n_jobs=50, count=100, workers=16):
     return placed / dt
 
 
+def _batch_job(count, cpu=100, mem=64):
+    from nomad_tpu import mock
+    j = mock.batch_job()
+    tg = j.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.cpu = cpu
+    tg.tasks[0].resources.memory_mb = mem
+    tg.ephemeral_disk.size_mb = 0
+    return j
+
+
+def _service_job(count, cpu=100, mem=64, spread=True, priority=None):
+    from nomad_tpu import mock
+    from nomad_tpu.structs.job import Affinity, Spread
+    j = mock.job()
+    tg = j.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.cpu = cpu
+    tg.tasks[0].resources.memory_mb = mem
+    tg.ephemeral_disk.size_mb = 0
+    if spread:
+        tg.spreads = [Spread("${attr.rack}", 50, ())]
+        tg.affinities = [Affinity("${node.datacenter}", "dc1", "=", 50)]
+    if priority is not None:
+        j.priority = priority
+    return j
+
+
+def _server(workers=8):
+    from nomad_tpu.core.server import Server, ServerConfig
+    s = Server(ServerConfig(num_schedulers=workers, heartbeat_ttl=3600.0,
+                            gc_interval=3600.0))
+    s.start()
+    return s
+
+
+def _fill_nodes(s, n, racks=50, node_fn=None):
+    from nomad_tpu import mock
+    for i in range(n):
+        node = mock.node()
+        node.attributes["rack"] = f"r{i % racks}"
+        if node_fn:
+            node_fn(node, i)
+        s.store.upsert_node(s.next_index(), node)
+
+
+def bench_dev_agent_sim():
+    """configs[0]: 1 service job, 3 task groups, 5-node dev-agent sim —
+    end-to-end registration->placement latency."""
+    from nomad_tpu import mock
+    s = _server(workers=2)
+    try:
+        _fill_nodes(s, 5)
+        lat = []
+        for trial in range(6):
+            j = mock.job()
+            tgs = []
+            for k in range(3):
+                tg = j.task_groups[0].copy() if k else j.task_groups[0]
+                tg.name = f"g{k}"
+                tg.count = 2
+                tgs.append(tg)
+            j.task_groups = tgs
+            t0 = time.time()
+            s.register_job(j)
+            placed = _wait_allocs(s.store, [j], 6, timeout=30)
+            lat.append(time.time() - t0)
+            assert placed == 6, placed
+        lat.sort()
+        log(f"dev-agent sim: p50 register->placed latency "
+            f"{lat[len(lat)//2]*1000:.0f} ms (6 allocs, 3 tgs, 5 nodes)")
+        return lat[len(lat)//2]
+    finally:
+        s.stop()
+
+
+def bench_c2m(n_nodes=10000, n_batch=96, batch_count=1000,
+              n_service=40, service_count=100):
+    """configs[2]: C2M — 10K nodes / 100K allocs, mixed service+batch,
+    spread + node-affinity scoring, through the full spine."""
+    s = _server(workers=8)
+    try:
+        t0 = time.time()
+        _fill_nodes(s, n_nodes)
+        log(f"C2M world build ({n_nodes} nodes): {time.time()-t0:.1f}s")
+        w1, w2 = _batch_job(100), _service_job(50)
+        s.register_job(w1)
+        s.register_job(w2)
+        _wait_allocs(s.store, [w1, w2], 150, timeout=300)
+
+        jobs = [_batch_job(batch_count) for _ in range(n_batch)] + \
+               [_service_job(service_count) for _ in range(n_service)]
+        want = n_batch * batch_count + n_service * service_count
+        t0 = time.time()
+        for j in jobs:
+            s.register_job(j)
+        placed = _wait_allocs(s.store, jobs, want, timeout=600)
+        dt = time.time() - t0
+        log(f"C2M spine: {placed}/{want} allocs in {dt:.1f}s "
+            f"({placed/dt:.0f} allocs/s)")
+        return placed / dt
+    finally:
+        s.stop()
+
+
+def bench_device_constrained(n_nodes=10000):
+    """configs[3]: 10K nodes, half with GPU device groups; jobs with
+    device requests and job anti-affinity."""
+    from nomad_tpu.structs.resources import DeviceRequest, NodeDevice
+    s = _server(workers=8)
+    try:
+        def node_fn(node, i):
+            if i % 2 == 0:
+                node.node_resources.devices = [NodeDevice(
+                    vendor="nvidia", type="gpu", name="a100",
+                    instance_ids=[f"gpu-{i}-0", f"gpu-{i}-1"])]
+        t0 = time.time()
+        _fill_nodes(s, n_nodes, node_fn=node_fn)
+        log(f"device world build: {time.time()-t0:.1f}s")
+        warm = _batch_job(50)
+        warm.task_groups[0].tasks[0].resources.devices = [
+            DeviceRequest(name="gpu", count=1)]
+        s.register_job(warm)
+        _wait_allocs(s.store, [warm], 50, timeout=300)
+
+        jobs = []
+        for _ in range(20):
+            j = _batch_job(100)
+            j.task_groups[0].tasks[0].resources.devices = [
+                DeviceRequest(name="gpu", count=1)]
+            jobs.append(j)
+        want = 20 * 100
+        t0 = time.time()
+        for j in jobs:
+            s.register_job(j)
+        placed = _wait_allocs(s.store, jobs, want, timeout=300)
+        dt = time.time() - t0
+        log(f"device-constrained: {placed}/{want} GPU allocs in {dt:.1f}s "
+            f"({placed/dt:.0f} allocs/s)")
+        return placed / dt
+    finally:
+        s.stop()
+
+
+def bench_preemption_heavy(n_nodes=1000):
+    """configs[4]: cluster at ~95% utilization of low-priority work;
+    high-priority service jobs must preempt across priority tiers."""
+    s = _server(workers=8)
+    try:
+        cfg = s.store.scheduler_config
+        cfg.preemption_config.service_scheduler_enabled = True
+        cfg.preemption_config.batch_scheduler_enabled = True
+        _fill_nodes(s, n_nodes)
+        # fill to ~95%: nodes are 4000cpu/8192mb; 9 allocs x 420cpu = 94.5%
+        fillers = [_batch_job(n_nodes * 3, cpu=420, mem=850)
+                   for _ in range(3)]
+        fillers_prio = []
+        for i, j in enumerate(fillers):
+            j.priority = 20 + i * 10
+            fillers_prio.append(j)
+            s.register_job(j)
+        _wait_allocs(s.store, fillers, n_nodes * 9, timeout=600)
+
+        jobs = [_service_job(50, cpu=420, mem=850, spread=False,
+                             priority=90) for _ in range(10)]
+        want = 500
+        t0 = time.time()
+        for j in jobs:
+            s.register_job(j)
+        placed = _wait_allocs(s.store, jobs, want, timeout=300)
+        dt = time.time() - t0
+        preempted = sum(
+            1 for a in s.store._allocs.values()
+            if a.desired_status == "evict")
+        log(f"preemption-heavy: {placed}/{want} high-prio allocs in "
+            f"{dt:.1f}s ({placed/dt:.0f} allocs/s, {preempted} preempted)")
+        return placed / dt
+    finally:
+        s.stop()
+
+
 def bench_kernel_c2m_scale():
     """Kernel-only: one dense placement scan at 10K-node scale."""
     from nomad_tpu import mock
@@ -119,6 +300,17 @@ def main():
     except Exception as e:          # noqa: BLE001
         log("kernel bench failed:", e)
         kernel_rate = 0.0
+
+    if os.environ.get("BENCH_ALL") == "1":
+        # the full BASELINE.json scenario suite (several minutes)
+        for name, fn in (("dev_agent", bench_dev_agent_sim),
+                         ("c2m", bench_c2m),
+                         ("device", bench_device_constrained),
+                         ("preemption", bench_preemption_heavy)):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001
+                log(f"scenario {name} failed: {e}")
 
     target = 1_000_000 / 30.0       # north-star C2M rate (v5e-8)
     print(json.dumps({

@@ -22,8 +22,10 @@ class Cluster:
                  data_dir: Optional[str] = None):
         self.transport = InMemTransport()
         names = [f"server-{i}" for i in range(n)]
+        # timeouts tolerate multi-hundred-ms GIL pauses (jit compiles in
+        # neighboring tests share the process) without leader flapping
         self.raft_config = raft_config or RaftConfig(
-            heartbeat_interval=0.02, election_timeout=0.1)
+            heartbeat_interval=0.05, election_timeout=0.3)
         self.servers: List[Server] = []
         for nm in names:
             cfg = config or ServerConfig(num_schedulers=2)

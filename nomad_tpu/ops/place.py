@@ -189,12 +189,12 @@ def _place_step(inp: PlaceInputs, spread_algorithm: bool, carry, slot):
 
 def _pack_outputs(node, score, fit_s, n_eval, n_exh, top_n, top_s) -> jax.Array:
     """Pack the per-slot outputs into ONE f32 array [..., S, 5 + 2*TOP_K]
-    (ints bitcast to f32) so the host fetches a single leaf — on
-    high-latency runtimes every device->host leaf is a ~20-35 ms round
-    trip, so 7 leaves vs 1 is the difference between ~240 ms and ~25 ms
-    per dispatch."""
-    as_f = lambda x: jax.lax.bitcast_convert_type(x.astype(jnp.int32),
-                                                  jnp.float32)
+    so the host fetches a single leaf — on high-latency runtimes every
+    device->host leaf is a ~20-35 ms round trip, so 7 leaves vs 1 is the
+    difference between ~240 ms and ~25 ms per dispatch.  Integers are
+    VALUE-encoded as floats (exact below 2^24) — bitcasting them would
+    produce denormals that TPU hardware flushes to zero."""
+    as_f = lambda x: x.astype(jnp.float32)
     return jnp.concatenate([
         as_f(node)[..., None], score[..., None], fit_s[..., None],
         as_f(n_eval)[..., None], as_f(n_exh)[..., None],
@@ -202,9 +202,9 @@ def _pack_outputs(node, score, fit_s, n_eval, n_exh, top_n, top_s) -> jax.Array:
 
 
 def unpack_outputs(packed: np.ndarray):
-    """Host-side inverse of _pack_outputs (numpy views, no copies of the
-    float parts).  packed: f32[..., S, 5 + 2*TOP_K]."""
-    as_i = lambda x: np.ascontiguousarray(x).view(np.int32)
+    """Host-side inverse of _pack_outputs.
+    packed: f32[..., S, 5 + 2*TOP_K]."""
+    as_i = lambda x: np.rint(x).astype(np.int32)
     node = as_i(packed[..., 0])
     score = packed[..., 1]
     fit_s = packed[..., 2]
@@ -450,7 +450,27 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
                                         penalty, coll_f, spread_algorithm)
     n_eval = jnp.sum(feasible).astype(jnp.int32)
     n_exh = jnp.sum(feasible & ~fits_f).astype(jnp.int32)
-    return assign, placed, n_eval, n_exh, final_scores, used_f
+    # pack EVERYTHING into one f32[N, R+3] leaf (one D2H round trip):
+    # cols [0,R) used, col R assign, col R+1 scores, col R+2 scalars in
+    # rows 0-2.  Integers are value-encoded (exact below 2^24); bitcast
+    # encodings become denormals that TPU hardware flushes to zero.
+    as_f = lambda x: x.astype(jnp.float32)
+    scalars = jnp.zeros(N, jnp.float32).at[0].set(as_f(placed)) \
+        .at[1].set(as_f(n_eval)).at[2].set(as_f(n_exh))
+    return jnp.concatenate([used_f, as_f(assign)[:, None],
+                            final_scores[:, None], scalars[:, None]],
+                           axis=-1)
+
+
+def unpack_bulk(packed: np.ndarray):
+    """Host inverse of place_bulk_jit's packed leaf: returns
+    (assign i32[N], placed, n_eval, n_exh, scores f32[N], used f32[N,R])."""
+    R = packed.shape[1] - 3
+    used = packed[:, :R]
+    assign = np.rint(packed[:, R]).astype(np.int32)
+    scores = packed[:, R + 1]
+    s = np.rint(packed[:3, R + 2]).astype(np.int32)
+    return assign, int(s[0]), int(s[1]), int(s[2]), scores, used
 
 
 def place_eval(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceResult:

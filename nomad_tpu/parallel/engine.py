@@ -91,6 +91,9 @@ class PlacementEngine:
         self._cv = threading.Condition()
         self._stop = False
         self._overlay_lock = threading.Lock()
+        # serializes bulk-path basis-read -> kernel -> register windows so
+        # concurrent bulk evals cannot pile onto the same nodes
+        self.bulk_gate = threading.Lock()
         self._overlays: Dict[int, np.ndarray] = {}   # id(cm) -> f32[N, R]
         self._tickets: Dict[int, Tuple[int, List[Tuple[int, np.ndarray]]]] = {}
         self._next_ticket = 1
@@ -118,6 +121,36 @@ class PlacementEngine:
             self._queue.append(req)
             self._cv.notify()
         return req.future.result()
+
+    def register_external(self, cm, contributions) -> int:
+        """Record usage scheduled OUTSIDE the engine (the bulk wavefront
+        path) in the in-flight overlay so engine dispatches see it before
+        the plan commits.  `contributions`: [(row, f32[R])].  Returns a
+        ticket for complete()."""
+        with self._overlay_lock:
+            key = id(cm)
+            overlay = self._overlays.get(key)
+            n = cm.used.shape[0]
+            if overlay is None or overlay.shape[0] < n:
+                grown = np.zeros((n, NUM_RESOURCE_DIMS), np.float32)
+                if overlay is not None:
+                    grown[:overlay.shape[0]] = overlay
+                overlay = self._overlays[key] = grown
+            contribs = []
+            for row, vec in contributions:
+                if row < overlay.shape[0]:
+                    vec = np.asarray(vec, np.float32)
+                    overlay[row] += vec
+                    contribs.append((row, vec))
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._tickets[ticket] = (key, contribs)
+            self.stats["tickets_open"] = len(self._tickets)
+        return ticket
+
+    def basis_for(self, cm) -> np.ndarray:
+        """Public view of committed usage + in-flight overlay."""
+        return self._basis_for(cm)
 
     def complete(self, ticket: int) -> None:
         """Release a placement's in-flight usage (its plan is now either

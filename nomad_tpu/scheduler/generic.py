@@ -166,6 +166,7 @@ class GenericScheduler:
             self.queued_allocs[pr.task_group] = \
                 self.queued_allocs.get(pr.task_group, 0) + 1
 
+        self._ext_tickets: List[int] = []
         try:
             if not stopped and results.place:
                 self._compute_placements(results.place, results.stop +
@@ -182,6 +183,13 @@ class GenericScheduler:
             if getattr(self, "_stack", None) is not None:
                 self._stack.release()
                 self._stack = None
+            if self._ext_tickets:
+                from nomad_tpu.parallel.engine import get_engine
+                eng = get_engine()
+                if eng is not None:
+                    for t in self._ext_tickets:
+                        eng.complete(t)
+                self._ext_tickets = []
         adjust_queued_allocations(self.plan_result, self.queued_allocs)
 
         full, expected, actual = self.plan_result.full_commit(self.plan)
@@ -255,9 +263,14 @@ class GenericScheduler:
         self._last_feasible_union = np.any(
             np.stack([g.feasible for g in groups]), axis=0)
 
-        # proposed-usage basis: committed usage minus what this plan stops;
-        # `deltas` mirrors every adjustment sparsely for the batching engine
-        used = cm.used.copy()
+        # proposed-usage basis: committed usage PLUS the engine's in-flight
+        # overlay (placements of concurrently scheduled, not-yet-committed
+        # plans) minus what this plan stops; `deltas` mirrors every
+        # adjustment sparsely for the batching engine
+        from nomad_tpu.parallel.engine import get_engine
+        _eng = get_engine()
+        used = _eng.basis_for(cm) if _eng is not None \
+            and cm.used.shape[0] == cm.capacity.shape[0] else cm.used.copy()
         deltas: List[Tuple[int, np.ndarray]] = []
         freed_ports: Dict[int, Set[int]] = {}
         stopped_ids: Set[str] = set()
@@ -306,11 +319,15 @@ class GenericScheduler:
                         continue
             slot_requests.append(pr)
 
-        # --- bulk path: groups with many identical slots and no
+        # --- bulk path: groups with MANY identical slots and no
         # placement-coupled constraints (spreads / distinct_*) place via
         # the wavefront kernel in O(waves) steps instead of an
-        # O(slots) scan — the C2M-scale path (ops.place.place_bulk_jit)
-        BULK_MIN = 32
+        # O(slots) scan — the C2M-scale path (ops.place.place_bulk_jit).
+        # Below the threshold the chained engine amortizes device round
+        # trips across concurrent evals better than one serialized bulk
+        # call per eval (crossover ~= scan steps x step-cost vs one
+        # round trip on a high-latency runtime).
+        BULK_MIN = 512
         by_group: Dict[int, List[PlacementRequest]] = {}
         for pr in slot_requests:
             by_group.setdefault(tg_index[pr.task_group], []).append(pr)
@@ -330,15 +347,16 @@ class GenericScheduler:
             if not eligible:
                 scan_requests.extend(prs)
                 continue
-            bulk = self._place_bulk(cm, job, g, prs, allocs_by_tg,
-                                    penalty_nodes, used, stack)
+            bulk, ticket = self._place_bulk(cm, job, g, prs, allocs_by_tg,
+                                            penalty_nodes, deltas, stack)
             bulk_results.append((gi, prs, bulk))
-            # subsequent groups (and the engine) see this usage
+            if ticket is not None:
+                self._ext_tickets.append(ticket)
+            # subsequent groups + host bookkeeping see this usage (the
+            # engine sees it through the overlay ticket, NOT deltas —
+            # deltas stay stops/preplacements only, or the engine would
+            # double-count)
             assign, _placed, _ne, _nx, _scores, used = bulk
-            rows_used = np.flatnonzero(assign)
-            for row in rows_used:
-                deltas.append((int(row),
-                               g.demand * float(assign[row])))
         slot_requests = scan_requests
 
         slots = [tg_index[pr.task_group] for pr in slot_requests]
@@ -559,15 +577,20 @@ class GenericScheduler:
                     account_device_evictions(row, extra)
 
     def _place_bulk(self, cm, job, g, prs, allocs_by_tg, penalty_nodes,
-                    used, stack):
+                    deltas, stack):
         """One wavefront-kernel call placing len(prs) identical slots of
-        group `g` (ops.place.place_bulk_jit).  Returns (assign i32[N],
-        placed, nodes_evaluated, nodes_exhausted, scores f32[N],
-        used_after f32[N, R]) as host arrays."""
+        group `g` (ops.place.place_bulk_jit).  Runs under the engine's
+        bulk gate: the usage basis (committed + in-flight overlay) is
+        read, the kernel runs, and the resulting placements register in
+        the overlay atomically w.r.t. other bulk evals.  Returns
+        ((assign i32[N], placed, nodes_evaluated, nodes_exhausted,
+        scores f32[N], used_after f32[N, R]), overlay ticket or None)."""
         import jax
 
-        from nomad_tpu.ops.place import place_bulk_jit
+        from nomad_tpu.ops.place import place_bulk_jit, unpack_bulk
+        from nomad_tpu.parallel.engine import get_engine
 
+        eng = get_engine()
         N = cm.n_rows
         penalty = np.zeros(N, bool)
         for nid in (penalty_nodes or {}).get(g.tg.name, ()):
@@ -579,16 +602,34 @@ class GenericScheduler:
             row = cm.row_of.get(a.node_id)
             if row is not None:
                 coll0[row] += 1
-        out = place_bulk_jit(
-            np.ascontiguousarray(cm.capacity),
-            np.ascontiguousarray(used.astype(np.float32)),
-            g.feasible, g.affinity.astype(np.float32),
-            bool(g.has_affinity), np.int32(max(g.tg.count, 1)), penalty,
-            coll0, g.demand.astype(np.float32), np.int32(len(prs)),
-            spread_algorithm=stack.spread_algorithm)
-        assign, placed, n_eval, n_exh, scores, used_f = jax.device_get(out)
-        return (np.asarray(assign), int(placed), int(n_eval), int(n_exh),
-                np.asarray(scores), np.asarray(used_f))
+
+        import contextlib
+        gate = eng.bulk_gate if eng is not None else contextlib.nullcontext()
+        with gate:
+            if eng is not None and cm.used.shape[0] == N:
+                base = eng.basis_for(cm)
+            else:
+                base = cm.used.copy()
+            for row, vec in deltas:       # this eval's stops/preplacements
+                if row < N:
+                    base[row] += vec
+            packed = place_bulk_jit(
+                np.ascontiguousarray(cm.capacity),
+                np.ascontiguousarray(base.astype(np.float32)),
+                g.feasible, g.affinity.astype(np.float32),
+                bool(g.has_affinity), np.int32(max(g.tg.count, 1)), penalty,
+                coll0, g.demand.astype(np.float32), np.int32(len(prs)),
+                spread_algorithm=stack.spread_algorithm)
+            assign, placed, n_eval, n_exh, scores, used_f = \
+                unpack_bulk(jax.device_get(packed))
+            ticket = None
+            if eng is not None:
+                contribs = [(int(row), g.demand * float(assign[row]))
+                            for row in np.flatnonzero(assign)]
+                if contribs:
+                    ticket = eng.register_external(cm, contribs)
+        return ((assign, int(placed), int(n_eval), int(n_exh),
+                 np.asarray(scores), np.asarray(used_f)), ticket)
 
     def _fail_placement(self, pr: PlacementRequest, metric: AllocMetric,
                         reason: str) -> None:

@@ -7,7 +7,7 @@ import pytest
 
 from nomad_tpu import mock
 from nomad_tpu.encode import ClusterMatrix
-from nomad_tpu.ops.place import place_bulk_jit, place_eval
+from nomad_tpu.ops.place import place_bulk_jit, place_eval, unpack_bulk
 from nomad_tpu.scheduler.stack import DenseStack
 
 
@@ -50,13 +50,14 @@ def _run_both(cm, count, cpu=500, mem=256, existing=None):
         row = cm.row_of.get(a.node_id)
         if row is not None:
             coll0[row] += 1
-    out = place_bulk_jit(
+    packed = place_bulk_jit(
         np.ascontiguousarray(cm.capacity),
         np.ascontiguousarray(cm.used.astype(np.float32)),
         g.feasible, g.affinity.astype(np.float32), bool(g.has_affinity),
         np.int32(max(tg.count, 1)), np.zeros(cm.n_rows, bool), coll0,
         g.demand.astype(np.float32), np.int32(count))
-    assign, placed, n_eval, n_exh, scores, used_f = jax.device_get(out)
+    assign, placed, n_eval, n_exh, scores, used_f = unpack_bulk(
+        jax.device_get(packed))
     return scan_counts, np.asarray(assign).astype(np.int64), int(placed)
 
 
@@ -109,11 +110,15 @@ def test_generic_scheduler_uses_bulk_path():
     for _ in range(16):
         h.store.upsert_node(h.next_index(), mock.node())
     job = mock.batch_job()
-    job.task_groups[0].count = 120
+    tg = job.task_groups[0]
+    tg.count = 600                  # >= BULK_MIN
+    tg.tasks[0].resources.cpu = 50
+    tg.tasks[0].resources.memory_mb = 100
+    tg.ephemeral_disk.size_mb = 0
     h.store.upsert_job(h.next_index(), job)
     h.process("batch", mock.eval(job_id=job.id, type="batch"))
     allocs = h.store.allocs_by_job("default", job.id)
-    assert len(allocs) == 120
+    assert len(allocs) == 600
     # usage actually committed and within capacity
     assert (h.store.matrix.used <= h.store.matrix.capacity + 1e-3).all()
     # placement metadata present
