@@ -227,6 +227,9 @@ class PlanApplier:
             if ok[i] and not self._csi_claims_ok(
                     plan.node_allocation[node_id], pending_writers):
                 ok[i] = False
+            if ok[i] and not self._device_claims_ok(
+                    plan, node_id, plan.node_allocation[node_id]):
+                ok[i] = False
         for i, node_id in enumerate(node_ids):
             if ok[i]:
                 result.node_allocation[node_id] = \
@@ -280,6 +283,38 @@ class PlanApplier:
                                 holder.job_id != job.id:
                             return False
                 pending_writers.setdefault(key, set()).add(job.id)
+        return True
+
+    def _device_claims_ok(self, plan: Plan, node_id: str,
+                          allocs: List[Allocation]) -> bool:
+        """Device instance exclusivity at commit (the reference's
+        DeviceAccounter collision check, structs/devices.go): the plan's
+        placements must not claim instance ids already held by live
+        allocs on the node (minus the plan's own stops/evictions) or by
+        each other."""
+        wanted: Dict[str, Set[str]] = {}
+        any_dev = False
+        for a in allocs:
+            for tr in a.allocated_resources.tasks.values():
+                for d in tr.devices:
+                    any_dev = True
+                    gid = f"{d['vendor']}/{d['type']}/{d['name']}"
+                    ids = set(d.get("device_ids", []))
+                    if ids & wanted.get(gid, set()):
+                        return False          # duplicate within the plan
+                    wanted.setdefault(gid, set()).update(ids)
+        if not any_dev:
+            return True
+        dropped = {a.id for a in plan.node_update.get(node_id, [])}
+        dropped |= {a.id for a in plan.node_preemptions.get(node_id, [])}
+        for live in self.store.allocs_by_node(node_id):
+            if live.terminal_status() or live.id in dropped:
+                continue
+            for tr in live.allocated_resources.tasks.values():
+                for d in tr.devices:
+                    gid = f"{d['vendor']}/{d['type']}/{d['name']}"
+                    if set(d.get("device_ids", ())) & wanted.get(gid, set()):
+                        return False
         return True
 
     # ------------------------------------------------------------- commit

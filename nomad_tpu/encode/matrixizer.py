@@ -69,8 +69,9 @@ class ClusterMatrix:
         self.port_words = np.zeros((cap, _PORT_WORDS), dtype=np.uint32)
         self.dyn_port_lo = np.full(cap, 20000, dtype=np.int32)
         self.dyn_port_hi = np.full(cap, 32000, dtype=np.int32)
-        # device-group id -> i32[N] instance capacity per node
+        # device-group id -> i32[N] instance capacity / committed usage
         self.device_caps: Dict[str, np.ndarray] = {}
+        self.device_used: Dict[str, np.ndarray] = {}
         # generation counter bumped on any mutation (device cache invalidation)
         self.generation = 0
         # authoritative live-alloc usage, keyed by node id so it survives node
@@ -100,6 +101,9 @@ class ClusterMatrix:
         for k in self.device_caps:
             self.device_caps[k] = np.concatenate(
                 [self.device_caps[k], np.zeros(old, np.int32)])
+        for k in self.device_used:
+            self.device_used[k] = np.concatenate(
+                [self.device_used[k], np.zeros(old, np.int32)])
         self._n_rows = new
 
     # ------------------------------------------------------------- nodes
@@ -149,10 +153,16 @@ class ClusterMatrix:
         # re-apply this node's live-alloc usage (covers allocs that arrived
         # before the node row existed, and node re-registration)
         self.used[row] = 0
-        for vec, ports in self._node_allocs.get(node.id, {}).values():
+        for col in self.device_used.values():
+            col[row] = 0
+        for vec, ports, devs in self._node_allocs.get(node.id, {}).values():
             self.used[row] += vec
             for p in ports:
                 words[p >> 5] |= np.uint32(1 << (p & 31))
+            for gid, cnt in devs.items():
+                col = self.device_used.setdefault(
+                    gid, np.zeros(self._n_rows, dtype=np.int32))
+                col[row] += cnt
         self.port_words[row] = words
         self.generation += 1
         return row
@@ -168,6 +178,8 @@ class ClusterMatrix:
         self.port_words[row] = 0
         for col in self.device_caps.values():
             col[row] = 0
+        for col in self.device_used.values():
+            col[row] = 0
         self.attrs.clear_row(row)
         self._free_rows.append(row)
         self.generation += 1
@@ -177,6 +189,16 @@ class ClusterMatrix:
     @staticmethod
     def _alloc_res_vec(alloc) -> np.ndarray:
         return comparable_vec(alloc.comparable_resources())
+
+    @staticmethod
+    def _alloc_devices(alloc) -> Dict[str, int]:
+        """device group id -> instance count used by this alloc."""
+        out: Dict[str, int] = {}
+        for tr in alloc.allocated_resources.tasks.values():
+            for d in tr.devices:
+                gid = f"{d['vendor']}/{d['type']}/{d['name']}"
+                out[gid] = out.get(gid, 0) + len(d.get("device_ids", []))
+        return out
 
     @staticmethod
     def _alloc_ports(alloc) -> Tuple[int, ...]:
@@ -195,12 +217,16 @@ class ClusterMatrix:
         node_id = self._alloc_node.pop(alloc_id, None)
         if node_id is None:
             return
-        vec, ports = self._node_allocs[node_id].pop(alloc_id)
+        vec, ports, devs = self._node_allocs[node_id].pop(alloc_id)
         row = self.row_of.get(node_id)
         if row is not None:
             self.used[row] -= vec
             for p in ports:
                 self.port_words[row, p >> 5] &= ~np.uint32(1 << (p & 31))
+            for gid, n in devs.items():
+                col = self.device_used.get(gid)
+                if col is not None:
+                    col[row] -= n
 
     def upsert_alloc(self, alloc) -> None:
         """Track / untrack an allocation's resource usage on its node.
@@ -212,13 +238,19 @@ class ClusterMatrix:
         if not alloc.terminal_status() and alloc.node_id:
             vec = self._alloc_res_vec(alloc)
             ports = self._alloc_ports(alloc)
-            self._node_allocs.setdefault(alloc.node_id, {})[alloc.id] = (vec, ports)
+            devs = self._alloc_devices(alloc)
+            self._node_allocs.setdefault(alloc.node_id, {})[alloc.id] = \
+                (vec, ports, devs)
             self._alloc_node[alloc.id] = alloc.node_id
             row = self.row_of.get(alloc.node_id)
             if row is not None:
                 self.used[row] += vec
                 for p in ports:
                     self.port_words[row, p >> 5] |= np.uint32(1 << (p & 31))
+                for gid, n in devs.items():
+                    col = self.device_used.setdefault(
+                        gid, np.zeros(self._n_rows, dtype=np.int32))
+                    col[row] += n
         self.generation += 1
 
     def remove_alloc(self, alloc_id: str) -> None:

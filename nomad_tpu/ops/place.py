@@ -60,6 +60,11 @@ class PlaceInputs:
     spread_wfrac: jax.Array    # f32[G, K] weight / sum(|weights|)
     spread_counts: jax.Array   # f32[G, K, V+1] initial per-value counts
     spread_active: jax.Array   # bool[G, K]
+    # per-(group, node) placement capacity: how many instances of the
+    # group this eval may still put on the node (-1 = unlimited).  Models
+    # consumable per-node resources the R-dims don't cover — device
+    # instances (reference deviceAllocator free counts) — as a carry.
+    place_cap: jax.Array       # i32[G, N]
     # slots
     demand: jax.Array          # f32[S, R]
     slot_tg: jax.Array         # i32[S]
@@ -123,12 +128,12 @@ def _spread_boost(inp: PlaceInputs, g: jax.Array, counts: jax.Array) -> jax.Arra
 
 
 def _place_step(inp: PlaceInputs, spread_algorithm: bool, carry, slot):
-    used, tg_count, spread_counts = carry
+    used, tg_count, spread_counts, place_cap = carry
     g = inp.slot_tg[slot]
     d = inp.demand[slot]
     active = inp.slot_active[slot]
 
-    feas = inp.feasible[g]
+    feas = inp.feasible[g] & (place_cap[g] != 0)
     util = used + d
     fits = jnp.all(util <= inp.capacity, axis=-1) & feas
 
@@ -168,6 +173,8 @@ def _place_step(inp: PlaceInputs, spread_algorithm: bool, carry, slot):
     sel_onehot = (jnp.arange(used.shape[0]) == sel) & ok
     used = used + jnp.where(sel_onehot[:, None], d, 0.0)
     tg_count = tg_count.at[g, sel].add(jnp.where(ok, 1, 0))
+    place_cap = place_cap.at[g, sel].add(
+        jnp.where(ok & (place_cap[g, sel] > 0), -1, 0))
     v = inp.spread_vidx[g, :, sel]                      # i32[K]
     Vp1 = spread_counts.shape[-1]
     upd = jax.nn.one_hot(jnp.minimum(v, Vp1 - 1), Vp1, dtype=spread_counts.dtype)
@@ -184,7 +191,7 @@ def _place_step(inp: PlaceInputs, spread_algorithm: bool, carry, slot):
         top_nodes.astype(jnp.int32),
         top_scores,
     )
-    return (used, tg_count, spread_counts), out
+    return (used, tg_count, spread_counts, place_cap), out
 
 
 def _pack_outputs(node, score, fit_s, n_eval, n_exh, top_n, top_s) -> jax.Array:
@@ -220,9 +227,9 @@ def place_eval_packed_jit(inp: PlaceInputs, spread_algorithm: bool = False):
     """Single-eval kernel with packed output: returns (f32[S, 5+2K]
     packed outputs, f32[N, R] final usage)."""
     S = inp.demand.shape[0]
-    carry0 = (inp.used, inp.tg_count, inp.spread_counts)
+    carry0 = (inp.used, inp.tg_count, inp.spread_counts, inp.place_cap)
     step = functools.partial(_place_step, inp, spread_algorithm)
-    (used, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
+    (used, _, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
     return _pack_outputs(*outs), used
 
 
@@ -231,9 +238,9 @@ def place_eval_jit(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceRes
     """Place all slots of one evaluation.  Shapes are static; callers bucket
     N/G/S/K/V so the jit cache stays small."""
     S = inp.demand.shape[0]
-    carry0 = (inp.used, inp.tg_count, inp.spread_counts)
+    carry0 = (inp.used, inp.tg_count, inp.spread_counts, inp.place_cap)
     step = functools.partial(_place_step, inp, spread_algorithm)
-    (used, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
+    (used, _, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
     node, score, fit_s, n_eval, n_exh, top_n, top_s = outs
     return PlaceResult(node=node, score=score, fit_score=fit_s,
                        nodes_evaluated=n_eval, nodes_exhausted=n_exh,
@@ -263,6 +270,7 @@ class EvalBatch:
     spread_wfrac: jax.Array    # f32[E, G, K]
     spread_counts: jax.Array   # f32[E, G, K, V+1]
     spread_active: jax.Array   # bool[E, G, K]
+    place_cap: jax.Array       # i32[E, G, N]
     demand: jax.Array          # f32[E, S, R]
     slot_tg: jax.Array         # i32[E, S]
     slot_active: jax.Array     # bool[E, S]
@@ -298,12 +306,13 @@ def place_batch_jit(capacity: jax.Array, used0: jax.Array, batch: EvalBatch,
             spread_desired=ev.spread_desired,
             spread_targeted=ev.spread_targeted,
             spread_wfrac=ev.spread_wfrac, spread_counts=ev.spread_counts,
-            spread_active=ev.spread_active, demand=ev.demand,
+            spread_active=ev.spread_active, place_cap=ev.place_cap,
+            demand=ev.demand,
             slot_tg=ev.slot_tg, slot_active=ev.slot_active)
         S = ev.demand.shape[0]
-        carry0 = (used, ev.tg_count, ev.spread_counts)
+        carry0 = (used, ev.tg_count, ev.spread_counts, ev.place_cap)
         step = functools.partial(_place_step, inp, spread_algorithm)
-        (used_f, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
+        (used_f, _, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
         return used_f, _pack_outputs(*outs)
 
     used_final, packed = jax.lax.scan(eval_step, used0, batch)

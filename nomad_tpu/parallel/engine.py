@@ -49,8 +49,8 @@ from nomad_tpu.ops.place import (
 _PER_EVAL_FIELDS = (
     "feasible", "affinity", "has_affinity", "desired_count", "penalty",
     "tg_count", "spread_vidx", "spread_desired", "spread_targeted",
-    "spread_wfrac", "spread_counts", "spread_active", "demand", "slot_tg",
-    "slot_active",
+    "spread_wfrac", "spread_counts", "spread_active", "place_cap",
+    "demand", "slot_tg", "slot_active",
 )
 
 _DELTA_BUCKET_MIN = 8
@@ -93,9 +93,12 @@ class PlacementEngine:
         self._overlay_lock = threading.Lock()
         # serializes bulk-path basis-read -> kernel -> register windows so
         # concurrent bulk evals cannot pile onto the same nodes
-        self.bulk_gate = threading.Lock()
+        self.bulk_gate = threading.RLock()
         self._overlays: Dict[int, np.ndarray] = {}   # id(cm) -> f32[N, R]
+        # id(cm) -> {device gid -> i32[N] in-flight instance counts}
+        self._dev_overlays: Dict[int, Dict[str, np.ndarray]] = {}
         self._tickets: Dict[int, Tuple[int, List[Tuple[int, np.ndarray]]]] = {}
+        self._dev_tickets: Dict[int, Tuple[int, List[Tuple[str, int, int]]]] = {}
         self._next_ticket = 1
         self.stats = {"dispatches": 0, "batched_evals": 0, "single_evals": 0,
                       "max_batch_seen": 0, "tickets_open": 0,
@@ -152,10 +155,54 @@ class PlacementEngine:
         """Public view of committed usage + in-flight overlay."""
         return self._basis_for(cm)
 
+    def register_devices(self, cm, contributions) -> int:
+        """In-flight device instance counts: [(gid, row, count)].
+        Steers concurrent evals away from nodes whose instances are
+        claimed by not-yet-committed plans."""
+        with self._overlay_lock:
+            key = id(cm)
+            per = self._dev_overlays.setdefault(key, {})
+            n = cm.n_rows
+            kept = []
+            for gid, row, count in contributions:
+                col = per.get(gid)
+                if col is None or col.shape[0] < n:
+                    grown = np.zeros(n, np.int32)
+                    if col is not None:
+                        grown[:col.shape[0]] = col
+                    col = per[gid] = grown
+                if row < col.shape[0]:
+                    col[row] += count
+                    kept.append((gid, row, count))
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._dev_tickets[ticket] = (key, kept)
+        return ticket
+
+    def device_overlay(self, cm, gid: str):
+        """i32[N] in-flight instance counts for a device group, or None."""
+        with self._overlay_lock:
+            per = self._dev_overlays.get(id(cm))
+            if not per:
+                return None
+            col = per.get(gid)
+            return None if col is None else col.copy()
+
     def complete(self, ticket: int) -> None:
         """Release a placement's in-flight usage (its plan is now either
         committed into cm.used or abandoned)."""
         with self._overlay_lock:
+            dev_entry = self._dev_tickets.pop(ticket, None)
+            if dev_entry is not None:
+                key, contribs = dev_entry
+                per = self._dev_overlays.get(key, {})
+                for gid, row, count in contribs:
+                    col = per.get(gid)
+                    if col is not None and row < col.shape[0]:
+                        col[row] -= count
+                if not self._dev_tickets:
+                    self._dev_overlays.clear()
+                return
             entry = self._tickets.pop(ticket, None)
             if entry is None:
                 return

@@ -45,7 +45,7 @@ def stack_inputs(inputs) -> PlaceInputs:
 _NODE_AXIS = {
     "capacity": 0, "used": 0,
     "feasible": 1, "affinity": 1, "penalty": 1, "tg_count": 1,
-    "spread_vidx": 2,
+    "spread_vidx": 2, "place_cap": 1,
     "has_affinity": None, "desired_count": None,
     "spread_desired": None, "spread_targeted": None, "spread_wfrac": None,
     "spread_counts": None, "spread_active": None,
@@ -58,6 +58,7 @@ def _input_specs(batched: bool) -> PlaceInputs:
     for name, axis in _NODE_AXIS.items():
         ndim = {"capacity": 2, "used": 2, "feasible": 2, "affinity": 2,
                 "penalty": 2, "tg_count": 2, "spread_vidx": 3,
+                "place_cap": 2,
                 "has_affinity": 1, "desired_count": 1, "spread_desired": 3,
                 "spread_targeted": 2, "spread_wfrac": 2, "spread_counts": 3,
                 "spread_active": 2, "demand": 2, "slot_tg": 1,
@@ -75,14 +76,14 @@ def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
                         shard_offset: jax.Array, carry, slot):
     """One placement step on a node shard (mirrors ops.place._place_step;
     the selection and carry updates go through 'nodes' collectives)."""
-    used, tg_count, spread_counts = carry
+    used, tg_count, spread_counts, place_cap = carry
     g = inp.slot_tg[slot]
     d = inp.demand[slot]
     active = inp.slot_active[slot]
     n_local = used.shape[0]
     global_rows = shard_offset + jnp.arange(n_local)
 
-    feas = inp.feasible[g]
+    feas = inp.feasible[g] & (place_cap[g] != 0)
     util = used + d
     fits = jnp.all(util <= inp.capacity, axis=-1) & feas
 
@@ -130,6 +131,9 @@ def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
     tg_count = tg_count + jnp.where(
         (jnp.arange(tg_count.shape[0]) == g)[:, None] & sel_local[None, :],
         1, 0)
+    place_cap = place_cap - jnp.where(
+        (jnp.arange(place_cap.shape[0]) == g)[:, None]
+        & sel_local[None, :] & (place_cap > 0), 1, 0)
     # selected node's spread value indices: psum of masked gather
     K = inp.spread_vidx.shape[1]
     Vp1 = spread_counts.shape[-1]
@@ -156,7 +160,7 @@ def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
         top_i[order].astype(jnp.int32),
         top_s[order],
     )
-    return (used, tg_count, spread_counts), out
+    return (used, tg_count, spread_counts, place_cap), out
 
 
 def _shard_body(inp: PlaceInputs, spread_algorithm: bool):
@@ -165,10 +169,10 @@ def _shard_body(inp: PlaceInputs, spread_algorithm: bool):
     n_local = inp.used.shape[0]
     shard_offset = idx * n_local
     S = inp.demand.shape[0]
-    carry0 = (inp.used, inp.tg_count, inp.spread_counts)
+    carry0 = (inp.used, inp.tg_count, inp.spread_counts, inp.place_cap)
     step = functools.partial(_place_step_sharded, inp, spread_algorithm,
                              shard_offset)
-    (used, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
+    (used, _, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
     node, score, n_eval, n_exh, top_i, top_s = outs
     return node, score, n_eval, n_exh, top_i, top_s, used
 

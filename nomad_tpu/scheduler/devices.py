@@ -65,6 +65,7 @@ def assign_device_instances(node, allocs, request,
     over device attributes is handled by the caller via
     nomad_tpu.scheduler.feasible.check_operand on dev.attributes.
     """
+    import random as _random
     used = _used_instances(allocs)
     for gid, ids in (extra_used or {}).items():
         used.setdefault(gid, set()).update(ids)
@@ -73,6 +74,12 @@ def assign_device_instances(node, allocs, request,
             continue
         free = [i for i in dev.instance_ids if i not in used.get(dev.id, set())]
         if len(free) >= request.count:
+            # random choice among free instances: concurrent evals that
+            # cannot see each other's in-flight assignments would all
+            # deterministically take the first-free ids and collide at
+            # the applier; random picks make them disjoint with high
+            # probability (the applier still enforces exclusivity)
+            picked = _random.sample(free, request.count)
             return {"vendor": dev.vendor, "type": dev.type, "name": dev.name,
-                    "device_ids": free[:request.count]}
+                    "device_ids": picked}
     return None

@@ -267,6 +267,37 @@ def csi_volume_mask(cm: ClusterMatrix, snapshot, namespace: str,
     return mask
 
 
+def device_place_cap(cm: ClusterMatrix, requests) -> np.ndarray:
+    """i32[N]: how many instances of this group an eval may place per
+    node = min over requests of floor(free_instances / count), counting
+    committed usage plus the engine's in-flight overlay."""
+    cap = np.full(cm.n_rows, 2**30, np.int64)
+    from nomad_tpu.parallel.engine import get_engine
+    eng = get_engine()
+    for req in requests:
+        best = np.zeros(cm.n_rows, np.int64)
+        parts = req.name.split("/")
+        for gid, caps in cm.device_caps.items():
+            vendor, dtype, name = gid.split("/")
+            if len(parts) == 1:
+                match = parts[0] == dtype
+            elif len(parts) == 2:
+                match = parts[0] == dtype and parts[1] == name
+            else:
+                match = ((vendor, dtype, name) == tuple(parts))
+            if not match:
+                continue
+            free = caps.astype(np.int64) - cm.device_used.get(gid, 0)
+            if eng is not None:
+                inflight = eng.device_overlay(cm, gid)
+                if inflight is not None and \
+                        inflight.shape[0] == free.shape[0]:
+                    free = free - inflight
+            best = np.maximum(best, free // max(req.count, 1))
+        cap = np.minimum(cap, best)
+    return np.clip(cap, 0, 2**30).astype(np.int32)
+
+
 def host_volume_mask(cm: ClusterMatrix, volumes) -> np.ndarray:
     """HostVolumeChecker (feasible.go:133): every requested host volume must
     exist; a read-only node volume only satisfies read-only requests."""
@@ -285,7 +316,8 @@ def host_volume_mask(cm: ClusterMatrix, volumes) -> np.ndarray:
     return mask
 
 
-def device_mask(cm: ClusterMatrix, requests) -> np.ndarray:
+def device_mask(cm: ClusterMatrix, requests,
+                include_usage: bool = True) -> np.ndarray:
     """DeviceChecker count feasibility (feasible.go:1192): every device
     request must be satisfiable by some matching device group's capacity.
     Matching follows NodeDeviceResource.ID semantics (type / type/name /
@@ -303,6 +335,17 @@ def device_mask(cm: ClusterMatrix, requests) -> np.ndarray:
             else:
                 match = ((vendor, dtype, name) == tuple(parts))
             if match:
-                ok |= caps >= req.count
+                if include_usage:
+                    free = caps - cm.device_used.get(gid, 0)
+                    from nomad_tpu.parallel.engine import get_engine
+                    eng = get_engine()
+                    if eng is not None:
+                        inflight = eng.device_overlay(cm, gid)
+                        if inflight is not None \
+                                and inflight.shape[0] == free.shape[0]:
+                            free = free - inflight
+                else:
+                    free = caps
+                ok |= free >= req.count
         mask &= ok
     return mask

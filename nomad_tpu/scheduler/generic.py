@@ -253,6 +253,41 @@ class GenericScheduler:
 
     def _compute_placements(self, places: List[PlacementRequest],
                             stops, all_allocs: List[Allocation]) -> None:
+        """Device-requesting evals serialize through the engine's gate:
+        instance picks race-free across workers (basis read, placement,
+        id assignment and overlay registration are atomic), mirroring how
+        bulk evals serialize.  Everything else runs concurrently."""
+        import contextlib
+
+        from nomad_tpu.parallel.engine import get_engine
+        eng = get_engine()
+        device_eval = any(t.resources.devices
+                          for tg in self.job.task_groups
+                          for t in tg.tasks)
+        gate = eng.bulk_gate if (eng is not None and device_eval) \
+            else contextlib.nullcontext()
+        with gate:
+            self._compute_placements_inner(places, stops, all_allocs)
+            if device_eval and eng is not None:
+                contribs = []
+                for node_id, allocs_ in self.plan.node_allocation.items():
+                    row = self.state.matrix.row_of.get(node_id)
+                    if row is None:
+                        continue
+                    for a_ in allocs_:
+                        for tr_ in a_.allocated_resources.tasks.values():
+                            for d_ in tr_.devices:
+                                gid_ = (f"{d_['vendor']}/{d_['type']}/"
+                                        f"{d_['name']}")
+                                contribs.append(
+                                    (gid_, row,
+                                     len(d_.get("device_ids", []))))
+                if contribs:
+                    self._ext_tickets.append(eng.register_devices(
+                        self.state.matrix, contribs))
+
+    def _compute_placements_inner(self, places: List[PlacementRequest],
+                                  stops, all_allocs: List[Allocation]) -> None:
         cm = self.state.matrix
         stack = DenseStack(cm, self.state.scheduler_config,
                            snapshot=self.state)
@@ -397,7 +432,12 @@ class GenericScheduler:
             if not wants:
                 return {}
             from nomad_tpu.scheduler.devices import assign_device_instances
-            node_allocs = [a for a in self.state.allocs_by_node(node.id)
+            # instance ids are picked against the LIVE store view: under
+            # the device gate all prior device plans have committed, so
+            # the freshest state (not this eval's older snapshot) is what
+            # prevents id collisions at the applier
+            live_view = getattr(self.state, "_store", None) or self.state
+            node_allocs = [a for a in live_view.allocs_by_node(node.id)
                            if not a.terminal_status()]
             node_allocs += self.plan.node_allocation.get(node.id, [])
             # allocs this plan already stops or preempts no longer hold
@@ -434,7 +474,8 @@ class GenericScheduler:
             return out
 
         def place_on(pr: PlacementRequest, row: int, metric: AllocMetric,
-                     preempted=None, extra_freed=None) -> bool:
+                     preempted=None, extra_freed=None,
+                     alt_rows=None) -> bool:
             gi = tg_index[pr.task_group]
             tg = job.task_groups[gi]
             node_id = cm.node_ids[row]
@@ -449,8 +490,32 @@ class GenericScheduler:
             devices = assign_devices(pr, tg, node, row, preempted) \
                 if node is not None else {}
             if devices is None:
-                self._fail_placement(pr, metric, "devices exhausted")
-                return False
+                # the dense kernel scores cpu/mem, not per-node device
+                # instances; earlier placements of THIS eval may have
+                # claimed the node's instances — fall back to the next
+                # best candidates from the kernel's top-K (the reference
+                # iterator simply pulls the next node, rank.go:193)
+                alt_list = [] if alt_rows is None else list(alt_rows)
+                for alt in alt_list:
+                    alt = int(alt)
+                    if alt < 0 or alt == row or not cm.node_ids[alt]:
+                        continue
+                    if not groups[gi].feasible[alt]:
+                        continue
+                    d = groups[gi].demand
+                    if not np.all(used[alt] + d <= cm.capacity[alt]):
+                        continue
+                    alt_node = self.state.node_by_id(cm.node_ids[alt])
+                    devices = assign_devices(pr, tg, alt_node, alt,
+                                             preempted) \
+                        if alt_node is not None else {}
+                    if devices is not None:
+                        row, node_id, node = alt, cm.node_ids[alt], alt_node
+                        used[row] += d
+                        break
+                else:
+                    self._fail_placement(pr, metric, "devices exhausted")
+                    return False
             freed = set(freed_ports.get(row, set()))
             if extra_freed:
                 freed |= extra_freed
@@ -498,7 +563,8 @@ class GenericScheduler:
             found = preemptor.find(
                 groups[gi].feasible, groups[gi].demand, used,
                 static_ports=groups[gi].static_ports,
-                feasible_pre_ports=groups[gi].feasible_pre_ports)
+                feasible_pre_ports=groups[gi].feasible_pre_ports,
+                device_blocked=groups[gi].device_blocked)
             if found is None:
                 return False
             row, evicted = found
@@ -573,7 +639,9 @@ class GenericScheduler:
                         self._fail_placement(pr, metric_for(i), "exhausted")
                 else:
                     extra = []
-                    place_on(pr, row, metric_for(i), preempted=extra)
+                    alts = result.top_nodes[i] if result is not None else []
+                    place_on(pr, row, metric_for(i), preempted=extra,
+                             alt_rows=alts)
                     account_device_evictions(row, extra)
 
     def _place_bulk(self, cm, job, g, prs, allocs_by_tg, penalty_nodes,

@@ -120,6 +120,7 @@ class Preemptor:
              used: np.ndarray,
              static_ports: Optional[List[int]] = None,
              feasible_pre_ports: Optional[np.ndarray] = None,
+             device_blocked: Optional[np.ndarray] = None,
              ) -> Optional[Tuple[int, List]]:
         """-> (node row, allocs to preempt) or None.
 
@@ -140,6 +141,13 @@ class Preemptor:
             forced = self._port_forced_evictions(static_ports, port_rows)
             for row in forced:
                 feasible[row] = True   # eligible again via eviction
+        # instance-exhausted device nodes: eligible targets — the actual
+        # device evictions are chosen later by preempt_for_device inside
+        # the placement (PreemptForDevice, preemption.go:472)
+        dev_rows = np.zeros(len(feasible), bool)
+        if device_blocked is not None:
+            dev_rows = np.asarray(device_blocked) & ~feasible
+            feasible |= dev_rows
 
         met, picked, avail_after = preempt_for_task_group(
             self.cand_res, self.cand_prio, self.cand_valid,
@@ -151,10 +159,11 @@ class Preemptor:
         fits_plain = np.all(remaining >= demand, axis=-1)
         no_ports_needed = np.array(
             [r not in forced for r in range(len(fits_plain))])
-        met &= ~(fits_plain & no_ports_needed)
-        # port rows that fit resource-wise still need their forced evictions
+        met &= ~(fits_plain & no_ports_needed & ~dev_rows)
+        # port/device rows that fit resource-wise still need their evictions
         met |= (np.array([r in forced for r in range(len(fits_plain))])
                 & fits_plain & feasible)
+        met |= dev_rows & fits_plain
         picked = np.asarray(picked).copy()
         # fold the forced port evictions into each row's pick set, and
         # re-check resource sufficiency with the combined freed set (the

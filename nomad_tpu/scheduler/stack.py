@@ -80,6 +80,12 @@ class CompiledGroup:
     # and the static ports the group asks for
     feasible_pre_ports: Optional[np.ndarray] = None   # bool[N]
     static_ports: List[int] = field(default_factory=list)
+    # nodes with device COUNT capacity but no free instances: preemption
+    # targets for PreemptForDevice
+    device_blocked: Optional[np.ndarray] = None       # bool[N]
+    # per-node placement capacity for this eval (instances the group may
+    # still place per node; -1 = unlimited)
+    place_cap: Optional[np.ndarray] = None            # i32[N]
 
 
 class DenseStack:
@@ -107,13 +113,13 @@ class DenseStack:
         job_constraints = list(job.constraints)
         tg_constraints = list(tg.constraints)
         drivers = []
+        dev_reqs = []
         affinities = list(job.affinities) + list(tg.affinities)
         for t in tg.tasks:
             tg_constraints += list(t.constraints)
             affinities += list(t.affinities)
             drivers.append(t.driver)
-            for dev in t.resources.devices:
-                mask &= fz.device_mask(cm, [dev])
+            dev_reqs.extend(t.resources.devices)
         constraints = job_constraints + tg_constraints
 
         distinct_hosts_job = any(c.operand == Operand.DISTINCT_HOSTS
@@ -131,7 +137,23 @@ class DenseStack:
             mask &= fz.csi_volume_mask(cm, self.snapshot, job.namespace,
                                        job.id, tg.volumes)
 
+        # device COUNT capacity gates feasibility (reference DeviceChecker,
+        # feasible.go:1192); instance AVAILABILITY applies after the
+        # preemption-eligibility snapshot so device preemption can still
+        # target instance-exhausted nodes
+        if dev_reqs:
+            mask &= fz.device_mask(cm, dev_reqs, include_usage=False)
         feasible_pre_ports = mask.copy()
+        device_blocked = None
+        place_cap = None
+        if dev_reqs:
+            avail = fz.device_mask(cm, dev_reqs)
+            device_blocked = mask & ~avail
+            mask = mask & avail
+            # per-node instance budget for this eval: the kernel's
+            # place_cap carry stops it over-subscribing a node's free
+            # instances within one eval (deviceAllocator free counts)
+            place_cap = fz.device_place_cap(cm, dev_reqs)
         static_ports = group_static_ports(tg)
         if static_ports:
             mask &= cm.static_ports_free(static_ports)
@@ -158,7 +180,9 @@ class DenseStack:
                              distinct_hosts_tg=distinct_hosts_tg,
                              distinct_property=distinct_property,
                              feasible_pre_ports=feasible_pre_ports,
-                             static_ports=static_ports)
+                             static_ports=static_ports,
+                             device_blocked=device_blocked,
+                             place_cap=place_cap)
 
     # ------------------------------------------------------------- assemble
 
@@ -286,6 +310,11 @@ class DenseStack:
                         if row is not None and col.values[row] in rank:
                             scounts[gi, ki, rank[col.values[row]]] += 1
 
+        place_cap = np.full((G, N), -1, np.int32)
+        for gi, g in enumerate(groups):
+            if g.place_cap is not None:
+                place_cap[gi] = g.place_cap
+
         demand = np.zeros((S, R), np.float32)
         slot_tg = np.zeros(S, np.int32)
         slot_active = np.zeros(S, bool)
@@ -302,6 +331,7 @@ class DenseStack:
             desired_count=desired, penalty=penalty, tg_count=tg_count,
             spread_vidx=vidx, spread_desired=sdesired, spread_targeted=stargeted,
             spread_wfrac=swfrac, spread_counts=scounts, spread_active=sactive,
+            place_cap=place_cap,
             demand=demand, slot_tg=slot_tg, slot_active=slot_active,
         )
 
