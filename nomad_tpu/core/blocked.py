@@ -34,6 +34,8 @@ class BlockedEvals:
         # job key -> eval id (one blocked eval per job; dupes cancelled)
         self._jobs: Dict[Tuple[str, str], str] = {}
         self._duplicates: List[Evaluation] = []
+        # eval ids already given their one overlay-drain second chance
+        self._drain_woken: Set[str] = set()
         # (namespace, job) of evals blocked on quota -> quota name
         self._quota: Dict[str, Set[str]] = {}
         # per-class (and global) capacity-change indexes for missed-unblock
@@ -72,6 +74,7 @@ class BlockedEvals:
 
     def block(self, ev: Evaluation) -> None:
         with self._lock:
+
             if not self.enabled:
                 return
             if self._missed_unblock_locked(ev):
@@ -141,6 +144,7 @@ class BlockedEvals:
                 return []
             self._unblock_indexes[computed_class] = max(
                 index, self._unblock_indexes.get(computed_class, 0))
+            self._drain_woken.clear()   # real change: re-arm second chances
             to_release = []
             for eid, ev in list(self._captured.items()):
                 if eid in self._escaped:
@@ -162,6 +166,22 @@ class BlockedEvals:
             self._global_unblock_index = max(self._global_unblock_index, index)
             released = list(self._captured.values())
             for ev in released:
+                self._drop_locked(ev.id)
+            self._drain_woken.clear()   # real change: re-arm second chances
+        self._requeue(released, index)
+        return released
+
+    def unblock_once(self, index: int) -> List[Evaluation]:
+        """Requeue blocked evals that have not been woken by this path
+        before (one second chance per blocked instance).  Used by the
+        engine's overlay-drain hook: an eval that failed against phantom
+        in-flight usage deserves one clean retry, but a genuinely
+        unplaceable eval must not ping-pong forever."""
+        with self._lock:
+            released = [ev for ev in self._captured.values()
+                        if ev.id not in self._drain_woken]
+            for ev in released:
+                self._drain_woken.add(ev.id)
                 self._drop_locked(ev.id)
         self._requeue(released, index)
         return released

@@ -338,6 +338,16 @@ class PlanApplier:
         else:
             index = store.latest_index + 1
             store.upsert_plan_results(index, applied)
+        # release the scheduler's in-flight overlay tickets NOW: the
+        # usage just became committed state, and any window where both
+        # the store and the overlay count it makes concurrent kernels
+        # see phantom usage
+        if plan.engine_tickets:
+            from nomad_tpu.parallel.engine import get_engine
+            eng = get_engine()
+            if eng is not None:
+                for t in plan.engine_tickets:
+                    eng.complete(t)
         result.alloc_index = index
         self.stats["applied"] += 1
 

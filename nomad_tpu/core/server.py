@@ -171,6 +171,14 @@ class Server:
     # ------------------------------------------------------------- lifecycle
 
     def start(self) -> None:
+        # placements can fail against transient in-flight over-reservation
+        # (the engine overlay's double-count window); once the overlay
+        # drains, give blocked evals another chance
+        from nomad_tpu.parallel.engine import get_engine
+        _eng = get_engine()
+        if _eng is not None:
+            _eng.on_drain = lambda: self.blocked_evals.unblock_once(
+                self.store.latest_index)
         if self.membership is not None:
             self.membership.start()
         if self.raft is not None:

@@ -100,6 +100,10 @@ class PlacementEngine:
         self._tickets: Dict[int, Tuple[int, List[Tuple[int, np.ndarray]]]] = {}
         self._dev_tickets: Dict[int, Tuple[int, List[Tuple[str, int, int]]]] = {}
         self._next_ticket = 1
+        # called (outside locks) whenever the in-flight overlay fully
+        # drains: transient over-reservation may have failed placements
+        # that would now succeed, so the server re-queues blocked evals
+        self.on_drain = None
         self.stats = {"dispatches": 0, "batched_evals": 0, "single_evals": 0,
                       "max_batch_seen": 0, "tickets_open": 0,
                       "stack_s": 0.0, "put_s": 0.0, "device_s": 0.0,
@@ -191,6 +195,7 @@ class PlacementEngine:
     def complete(self, ticket: int) -> None:
         """Release a placement's in-flight usage (its plan is now either
         committed into cm.used or abandoned)."""
+        drained = False
         with self._overlay_lock:
             dev_entry = self._dev_tickets.pop(ticket, None)
             if dev_entry is not None:
@@ -202,22 +207,27 @@ class PlacementEngine:
                         col[row] -= count
                 if not self._dev_tickets:
                     self._dev_overlays.clear()
-                return
-            entry = self._tickets.pop(ticket, None)
-            if entry is None:
-                return
-            cm_key, contrib = entry
-            overlay = self._overlays.get(cm_key)
-            if overlay is None:
-                return
-            for row, vec in contrib:
-                if row < overlay.shape[0]:
-                    overlay[row] -= vec
-            self.stats["tickets_open"] = len(self._tickets)
-            if not self._tickets:
-                # nothing in flight: drop overlays entirely so numerical
-                # residue never accumulates
-                self._overlays.clear()
+                    drained = not self._tickets
+            else:
+                entry = self._tickets.pop(ticket, None)
+                if entry is not None:
+                    cm_key, contrib = entry
+                    overlay = self._overlays.get(cm_key)
+                    if overlay is not None:
+                        for row, vec in contrib:
+                            if row < overlay.shape[0]:
+                                overlay[row] -= vec
+                    self.stats["tickets_open"] = len(self._tickets)
+                    if not self._tickets:
+                        # nothing in flight: drop overlays entirely so
+                        # numerical residue never accumulates
+                        self._overlays.clear()
+                        drained = not self._dev_tickets
+        if drained and self.on_drain is not None:
+            try:
+                self.on_drain()
+            except Exception:                   # noqa: BLE001
+                pass
 
     def stop(self) -> None:
         with self._cv:

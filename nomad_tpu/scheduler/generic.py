@@ -176,6 +176,15 @@ class GenericScheduler:
                 self._finish_eval()
                 return True, False
 
+            # the applier releases these overlay tickets atomically with
+            # the commit; the finally below is only the abandoned-plan
+            # safety net (complete() is idempotent)
+            tickets = list(self._ext_tickets)
+            st = getattr(self, "_stack", None)
+            if st is not None and getattr(st, "last_ticket", None) is not None:
+                tickets.append(st.last_ticket)
+            self.plan.engine_tickets = tickets
+
             self.plan_result = self.planner.submit_plan(self.plan)
         finally:
             # release the in-flight usage overlay: the plan is now either
@@ -552,6 +561,8 @@ class GenericScheduler:
         preemption_on = self.state.scheduler_config.preemption_enabled(
             scheduler_type)
 
+        preempt_cache: Dict[int, List] = {}
+
         def try_preempt(pr: PlacementRequest, i: Optional[int]) -> bool:
             nonlocal preemptor
             if not preemption_on:
@@ -560,14 +571,18 @@ class GenericScheduler:
                 from nomad_tpu.scheduler.preemption import Preemptor
                 preemptor = Preemptor(self.state, job.priority)
             gi = tg_index[pr.task_group]
-            found = preemptor.find(
-                groups[gi].feasible, groups[gi].demand, used,
-                static_ports=groups[gi].static_ports,
-                feasible_pre_ports=groups[gi].feasible_pre_ports,
-                device_blocked=groups[gi].device_blocked)
-            if found is None:
+            cache = preempt_cache.setdefault(gi, [])
+            if not cache:
+                # one kernel round serves a batch of failed slots (each
+                # find round trip costs ~a tunnel RTT)
+                cache.extend(preemptor.find_many(
+                    groups[gi].feasible, groups[gi].demand, used, 16,
+                    static_ports=groups[gi].static_ports,
+                    feasible_pre_ports=groups[gi].feasible_pre_ports,
+                    device_blocked=groups[gi].device_blocked))
+            if not cache:
                 return False
-            row, evicted = found
+            row, evicted = cache.pop(0)
             # ports held by the evicted allocs become claimable — but only
             # commit that (and the usage adjustments) if the placement
             # actually lands, else later placements would claim ports of
@@ -696,8 +711,10 @@ class GenericScheduler:
                             for row in np.flatnonzero(assign)]
                 if contribs:
                     ticket = eng.register_external(cm, contribs)
+        # device_get arrays are read-only; later host bookkeeping
+        # (preemption, sticky adds) mutates the usage matrix in place
         return ((assign, int(placed), int(n_eval), int(n_exh),
-                 np.asarray(scores), np.asarray(used_f)), ticket)
+                 np.asarray(scores), np.array(used_f)), ticket)
 
     def _fail_placement(self, pr: PlacementRequest, metric: AllocMetric,
                         reason: str) -> None:

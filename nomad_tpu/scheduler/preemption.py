@@ -64,12 +64,14 @@ class Preemptor:
         self.cand_res = np.zeros((N, A, NUM_RESOURCE_DIMS), np.float32)
         self.cand_prio = np.zeros((N, A), np.int32)
         self.cand_valid = np.zeros((N, A), bool)
+        self._cand_index = {}          # alloc id -> (row, i)
         for row, allocs in enumerate(per_node):
             for i, a in enumerate(allocs):
                 cr = a.comparable_resources()
                 self.cand_res[row, i] = comparable_vec(cr)
                 self.cand_prio[row, i] = a.job.priority if a.job else 50
                 self.cand_valid[row, i] = True
+                self._cand_index[a.id] = (row, i)
         self.max_steps = min(A, 32)
         self._built = True
 
@@ -77,10 +79,10 @@ class Preemptor:
         """Mark allocs chosen for preemption unusable for later slots."""
         if not self._built:
             return
-        for row, allocs in enumerate(self.cand_allocs):
-            for i, a in enumerate(allocs):
-                if a.id in alloc_ids:
-                    self.cand_valid[row, i] = False
+        for aid in alloc_ids:
+            loc = self._cand_index.get(aid)
+            if loc is not None:
+                self.cand_valid[loc[0], loc[1]] = False
 
     # ------------------------------------------------------------- ports
 
@@ -177,20 +179,22 @@ class Preemptor:
             return None
 
         # rank eligible nodes: mean of (binpack fit after preemption) and
-        # the logistic preemption score of the evicted set
+        # the logistic preemption score of the evicted set.  Fit for ALL
+        # nodes in one vectorized call — a per-row eager device op would
+        # cost one host<->device round trip per node
         from nomad_tpu.ops.fit import score_fit
         rows = np.flatnonzero(met)
+        freed_all = (self.cand_res * picked[:, :, None]).sum(axis=1)
+        util_after = used - freed_all + demand[None, :]
+        fit_all = np.asarray(score_fit(
+            cm.capacity, util_after.astype(np.float32), False)) / 18.0
         best_row, best_score = -1, -np.inf
         for row in rows:
             evicted = [self.cand_allocs[row][i]
                        for i in np.flatnonzero(picked[row])]
-            freed = self.cand_res[row][picked[row]].sum(axis=0)
-            util_after = used[row] - freed + demand
-            fit = float(np.asarray(score_fit(
-                cm.capacity[row:row + 1], util_after[None, :], False))[0]) / 18.0
             p_score = preemption_score(net_priority(
                 [a.job.priority if a.job else 50 for a in evicted]))
-            score = (fit + p_score) / 2.0
+            score = (float(fit_all[row]) + p_score) / 2.0
             if score > best_score:
                 best_score, best_row = score, int(row)
 
@@ -201,6 +205,44 @@ class Preemptor:
         evicted = self._superset_filter(
             evicted, remaining[best_row], demand, protected)
         return best_row, evicted
+
+    def find_many(self, feasible: np.ndarray, demand: np.ndarray,
+                  used: np.ndarray, count: int,
+                  static_ports: Optional[List[int]] = None,
+                  feasible_pre_ports: Optional[np.ndarray] = None,
+                  device_blocked: Optional[np.ndarray] = None,
+                  ) -> List[Tuple[int, List]]:
+        """Up to `count` preemption assignments from ONE kernel round.
+        Eviction sets on distinct rows are disjoint (an alloc lives on one
+        node), so the round's ranked rows can serve `count` slots without
+        paying one device round trip per slot; later rounds (triggered by
+        the caller when this batch is exhausted) see updated usage and
+        invalidated candidates."""
+        first = self.find(feasible, demand, used,
+                          static_ports=static_ports,
+                          feasible_pre_ports=feasible_pre_ports,
+                          device_blocked=device_blocked)
+        if first is None:
+            return []
+        out: List[Tuple[int, List]] = [first]
+        row0 = first[0]
+        for row, picked, forced, remaining in getattr(
+                self, "_last_ranked", []):
+            if len(out) >= count:
+                break
+            if row == row0:
+                continue
+            evicted = [self.cand_allocs[row][i]
+                       for i in np.flatnonzero(picked[row])
+                       if self.cand_valid[row, i]]
+            if not evicted:
+                continue
+            protected = {self.cand_allocs[row][i].id
+                         for i in forced.get(row, ())}
+            evicted = self._superset_filter(
+                evicted, remaining[row], demand, protected)
+            out.append((row, evicted))
+        return out
 
     # ------------------------------------------------------------- devices
 

@@ -45,6 +45,10 @@ class Plan:
     deployment_updates: List[dict] = field(default_factory=list)
     annotations: Optional[PlanAnnotations] = None
     snapshot_index: int = 0
+    # in-flight overlay tickets of the PlacementEngine covering this
+    # plan's placements; the applier releases them atomically with the
+    # commit (closing the committed+overlaid double-count window)
+    engine_tickets: List[int] = field(default_factory=list)
 
     def append_stopped_alloc(self, alloc: Allocation, desired_desc: str,
                              client_status: str = "", followup_eval_id: str = "") -> None:
