@@ -35,6 +35,9 @@ class PlanApplier:
         # ApplyPlanResultsRequest, plan_apply.go:204); None = direct store
         # write (the scheduler Harness mode, testing.go:180)
         self._commit_fn = commit_fn
+        # called after a commit that evicted allocs (the preempted list);
+        # the server creates PreemptionEvals here, outside the raft lock
+        self.on_preempted = None
         self._lock = threading.Lock()
         # pipelining overlay: accepted-but-not-yet-committed plan effects,
         # keyed by plan eval token/id (reference plan_apply.go:71-178
@@ -350,6 +353,11 @@ class PlanApplier:
                     eng.complete(t)
         result.alloc_index = index
         self.stats["applied"] += 1
+        if applied.allocs_preempted and self.on_preempted is not None:
+            try:
+                self.on_preempted(applied.allocs_preempted)
+            except Exception:                  # noqa: BLE001
+                pass
 
 
 def _alloc_ports(a: Allocation) -> List[int]:

@@ -79,6 +79,11 @@ class Server:
         self.blocked_evals = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
         self.applier = PlanApplier(self.store, commit_fn=self._commit_plan)
+        # PreemptionEvals are created by the applier AFTER the raft apply
+        # returns (reference plan_apply.go applyPlan) — creating them from
+        # inside the FSM's state-change watcher would re-enter the raft
+        # write path under its own lock and deadlock the commit
+        self.applier.on_preempted = self._create_preemption_evals
         self.workers: List[Worker] = []
         self.remote_workers: List[Worker] = []
         self._raft_lock = threading.Lock()     # serializes indexed writes
@@ -177,7 +182,7 @@ class Server:
         from nomad_tpu.parallel.engine import get_engine
         _eng = get_engine()
         if _eng is not None:
-            _eng.on_drain = lambda: self.blocked_evals.unblock_once(
+            _eng.on_drain = lambda: self.blocked_evals.unblock_all(
                 self.store.latest_index)
         if self.membership is not None:
             self.membership.start()
@@ -354,22 +359,31 @@ class Server:
                 if node is not None:
                     self.blocked_evals.unblock(node.computed_class,
                                                self.store.latest_index)
-            # preempted allocs need their job rescheduled (the reference
-            # creates PreemptionEvals in applyPlan, plan_apply.go:204+)
-            if a.preempted_by_allocation and a.desired_status == "evict" \
-                    and not getattr(a, "_preemption_eval_created", False):
-                a._preemption_eval_created = True
-                job = a.job or self.store.job_by_id(a.namespace, a.job_id)
-                if job is not None and not job.stopped():
-                    self.create_evals([Evaluation(
-                        namespace=a.namespace, priority=job.priority,
-                        type=job.type, job_id=job.id,
-                        triggered_by=EvalTrigger.PREEMPTION,
-                        status=EvalStatus.PENDING)])
 
     # ------------------------------------------------------------- API ops
     # (these are what the RPC endpoints call; reference nomad/job_endpoint.go,
     #  node_endpoint.go, eval_endpoint.go)
+
+    def _create_preemption_evals(self, preempted) -> None:
+        """One reschedule eval per job whose allocs were preempted
+        (reference CreatePreemptionEvals, plan_apply.go:204+)."""
+        seen = set()
+        evals = []
+        for a in preempted:
+            key = (a.namespace, a.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            job = a.job or self.store.job_by_id(a.namespace, a.job_id)
+            if job is None or job.stopped():
+                continue
+            evals.append(Evaluation(
+                namespace=a.namespace, priority=job.priority,
+                type=job.type, job_id=job.id,
+                triggered_by=EvalTrigger.PREEMPTION,
+                status=EvalStatus.PENDING))
+        if evals:
+            self.create_evals(evals)
 
     def update_eval(self, ev: Evaluation) -> None:
         self.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})

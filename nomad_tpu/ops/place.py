@@ -355,7 +355,7 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
                    demand: jax.Array,      # f32[R]
                    count: jax.Array,       # i32 scalar: instances to place
                    spread_algorithm: bool = False,
-                   max_waves: int = 4096):
+                   max_waves: int = 65536):
     """Bulk placement of `count` IDENTICAL slots of one task group
     (spreads inactive) in O(waves) device steps instead of O(count) scan
     steps — the C2M-scale path (SURVEY.md §7 "slot-batching smarter than
@@ -370,6 +370,10 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
     own placement (the binpack filling regime), the node is filled with
     as many instances as fit / remain in one step.  Ties at s* fall back
     to single placements, preserving the lowest-row tie-break.
+
+    max_waves is a runaway guard only — it must exceed any realistic
+    count, because packed clusters can degrade to one placement per wave
+    and an exhausted guard silently strands unplaced slots.
 
     Returns (assign i32[N] — instances per node, placed i32,
     nodes_evaluated i32, nodes_exhausted i32, final_scores f32[N],

@@ -101,3 +101,40 @@ def preemption_score(net_prio: float) -> float:
     import math
     rate, origin = 0.0048, 2048.0
     return 1.0 / (1.0 + math.exp(rate * (net_prio - origin)))
+
+
+def preempt_for_task_group_np(cand_res, cand_prio, cand_valid, remaining,
+                              ask, max_steps: int = 16):
+    """Numpy twin of preempt_for_task_group, used on the scheduler-worker
+    host path: worker threads must not issue device work concurrently
+    with the PlacementEngine's dispatcher (single-dispatch-thread
+    discipline — concurrent fetches can wedge on tunneled runtimes), and
+    at N x A x steps this selection is trivial host math anyway."""
+    import numpy as np
+
+    N, A, R = cand_res.shape
+    picked = np.zeros((N, A), bool)
+    needed = np.broadcast_to(ask, (N, R)).copy()
+    avail = remaining.astype(np.float32).copy()
+    met = np.all(avail >= ask, axis=-1)
+    INT_MAX = np.int32(2**31 - 1)
+    BIGF = np.float32(3.4e38)
+    for _ in range(max_steps):
+        open_ = cand_valid & ~picked
+        prio_masked = np.where(open_, cand_prio, INT_MAX)
+        min_prio = prio_masked.min(axis=1)                    # [N]
+        tier = open_ & (cand_prio == min_prio[:, None])
+        askp = needed[:, None, :]                             # [N,1,R]
+        coord = np.where(askp > 0.0,
+                         (askp - cand_res) / np.maximum(askp, 1e-9), 0.0)
+        dist = np.sqrt((coord * coord).sum(axis=-1))          # [N, A]
+        dist = np.where(tier, dist, BIGF)
+        pick = dist.argmin(axis=1)                            # [N]
+        can_pick = tier.any(axis=1) & ~met
+        onehot = (np.arange(A)[None, :] == pick[:, None]) & can_pick[:, None]
+        picked |= onehot
+        freed = (cand_res * onehot[:, :, None]).sum(axis=1)
+        avail += freed
+        needed -= freed
+        met |= np.all(avail >= ask, axis=-1)
+    return met, picked, avail

@@ -192,9 +192,11 @@ class PlacementEngine:
             col = per.get(gid)
             return None if col is None else col.copy()
 
-    def complete(self, ticket: int) -> None:
+    def complete(self, ticket) -> None:
         """Release a placement's in-flight usage (its plan is now either
         committed into cm.used or abandoned)."""
+        if ticket is None:
+            return
         drained = False
         with self._overlay_lock:
             dev_entry = self._dev_tickets.pop(ticket, None)
@@ -238,10 +240,16 @@ class PlacementEngine:
     # ------------------------------------------------------------- overlay
 
     def _basis_for(self, cm) -> np.ndarray:
-        """cm.used + in-flight overlay (copy), under the overlay lock."""
+        """cm.used + in-flight overlay (copy).  The committed matrix is
+        copied under ITS owner's lock: a copy taken mid-commit would see
+        a plan half in the matrix while the overlay still counts it fully
+        — phantom usage that silently shrinks placements."""
+        import contextlib
+        cm_lock = getattr(cm, "lock", None) or contextlib.nullcontext()
         with self._overlay_lock:
+            with cm_lock:
+                used = np.array(cm.used, dtype=np.float32)
             overlay = self._overlays.get(id(cm))
-            used = np.array(cm.used, dtype=np.float32)
             if overlay is not None:
                 n = min(overlay.shape[0], used.shape[0])
                 used[:n] += overlay[:n]
@@ -258,6 +266,11 @@ class PlacementEngine:
         for row, vec in req.deltas:
             if vec.max(initial=0.0) > 0.0 and (vec >= 0.0).all():
                 contrib.append((row, vec))    # sticky pre-placement adds
+        if not contrib:
+            # nothing placed: no overlay entry, no ticket — otherwise a
+            # permanently-unplaceable eval would drain the overlay on
+            # every retry and busy-loop the blocked-eval wakeups
+            return None
         with self._overlay_lock:
             key = id(req.cm)
             overlay = self._overlays.get(key)

@@ -301,6 +301,7 @@ class NomadFSM:
             s._csi_volumes = dict(data.get("csi_volumes", {}))
             s._csi_plugins = dict(data.get("csi_plugins", {}))
             s.matrix = ClusterMatrix()
+            s.matrix.lock = s._lock
             for n in data["nodes"]:
                 s.matrix.upsert_node(n)
             for a in data["allocs"]:

@@ -465,7 +465,8 @@ class GenericScheduler:
                     nonlocal preemptor
                     if preemptor is None:
                         from nomad_tpu.scheduler.preemption import Preemptor
-                        preemptor = Preemptor(self.state, job.priority)
+                        preemptor = Preemptor(self.state, job.priority,
+                                              seed=self.eval.id)
                     extra = preemptor.preempt_for_device(
                         node, live, req, exclude=evicted_ids)
                     if extra:
@@ -569,7 +570,8 @@ class GenericScheduler:
                 return False
             if preemptor is None:
                 from nomad_tpu.scheduler.preemption import Preemptor
-                preemptor = Preemptor(self.state, job.priority)
+                preemptor = Preemptor(self.state, job.priority,
+                                      seed=self.eval.id)
             gi = tg_index[pr.task_group]
             cache = preempt_cache.setdefault(gi, [])
             if not cache:

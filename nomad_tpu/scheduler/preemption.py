@@ -29,18 +29,37 @@ import numpy as np
 from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS, comparable_vec, pad_to_bucket
 from nomad_tpu.ops.preempt import (
     net_priority,
-    preempt_for_task_group,
+    preempt_for_task_group_np,
     preemption_score,
 )
 
 PRIORITY_DELTA = 10   # preemption.go:663-697: need >= 10 priority gap
 
 
+def _score_fit_np(capacity, util):
+    """Numpy twin of ops.fit.score_fit (binpack) for the host ranking
+    path — worker threads stay off the device."""
+    from nomad_tpu.encode.matrixizer import RES_CPU, RES_MEM
+    cap = capacity[:, (RES_CPU, RES_MEM)].astype(np.float64)
+    use = util[:, (RES_CPU, RES_MEM)].astype(np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        frac = 1.0 - use / cap
+    zero = cap <= 0.0
+    frac = np.where(zero & (use > 0.0), -np.inf, frac)
+    frac = np.where(zero & (use <= 0.0), 1.0, frac)
+    total = np.power(10.0, frac).sum(axis=-1)
+    return np.clip(20.0 - total, 0.0, 18.0).astype(np.float32)
+
+
 class Preemptor:
-    def __init__(self, snapshot, job_priority: int):
+    def __init__(self, snapshot, job_priority: int, seed: str = ""):
         self.snapshot = snapshot
         self.cm = snapshot.matrix
         self.job_priority = job_priority
+        # per-eval decorrelation seed (the reference's seeded node shuffle,
+        # util.go:464-486): concurrent evals must not all rank the same
+        # victims first or only one plan per round survives the applier
+        self._seed = seed
         self._built = False
         self.already_preempted: Set[str] = set()
 
@@ -151,7 +170,7 @@ class Preemptor:
             dev_rows = np.asarray(device_blocked) & ~feasible
             feasible |= dev_rows
 
-        met, picked, avail_after = preempt_for_task_group(
+        met, picked, avail_after = preempt_for_task_group_np(
             self.cand_res, self.cand_prio, self.cand_valid,
             remaining.astype(np.float32), demand.astype(np.float32),
             max_steps=self.max_steps)
@@ -182,12 +201,10 @@ class Preemptor:
         # the logistic preemption score of the evicted set.  Fit for ALL
         # nodes in one vectorized call — a per-row eager device op would
         # cost one host<->device round trip per node
-        from nomad_tpu.ops.fit import score_fit
         rows = np.flatnonzero(met)
         freed_all = (self.cand_res * picked[:, :, None]).sum(axis=1)
         util_after = used - freed_all + demand[None, :]
-        fit_all = np.asarray(score_fit(
-            cm.capacity, util_after.astype(np.float32), False)) / 18.0
+        fit_all = _score_fit_np(cm.capacity, util_after) / 18.0
         best_row, best_score = -1, -np.inf
         for row in rows:
             evicted = [self.cand_allocs[row][i]

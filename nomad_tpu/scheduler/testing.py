@@ -23,6 +23,7 @@ class Harness:
     def __init__(self, store: Optional[StateStore] = None):
         self.store = store or StateStore()
         self.applier = PlanApplier(self.store)
+        self.applier.on_preempted = self._preemption_evals
         self.plans: List[Plan] = []
         self.results: List[PlanResult] = []
         self.create_evals_list: List[Evaluation] = []
@@ -58,6 +59,19 @@ class Harness:
         return self.store.snapshot()
 
     # ------------------------------------------------------------- helpers
+
+    def _preemption_evals(self, preempted) -> None:
+        seen = set()
+        for a in preempted:
+            key = (a.namespace, a.job_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            from nomad_tpu.structs import Evaluation
+            self.create_evals([Evaluation(
+                namespace=a.namespace, job_id=a.job_id,
+                type=a.job.type if a.job else "service",
+                triggered_by="preemption", status="pending")])
 
     def next_index(self) -> int:
         return next(self._index)

@@ -160,6 +160,9 @@ class StateStore:
         self._csi_volumes: Dict[Tuple[str, str], object] = {}   # (ns, id)
         self._csi_plugins: Dict[str, object] = {}
         self.matrix = ClusterMatrix()
+        # readers outside the store (the placement engine's basis copies)
+        # take this lock to avoid tearing a half-applied commit
+        self.matrix.lock = self._lock
         self._snapshot_cache: Optional[StateSnapshot] = None
         # watchers: fn(table: str, obj) called after commit, outside hot loops
         self._watchers: List[Callable[[str, object], None]] = []
