@@ -1,0 +1,98 @@
+"""Agent HCL configuration files (reference command/agent/config.go +
+config_parse.go: HCL files merged with CLI flags).
+
+Supported blocks mirror the reference's layout:
+
+    name       = "agent-1"
+    region     = "global"
+    datacenter = "dc1"
+    data_dir   = "/var/lib/nomad"
+    bind_addr  = "0.0.0.0"
+
+    ports { http = 4646 }
+
+    server {
+      enabled            = true
+      num_schedulers     = 8
+      enabled_schedulers = ["service", "batch"]
+      heartbeat_grace    = "30s"
+    }
+
+    client  { enabled = true }
+    acl     { enabled = true }
+
+Values parse with the jobspec HCL tokenizer; CLI flags override file
+values (the reference merges files first, flags last)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from nomad_tpu.agent.agent import AgentConfig
+from nomad_tpu.jobspec.hcl import parse_hcl
+
+
+def _duration_s(v, default: float) -> float:
+    if v is None:
+        return default
+    s = str(v)
+    try:
+        if s.endswith("ms"):
+            return float(s[:-2]) / 1000.0
+        if s.endswith("s"):
+            return float(s[:-1])
+        if s.endswith("m"):
+            return float(s[:-1]) * 60.0
+        if s.endswith("h"):
+            return float(s[:-1]) * 3600.0
+        return float(s)
+    except ValueError:
+        return default
+
+
+def load_config_file(path: str,
+                     base: Optional[AgentConfig] = None) -> AgentConfig:
+    """Parse one HCL agent config file onto `base` (or a fresh default)."""
+    with open(path) as f:
+        body = parse_hcl(f.read())
+    cfg = base or AgentConfig()
+
+    for key, attr in (("name", "name"), ("region", "region"),
+                      ("datacenter", "datacenter"),
+                      ("data_dir", "data_dir"),
+                      ("bind_addr", "http_host")):
+        v = body.get(key)
+        if v is not None:
+            setattr(cfg, attr, str(v))
+
+    ports = body.first("ports")
+    if ports is not None and ports.get("http") is not None:
+        cfg.http_port = int(ports.get("http"))
+
+    server = body.first("server")
+    if server is not None:
+        if server.get("enabled") is not None:
+            cfg.server_enabled = _truthy(server.get("enabled"))
+        if server.get("num_schedulers") is not None:
+            cfg.num_schedulers = int(server.get("num_schedulers"))
+        es = server.get("enabled_schedulers")
+        if isinstance(es, list) and es:
+            cfg.enabled_schedulers = [str(x) for x in es]
+        if server.get("heartbeat_grace") is not None:
+            cfg.heartbeat_ttl = _duration_s(
+                server.get("heartbeat_grace"), cfg.heartbeat_ttl)
+
+    client = body.first("client")
+    if client is not None and client.get("enabled") is not None:
+        cfg.client_enabled = _truthy(client.get("enabled"))
+
+    acl = body.first("acl")
+    if acl is not None and acl.get("enabled") is not None:
+        cfg.acl_enabled = _truthy(acl.get("enabled"))
+
+    if cfg.server_enabled and cfg.client_enabled:
+        cfg.dev_mode = False
+    return cfg
+
+
+def _truthy(v) -> bool:
+    return v in (True, "true", "True", 1, "1")

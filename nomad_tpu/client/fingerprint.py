@@ -84,6 +84,7 @@ def fingerprint_node(node, drivers: Dict[str, dict],
     attrs = {}
     attrs.update(fingerprint_arch())
     attrs.update(fingerprint_kernel())
+    attrs.update(fingerprint_cloud())
     attrs.update(fingerprint_host())
     cpu_attrs, cpu_res = fingerprint_cpu()
     attrs.update(cpu_attrs)
@@ -99,3 +100,36 @@ def fingerprint_node(node, drivers: Dict[str, dict],
     node.node_resources = NodeResources(
         cpu=cpu_res, memory_mb=mem_mb, disk_mb=disk_mb)
     node.drivers = dict(drivers)
+
+
+# --------------------------------------------------------------- cloud env
+
+def fingerprint_cloud() -> Dict[str, str]:
+    """Cloud-environment fingerprints (reference client/fingerprint/
+    env_aws.go, env_gce.go, env_azure.go, env_digitalocean.go).  The
+    reference queries each platform's metadata service with a short
+    timeout; in network-restricted environments the detection falls back
+    to platform environment markers and DMI vendor strings, yielding no
+    attributes when nothing identifies a platform."""
+    attrs: Dict[str, str] = {}
+    vendor = ""
+    for path in ("/sys/class/dmi/id/sys_vendor",
+                 "/sys/class/dmi/id/product_name"):
+        try:
+            with open(path) as f:
+                vendor += f.read().strip().lower() + " "
+        except OSError:
+            pass
+    if "amazon" in vendor or os.environ.get("AWS_EXECUTION_ENV"):
+        attrs["unique.platform.aws.hostname"] = os.uname().nodename
+        attrs["platform.aws.detected"] = "true"
+    if "google" in vendor or os.environ.get("GCE_METADATA_HOST"):
+        attrs["unique.platform.gce.hostname"] = os.uname().nodename
+        attrs["platform.gce.detected"] = "true"
+    if "microsoft" in vendor:
+        attrs["unique.platform.azure.name"] = os.uname().nodename
+        attrs["platform.azure.detected"] = "true"
+    if "digitalocean" in vendor:
+        attrs["unique.platform.digitalocean.name"] = os.uname().nodename
+        attrs["platform.digitalocean.detected"] = "true"
+    return attrs

@@ -49,17 +49,44 @@ class Cli:
 
     def cmd_agent(self, args) -> int:
         from nomad_tpu.agent import Agent, AgentConfig
-        cfg = AgentConfig(
-            name=args.name,
-            dev_mode=args.dev,
-            server_enabled=args.dev or args.server,
-            client_enabled=args.dev or args.client,
-            http_host=args.bind,
-            http_port=args.port,
-            num_schedulers=args.num_schedulers,
-            acl_enabled=args.acl_enabled,
-            data_dir=args.data_dir or None,
-        )
+        if getattr(args, "config_file", ""):
+            # reference merge order (command/agent/config.go): config
+            # files first, CLI flags override the merged result
+            from nomad_tpu.agent.config_file import load_config_file
+            cfg = load_config_file(args.config_file)
+            flag_overrides = {
+                "name": ("name", "agent-1"),
+                "bind": ("http_host", "127.0.0.1"),
+                "port": ("http_port", 4646),
+                "num_schedulers": ("num_schedulers", 4),
+            }
+            for flag, (attr, default) in flag_overrides.items():
+                v = getattr(args, flag)
+                if v != default:
+                    setattr(cfg, attr, v)
+            if args.dev:
+                cfg.dev_mode = True
+                cfg.server_enabled = cfg.client_enabled = True
+            if args.server:
+                cfg.server_enabled = True
+            if args.client:
+                cfg.client_enabled = True
+            if args.acl_enabled:
+                cfg.acl_enabled = True
+            if args.data_dir:
+                cfg.data_dir = args.data_dir
+        else:
+            cfg = AgentConfig(
+                name=args.name,
+                dev_mode=args.dev,
+                server_enabled=args.dev or args.server,
+                client_enabled=args.dev or args.client,
+                http_host=args.bind,
+                http_port=args.port,
+                num_schedulers=args.num_schedulers,
+                acl_enabled=args.acl_enabled,
+                data_dir=args.data_dir or None,
+            )
         agent = Agent(cfg)
         agent.start()
         self.p(f"==> nomad-tpu agent started: http={agent.http_addr} "
@@ -532,6 +559,8 @@ def build_parser() -> argparse.ArgumentParser:
     ag.add_argument("-acl-enabled", action="store_true",
                     dest="acl_enabled")
     ag.add_argument("-data-dir", default="", dest="data_dir")
+    ag.add_argument("-config", default="", dest="config_file",
+                    help="HCL agent configuration file")
     ag.set_defaults(fn="cmd_agent")
 
     job = sub.add_parser("job", help="job commands").add_subparsers(

@@ -1,0 +1,49 @@
+"""Agent HCL config files (reference command/agent/config.go) and the
+cloud-environment fingerprints."""
+import tempfile
+
+from nomad_tpu.agent.config_file import load_config_file
+
+
+def test_agent_hcl_config_parses():
+    hcl = '''
+name       = "prod-1"
+region     = "eu"
+datacenter = "dc7"
+data_dir   = "/tmp/nomad-data"
+bind_addr  = "0.0.0.0"
+
+ports { http = 5656 }
+
+server {
+  enabled            = true
+  num_schedulers     = 8
+  enabled_schedulers = ["service", "batch"]
+  heartbeat_grace    = "30s"
+}
+
+client { enabled = true }
+acl    { enabled = true }
+'''
+    with tempfile.NamedTemporaryFile("w", suffix=".hcl",
+                                     delete=False) as f:
+        f.write(hcl)
+        path = f.name
+    cfg = load_config_file(path)
+    assert cfg.name == "prod-1"
+    assert cfg.region == "eu"
+    assert cfg.datacenter == "dc7"
+    assert cfg.data_dir == "/tmp/nomad-data"
+    assert cfg.http_host == "0.0.0.0"
+    assert cfg.http_port == 5656
+    assert cfg.server_enabled and cfg.client_enabled and cfg.acl_enabled
+    assert cfg.num_schedulers == 8
+    assert cfg.enabled_schedulers == ["service", "batch"]
+    assert cfg.heartbeat_ttl == 30.0
+    assert not cfg.dev_mode
+
+
+def test_cloud_fingerprint_no_crash():
+    from nomad_tpu.client.fingerprint import fingerprint_cloud
+    attrs = fingerprint_cloud()
+    assert isinstance(attrs, dict)   # empty off-cloud, never raises
