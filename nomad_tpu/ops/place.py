@@ -329,7 +329,7 @@ def _bulk_scores(capacity, used, demand, feasible, affinity, has_affinity,
     total = fit
     n_scorers = jnp.ones_like(fit)
     anti = -(coll.astype(jnp.float32) + 1.0) / jnp.maximum(
-        jnp.float32(desired), 1.0)
+        jnp.asarray(desired).astype(jnp.float32), 1.0)
     has_coll = coll > 0
     total = total + jnp.where(has_coll, anti, 0.0)
     n_scorers = n_scorers + has_coll
@@ -342,24 +342,15 @@ def _bulk_scores(capacity, used, demand, feasible, affinity, has_affinity,
     return jnp.where(fits, final, -jnp.inf), fits
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("spread_algorithm", "max_waves"))
-def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
-                   used0: jax.Array,       # f32[N, R]
-                   feasible: jax.Array,    # bool[N]
-                   affinity: jax.Array,    # f32[N]
-                   has_affinity: bool,
-                   desired: jax.Array,     # i32 scalar (tg count)
-                   penalty: jax.Array,     # bool[N]
-                   coll0: jax.Array,       # i32[N] existing co-placements
-                   demand: jax.Array,      # f32[R]
-                   count: jax.Array,       # i32 scalar: instances to place
-                   spread_algorithm: bool = False,
-                   max_waves: int = 65536):
-    """Bulk placement of `count` IDENTICAL slots of one task group
-    (spreads inactive) in O(waves) device steps instead of O(count) scan
-    steps — the C2M-scale path (SURVEY.md §7 "slot-batching smarter than
-    a 100K-step scan").
+def _bulk_loop(capacity, used0, feasible, affinity, has_affinity, desired,
+               penalty, coll0, demand, count,
+               spread_algorithm: bool, max_waves: int):
+    """The wavefront placement loop shared by the single-eval
+    (`place_bulk_jit`) and batched (`place_bulk_batch_jit`) kernels.
+    Places `count` IDENTICAL slots of one task group (spreads inactive)
+    in O(waves) device steps instead of O(count) scan steps — the
+    C2M-scale path (SURVEY.md §7 "slot-batching smarter than a 100K-step
+    scan").
 
     Exactness vs the sequential scan: each wave places one instance on
     every node whose current score strictly exceeds s* = the best
@@ -375,11 +366,10 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
     count, because packed clusters can degrade to one placement per wave
     and an exhausted guard silently strands unplaced slots.
 
-    Returns (assign i32[N] — instances per node, placed i32,
-    nodes_evaluated i32, nodes_exhausted i32, final_scores f32[N],
-    used_final f32[N, R]).
+    Returns (used_f f32[N, R], coll_f i32[N], assign i32[N], placed i32).
     """
     N = capacity.shape[0]
+    desired_f = jnp.asarray(desired).astype(jnp.float32)
     rows = jnp.arange(N)
     pos = demand > 0.0
 
@@ -430,7 +420,7 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
         coll_m = coll[best].astype(jnp.float32) + ms - 1.0
         total_m = fit_m
         n_sc = jnp.ones(M)
-        anti_m = -(coll_m + 1.0) / jnp.maximum(jnp.float32(desired), 1.0)
+        anti_m = -(coll_m + 1.0) / jnp.maximum(desired_f, 1.0)
         has_coll_m = coll_m > 0.0
         total_m = total_m + jnp.where(has_coll_m, anti_m, 0.0)
         n_sc = n_sc + has_coll_m
@@ -458,18 +448,50 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
     c0 = (used0, coll0, jnp.int32(0), jnp.zeros(N, jnp.int32),
           jnp.array(False), jnp.int32(0))
     used_f, coll_f, placed, assign, _, _ = jax.lax.while_loop(cond, body, c0)
+    return used_f, coll_f, assign, placed
+
+
+def _bulk_tail(capacity, used_f, coll_f, feasible, affinity, has_affinity,
+               desired, penalty, demand, spread_algorithm: bool):
+    """Final scores + eval/exhaustion counts after a wavefront run."""
     final_scores, fits_f = _bulk_scores(capacity, used_f, demand, feasible,
                                         affinity, has_affinity, desired,
                                         penalty, coll_f, spread_algorithm)
     n_eval = jnp.sum(feasible).astype(jnp.int32)
     n_exh = jnp.sum(feasible & ~fits_f).astype(jnp.int32)
-    # pack EVERYTHING into one f32[N, R+3] leaf (one D2H round trip):
-    # cols [0,R) used, col R assign, col R+1 scores, col R+2 scalars in
-    # rows 0-2.  Integers are value-encoded (exact below 2^24); bitcast
-    # encodings become denormals that TPU hardware flushes to zero.
+    return final_scores, n_eval, n_exh
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("spread_algorithm", "max_waves"))
+def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
+                   used0: jax.Array,       # f32[N, R]
+                   feasible: jax.Array,    # bool[N]
+                   affinity: jax.Array,    # f32[N]
+                   has_affinity: bool,
+                   desired: jax.Array,     # i32 scalar (tg count)
+                   penalty: jax.Array,     # bool[N]
+                   coll0: jax.Array,       # i32[N] existing co-placements
+                   demand: jax.Array,      # f32[R]
+                   count: jax.Array,       # i32 scalar: instances to place
+                   spread_algorithm: bool = False,
+                   max_waves: int = 65536):
+    """Single-eval wavefront placement (see `_bulk_loop` for semantics).
+
+    Returns one packed f32[N, R+3] leaf (one D2H round trip): cols [0,R)
+    used, col R assign, col R+1 scores, col R+2 scalars in rows 0-2.
+    Integers are value-encoded (exact below 2^24); bitcast encodings
+    become denormals that TPU hardware flushes to zero."""
+    used_f, coll_f, assign, placed = _bulk_loop(
+        capacity, used0, feasible, affinity, has_affinity, desired,
+        penalty, coll0, demand, count, spread_algorithm, max_waves)
+    final_scores, n_eval, n_exh = _bulk_tail(
+        capacity, used_f, coll_f, feasible, affinity, has_affinity,
+        desired, penalty, demand, spread_algorithm)
     as_f = lambda x: x.astype(jnp.float32)
-    scalars = jnp.zeros(N, jnp.float32).at[0].set(as_f(placed)) \
-        .at[1].set(as_f(n_eval)).at[2].set(as_f(n_exh))
+    scalars = jnp.zeros(capacity.shape[0], jnp.float32) \
+        .at[0].set(as_f(placed)).at[1].set(as_f(n_eval)) \
+        .at[2].set(as_f(n_exh))
     return jnp.concatenate([used_f, as_f(assign)[:, None],
                             final_scores[:, None], scalars[:, None]],
                            axis=-1)
