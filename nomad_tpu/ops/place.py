@@ -247,75 +247,168 @@ def place_eval_jit(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceRes
                        top_nodes=top_n, top_scores=top_s, used=used)
 
 
-@jax.tree_util.register_dataclass
-@dataclass
-class EvalBatch:
-    """Per-eval placement inputs for a *chained* batch dispatch, every
-    field with a leading E (eval) axis.  `capacity`/`used` are NOT here:
-    they are shared across the batch (one basis matrix), and each eval's
-    usage adjustments (plan stops freeing resources, sticky-disk
-    pre-placements consuming them) ride as a sparse delta:
-    `delta_rows[e, d]` = node row (== N for inactive slots, dropped by the
-    scatter), `delta_vals[e, d]` = f32[R] resource adjustment.
-    """
-    feasible: jax.Array        # bool[E, G, N]
-    affinity: jax.Array        # f32[E, G, N]
-    has_affinity: jax.Array    # bool[E, G]
-    desired_count: jax.Array   # i32[E, G]
-    penalty: jax.Array         # bool[E, G, N]
-    tg_count: jax.Array        # i32[E, G, N]
-    spread_vidx: jax.Array     # i32[E, G, K, N]
-    spread_desired: jax.Array  # f32[E, G, K, V+1]
-    spread_targeted: jax.Array # bool[E, G, K]
-    spread_wfrac: jax.Array    # f32[E, G, K]
-    spread_counts: jax.Array   # f32[E, G, K, V+1]
-    spread_active: jax.Array   # bool[E, G, K]
-    place_cap: jax.Array       # i32[E, G, N]
-    demand: jax.Array          # f32[E, S, R]
-    slot_tg: jax.Array         # i32[E, S]
-    slot_active: jax.Array     # bool[E, S]
-    delta_rows: jax.Array      # i32[E, D]
-    delta_vals: jax.Array      # f32[E, D, R]
+# --------------------------------------------------------------------------
+# Packed H2D transport.
+#
+# The D2H side already ships ONE leaf (_pack_outputs) because every
+# device<->host leaf on a high-latency runtime is its own ~20-35 ms round
+# trip; the H2D side of a batch dispatch used to ship an ~18-leaf
+# per-eval-field pytree and paid the same per-leaf tax 18x.  Here every
+# eval's placement inputs flatten into two f32 vectors:
+#
+#   heavy[Lh]: the G x N-scale tensors (feasibility, affinity, penalty,
+#       co-placement counts, place capacity, spread programs).  These are
+#       functions of (job version, cluster epoch, existing allocs) and are
+#       IDENTICAL across evals of the same job state, so the engine
+#       content-addresses them into a device-resident cache — a cache hit
+#       ships zero bytes (SURVEY.md §7 "Host<->device latency": keep the
+#       big tensors resident, ship only deltas).
+#   light[Ll]: the per-eval slot demand/targets and sparse usage deltas —
+#       KBs, always shipped, concatenated with the f32[N, R] usage basis
+#       into one dyn buffer = ONE device_put per dispatch.
+#
+# Integers are VALUE-encoded as f32 (exact below 2^24); bitcasting would
+# produce denormals that TPU hardware flushes to zero.
+# --------------------------------------------------------------------------
+
+def heavy_dims(inp: PlaceInputs):
+    """(G, N, K, Vp1) of one eval's inputs."""
+    G, N = inp.feasible.shape
+    K = inp.spread_wfrac.shape[1]
+    Vp1 = inp.spread_desired.shape[2]
+    return G, N, K, Vp1
 
 
-@functools.partial(jax.jit, static_argnames=("spread_algorithm",))
-def place_batch_jit(capacity: jax.Array, used0: jax.Array, batch: EvalBatch,
-                    spread_algorithm: bool = False):
-    """Place a batch of E evaluations in one dispatch, chaining the
-    proposed-usage matrix across them.  Returns (packed outputs
-    f32[E, S, 5+2K] — see _pack_outputs/unpack_outputs — and the final
-    usage matrix, left device-resident).
+_HEAVY_FIELDS = ("feasible", "affinity", "penalty", "tg_count", "place_cap",
+                 "spread_vidx", "spread_desired", "spread_counts",
+                 "has_affinity", "desired_count", "spread_targeted",
+                 "spread_wfrac", "spread_active")
+
+
+def pack_heavy(inp: PlaceInputs) -> np.ndarray:
+    """Flatten one eval's G x N-scale tensors into one f32 vector."""
+    return np.concatenate(
+        [np.asarray(getattr(inp, f), np.float32).ravel()
+         for f in _HEAVY_FIELDS])
+
+
+def heavy_digest(inp: PlaceInputs) -> bytes:
+    """Content fingerprint of the heavy block WITHOUT materializing the
+    packed array (the common case is a cache hit)."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    for f in _HEAVY_FIELDS:
+        h.update(np.ascontiguousarray(getattr(inp, f)).tobytes())
+    return h.digest()
+
+
+def _unpack_heavy(h: jax.Array, G: int, N: int, K: int, Vp1: int):
+    """In-kernel inverse of pack_heavy; returns a field dict."""
+    o = 0
+    def take(n, shape):
+        nonlocal o
+        v = h[o:o + n].reshape(shape)
+        o += n
+        return v
+    return dict(
+        feasible=take(G * N, (G, N)) > 0.5,
+        affinity=take(G * N, (G, N)),
+        penalty=take(G * N, (G, N)) > 0.5,
+        tg_count=take(G * N, (G, N)).astype(jnp.int32),
+        place_cap=take(G * N, (G, N)).astype(jnp.int32),
+        spread_vidx=take(G * K * N, (G, K, N)).astype(jnp.int32),
+        spread_desired=take(G * K * Vp1, (G, K, Vp1)),
+        spread_counts=take(G * K * Vp1, (G, K, Vp1)),
+        has_affinity=take(G, (G,)) > 0.5,
+        desired_count=take(G, (G,)).astype(jnp.int32),
+        spread_targeted=take(G * K, (G, K)) > 0.5,
+        spread_wfrac=take(G * K, (G, K)),
+        spread_active=take(G * K, (G, K)) > 0.5,
+    )
+
+
+def light_len(S: int, R: int, D: int) -> int:
+    return S * (R + 2) + D * (R + 1)
+
+
+def pack_light(inp: PlaceInputs, deltas, D: int) -> np.ndarray:
+    """Flatten one eval's slot tensors + sparse usage deltas.  `deltas` is
+    [(row, f32[R])]; inactive delta slots encode row = N (dropped by the
+    in-kernel scatter's mode='drop')."""
+    S, R = inp.demand.shape
+    N = inp.feasible.shape[1]
+    out = np.empty(light_len(S, R, D), np.float32)
+    o = 0
+    out[o:o + S * R] = np.asarray(inp.demand, np.float32).ravel(); o += S * R
+    out[o:o + S] = np.asarray(inp.slot_tg, np.float32); o += S
+    out[o:o + S] = np.asarray(inp.slot_active, np.float32); o += S
+    rows = np.full(D, N, np.float32)
+    vals = np.zeros((D, R), np.float32)
+    for d, (row, vec) in enumerate(deltas[:D]):
+        rows[d] = row
+        vals[d] = vec
+    out[o:o + D] = rows; o += D
+    out[o:o + D * R] = vals.ravel()
+    return out
+
+
+def _unpack_light(l: jax.Array, S: int, R: int, D: int):
+    o = 0
+    def take(n, shape):
+        nonlocal o
+        v = l[o:o + n].reshape(shape)
+        o += n
+        return v
+    demand = take(S * R, (S, R))
+    slot_tg = take(S, (S,)).astype(jnp.int32)
+    slot_active = take(S, (S,)) > 0.5
+    delta_rows = take(D, (D,)).astype(jnp.int32)
+    delta_vals = take(D * R, (D, R))
+    return demand, slot_tg, slot_active, delta_rows, delta_vals
+
+
+@functools.partial(jax.jit, static_argnames=("dims", "spread_algorithm"))
+def place_batch_packed_jit(capacity: jax.Array,     # f32[N, R]
+                           heavy: tuple,            # E x f32[Lh] (device)
+                           dyn: jax.Array,          # f32[N*R + E*Ll]
+                           dims: tuple,             # (G, N, K, Vp1, S, D)
+                           spread_algorithm: bool = False):
+    """Chained batch placement over the packed transport: `heavy` is a
+    tuple of E device-resident per-eval blocks (cache hits ship nothing),
+    `dyn` is the one always-shipped leaf (usage basis + per-eval light
+    blocks).
 
     Chaining (a `lax.scan` over the eval axis, carrying f32[N, R] usage)
     makes the batch exactly equivalent to sequential worker processing:
     eval e+1 scores against usage that includes eval e's placements, so
     concurrently submitted plans never conflict on resources — any commit
-    order of the resulting plans fits, because chained usage is cumulative.
-    This replaces the reference's optimistic-conflict-then-retry dance
-    (nomad/worker.go:81-85 concurrent workers + plan_apply.go partial
-    commit) with a conflict-free device-side pipeline; the serialized plan
-    applier still re-validates as defense in depth.
-    """
-    def eval_step(used, ev: EvalBatch):
-        used = used.at[ev.delta_rows].add(ev.delta_vals, mode="drop")
-        inp = PlaceInputs(
-            capacity=capacity, used=used, feasible=ev.feasible,
-            affinity=ev.affinity, has_affinity=ev.has_affinity,
-            desired_count=ev.desired_count, penalty=ev.penalty,
-            tg_count=ev.tg_count, spread_vidx=ev.spread_vidx,
-            spread_desired=ev.spread_desired,
-            spread_targeted=ev.spread_targeted,
-            spread_wfrac=ev.spread_wfrac, spread_counts=ev.spread_counts,
-            spread_active=ev.spread_active, place_cap=ev.place_cap,
-            demand=ev.demand,
-            slot_tg=ev.slot_tg, slot_active=ev.slot_active)
-        S = ev.demand.shape[0]
-        carry0 = (used, ev.tg_count, ev.spread_counts, ev.place_cap)
+    order of the resulting plans fits, because chained usage is
+    cumulative.  This replaces the reference's optimistic
+    conflict-then-retry dance (nomad/worker.go:81-85 concurrent workers +
+    plan_apply.go partial commit) with a conflict-free device-side
+    pipeline; the serialized plan applier still re-validates as defense
+    in depth."""
+    G, N, K, Vp1, S, D = dims
+    R = capacity.shape[1]
+    E = len(heavy)
+    hstack = jnp.stack(heavy)
+    used0 = dyn[:N * R].reshape(N, R)
+    light = dyn[N * R:].reshape(E, -1)
+
+    def eval_step(used, hl):
+        h, l = hl
+        f = _unpack_heavy(h, G, N, K, Vp1)
+        demand, slot_tg, slot_active, delta_rows, delta_vals = \
+            _unpack_light(l, S, R, D)
+        used = used.at[delta_rows].add(delta_vals, mode="drop")
+        inp = PlaceInputs(capacity=capacity, used=used, demand=demand,
+                          slot_tg=slot_tg, slot_active=slot_active, **f)
+        carry0 = (used, f["tg_count"], f["spread_counts"], f["place_cap"])
         step = functools.partial(_place_step, inp, spread_algorithm)
         (used_f, _, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
         return used_f, _pack_outputs(*outs)
 
-    used_final, packed = jax.lax.scan(eval_step, used0, batch)
+    used_final, packed = jax.lax.scan(eval_step, used0, (hstack, light))
     return packed, used_final
 
 

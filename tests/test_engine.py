@@ -155,3 +155,33 @@ def test_concurrent_callers_coalesce():
         assert (total <= cm.capacity + 1e-3).all()
     finally:
         engine.stop()
+
+
+def test_packed_cache_hits_and_single_path():
+    """The content-addressed device cache dedupes identical heavy blocks
+    across evals (same job state -> hit -> zero bytes shipped) and the
+    packed single-eval path matches the raw kernel."""
+    cm = _world()
+    engine = PlacementEngine()
+    try:
+        # single-eval path parity vs place_eval
+        r = _request(cm, count=3)
+        exp = place_eval(_request(cm, count=3).inputs, False)
+        engine._dispatch([r])
+        got, ticket = r.future.result(timeout=30)
+        np.testing.assert_array_equal(got.node[:3], exp.node[:3])
+        np.testing.assert_allclose(got.score[:3], exp.score[:3], rtol=1e-5)
+        engine.complete(ticket)
+        assert engine._cache.misses >= 1
+
+        # identical-content batch: every heavy block after the first hits
+        misses0 = engine._cache.misses
+        reqs = [_request(cm, count=3) for _ in range(4)]
+        engine._dispatch(reqs)
+        for rq in reqs:
+            _, t = rq.future.result(timeout=30)
+            engine.complete(t)
+        assert engine._cache.misses == misses0   # all heavy blocks cached
+        assert engine._cache.hits >= 4
+    finally:
+        engine.stop()

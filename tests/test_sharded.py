@@ -25,8 +25,9 @@ def build_inputs(n_nodes=16, count=6, seed=0):
 
 
 def test_sharded_matches_single_chip():
+    from nomad_tpu.ops.place import place_eval
     st, inp, count = build_inputs()
-    single = st.place(inp)
+    single = place_eval(inp, st.spread_algorithm)
 
     mesh = make_mesh(n_eval_shards=2, n_node_shards=4)
     batch = stack_inputs([inp, inp])
