@@ -590,6 +590,118 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
                            axis=-1)
 
 
+# --- batched bulk transport (same packed single-leaf scheme as
+# place_batch_packed_jit: heavy = per-eval node-axis tensors, content-
+# addressed device-side; light = per-eval scalars + sparse deltas) -------
+
+def pack_bulk_heavy(feasible, affinity, penalty, coll0) -> np.ndarray:
+    """f32[4N]: one bulk eval's node-axis tensors."""
+    return np.concatenate([
+        np.asarray(feasible, np.float32),
+        np.asarray(affinity, np.float32),
+        np.asarray(penalty, np.float32),
+        np.asarray(coll0, np.float32)])
+
+
+def bulk_heavy_digest(feasible, affinity, penalty, coll0) -> bytes:
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    for a in (feasible, affinity, penalty, coll0):
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.digest()
+
+
+def bulk_light_len(R: int, D: int) -> int:
+    return 3 + R + D * (R + 1)
+
+
+def pack_bulk_light(has_affinity, desired, count, demand, deltas,
+                    N: int, D: int) -> np.ndarray:
+    R = demand.shape[0]
+    out = np.empty(bulk_light_len(R, D), np.float32)
+    out[0] = float(bool(has_affinity))
+    out[1] = float(desired)
+    out[2] = float(count)
+    out[3:3 + R] = np.asarray(demand, np.float32)
+    rows = np.full(D, N, np.float32)
+    vals = np.zeros((D, R), np.float32)
+    for d, (row, vec) in enumerate(deltas[:D]):
+        rows[d] = row
+        vals[d] = vec
+    out[3 + R:3 + R + D] = rows
+    out[3 + R + D:] = vals.ravel()
+    return out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("D", "spread_algorithm", "max_waves"))
+def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
+                         heavy: tuple,           # E x f32[4N] (device)
+                         dyn: jax.Array,         # f32[N*R + E*Ll]
+                         D: int,
+                         spread_algorithm: bool = False,
+                         max_waves: int = 65536):
+    """Chained batch of E wavefront bulk evals in ONE dispatch: a
+    `lax.scan` over the eval axis carries the usage matrix, each step
+    runs `_bulk_loop` (the O(waves) wavefront placement), so eval e+1
+    scores against usage including eval e's placements — identical to
+    sequential bulk processing but paying one transfer round trip per
+    *batch*.  Each eval's sparse deltas (its own plan's stops /
+    preplacements) are scoped to that eval only: they apply before its
+    wavefront and are backed out of the carry after, matching the
+    serialized bulk path where uncommitted stops of one eval are never
+    visible to another (only *placements* chain forward, mirroring the
+    engine's in-flight overlay).  Returns (packed f32[E, 2N+3] — per
+    eval assign[N], final_scores[N], then (placed, n_eval, n_exh) — and
+    the final usage, left device-resident)."""
+    N, R = capacity.shape
+    E = len(heavy)
+    hstack = jnp.stack(heavy)
+    used0 = dyn[:N * R].reshape(N, R)
+    light = dyn[N * R:].reshape(E, -1)
+
+    def eval_step(used, hl):
+        h, l = hl
+        feasible = h[:N] > 0.5
+        affinity = h[N:2 * N]
+        penalty = h[2 * N:3 * N] > 0.5
+        coll0 = h[3 * N:].astype(jnp.int32)
+        has_aff = l[0] > 0.5
+        desired = l[1].astype(jnp.int32)
+        count = l[2].astype(jnp.int32)
+        demand = l[3:3 + R]
+        delta_rows = l[3 + R:3 + R + D].astype(jnp.int32)
+        delta_vals = l[3 + R + D:].reshape(D, R)
+        delta_mat = jnp.zeros_like(used).at[delta_rows].add(
+            delta_vals, mode="drop")
+        used_f, coll_f, assign, placed = _bulk_loop(
+            capacity, used + delta_mat, feasible, affinity, has_aff,
+            desired, penalty, coll0, demand, count, spread_algorithm,
+            max_waves)
+        scores, n_eval, n_exh = _bulk_tail(
+            capacity, used_f, coll_f, feasible, affinity, has_aff,
+            desired, penalty, demand, spread_algorithm)
+        as_f = lambda x: x.astype(jnp.float32)
+        out = jnp.concatenate([
+            as_f(assign), scores,
+            jnp.stack([as_f(placed), as_f(n_eval), as_f(n_exh)])])
+        return used_f - delta_mat, out
+
+    used_final, packed = jax.lax.scan(eval_step, used0, (hstack, light))
+    return packed, used_final
+
+
+def unpack_bulk_batch(packed: np.ndarray):
+    """Host inverse of place_bulk_batch_jit's per-eval rows: returns
+    (assign i32[E, N], scores f32[E, N], placed i32[E], n_eval i32[E],
+    n_exh i32[E])."""
+    N = (packed.shape[1] - 3) // 2
+    assign = np.rint(packed[:, :N]).astype(np.int32)
+    scores = packed[:, N:2 * N]
+    s = np.rint(packed[:, 2 * N:]).astype(np.int32)
+    return assign, scores, s[:, 0], s[:, 1], s[:, 2]
+
+
 def unpack_bulk(packed: np.ndarray):
     """Host inverse of place_bulk_jit's packed leaf: returns
     (assign i32[N], placed, n_eval, n_exh, scores f32[N], used f32[N,R])."""

@@ -40,11 +40,16 @@ from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS, pad_to_bucket
 from nomad_tpu.ops.place import (
     PlaceInputs,
     PlaceResult,
+    bulk_heavy_digest,
     heavy_digest,
     heavy_dims,
+    pack_bulk_heavy,
+    pack_bulk_light,
     pack_heavy,
     pack_light,
     place_batch_packed_jit,
+    place_bulk_batch_jit,
+    unpack_bulk_batch,
     unpack_outputs,
 )
 
@@ -91,6 +96,15 @@ class _DeviceCache:
         key = (heavy_dims(inputs), heavy_digest(inputs))
         return self._get_or_put(key, lambda: pack_heavy(inputs))
 
+    def bulk_heavy(self, r):
+        """Device-resident packed node-axis block of one bulk request."""
+        key = ("bulk", r.feasible.shape[0],
+               bulk_heavy_digest(r.feasible, r.affinity, r.penalty,
+                                 r.coll0))
+        return self._get_or_put(
+            key, lambda: pack_bulk_heavy(r.feasible, r.affinity,
+                                         r.penalty, r.coll0))
+
     def capacity(self, arr: np.ndarray):
         import hashlib
         # snapshot-copy FIRST, hash the copy: the live cm.capacity can be
@@ -116,6 +130,28 @@ class _Request:
         return (id(self.cm), self.spread_algorithm, i.feasible.shape,
                 i.spread_vidx.shape, i.spread_desired.shape,
                 i.demand.shape)
+
+
+@dataclass
+class _BulkRequest:
+    """One wavefront bulk eval (many identical slots of one task group,
+    spreads/distinct/ports/devices inactive) for the batched bulk kernel."""
+    cm: object
+    feasible: np.ndarray            # bool[N]
+    affinity: np.ndarray            # f32[N]
+    has_affinity: bool
+    desired: int
+    penalty: np.ndarray             # bool[N]
+    coll0: np.ndarray               # i32[N] existing co-placements
+    demand: np.ndarray              # f32[R]
+    count: int
+    deltas: List[Tuple[int, np.ndarray]]
+    spread_algorithm: bool
+    future: Future
+
+    def shape_key(self):
+        return ("bulk", id(self.cm), self.spread_algorithm,
+                self.feasible.shape[0])
 
 
 class PlacementEngine:
@@ -154,7 +190,8 @@ class PlacementEngine:
         self.stats = {"dispatches": 0, "batched_evals": 0, "single_evals": 0,
                       "max_batch_seen": 0, "tickets_open": 0,
                       "stack_s": 0.0, "put_s": 0.0, "device_s": 0.0,
-                      "resolve_s": 0.0, "cache_hits": 0, "cache_misses": 0}
+                      "resolve_s": 0.0, "cache_hits": 0, "cache_misses": 0,
+                      "bulk_evals": 0}
         self._cache = _DeviceCache()
         self._thread = threading.Thread(
             target=self._run, name="placement-engine", daemon=True)
@@ -170,6 +207,33 @@ class PlacementEngine:
         will never be), releasing its in-flight usage contribution."""
         req = _Request(cm=cm, inputs=inputs, deltas=list(deltas or ()),
                        spread_algorithm=spread_algorithm, future=Future())
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("placement engine stopped")
+            self._queue.append(req)
+            self._cv.notify()
+        return req.future.result()
+
+    def place_bulk(self, cm, *, feasible, affinity, has_affinity, desired,
+                   penalty, coll0, demand, count,
+                   deltas: Optional[Sequence[Tuple[int, np.ndarray]]] = None,
+                   spread_algorithm: bool = False):
+        """Wavefront bulk placement of `count` identical slots, batched
+        with concurrent bulk evals into one chained device dispatch
+        (ops.place.place_bulk_batch_jit).  Blocks; returns (assign i32[N],
+        placed, nodes_evaluated, nodes_exhausted, scores f32[N],
+        used_after f32[N, R], ticket).  The caller MUST `complete(ticket)`
+        once the plan is submitted (ticket may be None if nothing
+        placed)."""
+        req = _BulkRequest(
+            cm=cm, feasible=np.asarray(feasible, bool),
+            affinity=np.asarray(affinity, np.float32),
+            has_affinity=bool(has_affinity), desired=int(desired),
+            penalty=np.asarray(penalty, bool),
+            coll0=np.asarray(coll0, np.int32),
+            demand=np.asarray(demand, np.float32), count=int(count),
+            deltas=list(deltas or ()), spread_algorithm=spread_algorithm,
+            future=Future())
         with self._cv:
             if self._stop:
                 raise RuntimeError("placement engine stopped")
@@ -360,15 +424,22 @@ class PlacementEngine:
     def _dispatch(self, batch: List[_Request]) -> None:
         import jax
 
-        groups: Dict[tuple, List[_Request]] = {}
+        groups: Dict[tuple, List] = {}
         for r in batch:
             groups.setdefault(r.shape_key(), []).append(r)
         self.stats["dispatches"] += 1
         self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"],
                                            len(batch))
 
-        pending = []   # (requests, device result tuple)
+        pending = []        # (requests, device packed)
+        pending_bulk = []   # (requests, (device packed, basis))
         for reqs in groups.values():
+            if isinstance(reqs[0], _BulkRequest):
+                for part in self._split_bulk(reqs):
+                    pending_bulk.append(
+                        (part, self._dispatch_bulk_group(part)))
+                self.stats["bulk_evals"] += len(reqs)
+                continue
             # single path also when the matrix has grown (re-bucketed)
             # since these inputs were built: the dispatch-time basis no
             # longer matches the padded node axis
@@ -381,11 +452,13 @@ class PlacementEngine:
             pending.append((reqs, self._dispatch_group(reqs)))
             self.stats["batched_evals"] += len(reqs)
 
-        if not pending:
+        if not pending and not pending_bulk:
             return
-        # one D2H transfer per group (usually one group -> one leaf)
+        # one D2H transfer for ALL groups (usually one leaf each)
         t0 = _time.time()
-        fetched = jax.device_get([packed for _, packed in pending])
+        fetched = jax.device_get(
+            [packed for _, packed in pending]
+            + [packed for _, (packed, _) in pending_bulk])
         self.stats["device_s"] += _time.time() - t0
         t0 = _time.time()
         for (reqs, _), packed in zip(pending, fetched):
@@ -398,7 +471,78 @@ class PlacementEngine:
                     top_nodes=top_n[i], top_scores=top_s[i], used=None)
                 ticket = self._register(r, res)
                 r.future.set_result((res, ticket))
+        for (reqs, (_, basis)), packed in zip(
+                pending_bulk, fetched[len(pending):]):
+            self._resolve_bulk(reqs, np.asarray(packed), basis)
         self.stats["resolve_s"] += _time.time() - t0
+
+    # ---------------------------------------------------------- bulk path
+
+    def _split_bulk(self, reqs: List[_BulkRequest]):
+        for i in range(0, len(reqs), self.max_batch):
+            yield reqs[i:i + self.max_batch]
+
+    def _dispatch_bulk_group(self, reqs: List[_BulkRequest]):
+        import jax
+
+        cm = reqs[0].cm
+        N = reqs[0].feasible.shape[0]
+        E = self.max_batch
+        # rows are stable across matrix re-bucketing (growth only pads
+        # the node axis), so the enqueue-time world is the prefix slice
+        capacity = cm.capacity[:N]
+        basis = self._basis_for(cm)[:N]
+        D = pad_to_bucket(max([len(r.deltas) for r in reqs] + [1]),
+                          minimum=_DELTA_BUCKET_MIN)
+
+        t0 = _time.time()
+        lights = [pack_bulk_light(r.has_affinity, r.desired, r.count,
+                                  r.demand, r.deltas, N, D) for r in reqs]
+        Ll = lights[0].shape[0]
+        if E > len(reqs):
+            # padded evals have count=0: the wavefront loop exits at once
+            lights += [np.zeros(Ll, np.float32)] * (E - len(reqs))
+        basis = np.ascontiguousarray(basis, dtype=np.float32)
+        dyn = np.concatenate([basis.ravel()] + lights)
+        self.stats["stack_s"] += _time.time() - t0
+        t0 = _time.time()
+        cap_dev = self._cache.capacity(capacity)
+        heavy = [self._cache.bulk_heavy(r) for r in reqs]
+        heavy += [heavy[0]] * (E - len(reqs))
+        self.stats["cache_hits"] = self._cache.hits
+        self.stats["cache_misses"] = self._cache.misses
+        dyn_dev = jax.device_put(dyn)
+        packed, _used_final = place_bulk_batch_jit(
+            cap_dev, tuple(heavy), dyn_dev, D,
+            spread_algorithm=reqs[0].spread_algorithm)
+        self.stats["put_s"] += _time.time() - t0
+        return packed, basis
+
+    def _resolve_bulk(self, reqs: List[_BulkRequest], packed: np.ndarray,
+                      basis: np.ndarray) -> None:
+        """Mirror the kernel's chained usage host-side so every caller
+        gets the exact used matrix its placements produced: each eval
+        sees basis + prior evals' PLACEMENTS + its own private deltas;
+        deltas never chain forward (uncommitted stops of one eval are
+        invisible to others, exactly like the in-flight overlay)."""
+        assign, scores, placed, n_eval, n_exh = unpack_bulk_batch(packed)
+        u = basis.copy()
+        N = u.shape[0]
+        for i, r in enumerate(reqs):
+            own = u.copy()
+            for row, vec in r.deltas:
+                if row < N:
+                    own[row] += vec
+            placements = np.outer(assign[i].astype(np.float32), r.demand)
+            own += placements
+            u += placements
+            contribs = [(int(row), r.demand * float(assign[i][row]))
+                        for row in np.flatnonzero(assign[i])]
+            ticket = self.register_external(r.cm, contribs) \
+                if contribs else None
+            r.future.set_result(
+                (assign[i], int(placed[i]), int(n_eval[i]),
+                 int(n_exh[i]), scores[i], own, ticket))
 
     def _run_single(self, r: _Request) -> None:
         """Lone request: packed E=1 dispatch through the same device

@@ -367,11 +367,11 @@ class GenericScheduler:
         # placement-coupled constraints (spreads / distinct_*) place via
         # the wavefront kernel in O(waves) steps instead of an
         # O(slots) scan — the C2M-scale path (ops.place.place_bulk_jit).
-        # Below the threshold the chained engine amortizes device round
-        # trips across concurrent evals better than one serialized bulk
-        # call per eval (crossover ~= scan steps x step-cost vs one
-        # round trip on a high-latency runtime).
-        BULK_MIN = 512
+        # Concurrent bulk evals coalesce into one chained device dispatch
+        # (engine.place_bulk -> place_bulk_batch_jit), so the threshold
+        # only guards against wavefront overhead on tiny counts where the
+        # O(slots) scan is just as cheap.
+        BULK_MIN = 64
         by_group: Dict[int, List[PlacementRequest]] = {}
         for pr in slot_requests:
             by_group.setdefault(tg_index[pr.task_group], []).append(pr)
@@ -663,13 +663,13 @@ class GenericScheduler:
 
     def _place_bulk(self, cm, job, g, prs, allocs_by_tg, penalty_nodes,
                     deltas, stack):
-        """One wavefront-kernel call placing len(prs) identical slots of
-        group `g` (ops.place.place_bulk_jit).  Runs under the engine's
-        bulk gate: the usage basis (committed + in-flight overlay) is
-        read, the kernel runs, and the resulting placements register in
-        the overlay atomically w.r.t. other bulk evals.  Returns
-        ((assign i32[N], placed, nodes_evaluated, nodes_exhausted,
-        scores f32[N], used_after f32[N, R]), overlay ticket or None)."""
+        """Wavefront placement of len(prs) identical slots of group `g`.
+        With the engine present this coalesces with concurrent bulk evals
+        into ONE chained device dispatch (engine.place_bulk ->
+        ops.place.place_bulk_batch_jit) — conflict-free by chaining, no
+        serializing gate needed.  Returns ((assign i32[N], placed,
+        nodes_evaluated, nodes_exhausted, scores f32[N],
+        used_after f32[N, R]), overlay ticket or None)."""
         import jax
 
         from nomad_tpu.ops.place import place_bulk_jit, unpack_bulk
@@ -688,35 +688,36 @@ class GenericScheduler:
             if row is not None:
                 coll0[row] += 1
 
-        import contextlib
-        gate = eng.bulk_gate if eng is not None else contextlib.nullcontext()
-        with gate:
-            if eng is not None and cm.used.shape[0] == N:
-                base = eng.basis_for(cm)
-            else:
-                base = cm.used.copy()
-            for row, vec in deltas:       # this eval's stops/preplacements
-                if row < N:
-                    base[row] += vec
-            packed = place_bulk_jit(
-                np.ascontiguousarray(cm.capacity),
-                np.ascontiguousarray(base.astype(np.float32)),
-                g.feasible, g.affinity.astype(np.float32),
-                bool(g.has_affinity), np.int32(max(g.tg.count, 1)), penalty,
-                coll0, g.demand.astype(np.float32), np.int32(len(prs)),
-                spread_algorithm=stack.spread_algorithm)
-            assign, placed, n_eval, n_exh, scores, used_f = \
-                unpack_bulk(jax.device_get(packed))
-            ticket = None
-            if eng is not None:
-                contribs = [(int(row), g.demand * float(assign[row]))
-                            for row in np.flatnonzero(assign)]
-                if contribs:
-                    ticket = eng.register_external(cm, contribs)
+        if eng is not None:
+            assign, placed, n_eval, n_exh, scores, used_f, ticket = \
+                eng.place_bulk(
+                    cm, feasible=g.feasible,
+                    affinity=g.affinity.astype(np.float32),
+                    has_affinity=bool(g.has_affinity),
+                    desired=max(g.tg.count, 1), penalty=penalty,
+                    coll0=coll0, demand=g.demand.astype(np.float32),
+                    count=len(prs), deltas=deltas,
+                    spread_algorithm=stack.spread_algorithm)
+            return ((assign, placed, n_eval, n_exh, scores, used_f),
+                    ticket)
+
+        base = cm.used.copy()
+        for row, vec in deltas:       # this eval's stops/preplacements
+            if row < N:
+                base[row] += vec
+        packed = place_bulk_jit(
+            np.ascontiguousarray(cm.capacity),
+            np.ascontiguousarray(base.astype(np.float32)),
+            g.feasible, g.affinity.astype(np.float32),
+            bool(g.has_affinity), np.int32(max(g.tg.count, 1)), penalty,
+            coll0, g.demand.astype(np.float32), np.int32(len(prs)),
+            spread_algorithm=stack.spread_algorithm)
+        assign, placed, n_eval, n_exh, scores, used_f = \
+            unpack_bulk(jax.device_get(packed))
         # device_get arrays are read-only; later host bookkeeping
         # (preemption, sticky adds) mutates the usage matrix in place
         return ((assign, int(placed), int(n_eval), int(n_exh),
-                 np.asarray(scores), np.array(used_f)), ticket)
+                 np.asarray(scores), np.array(used_f)), None)
 
     def _fail_placement(self, pr: PlacementRequest, metric: AllocMetric,
                         reason: str) -> None:
