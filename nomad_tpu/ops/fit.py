@@ -30,11 +30,12 @@ def validate_capacity(capacity: jax.Array, used: jax.Array) -> jax.Array:
 
 
 def free_fractions(capacity: jax.Array, util: jax.Array) -> jax.Array:
-    """f32[N, 2]: free cpu/mem fractions after `util`, with the zero-capacity
-    convention of structs.resources._free_ratio (used>0 on cap<=0 -> -inf,
-    0 on 0 -> 1)."""
-    cap = jnp.asarray(capacity)[:, (RES_CPU, RES_MEM)]
-    use = jnp.asarray(util)[:, (RES_CPU, RES_MEM)]
+    """f32[..., 2]: free cpu/mem fractions after `util`, with the
+    zero-capacity convention of structs.resources._free_ratio (used>0 on
+    cap<=0 -> -inf, 0 on 0 -> 1).  Broadcasts over leading axes (the bulk
+    kernel evaluates an [N, M] fill grid in one call)."""
+    cap = jnp.asarray(capacity)[..., (RES_CPU, RES_MEM)]
+    use = jnp.asarray(util)[..., (RES_CPU, RES_MEM)]
     frac = 1.0 - use / cap
     zero_cap = cap <= 0.0
     frac = jnp.where(zero_cap & (use > 0.0), -jnp.inf, frac)

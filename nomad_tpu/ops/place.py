@@ -34,7 +34,11 @@ import numpy as np
 from nomad_tpu.ops.fit import score_fit
 
 TOP_K = 5  # score_meta entries kept per placement (structs.go:10341 kheap)
-_FILL_GRID = 256   # m-grid for the bulk kernel's exact fill-run length
+# m-grid bound for the bulk kernel's per-node fill-run length: a run
+# longer than the grid just continues next wave, so this trades wave
+# count against the [N, M] grid's per-wave compute (the grid is the
+# dominant op in a wave's body)
+_FILL_GRID = 64
 
 
 @jax.tree_util.register_dataclass
@@ -445,15 +449,30 @@ def _bulk_loop(capacity, used0, feasible, affinity, has_affinity, desired,
     C2M-scale path (SURVEY.md §7 "slot-batching smarter than a 100K-step
     scan").
 
-    Exactness vs the sequential scan: each wave places one instance on
-    every node whose current score strictly exceeds s* = the best
-    post-placement score any node could have — sequential greedy would
-    pick exactly those nodes (in score order) before ever returning to a
-    node it already used this wave, because scores are row-independent.
-    When the wave is a single node that still beats everyone after its
-    own placement (the binpack filling regime), the node is filled with
-    as many instances as fit / remain in one step.  Ties at s* fall back
-    to single placements, preserving the lowest-row tie-break.
+    Exactness vs the sequential scan: scoring is row-independent, so
+    sequential greedy fills nodes in contiguous "runs" — it keeps
+    picking node i while score_i(after m instances) strictly exceeds
+    every other node's current score — and the FIRST placement on a node
+    that became argmax (by score or the lowest-row tie-break) is forced
+    regardless of its post-score.  Each wave computes, for EVERY node,
+    that run length on a vectorized [N, M] fill grid (anti-affinity
+    decays linearly, binpack fit rises as the node fills; non-monotone
+    dips are honored because the run counts LEADING m's only, and
+    `second_i` uses wave-start scores of the others, which can only
+    UNDER-count a run — the next wave catches the remainder), then
+    places the runs of the active wave set in greedy order
+    (score desc, row asc — the argmax tie-break), cumulatively capped by
+    the remaining count:
+
+      * strict set (cur > s* = best post-placement score anywhere): the
+        nodes greedy provably drains before revisiting anyone;
+      * else the tie set (cur == global max): every tied node places at
+        least one instance (greedy visits each in row order before any
+        score re-enters the tie) plus its fill run.
+
+    A uniform cluster thus fills in O(count / (nodes x per-node run))
+    waves — one wave in the common fresh-world case — instead of one
+    node-fill per wave.
 
     max_waves is a runaway guard only — it must exceed any realistic
     count, because packed clusters can degrade to one placement per wave
@@ -463,8 +482,6 @@ def _bulk_loop(capacity, used0, feasible, affinity, has_affinity, desired,
     """
     N = capacity.shape[0]
     desired_f = jnp.asarray(desired).astype(jnp.float32)
-    rows = jnp.arange(N)
-    pos = demand > 0.0
 
     def cond(c):
         used, coll, placed, assign, stuck, waves = c
@@ -472,64 +489,65 @@ def _bulk_loop(capacity, used0, feasible, affinity, has_affinity, desired,
 
     def body(c):
         used, coll, placed, assign, stuck, waves = c
-        cur, fits = _bulk_scores(capacity, used, demand, feasible,
-                                 affinity, has_affinity, desired, penalty,
-                                 coll, spread_algorithm)
-        any_fit = jnp.any(fits)
-        # post-placement score of every node (row-independent, so adding
-        # the demand to every row evaluates each node's own "+1" world)
-        nxt, fits2 = _bulk_scores(capacity, used + demand, demand,
-                                  feasible, affinity, has_affinity,
-                                  desired, penalty, coll + 1,
-                                  spread_algorithm)
-        s_star = jnp.max(jnp.where(fits2, nxt, -jnp.inf))
-
-        wave = fits & (cur > s_star)
-        best = jnp.argmax(cur)              # lowest row among equals
-        singleton = (rows == best) & any_fit
-        wave = jnp.where(jnp.any(wave), wave, singleton)
-
-        remaining = count - placed
-        # cap the wave at `remaining`, best scores first (rank via argsort)
-        order = jnp.argsort(jnp.where(wave, -cur, jnp.inf))
-        rank = jnp.zeros(N, jnp.int32).at[order].set(rows.astype(jnp.int32))
-        wave = wave & (rank < remaining)
-
-        # singleton filling regime: compute the exact run length —
-        # sequential greedy keeps picking `best` while its score after the
-        # m-th instance stays strictly above the runner-up.  Score(m) is
-        # evaluated in closed form on a vectorized m-grid (anti-affinity
-        # decays linearly, binpack fit rises as the node fills, so the
-        # run ends at the first crossing; non-monotone dips are honored
-        # because the run counts LEADING m's only).
-        second = jnp.max(jnp.where(rows == best, -jnp.inf,
-                                   jnp.where(fits, cur, -jnp.inf)))
+        # ONE [N, M] scoring grid per wave: column m is every node's
+        # score/fitness with m more instances placed on it.  m=1 ("place
+        # one more now") is the wave-start score, m=2 each node's own
+        # "+1" world (scoring is row-independent, so this evaluates the
+        # post-placement score of every node at once), and the leading
+        # columns give the per-node fill runs.
         M = _FILL_GRID
-        ms = jnp.arange(1, M + 1, dtype=jnp.float32)          # m-th inst
-        util_m = used[best][None, :] + ms[:, None] * demand   # [M, R]
-        fits_m = jnp.all(util_m <= capacity[best][None, :], axis=-1)
-        cap_m = jnp.broadcast_to(capacity[best], (M, capacity.shape[1]))
-        fit_m = score_fit(cap_m, util_m, spread_algorithm) / 18.0
-        coll_m = coll[best].astype(jnp.float32) + ms - 1.0
+        ms = jnp.arange(1, M + 1, dtype=jnp.float32)
+        util_m = used[:, None, :] + ms[None, :, None] * demand  # [N, M, R]
+        fits_m = (jnp.all(util_m <= capacity[:, None, :], axis=-1)
+                  & feasible[:, None])
+        fit_m = score_fit(capacity[:, None, :], util_m,
+                          spread_algorithm) / 18.0               # [N, M]
+        coll_m = coll[:, None].astype(jnp.float32) + ms[None, :] - 1.0
         total_m = fit_m
-        n_sc = jnp.ones(M)
+        n_sc = jnp.ones_like(fit_m)
         anti_m = -(coll_m + 1.0) / jnp.maximum(desired_f, 1.0)
         has_coll_m = coll_m > 0.0
         total_m = total_m + jnp.where(has_coll_m, anti_m, 0.0)
         n_sc = n_sc + has_coll_m
-        total_m = total_m - penalty[best]
-        n_sc = n_sc + penalty[best]
-        aff_on_b = has_affinity & (affinity[best] != 0.0)
-        total_m = total_m + jnp.where(aff_on_b, affinity[best], 0.0)
-        n_sc = n_sc + aff_on_b
+        total_m = total_m - penalty[:, None]
+        n_sc = n_sc + penalty[:, None]
+        aff_on = has_affinity & (affinity != 0.0)                # [N]
+        total_m = total_m + jnp.where(aff_on[:, None],
+                                      affinity[:, None], 0.0)
+        n_sc = n_sc + aff_on[:, None]
         score_m = total_m / n_sc
-        ok_m = fits_m & (score_m > second)
-        run = jnp.sum(jnp.cumprod(ok_m.astype(jnp.int32))).astype(jnp.int32)
 
-        fill_mode = (jnp.sum(wave) == 1) & wave[best]
-        fill_n = jnp.clip(jnp.maximum(run, 1), 1, remaining)
-        per_node = jnp.where(wave, 1, 0) + jnp.where(
-            fill_mode & (rows == best), fill_n - 1, 0)
+        fits = fits_m[:, 0]
+        cur = jnp.where(fits, score_m[:, 0], -jnp.inf)
+        any_fit = jnp.any(fits)
+        s_star = jnp.max(jnp.where(fits_m[:, 1], score_m[:, 1], -jnp.inf))
+
+        strict = fits & (cur > s_star)
+        top2 = jax.lax.top_k(cur, 2)[0]
+        tie = fits & (cur == top2[0])
+        wave = jnp.where(jnp.any(strict), strict, tie)
+
+        # run_i = leading m's where node i still fits and score_i(m)
+        # strictly beats the best wave-start score among the OTHERS
+        second = jnp.where(cur == top2[0], top2[1], top2[0])   # [N]
+        # m=1 is the FORCED placement: once a node is the argmax (by
+        # score or by the lowest-row tie-break) greedy places on it
+        # regardless of what its score becomes after — only m >= 2 must
+        # strictly beat the others' wave-start scores to keep the run
+        ok_m = fits_m & ((score_m > second[:, None])
+                         | (ms[None, :] == 1.0))
+        run = jnp.sum(jnp.cumprod(ok_m.astype(jnp.int32), axis=1),
+                      axis=1).astype(jnp.int32)                  # [N]
+
+        # greedy-order the wave's runs (score desc, stable -> row asc
+        # among ties) and cap cumulatively at the remaining count
+        base = jnp.where(wave, run, 0)
+        remaining = count - placed
+        order = jnp.argsort(jnp.where(wave, -cur, jnp.inf))
+        base_sorted = base[order]
+        prefix = jnp.cumsum(base_sorted) - base_sorted
+        alloc_sorted = jnp.clip(remaining - prefix, 0, base_sorted)
+        per_node = jnp.zeros(N, jnp.int32).at[order].set(alloc_sorted)
 
         used = used + per_node[:, None].astype(jnp.float32) * demand
         coll = coll + per_node

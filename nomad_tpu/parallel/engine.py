@@ -168,8 +168,15 @@ class PlacementEngine:
     `complete(ticket)` once their plan has been applied (or abandoned) —
     the scheduler does this right after Planner.SubmitPlan returns."""
 
-    def __init__(self, max_batch: int = 16):
-        self.max_batch = max_batch
+    # eval-axis compile buckets: lax.scan compile cost is E-independent
+    # (one While body), so buckets only bound padding waste — scan-path
+    # pad evals still run their S slot steps, bulk pads exit immediately
+    E_BUCKETS = (1, 8, 16, 48)
+
+    def __init__(self, max_batch: int = 48):
+        # batches are sliced at max_batch before grouping, so every group
+        # must fit the largest compile bucket
+        self.max_batch = min(max_batch, self.E_BUCKETS[-1])
         self._queue: List[_Request] = []
         self._cv = threading.Condition()
         self._stop = False
@@ -240,6 +247,41 @@ class PlacementEngine:
             self._queue.append(req)
             self._cv.notify()
         return req.future.result()
+
+    def warmup(self, cm, inputs: Optional[PlaceInputs] = None,
+               bulk: Optional[dict] = None) -> None:
+        """Compile every E-bucket variant of the dispatch kernels for the
+        given input shapes, so a serving or measurement window never pays
+        a mid-run XLA compile (queue timing makes organically warmed
+        bucket coverage nondeterministic).  `inputs`: a representative
+        scan-path PlaceInputs; `bulk`: place_bulk-style field dict
+        (feasible/affinity/has_affinity/desired/penalty/coll0/demand/
+        count).  Results are discarded; nothing registers in the
+        in-flight overlay.  Timing/cache stats are restored afterwards so
+        one-time compile cost never skews serving diagnostics."""
+        import jax
+
+        stats_before = dict(self.stats)
+        cache_before = (self._cache.hits, self._cache.misses)
+        for E in self.E_BUCKETS:
+            if inputs is not None:
+                reqs = [_Request(cm=cm, inputs=inputs, deltas=[],
+                                 spread_algorithm=False, future=Future())
+                        for _ in range(E)]
+                packed = self._dispatch_packed(
+                    reqs, E=E, basis=np.asarray(inputs.used, np.float32),
+                    deltas_per_req=[[] for _ in reqs],
+                    capacity=np.asarray(inputs.capacity))
+                jax.block_until_ready(packed)
+            if bulk is not None:
+                breqs = [_BulkRequest(cm=cm, deltas=[],
+                                      spread_algorithm=False,
+                                      future=Future(), **bulk)
+                         for _ in range(E)]
+                packed, _basis = self._dispatch_bulk_group(breqs)
+                jax.block_until_ready(packed)
+        self.stats.update(stats_before)
+        self._cache.hits, self._cache.misses = cache_before
 
     def register_external(self, cm, contributions) -> int:
         """Record usage scheduled OUTSIDE the engine (the bulk wavefront
@@ -487,7 +529,7 @@ class PlacementEngine:
 
         cm = reqs[0].cm
         N = reqs[0].feasible.shape[0]
-        E = self.max_batch
+        E = next(b for b in self.E_BUCKETS if b >= len(reqs))
         # rows are stable across matrix re-bucketing (growth only pads
         # the node axis), so the enqueue-time world is the prefix slice
         capacity = cm.capacity[:N]
@@ -579,13 +621,11 @@ class PlacementEngine:
         through the device cache (hits ship nothing), light blocks + the
         usage basis concatenate into ONE device_put leaf.  Returns the
         device-side output array (fetch happens batched in _dispatch)."""
-        # one compiled batch shape per input-shape group: always pad the
-        # eval axis to max_batch (padding costs only wasted scan steps;
-        # another E bucket would cost a full XLA compile)
         cm = reqs[0].cm
         basis = self._basis_for(cm)
+        E = next(b for b in self.E_BUCKETS if b >= len(reqs))
         return self._dispatch_packed(
-            reqs, E=self.max_batch, basis=basis,
+            reqs, E=E, basis=basis,
             deltas_per_req=[r.deltas for r in reqs], capacity=cm.capacity)
 
     def _dispatch_packed(self, reqs: List[_Request], E: int,
