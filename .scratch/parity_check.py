@@ -1,0 +1,81 @@
+import numpy as np, jax
+from nomad_tpu import mock
+from nomad_tpu.encode import ClusterMatrix
+from nomad_tpu.scheduler.stack import DenseStack
+from nomad_tpu.structs.job import Affinity, Operand, Spread
+from nomad_tpu.ops.place import (pack_heavy, pack_light, place_batch_packed_jit,
+                                 unpack_outputs, heavy_dims,
+                                 pack_bulk_heavy, pack_bulk_light,
+                                 place_bulk_batch_jit, unpack_bulk_batch)
+from nomad_tpu.parallel.sharded import (make_serving_mesh, place_batch_sharded,
+                                        place_bulk_batch_sharded)
+
+cm = ClusterMatrix(initial_rows=64)
+rng = np.random.default_rng(0)
+for i in range(64):
+    n = mock.node()
+    n.attributes["rack"] = f"r{i%4}"
+    n.node_resources.cpu.cpu_shares = int(rng.integers(3000, 8000))
+    cm.upsert_node(n)
+j = mock.job()
+tg = j.task_groups[0]; tg.count = 6
+tg.spreads = [Spread("${attr.rack}", 70, ())]
+j.affinities.append(Affinity("${attr.rack}", "r1", Operand.EQ, weight=30))
+st = DenseStack(cm)
+groups = [st.compile_group(j, tg) for tg in j.task_groups]
+inp = st.build_inputs(j, groups, [0]*6, {})
+E, D, R = 4, 8, 4
+N = cm.n_rows
+G, _, K, Vp1 = heavy_dims(inp)
+S = inp.demand.shape[0]
+deltas = [(3, np.array([200., 100., 0., 0.], np.float32))]
+
+heavy = jax.device_put(pack_heavy(inp))
+lights = [pack_light(inp, deltas if e==0 else [], D) for e in range(E)]
+basis = np.ascontiguousarray(cm.used, np.float32)
+dyn = np.concatenate([basis.ravel()] + lights)
+packed, _ = place_batch_packed_jit(jax.device_put(np.ascontiguousarray(cm.capacity, np.float32)),
+                                   tuple([heavy]*E), jax.device_put(dyn), (G, N, K, Vp1, S, D))
+ref = unpack_outputs(np.asarray(jax.device_get(packed)))
+
+mesh = make_serving_mesh()
+fields = {f: np.stack([np.asarray(getattr(inp, f))]*E) for f in
+          ("feasible","affinity","has_affinity","desired_count","penalty","tg_count",
+           "spread_vidx","spread_desired","spread_targeted","spread_wfrac",
+           "spread_counts","spread_active","place_cap","demand","slot_tg","slot_active")}
+drows = np.full((E, D), N, np.int32); dvals = np.zeros((E, D, R), np.float32)
+drows[0,0] = 3; dvals[0,0] = deltas[0][1]
+packed_s, used_f = place_batch_sharded(mesh, np.ascontiguousarray(cm.capacity, np.float32),
+                                       basis, fields, drows, dvals)
+got = unpack_outputs(np.asarray(jax.device_get(packed_s)))
+for a, b, name in zip(ref, got, ("node","score","fit","ne","nx","tn","ts")):
+    if name in ("score","fit","ts"):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    elif name == "tn":
+        pass
+    else:
+        np.testing.assert_array_equal(a, b)
+print("scan-path sharded parity OK; nodes:", got[0][:, :6].tolist())
+
+bj = mock.batch_job(); btg = bj.task_groups[0]; btg.count = 40
+btg.tasks[0].resources.cpu = 300; btg.tasks[0].resources.memory_mb = 200
+btg.ephemeral_disk.size_mb = 0
+bst = DenseStack(cm); bg = bst.compile_group(bj, btg)
+hb = jax.device_put(pack_bulk_heavy(bg.feasible, bg.affinity, np.zeros(N,bool), np.zeros(N,np.int32)))
+lb = [pack_bulk_light(bg.has_affinity, 40, 40, bg.demand, deltas if e==0 else [], N, D) for e in range(E)]
+dynb = np.concatenate([basis.ravel()] + lb)
+pb, _ = place_bulk_batch_jit(jax.device_put(np.ascontiguousarray(cm.capacity, np.float32)),
+                             tuple([hb]*E), jax.device_put(dynb), D)
+ref_b = unpack_bulk_batch(np.asarray(jax.device_get(pb)))
+
+ass, sc, placed, ne, nx, uf = place_bulk_batch_sharded(
+    mesh, np.ascontiguousarray(cm.capacity, np.float32), basis,
+    np.stack([bg.feasible]*E), np.stack([bg.affinity.astype(np.float32)]*E),
+    np.array([bool(bg.has_affinity)]*E), np.array([40]*E, np.int32),
+    np.stack([np.zeros(N, bool)]*E), np.stack([np.zeros(N, np.int32)]*E),
+    np.stack([bg.demand.astype(np.float32)]*E), np.array([40]*E, np.int32),
+    drows, dvals)
+np.testing.assert_array_equal(np.asarray(ass), ref_b[0])
+np.testing.assert_array_equal(np.asarray(placed), ref_b[2])
+np.testing.assert_array_equal(np.asarray(ne), ref_b[3])
+print("bulk sharded parity OK; placed:", np.asarray(placed).tolist())

@@ -416,6 +416,49 @@ def place_batch_packed_jit(capacity: jax.Array,     # f32[N, R]
     return packed, used_final
 
 
+def bulk_wave_grid(capacity, used, demand, feasible, affinity,
+                   has_affinity, desired_f, penalty, coll,
+                   spread_algorithm: bool):
+    """The [N, M] per-wave fill/scoring grid shared by the single-device
+    (`_bulk_loop`) and node-sharded (parallel.sharded) bulk kernels —
+    column m is every node's score/fitness with m more instances placed
+    on it.  Returns (ms f32[M], fits_m bool[N, M], score_m f32[N, M]).
+    Operates on whatever node slice it is given (a shard passes its
+    local rows); MUST stay the single source of truth for the bulk
+    scoring stack or sharded/single-device placement parity breaks."""
+    M = _FILL_GRID
+    ms = jnp.arange(1, M + 1, dtype=jnp.float32)
+    util_m = used[:, None, :] + ms[None, :, None] * demand    # [N, M, R]
+    fits_m = (jnp.all(util_m <= capacity[:, None, :], axis=-1)
+              & feasible[:, None])
+    fit_m = score_fit(capacity[:, None, :], util_m,
+                      spread_algorithm) / 18.0                 # [N, M]
+    coll_m = coll[:, None].astype(jnp.float32) + ms[None, :] - 1.0
+    total_m = fit_m
+    n_sc = jnp.ones_like(fit_m)
+    anti_m = -(coll_m + 1.0) / jnp.maximum(desired_f, 1.0)
+    has_coll_m = coll_m > 0.0
+    total_m = total_m + jnp.where(has_coll_m, anti_m, 0.0)
+    n_sc = n_sc + has_coll_m
+    total_m = total_m - penalty[:, None]
+    n_sc = n_sc + penalty[:, None]
+    aff_on = has_affinity & (affinity != 0.0)                  # [N]
+    total_m = total_m + jnp.where(aff_on[:, None], affinity[:, None], 0.0)
+    n_sc = n_sc + aff_on[:, None]
+    return ms, fits_m, total_m / n_sc
+
+
+def bulk_run_lengths(ms, fits_m, score_m, second):
+    """Per-node greedy fill runs from the wave grid: leading m's where
+    the node still fits and score_m strictly beats `second` (the best
+    wave-start score among the OTHERS); m=1 is the FORCED placement —
+    once a node is argmax (by score or lowest-row tie-break), greedy
+    places on it regardless of its post-score."""
+    ok_m = fits_m & ((score_m > second[:, None]) | (ms[None, :] == 1.0))
+    return jnp.sum(jnp.cumprod(ok_m.astype(jnp.int32), axis=1),
+                   axis=1).astype(jnp.int32)
+
+
 def _bulk_scores(capacity, used, demand, feasible, affinity, has_affinity,
                  desired, penalty, coll, spread_algorithm: bool):
     """Composite per-node score for one task group with spreads inactive —
@@ -489,33 +532,15 @@ def _bulk_loop(capacity, used0, feasible, affinity, has_affinity, desired,
 
     def body(c):
         used, coll, placed, assign, stuck, waves = c
-        # ONE [N, M] scoring grid per wave: column m is every node's
-        # score/fitness with m more instances placed on it.  m=1 ("place
-        # one more now") is the wave-start score, m=2 each node's own
-        # "+1" world (scoring is row-independent, so this evaluates the
-        # post-placement score of every node at once), and the leading
-        # columns give the per-node fill runs.
-        M = _FILL_GRID
-        ms = jnp.arange(1, M + 1, dtype=jnp.float32)
-        util_m = used[:, None, :] + ms[None, :, None] * demand  # [N, M, R]
-        fits_m = (jnp.all(util_m <= capacity[:, None, :], axis=-1)
-                  & feasible[:, None])
-        fit_m = score_fit(capacity[:, None, :], util_m,
-                          spread_algorithm) / 18.0               # [N, M]
-        coll_m = coll[:, None].astype(jnp.float32) + ms[None, :] - 1.0
-        total_m = fit_m
-        n_sc = jnp.ones_like(fit_m)
-        anti_m = -(coll_m + 1.0) / jnp.maximum(desired_f, 1.0)
-        has_coll_m = coll_m > 0.0
-        total_m = total_m + jnp.where(has_coll_m, anti_m, 0.0)
-        n_sc = n_sc + has_coll_m
-        total_m = total_m - penalty[:, None]
-        n_sc = n_sc + penalty[:, None]
-        aff_on = has_affinity & (affinity != 0.0)                # [N]
-        total_m = total_m + jnp.where(aff_on[:, None],
-                                      affinity[:, None], 0.0)
-        n_sc = n_sc + aff_on[:, None]
-        score_m = total_m / n_sc
+        # ONE [N, M] scoring grid per wave (bulk_wave_grid, shared with
+        # the node-sharded kernel): m=1 ("place one more now") is the
+        # wave-start score, m=2 each node's own "+1" world (scoring is
+        # row-independent, so this evaluates the post-placement score of
+        # every node at once), and the leading columns give the per-node
+        # fill runs.
+        ms, fits_m, score_m = bulk_wave_grid(
+            capacity, used, demand, feasible, affinity, has_affinity,
+            desired_f, penalty, coll, spread_algorithm)
 
         fits = fits_m[:, 0]
         cur = jnp.where(fits, score_m[:, 0], -jnp.inf)
@@ -527,17 +552,8 @@ def _bulk_loop(capacity, used0, feasible, affinity, has_affinity, desired,
         tie = fits & (cur == top2[0])
         wave = jnp.where(jnp.any(strict), strict, tie)
 
-        # run_i = leading m's where node i still fits and score_i(m)
-        # strictly beats the best wave-start score among the OTHERS
         second = jnp.where(cur == top2[0], top2[1], top2[0])   # [N]
-        # m=1 is the FORCED placement: once a node is the argmax (by
-        # score or by the lowest-row tie-break) greedy places on it
-        # regardless of what its score becomes after — only m >= 2 must
-        # strictly beat the others' wave-start scores to keep the run
-        ok_m = fits_m & ((score_m > second[:, None])
-                         | (ms[None, :] == 1.0))
-        run = jnp.sum(jnp.cumprod(ok_m.astype(jnp.int32), axis=1),
-                      axis=1).astype(jnp.int32)                  # [N]
+        run = bulk_run_lengths(ms, fits_m, score_m, second)
 
         # greedy-order the wave's runs (score desc, stable -> row asc
         # among ties) and cap cumulatively at the remaining count

@@ -77,19 +77,37 @@ class _DeviceCache:
 
     def _get_or_put(self, key, build):
         import jax
+        return self._get_or_put_device(key, lambda: jax.device_put(build()))
+
+    def _get_or_put_device(self, key, build_device):
+        """build_device() must return the final (device-resident) value."""
         with self._lock:
             v = self._d.get(key)
             if v is not None:
                 self._d.move_to_end(key)
                 self.hits += 1
                 return v
-        arr = jax.device_put(build())
+        arr = build_device()
         with self._lock:
             self._d[key] = arr
             self.misses += 1
             while len(self._d) > self.max_entries:
                 self._d.popitem(last=False)
         return arr
+
+    def sharded(self, tag, mesh, pytree, shardings):
+        """Content-addressed sharded placement of a pytree: a hit returns
+        the device-resident (already mesh-sharded) arrays with zero bytes
+        shipped — the multi-chip twin of heavy()/bulk_heavy()."""
+        import hashlib
+
+        import jax
+        h = hashlib.blake2b(digest_size=16)
+        for leaf in jax.tree_util.tree_leaves(pytree):
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        key = ("sh", tag, id(mesh), h.digest())
+        return self._get_or_put_device(
+            key, lambda: jax.device_put(pytree, shardings))
 
     def heavy(self, inputs: PlaceInputs):
         """Device-resident packed heavy block for one eval's inputs."""
@@ -173,10 +191,23 @@ class PlacementEngine:
     # pad evals still run their S slot steps, bulk pads exit immediately
     E_BUCKETS = (1, 8, 16, 48)
 
-    def __init__(self, max_batch: int = 48):
+    def __init__(self, max_batch: int = 48,
+                 shard_min_nodes: Optional[int] = None):
         # batches are sliced at max_batch before grouping, so every group
         # must fit the largest compile bucket
         self.max_batch = min(max_batch, self.E_BUCKETS[-1])
+        # multi-chip serving: when >1 device is visible, dispatches whose
+        # node axis reaches shard_min_nodes (and divides the device
+        # count) route through the ('nodes',)-mesh kernels — the
+        # "pmap across the EvalBroker queue" north star, with the eval
+        # axis kept chained for single-device-identical placements.
+        # NOMAD_TPU_SHARD=0 disables; NOMAD_TPU_SHARD_MIN tunes.
+        if shard_min_nodes is None:
+            shard_min_nodes = int(os.environ.get("NOMAD_TPU_SHARD_MIN",
+                                                 "1024"))
+        self.shard_min_nodes = shard_min_nodes
+        self._serving_mesh = None
+        self._mesh_checked = False
         self._queue: List[_Request] = []
         self._cv = threading.Condition()
         self._stop = False
@@ -263,23 +294,34 @@ class PlacementEngine:
 
         stats_before = dict(self.stats)
         cache_before = (self._cache.hits, self._cache.misses)
+        mesh = self._mesh_for(cm.n_rows)
         for E in self.E_BUCKETS:
             if inputs is not None:
                 reqs = [_Request(cm=cm, inputs=inputs, deltas=[],
                                  spread_algorithm=False, future=Future())
                         for _ in range(E)]
-                packed = self._dispatch_packed(
-                    reqs, E=E, basis=np.asarray(inputs.used, np.float32),
-                    deltas_per_req=[[] for _ in reqs],
-                    capacity=np.asarray(inputs.capacity))
-                jax.block_until_ready(packed)
+                if mesh is not None:
+                    jax.block_until_ready(
+                        self._dispatch_group_sharded(reqs, mesh))
+                else:
+                    packed = self._dispatch_packed(
+                        reqs, E=E,
+                        basis=np.asarray(inputs.used, np.float32),
+                        deltas_per_req=[[] for _ in reqs],
+                        capacity=np.asarray(inputs.capacity))
+                    jax.block_until_ready(packed)
             if bulk is not None:
                 breqs = [_BulkRequest(cm=cm, deltas=[],
                                       spread_algorithm=False,
                                       future=Future(), **bulk)
                          for _ in range(E)]
-                packed, _basis = self._dispatch_bulk_group(breqs)
-                jax.block_until_ready(packed)
+                if mesh is not None:
+                    out, _b = self._dispatch_bulk_group_sharded(breqs,
+                                                                mesh)
+                    jax.block_until_ready(out)
+                else:
+                    packed, _basis = self._dispatch_bulk_group(breqs)
+                    jax.block_until_ready(packed)
         self.stats.update(stats_before)
         self._cache.hits, self._cache.misses = cache_before
 
@@ -477,16 +519,30 @@ class PlacementEngine:
         pending_bulk = []   # (requests, (device packed, basis))
         for reqs in groups.values():
             if isinstance(reqs[0], _BulkRequest):
+                mesh = self._mesh_for(reqs[0].feasible.shape[0])
                 for part in self._split_bulk(reqs):
-                    pending_bulk.append(
-                        (part, self._dispatch_bulk_group(part)))
+                    if mesh is not None:
+                        pending_bulk.append(
+                            (part,
+                             self._dispatch_bulk_group_sharded(part, mesh)))
+                    else:
+                        pending_bulk.append(
+                            (part, self._dispatch_bulk_group(part)))
                 self.stats["bulk_evals"] += len(reqs)
+                continue
+            rebucketed = (reqs[0].cm.capacity.shape[0]
+                          != reqs[0].inputs.capacity.shape[0])
+            mesh = None if rebucketed else \
+                self._mesh_for(reqs[0].inputs.capacity.shape[0])
+            if mesh is not None:
+                pending.append(
+                    (reqs, self._dispatch_group_sharded(reqs, mesh)))
+                self.stats["batched_evals"] += len(reqs)
                 continue
             # single path also when the matrix has grown (re-bucketed)
             # since these inputs were built: the dispatch-time basis no
             # longer matches the padded node axis
-            if (len(reqs) == 1 or
-                    reqs[0].cm.capacity.shape[0] != reqs[0].inputs.capacity.shape[0]):
+            if len(reqs) == 1 or rebucketed:
                 for r in reqs:
                     self._run_single(r)
                 self.stats["single_evals"] += len(reqs)
@@ -515,8 +571,146 @@ class PlacementEngine:
                 r.future.set_result((res, ticket))
         for (reqs, (_, basis)), packed in zip(
                 pending_bulk, fetched[len(pending):]):
-            self._resolve_bulk(reqs, np.asarray(packed), basis)
+            self._resolve_bulk(reqs, packed, basis)
         self.stats["resolve_s"] += _time.time() - t0
+
+    # ------------------------------------------------------- sharded path
+
+    def _mesh_for(self, N: int):
+        """The ('nodes',) serving mesh when sharding applies to this node
+        axis, else None."""
+        if os.environ.get("NOMAD_TPU_SHARD", "1") == "0":
+            return None
+        if not self._mesh_checked:
+            import jax
+
+            from nomad_tpu.parallel.sharded import make_serving_mesh
+            if len(jax.devices()) > 1:
+                self._serving_mesh = make_serving_mesh()
+            self._mesh_checked = True
+        mesh = self._serving_mesh
+        if mesh is None or N < self.shard_min_nodes:
+            return None
+        # shards need >= 2 local rows (the wave's top-2 reduction)
+        if N % mesh.devices.size != 0 or N < 2 * mesh.devices.size:
+            return None
+        return mesh
+
+    # per-eval PlaceInputs fields shipped to the sharded scan kernel
+    _SHARD_FIELDS = (
+        "feasible", "affinity", "has_affinity", "desired_count",
+        "penalty", "tg_count", "spread_vidx", "spread_desired",
+        "spread_targeted", "spread_wfrac", "spread_counts",
+        "spread_active", "place_cap", "demand", "slot_tg", "slot_active")
+
+    def _stack_deltas(self, deltas_per_req, E: int, N: int):
+        R = NUM_RESOURCE_DIMS
+        D = pad_to_bucket(max([len(d) for d in deltas_per_req] + [1]),
+                          minimum=_DELTA_BUCKET_MIN)
+        drows = np.full((E, D), N, np.int32)
+        dvals = np.zeros((E, D, R), np.float32)
+        for i, ds in enumerate(deltas_per_req):
+            for d, (row, vec) in enumerate(ds[:D]):
+                drows[i, d] = row
+                dvals[i, d] = vec
+        return drows, dvals
+
+    def _dispatch_group_sharded(self, reqs: List[_Request], mesh):
+        """Scan-path dispatch over the node-sharded serving mesh.  Pads
+        the eval axis to a compile bucket with inert evals (slot_active
+        all False)."""
+        from nomad_tpu.parallel.sharded import place_batch_sharded
+
+        cm = reqs[0].cm
+        N = reqs[0].inputs.capacity.shape[0]
+        E = next(b for b in self.E_BUCKETS if b >= len(reqs))
+        t0 = _time.time()
+        fields = {}
+        for f in self._SHARD_FIELDS:
+            arrs = [np.asarray(getattr(r.inputs, f)) for r in reqs]
+            if E > len(reqs):
+                pad = (np.zeros_like(arrs[0])
+                       if f == "slot_active" else arrs[0])
+                arrs += [pad] * (E - len(reqs))
+            fields[f] = np.stack(arrs)
+        drows, dvals = self._stack_deltas(
+            [r.deltas for r in reqs] + [[]] * (E - len(reqs)), E, N)
+        basis = self._basis_for(cm)
+        self.stats["stack_s"] += _time.time() - t0
+        t0 = _time.time()
+        # content-addressed sharded placement: identical job-state
+        # batches (the common case) ship zero bytes; basis/deltas always
+        # ship (they change every dispatch and are small)
+        from jax.sharding import NamedSharding
+        from nomad_tpu.parallel.sharded import _field_specs_batched
+        fshard = {k: NamedSharding(mesh, s)
+                  for k, s in _field_specs_batched().items()}
+        fields_dev = self._cache.sharded("scan", mesh, fields, fshard)
+        from jax.sharding import PartitionSpec as _P
+        # snapshot-copy: hashing the live cm.capacity then shipping it
+        # later could cache bytes under a digest they no longer match
+        cap_dev = self._cache.sharded(
+            "cap", mesh, np.array(cm.capacity, dtype=np.float32),
+            NamedSharding(mesh, _P("nodes", None)))
+        packed, _used = place_batch_sharded(
+            mesh, cap_dev,
+            np.ascontiguousarray(basis, dtype=np.float32), fields_dev,
+            drows, dvals, spread_algorithm=reqs[0].spread_algorithm)
+        self.stats["put_s"] += _time.time() - t0
+        self.stats["sharded_evals"] = (
+            self.stats.get("sharded_evals", 0) + len(reqs))
+        return packed
+
+    def _dispatch_bulk_group_sharded(self, reqs: List[_BulkRequest],
+                                     mesh):
+        from nomad_tpu.parallel.sharded import place_bulk_batch_sharded
+
+        cm = reqs[0].cm
+        N = reqs[0].feasible.shape[0]
+        E = next(b for b in self.E_BUCKETS if b >= len(reqs))
+        capacity = cm.capacity[:N]
+        basis = self._basis_for(cm)[:N]
+
+        t0 = _time.time()
+        pad = E - len(reqs)
+        stack1 = lambda get, dt: np.stack(
+            [np.asarray(get(r), dt) for r in reqs]
+            + [np.asarray(get(reqs[0]), dt)] * pad)
+        feas = stack1(lambda r: r.feasible, bool)
+        aff = stack1(lambda r: r.affinity, np.float32)
+        pen = stack1(lambda r: r.penalty, bool)
+        coll = stack1(lambda r: r.coll0, np.int32)
+        dem = stack1(lambda r: r.demand, np.float32)
+        hasa = np.array([r.has_affinity for r in reqs]
+                        + [False] * pad, bool)
+        des = np.array([r.desired for r in reqs] + [1] * pad, np.int32)
+        # padded evals have count=0: the wavefront exits immediately
+        cnt = np.array([r.count for r in reqs] + [0] * pad, np.int32)
+        drows, dvals = self._stack_deltas(
+            [r.deltas for r in reqs] + [[]] * pad, E, N)
+        basis = np.ascontiguousarray(basis, dtype=np.float32)
+        self.stats["stack_s"] += _time.time() - t0
+        t0 = _time.time()
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _P
+        node2 = NamedSharding(mesh, _P(None, "nodes"))
+        rep1 = NamedSharding(mesh, _P(None))
+        rep2 = NamedSharding(mesh, _P(None, None))
+        feas, aff, pen, coll, dem, hasa, des = self._cache.sharded(
+            "bulk", mesh, (feas, aff, pen, coll, dem, hasa, des),
+            (node2, node2, node2, node2, rep2, rep1, rep1))
+        cap_dev = self._cache.sharded(
+            "cap", mesh, np.array(capacity, dtype=np.float32),
+            NamedSharding(mesh, _P("nodes", None)))
+        out = place_bulk_batch_sharded(
+            mesh, cap_dev,
+            basis, feas, aff, hasa, des, pen, coll, dem, cnt,
+            drows, dvals, spread_algorithm=reqs[0].spread_algorithm)
+        assign, scores, placed, n_eval, n_exh, _used = out
+        self.stats["put_s"] += _time.time() - t0
+        self.stats["sharded_evals"] = (
+            self.stats.get("sharded_evals", 0) + len(reqs))
+        return (assign, scores, placed, n_eval, n_exh), basis
 
     # ---------------------------------------------------------- bulk path
 
@@ -567,7 +761,13 @@ class PlacementEngine:
         sees basis + prior evals' PLACEMENTS + its own private deltas;
         deltas never chain forward (uncommitted stops of one eval are
         invisible to others, exactly like the in-flight overlay)."""
-        assign, scores, placed, n_eval, n_exh = unpack_bulk_batch(packed)
+        if isinstance(packed, tuple):       # sharded path: raw field tuple
+            assign, scores, placed, n_eval, n_exh = \
+                [np.asarray(x) for x in packed]
+            assign = assign.astype(np.int32)
+        else:
+            assign, scores, placed, n_eval, n_exh = \
+                unpack_bulk_batch(np.asarray(packed))
         u = basis.copy()
         N = u.shape[0]
         for i, r in enumerate(reqs):

@@ -144,6 +144,8 @@ def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
     spread_counts = spread_counts.at[g].add(upd)
 
     # per-slot metrics (global)
+    fit_sel = jax.lax.psum(
+        jnp.sum(jnp.where(sel_local, fit_score, 0.0)), "nodes")
     n_eval = jax.lax.psum(jnp.sum(feas & active), "nodes")
     n_exh = jax.lax.psum(jnp.sum(feas & ~fits & active), "nodes")
     k_local = min(TOP_K, masked.shape[0])
@@ -155,6 +157,7 @@ def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
     out = (
         jnp.where(ok, sel, -1).astype(jnp.int32),
         jnp.where(ok, global_best, 0.0),
+        jnp.where(ok, fit_sel, 0.0),
         n_eval.astype(jnp.int32),
         n_exh.astype(jnp.int32),
         top_i[order].astype(jnp.int32),
@@ -173,8 +176,8 @@ def _shard_body(inp: PlaceInputs, spread_algorithm: bool):
     step = functools.partial(_place_step_sharded, inp, spread_algorithm,
                              shard_offset)
     (used, _, _, _), outs = jax.lax.scan(step, carry0, jnp.arange(S))
-    node, score, n_eval, n_exh, top_i, top_s = outs
-    return node, score, n_eval, n_exh, top_i, top_s, used
+    node, score, fit_s, n_eval, n_exh, top_i, top_s = outs
+    return node, score, fit_s, n_eval, n_exh, top_i, top_s, used
 
 
 def place_eval_batch_sharded(mesh: Mesh, stacked: PlaceInputs,
@@ -183,7 +186,7 @@ def place_eval_batch_sharded(mesh: Mesh, stacked: PlaceInputs,
 
     `stacked` has a leading eval-batch axis on every field (see
     stack_inputs); the batch is sharded over 'evals' and the node axis over
-    'nodes'.  Returns per-eval (node, score, nodes_evaluated,
+    'nodes'.  Returns per-eval (node, score, fit_score, nodes_evaluated,
     nodes_exhausted, top_nodes, top_scores, used_final).
     """
     in_specs = _input_specs(batched=True)
@@ -195,9 +198,242 @@ def place_eval_batch_sharded(mesh: Mesh, stacked: PlaceInputs,
 
     out_specs = (
         P("evals", None), P("evals", None), P("evals", None),
-        P("evals", None), P("evals", None, None), P("evals", None, None),
-        P("evals", "nodes", None),
+        P("evals", None), P("evals", None), P("evals", None, None),
+        P("evals", None, None), P("evals", "nodes", None),
     )
     fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(in_specs,),
                                out_specs=out_specs, check_vma=False))
     return fn(stacked)
+
+
+# --------------------------------------------------------------------------
+# Serving-path kernels: the PlacementEngine's chained batch semantics over
+# a 1-D ('nodes',) mesh.  The eval axis stays a lax.scan (eval e+1 scores
+# against usage including eval e's placements — identical placements to
+# the single-device engine, the property the conflict-free design relies
+# on); the node axis, where the FLOPs live, shards across devices.
+# Selection/ordering runs on [N]-vector collectives (all_gather/pmax/psum
+# over ICI), which are KBs per wave — the scoring stacks and the [N, M]
+# fill grid never leave their shard.
+# --------------------------------------------------------------------------
+
+
+def _apply_deltas_local(used, delta_rows, delta_vals, shard_offset):
+    """Scatter global-row sparse deltas into a node-sharded usage matrix
+    (rows outside this shard drop)."""
+    n_local = used.shape[0]
+    lrows = delta_rows - shard_offset
+    ok = (lrows >= 0) & (lrows < n_local)
+    lrows = jnp.where(ok, lrows, n_local)
+    return used.at[lrows].add(
+        jnp.where(ok[:, None], delta_vals, 0.0), mode="drop")
+
+
+def make_serving_mesh(devices=None) -> Mesh:
+    """1-D ('nodes',) mesh over all devices — the engine's serving mesh."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), ("nodes",))
+
+
+def _field_specs_batched() -> dict:
+    """PartitionSpecs for the per-eval field dict (PlaceInputs minus the
+    shared capacity/used basis), leading 'evals' batch axis unsharded on
+    the serving mesh (the eval axis is a chained scan)."""
+    specs = {}
+    for name, axis in _NODE_AXIS.items():
+        if name in ("capacity", "used"):
+            continue
+        ndim = {"feasible": 2, "affinity": 2, "penalty": 2, "tg_count": 2,
+                "spread_vidx": 3, "place_cap": 2, "has_affinity": 1,
+                "desired_count": 1, "spread_desired": 3,
+                "spread_targeted": 2, "spread_wfrac": 2,
+                "spread_counts": 3, "spread_active": 2, "demand": 2,
+                "slot_tg": 1, "slot_active": 1}[name]
+        parts = [None] * ndim
+        if axis is not None:
+            parts[axis] = "nodes"
+        specs[name] = P(*([None] + parts))
+    return specs
+
+
+_SERVING_FN_CACHE: dict = {}
+
+
+def place_batch_sharded(mesh: Mesh, capacity, used0, fields: dict,
+                        delta_rows, delta_vals,
+                        spread_algorithm: bool = False):
+    """Chained scan-path batch (engine _dispatch_group) over a ('nodes',)
+    mesh.  `fields`: per-eval PlaceInputs fields (minus capacity/used,
+    which ride separately as the batch-shared basis), each with a leading
+    E axis; `delta_rows` i32[E, D] / `delta_vals` f32[E, D, R] are each
+    eval's sparse usage adjustments (row == N drops).  Returns (packed
+    f32[E, S, 5+2K] — the engine's unpack_outputs layout — and the
+    node-sharded final usage)."""
+    from nomad_tpu.ops.place import _pack_outputs
+
+    def body(cap, u0, flds, drows, dvals):
+        idx = jax.lax.axis_index("nodes")
+        n_local = cap.shape[0]
+        shard_offset = idx * n_local
+
+        def eval_step(used, ev):
+            one, dr, dv = ev
+            used = _apply_deltas_local(used, dr, dv, shard_offset)
+            inp = PlaceInputs(capacity=cap, used=used, **one)
+            S = inp.demand.shape[0]
+            carry0 = (used, inp.tg_count, inp.spread_counts,
+                      inp.place_cap)
+            step = functools.partial(_place_step_sharded, inp,
+                                     spread_algorithm, shard_offset)
+            (used_f, _, _, _), outs = jax.lax.scan(step, carry0,
+                                                   jnp.arange(S))
+            return used_f, _pack_outputs(*outs)
+
+        used_final, packed = jax.lax.scan(eval_step, u0,
+                                          (flds, drows, dvals))
+        return packed, used_final
+
+    key = ("scan", mesh, spread_algorithm)
+    fn = _SERVING_FN_CACHE.get(key)
+    if fn is None:
+        in_specs = (P("nodes", None), P("nodes", None),
+                    _field_specs_batched(), P(None, None),
+                    P(None, None, None))
+        out_specs = (P(None, None, None), P("nodes", None))
+        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_vma=False))
+        _SERVING_FN_CACHE[key] = fn
+    return fn(capacity, used0, fields, delta_rows, delta_vals)
+
+
+def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
+                             feasible, affinity, has_affinity, desired,
+                             penalty, coll0, demand, count,
+                             delta_rows, delta_vals,
+                             spread_algorithm: bool = False,
+                             max_waves: int = 65536):
+    """Chained bulk wavefront batch (engine place_bulk) over a ('nodes',)
+    mesh — the C2M-scale multi-chip path.  Per-eval node-axis fields
+    carry a leading E axis; scalars (has_affinity/desired/count) are
+    f32[E].  Each wave computes its [N_local, M] scoring/fill grid on the
+    shard, then resolves the global greedy order from two all_gathered
+    [N] vectors (wave-start score + per-node run), every device deriving
+    the identical per-node placement so only its own rows mutate.
+    Returns (assign i32[E, N], scores f32[E, N], placed/n_eval/n_exh
+    i32[E] each, used_final sharded)."""
+    from nomad_tpu.ops.place import (
+        _bulk_scores,
+        bulk_run_lengths as _bulk_run_lengths,
+        bulk_wave_grid as _bulk_wave_grid,
+    )
+
+    def body(cap, u0, feas_e, aff_e, hasa_e, des_e, pen_e, coll_e,
+             dem_e, cnt_e, drows, dvals):
+        idx = jax.lax.axis_index("nodes")
+        n_local = cap.shape[0]
+        shard_offset = idx * n_local
+
+        def eval_step(used_in, ev):
+            feasible, affinity, has_aff, desired, penalty, coll0, \
+                demand, count, dr, dv = ev
+            # deltas are scoped to THIS eval (backed out of the carry
+            # below), matching place_bulk_batch_jit: uncommitted stops of
+            # one eval never leak into another's scoring
+            used = _apply_deltas_local(used_in, dr, dv, shard_offset)
+            delta_local = used - used_in
+            desired_f = desired.astype(jnp.float32)
+
+            def cond(c):
+                u, coll, placed, assign, stuck, waves = c
+                return (placed < count) & ~stuck & (waves < max_waves)
+
+            def wave(c):
+                u, coll, placed, assign, stuck, waves = c
+                # the shared single-source-of-truth scoring grid
+                # (ops.place.bulk_wave_grid) on this shard's rows; only
+                # the reductions/selection go through collectives
+                ms, fits_m, score_m = _bulk_wave_grid(
+                    cap, u, demand, feasible, affinity, has_aff,
+                    desired_f, penalty, coll, spread_algorithm)
+
+                fits = fits_m[:, 0]
+                cur = jnp.where(fits, score_m[:, 0], -jnp.inf)
+                any_fit = jax.lax.pmax(
+                    jnp.any(fits).astype(jnp.int32), "nodes") > 0
+                s_star = jax.lax.pmax(
+                    jnp.max(jnp.where(fits_m[:, 1], score_m[:, 1],
+                                      -jnp.inf)), "nodes")
+                # global top-2 of cur: local top-2, gathered
+                l2 = jax.lax.top_k(cur, 2)[0]
+                g2 = jax.lax.top_k(
+                    jax.lax.all_gather(l2, "nodes", tiled=True), 2)[0]
+                gmax, gsecond = g2[0], g2[1]
+                strict = fits & (cur > s_star)
+                use_strict = jax.lax.pmax(
+                    jnp.any(strict).astype(jnp.int32), "nodes") > 0
+                tie = fits & (cur == gmax)
+                wv = jnp.where(use_strict, strict, tie)
+                second = jnp.where(cur == gmax, gsecond, gmax)
+                run = _bulk_run_lengths(ms, fits_m, score_m, second)
+                base = jnp.where(wv, run, 0).astype(jnp.int32)
+
+                # global greedy order from gathered [N] vectors; every
+                # shard computes the identical per-node allocation and
+                # slices out its own rows
+                cur_g = jax.lax.all_gather(cur, "nodes", tiled=True)
+                base_g = jax.lax.all_gather(base, "nodes", tiled=True)
+                wave_g = base_g > 0
+                order = jnp.argsort(jnp.where(wave_g, -cur_g, jnp.inf))
+                base_sorted = base_g[order]
+                prefix = jnp.cumsum(base_sorted) - base_sorted
+                remaining = count - placed
+                alloc_sorted = jnp.clip(remaining - prefix, 0,
+                                        base_sorted)
+                per_node_g = jnp.zeros(base_g.shape[0], jnp.int32) \
+                    .at[order].set(alloc_sorted)
+                per_node = jax.lax.dynamic_slice(
+                    per_node_g, (shard_offset,), (n_local,))
+
+                u = u + per_node[:, None].astype(jnp.float32) * demand
+                coll = coll + per_node
+                assign = assign + per_node
+                placed = placed + jnp.sum(per_node_g)
+                return (u, coll, placed, assign, ~any_fit, waves + 1)
+
+            c0 = (used, coll0, jnp.int32(0),
+                  jnp.zeros(n_local, jnp.int32), jnp.array(False),
+                  jnp.int32(0))
+            used_f, coll_f, placed, assign, _, _ = \
+                jax.lax.while_loop(cond, wave, c0)
+
+            # final scores + metrics via the shared scoring stack
+            # (ops.place._bulk_scores on local rows; counts via psum)
+            scores, fits_f = _bulk_scores(
+                cap, used_f, demand, feasible, affinity, has_aff,
+                desired, penalty, coll_f, spread_algorithm)
+            n_eval = jax.lax.psum(jnp.sum(feasible), "nodes")
+            n_exh = jax.lax.psum(jnp.sum(feasible & ~fits_f), "nodes")
+            out = (assign, scores, placed.astype(jnp.int32),
+                   n_eval.astype(jnp.int32), n_exh.astype(jnp.int32))
+            return used_f - delta_local, out
+
+        used_final, outs = jax.lax.scan(
+            eval_step, u0,
+            (feas_e, aff_e, hasa_e, des_e, pen_e, coll_e, dem_e, cnt_e,
+             drows, dvals))
+        return outs + (used_final,)
+
+    key = ("bulk", mesh, spread_algorithm, max_waves)
+    fn = _SERVING_FN_CACHE.get(key)
+    if fn is None:
+        in_specs = (P("nodes", None), P("nodes", None),
+                    P(None, "nodes"), P(None, "nodes"), P(None), P(None),
+                    P(None, "nodes"), P(None, "nodes"), P(None, None),
+                    P(None), P(None, None), P(None, None, None))
+        out_specs = (P(None, "nodes"), P(None, "nodes"), P(None), P(None),
+                     P(None), P("nodes", None))
+        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, check_vma=False))
+        _SERVING_FN_CACHE[key] = fn
+    return fn(capacity, used0, feasible, affinity, has_affinity, desired,
+              penalty, coll0, demand, count, delta_rows, delta_vals)
