@@ -335,17 +335,22 @@ def light_len(S: int, R: int, D: int) -> int:
     return S * (R + 2) + D * (R + 1)
 
 
-def pack_light(inp: PlaceInputs, deltas, D: int) -> np.ndarray:
+def pack_light(inp: PlaceInputs, deltas, D: int,
+               S: Optional[int] = None) -> np.ndarray:
     """Flatten one eval's slot tensors + sparse usage deltas.  `deltas` is
     [(row, f32[R])]; inactive delta slots encode row = N (dropped by the
-    in-kernel scatter's mode='drop')."""
-    S, R = inp.demand.shape
+    in-kernel scatter's mode='drop').  `S` pads the slot axis to a
+    canonical bucket (padded slots are inactive) so the engine's compile
+    variants stay fixed regardless of per-eval slot counts."""
+    S_in, R = inp.demand.shape
+    S = S_in if S is None else S
     N = inp.feasible.shape[1]
-    out = np.empty(light_len(S, R, D), np.float32)
+    out = np.zeros(light_len(S, R, D), np.float32)
     o = 0
-    out[o:o + S * R] = np.asarray(inp.demand, np.float32).ravel(); o += S * R
-    out[o:o + S] = np.asarray(inp.slot_tg, np.float32); o += S
-    out[o:o + S] = np.asarray(inp.slot_active, np.float32); o += S
+    out[o:o + S_in * R] = np.asarray(inp.demand, np.float32).ravel()
+    o += S * R
+    out[o:o + S_in] = np.asarray(inp.slot_tg, np.float32); o += S
+    out[o:o + S_in] = np.asarray(inp.slot_active, np.float32); o += S
     rows = np.full(D, N, np.float32)
     vals = np.zeros((D, R), np.float32)
     for d, (row, vec) in enumerate(deltas[:D]):

@@ -53,7 +53,32 @@ from nomad_tpu.ops.place import (
     unpack_outputs,
 )
 
-_DELTA_BUCKET_MIN = 8
+# fixed sparse-delta slot count per eval: a CONSTANT so the delta axis
+# never forks another XLA compile variant (every distinct D was a full
+# recompile, billed mid-serving).  Evals with more deltas than this fold
+# them into a pre-applied basis instead (rare: deltas are one eval's
+# stops + sticky preplacements).
+_DELTA_BUCKET = 64
+# canonical slot-axis buckets, same rationale: per-eval slot counts vary
+# (retries place the remainder), and every distinct S was a compile
+_S_BUCKETS = (16, 128, 1024)
+
+
+def _s_bucket(n: int) -> int:
+    return next((b for b in _S_BUCKETS if b >= n), pad_to_bucket(n))
+
+
+def _fold_overflow(basis: "np.ndarray", deltas):
+    """Apply an oversized delta list directly into a PRIVATE basis copy
+    (the fixed delta bucket cannot carry it without forking an XLA
+    compile variant).  Returns the effective shipped delta list ([]) —
+    consumers must use it instead of the request's own deltas or the
+    fold double-counts."""
+    n = basis.shape[0]
+    for row, vec in deltas:
+        if row < n:
+            basis[row] += vec
+    return []
 
 
 class _DeviceCache:
@@ -145,9 +170,11 @@ class _Request:
 
     def shape_key(self):
         i = self.inputs
+        # the slot axis pads to a canonical bucket at dispatch, so evals
+        # sharing a bucket batch together regardless of raw slot count
         return (id(self.cm), self.spread_algorithm, i.feasible.shape,
                 i.spread_vidx.shape, i.spread_desired.shape,
-                i.demand.shape)
+                _s_bucket(i.demand.shape[0]), i.demand.shape[1])
 
 
 @dataclass
@@ -292,12 +319,26 @@ class PlacementEngine:
         one-time compile cost never skews serving diagnostics."""
         import jax
 
+        import dataclasses
+
         stats_before = dict(self.stats)
         cache_before = (self._cache.hits, self._cache.misses)
         mesh = self._mesh_for(cm.n_rows)
+        # every S bucket up to the sample's own (retry evals place the
+        # remainder with fewer slots, hitting the smaller buckets)
+        input_variants = []
+        if inputs is not None:
+            S_in = inputs.demand.shape[0]
+            # every bucket below the sample's slot count, then the sample
+            # itself (covering its own bucket even beyond _S_BUCKETS[-1])
+            for cut in [b for b in _S_BUCKETS if b < S_in] + [S_in]:
+                input_variants.append(dataclasses.replace(
+                    inputs, demand=inputs.demand[:cut],
+                    slot_tg=inputs.slot_tg[:cut],
+                    slot_active=inputs.slot_active[:cut]))
         for E in self.E_BUCKETS:
-            if inputs is not None:
-                reqs = [_Request(cm=cm, inputs=inputs, deltas=[],
+            for inp_v in input_variants:
+                reqs = [_Request(cm=cm, inputs=inp_v, deltas=[],
                                  spread_algorithm=False, future=Future())
                         for _ in range(E)]
                 if mesh is not None:
@@ -306,9 +347,9 @@ class PlacementEngine:
                 else:
                     packed = self._dispatch_packed(
                         reqs, E=E,
-                        basis=np.asarray(inputs.used, np.float32),
+                        basis=np.asarray(inp_v.used, np.float32),
                         deltas_per_req=[[] for _ in reqs],
-                        capacity=np.asarray(inputs.capacity))
+                        capacity=np.asarray(inp_v.capacity))
                     jax.block_until_ready(packed)
             if bulk is not None:
                 breqs = [_BulkRequest(cm=cm, deltas=[],
@@ -316,11 +357,11 @@ class PlacementEngine:
                                       future=Future(), **bulk)
                          for _ in range(E)]
                 if mesh is not None:
-                    out, _b = self._dispatch_bulk_group_sharded(breqs,
-                                                                mesh)
+                    out, _b, _d = self._dispatch_bulk_group_sharded(
+                        breqs, mesh)
                     jax.block_until_ready(out)
                 else:
-                    packed, _basis = self._dispatch_bulk_group(breqs)
+                    packed, _basis, _d = self._dispatch_bulk_group(breqs)
                     jax.block_until_ready(packed)
         self.stats.update(stats_before)
         self._cache.hits, self._cache.misses = cache_before
@@ -506,8 +547,6 @@ class PlacementEngine:
     # ------------------------------------------------------------- dispatch
 
     def _dispatch(self, batch: List[_Request]) -> None:
-        import jax
-
         groups: Dict[tuple, List] = {}
         for r in batch:
             groups.setdefault(r.shape_key(), []).append(r)
@@ -515,63 +554,93 @@ class PlacementEngine:
         self.stats["max_batch_seen"] = max(self.stats["max_batch_seen"],
                                            len(batch))
 
-        pending = []        # (requests, device packed)
-        pending_bulk = []   # (requests, (device packed, basis))
+        # groups resolve SEQUENTIALLY: each group's results register in
+        # the in-flight overlay before the next group's basis is read, so
+        # two groups in one cycle (a service scan group + a batch bulk
+        # group on the same matrix is the C2M steady state) never score
+        # against a basis blind to each other's placements — that
+        # blindness showed up as plan-applier conflicts and eval retries.
+        # Cost: one D2H round trip per group instead of one per cycle.
         for reqs in groups.values():
-            if isinstance(reqs[0], _BulkRequest):
-                mesh = self._mesh_for(reqs[0].feasible.shape[0])
-                for part in self._split_bulk(reqs):
-                    if mesh is not None:
-                        pending_bulk.append(
-                            (part,
-                             self._dispatch_bulk_group_sharded(part, mesh)))
-                    else:
-                        pending_bulk.append(
-                            (part, self._dispatch_bulk_group(part)))
-                self.stats["bulk_evals"] += len(reqs)
-                continue
-            rebucketed = (reqs[0].cm.capacity.shape[0]
-                          != reqs[0].inputs.capacity.shape[0])
-            mesh = None if rebucketed else \
-                self._mesh_for(reqs[0].inputs.capacity.shape[0])
-            if mesh is not None:
-                pending.append(
-                    (reqs, self._dispatch_group_sharded(reqs, mesh)))
-                self.stats["batched_evals"] += len(reqs)
-                continue
+            try:
+                self._dispatch_one_group(reqs)
+            except Exception as e:              # noqa: BLE001
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+
+    def _dispatch_one_group(self, reqs: List) -> None:
+        import jax
+
+        if isinstance(reqs[0], _BulkRequest):
+            mesh = self._mesh_for(reqs[0].feasible.shape[0])
+            for part in self._split_bulk(reqs):
+                if mesh is not None:
+                    packed, basis, dper = \
+                        self._dispatch_bulk_group_sharded(part, mesh)
+                else:
+                    packed, basis, dper = self._dispatch_bulk_group(part)
+                t0 = _time.time()
+                fetched = jax.device_get(packed)
+                self.stats["device_s"] += _time.time() - t0
+                t0 = _time.time()
+                self._resolve_bulk(part, fetched, basis, dper)
+                self.stats["resolve_s"] += _time.time() - t0
+            self.stats["bulk_evals"] += len(reqs)
+            return
+
+        rebucketed = (reqs[0].cm.capacity.shape[0]
+                      != reqs[0].inputs.capacity.shape[0])
+        mesh = None if rebucketed else \
+            self._mesh_for(reqs[0].inputs.capacity.shape[0])
+        # evals whose delta list exceeds the fixed slot bucket run alone
+        # with the deltas folded into a private basis (no new compile
+        # variant); on a mesh they stay SHARDED (an E=1 sharded dispatch
+        # is a warmed bucket) rather than regressing to one device
+        overflow = [r for r in reqs if len(r.deltas) > _DELTA_BUCKET]
+        if overflow:
+            reqs = [r for r in reqs if len(r.deltas) <= _DELTA_BUCKET]
+            for r in overflow:
+                if mesh is not None:
+                    packed = self._dispatch_group_sharded(
+                        [r], mesh, fold_deltas=True)
+                    self._fetch_resolve_scan([r], packed)
+                else:
+                    self._run_single(r)
+            self.stats["single_evals"] += len(overflow)
+            if not reqs:
+                return
+        if mesh is None and (len(reqs) == 1 or rebucketed):
             # single path also when the matrix has grown (re-bucketed)
             # since these inputs were built: the dispatch-time basis no
             # longer matches the padded node axis
-            if len(reqs) == 1 or rebucketed:
-                for r in reqs:
-                    self._run_single(r)
-                self.stats["single_evals"] += len(reqs)
-                continue
-            pending.append((reqs, self._dispatch_group(reqs)))
-            self.stats["batched_evals"] += len(reqs)
-
-        if not pending and not pending_bulk:
+            for r in reqs:
+                self._run_single(r)
+            self.stats["single_evals"] += len(reqs)
             return
-        # one D2H transfer for ALL groups (usually one leaf each)
+        if mesh is not None:
+            packed = self._dispatch_group_sharded(reqs, mesh)
+        else:
+            packed = self._dispatch_group(reqs)
+        self.stats["batched_evals"] += len(reqs)
+        self._fetch_resolve_scan(reqs, packed)
+
+    def _fetch_resolve_scan(self, reqs: List[_Request], packed) -> None:
+        import jax
+
         t0 = _time.time()
-        fetched = jax.device_get(
-            [packed for _, packed in pending]
-            + [packed for _, (packed, _) in pending_bulk])
+        fetched = jax.device_get(packed)
         self.stats["device_s"] += _time.time() - t0
         t0 = _time.time()
-        for (reqs, _), packed in zip(pending, fetched):
-            node, score, fit_s, n_eval, n_exh, top_n, top_s = \
-                unpack_outputs(packed)
-            for i, r in enumerate(reqs):
-                res = PlaceResult(
-                    node=node[i], score=score[i], fit_score=fit_s[i],
-                    nodes_evaluated=n_eval[i], nodes_exhausted=n_exh[i],
-                    top_nodes=top_n[i], top_scores=top_s[i], used=None)
-                ticket = self._register(r, res)
-                r.future.set_result((res, ticket))
-        for (reqs, (_, basis)), packed in zip(
-                pending_bulk, fetched[len(pending):]):
-            self._resolve_bulk(reqs, packed, basis)
+        node, score, fit_s, n_eval, n_exh, top_n, top_s = \
+            unpack_outputs(np.asarray(fetched))
+        for i, r in enumerate(reqs):
+            res = PlaceResult(
+                node=node[i], score=score[i], fit_score=fit_s[i],
+                nodes_evaluated=n_eval[i], nodes_exhausted=n_exh[i],
+                top_nodes=top_n[i], top_scores=top_s[i], used=None)
+            ticket = self._register(r, res)
+            r.future.set_result((res, ticket))
         self.stats["resolve_s"] += _time.time() - t0
 
     # ------------------------------------------------------- sharded path
@@ -605,8 +674,7 @@ class PlacementEngine:
 
     def _stack_deltas(self, deltas_per_req, E: int, N: int):
         R = NUM_RESOURCE_DIMS
-        D = pad_to_bucket(max([len(d) for d in deltas_per_req] + [1]),
-                          minimum=_DELTA_BUCKET_MIN)
+        D = _DELTA_BUCKET
         drows = np.full((E, D), N, np.int32)
         dvals = np.zeros((E, D, R), np.float32)
         for i, ds in enumerate(deltas_per_req):
@@ -615,27 +683,40 @@ class PlacementEngine:
                 dvals[i, d] = vec
         return drows, dvals
 
-    def _dispatch_group_sharded(self, reqs: List[_Request], mesh):
+    def _dispatch_group_sharded(self, reqs: List[_Request], mesh,
+                                fold_deltas: bool = False):
         """Scan-path dispatch over the node-sharded serving mesh.  Pads
         the eval axis to a compile bucket with inert evals (slot_active
-        all False)."""
+        all False).  `fold_deltas` (overflow singletons only) folds the
+        request's oversized delta list into the shipped basis copy."""
         from nomad_tpu.parallel.sharded import place_batch_sharded
 
         cm = reqs[0].cm
         N = reqs[0].inputs.capacity.shape[0]
         E = next(b for b in self.E_BUCKETS if b >= len(reqs))
+        S = _s_bucket(reqs[0].inputs.demand.shape[0])
         t0 = _time.time()
         fields = {}
         for f in self._SHARD_FIELDS:
             arrs = [np.asarray(getattr(r.inputs, f)) for r in reqs]
+            if f in ("demand", "slot_tg", "slot_active"):
+                # slot axis padded to the canonical bucket (pads inactive)
+                arrs = [np.concatenate(
+                    [a, np.zeros((S - a.shape[0],) + a.shape[1:],
+                                 a.dtype)]) if a.shape[0] < S else a
+                        for a in arrs]
             if E > len(reqs):
                 pad = (np.zeros_like(arrs[0])
                        if f == "slot_active" else arrs[0])
                 arrs += [pad] * (E - len(reqs))
             fields[f] = np.stack(arrs)
-        drows, dvals = self._stack_deltas(
-            [r.deltas for r in reqs] + [[]] * (E - len(reqs)), E, N)
         basis = self._basis_for(cm)
+        deltas_per = [r.deltas for r in reqs]
+        if fold_deltas:
+            assert len(reqs) == 1
+            deltas_per = [_fold_overflow(basis, reqs[0].deltas)]
+        drows, dvals = self._stack_deltas(
+            deltas_per + [[]] * (E - len(reqs)), E, N)
         self.stats["stack_s"] += _time.time() - t0
         t0 = _time.time()
         # content-addressed sharded placement: identical job-state
@@ -670,6 +751,9 @@ class PlacementEngine:
         E = next(b for b in self.E_BUCKETS if b >= len(reqs))
         capacity = cm.capacity[:N]
         basis = self._basis_for(cm)[:N]
+        deltas_per = [r.deltas for r in reqs]
+        if len(reqs) == 1 and len(reqs[0].deltas) > _DELTA_BUCKET:
+            deltas_per = [_fold_overflow(basis, reqs[0].deltas)]
 
         t0 = _time.time()
         pad = E - len(reqs)
@@ -687,7 +771,7 @@ class PlacementEngine:
         # padded evals have count=0: the wavefront exits immediately
         cnt = np.array([r.count for r in reqs] + [0] * pad, np.int32)
         drows, dvals = self._stack_deltas(
-            [r.deltas for r in reqs] + [[]] * pad, E, N)
+            deltas_per + [[]] * pad, E, N)
         basis = np.ascontiguousarray(basis, dtype=np.float32)
         self.stats["stack_s"] += _time.time() - t0
         t0 = _time.time()
@@ -710,13 +794,20 @@ class PlacementEngine:
         self.stats["put_s"] += _time.time() - t0
         self.stats["sharded_evals"] = (
             self.stats.get("sharded_evals", 0) + len(reqs))
-        return (assign, scores, placed, n_eval, n_exh), basis
+        return (assign, scores, placed, n_eval, n_exh), basis, deltas_per
 
     # ---------------------------------------------------------- bulk path
 
     def _split_bulk(self, reqs: List[_BulkRequest]):
-        for i in range(0, len(reqs), self.max_batch):
-            yield reqs[i:i + self.max_batch]
+        # oversized-delta requests go alone so their deltas can fold into
+        # the part's private basis copy (fixed delta bucket, no compile)
+        fits, overflow = [], []
+        for r in reqs:
+            (overflow if len(r.deltas) > _DELTA_BUCKET else fits).append(r)
+        for r in overflow:
+            yield [r]
+        for i in range(0, len(fits), self.max_batch):
+            yield fits[i:i + self.max_batch]
 
     def _dispatch_bulk_group(self, reqs: List[_BulkRequest]):
         import jax
@@ -728,12 +819,17 @@ class PlacementEngine:
         # the node axis), so the enqueue-time world is the prefix slice
         capacity = cm.capacity[:N]
         basis = self._basis_for(cm)[:N]
-        D = pad_to_bucket(max([len(r.deltas) for r in reqs] + [1]),
-                          minimum=_DELTA_BUCKET_MIN)
+        D = _DELTA_BUCKET
+        deltas_per = [r.deltas for r in reqs]
+        if len(reqs) == 1 and len(reqs[0].deltas) > D:
+            # singleton overflow part (_split_bulk): fold into the
+            # private basis copy instead of forking a compile variant
+            deltas_per = [_fold_overflow(basis, reqs[0].deltas)]
 
         t0 = _time.time()
         lights = [pack_bulk_light(r.has_affinity, r.desired, r.count,
-                                  r.demand, r.deltas, N, D) for r in reqs]
+                                  r.demand, ds, N, D)
+                  for r, ds in zip(reqs, deltas_per)]
         Ll = lights[0].shape[0]
         if E > len(reqs):
             # padded evals have count=0: the wavefront loop exits at once
@@ -752,15 +848,18 @@ class PlacementEngine:
             cap_dev, tuple(heavy), dyn_dev, D,
             spread_algorithm=reqs[0].spread_algorithm)
         self.stats["put_s"] += _time.time() - t0
-        return packed, basis
+        return packed, basis, deltas_per
 
     def _resolve_bulk(self, reqs: List[_BulkRequest], packed: np.ndarray,
-                      basis: np.ndarray) -> None:
+                      basis: np.ndarray, deltas_per) -> None:
         """Mirror the kernel's chained usage host-side so every caller
         gets the exact used matrix its placements produced: each eval
         sees basis + prior evals' PLACEMENTS + its own private deltas;
         deltas never chain forward (uncommitted stops of one eval are
-        invisible to others, exactly like the in-flight overlay)."""
+        invisible to others, exactly like the in-flight overlay).
+        `deltas_per` is what the dispatch actually SHIPPED per eval —
+        empty for an overflow singleton whose deltas were folded into
+        `basis` (re-applying r.deltas there would double-count)."""
         if isinstance(packed, tuple):       # sharded path: raw field tuple
             assign, scores, placed, n_eval, n_exh = \
                 [np.asarray(x) for x in packed]
@@ -772,7 +871,7 @@ class PlacementEngine:
         N = u.shape[0]
         for i, r in enumerate(reqs):
             own = u.copy()
-            for row, vec in r.deltas:
+            for row, vec in deltas_per[i]:
                 if row < N:
                     own[row] += vec
             placements = np.outer(assign[i].astype(np.float32), r.demand)
@@ -796,6 +895,9 @@ class PlacementEngine:
                 basis = self._basis_for(r.cm)
                 deltas = r.deltas
                 cap_src = r.cm.capacity
+                if len(deltas) > _DELTA_BUCKET:
+                    # basis is a fresh copy; no compile variant forked
+                    deltas = _fold_overflow(basis, deltas)
             else:
                 # matrix re-bucketed since inputs were built: inputs.used
                 # already carries the deltas, score against it verbatim
@@ -835,13 +937,12 @@ class PlacementEngine:
 
         i0 = reqs[0].inputs
         G, N, K, Vp1 = heavy_dims(i0)
-        S = i0.demand.shape[0]
+        S = _s_bucket(i0.demand.shape[0])
         R = NUM_RESOURCE_DIMS
-        D = pad_to_bucket(max([len(d) for d in deltas_per_req] + [1]),
-                          minimum=_DELTA_BUCKET_MIN)
+        D = _DELTA_BUCKET
 
         t0 = _time.time()
-        lights = [pack_light(r.inputs, d, D)
+        lights = [pack_light(r.inputs, d, D, S)
                   for r, d in zip(reqs, deltas_per_req)]
         Ll = lights[0].shape[0]
         if E > len(reqs):

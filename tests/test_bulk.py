@@ -198,3 +198,37 @@ def test_engine_bulk_batch_matches_serial():
         assert not engine._tickets     # drained
     finally:
         engine.stop()
+
+
+def test_engine_bulk_overflow_deltas_not_double_counted():
+    """An eval with more deltas than the fixed slot bucket folds them
+    into a private basis; the returned used matrix must count each delta
+    exactly once (regression: the resolve path re-applied them)."""
+    from nomad_tpu.parallel.engine import PlacementEngine, _DELTA_BUCKET
+
+    cm = _world(128, heterogeneous=False)
+    N = cm.n_rows
+    demand = np.array([100.0, 64.0, 0.0, 0.0], np.float32)
+    # one positive delta per row, more than the bucket holds
+    n_d = _DELTA_BUCKET + 8
+    vec = np.array([50.0, 10.0, 0.0, 0.0], np.float32)
+    deltas = [(i, vec) for i in range(n_d)]
+
+    engine = PlacementEngine()
+    try:
+        assign, placed, n_eval, n_exh, scores, used_after, ticket = \
+            engine.place_bulk(
+                cm, feasible=np.ones(N, bool),
+                affinity=np.zeros(N, np.float32), has_affinity=False,
+                desired=4, penalty=np.zeros(N, bool),
+                coll0=np.zeros(N, np.int32), demand=demand, count=4,
+                deltas=deltas)
+        assert placed == 4
+        expected = cm.used.astype(np.float32).copy()
+        for row, v in deltas:
+            expected[row] += v
+        expected += np.outer(assign.astype(np.float32), demand)
+        np.testing.assert_allclose(used_after, expected, rtol=1e-6)
+        engine.complete(ticket)
+    finally:
+        engine.stop()

@@ -63,7 +63,9 @@ def test_sharded_with_spread_and_affinity():
     mesh = make_mesh(n_eval_shards=1, n_node_shards=8)
     batch = stack_inputs([inp])
     node, score, *_ = place_eval_batch_sharded(mesh, batch)
-    assert np.array_equal(np.asarray(node[0]), single.node)
+    # the engine pads the slot axis to a canonical bucket; compare the
+    # real slots
+    assert np.array_equal(np.asarray(node[0]), single.node[:4])
     np.testing.assert_allclose(np.asarray(score[0])[:4], single.score[:4],
                                rtol=1e-5)
 
