@@ -1,0 +1,18 @@
+import sys; sys.path.insert(0, "/root/repo")
+import sys, time, json
+sys.argv = ['bench.py']
+import bench
+
+out = {}
+for name, fn in (("c2m_1m", bench.bench_c2m_1m),
+                 ("device", bench.bench_device_constrained),
+                 ("preemption", bench.bench_preemption_heavy)):
+    t0 = time.time()
+    try:
+        rate = fn()
+        out[name] = {"allocs_per_sec": round(rate, 1),
+                     "wall_s": round(time.time() - t0, 1)}
+    except Exception as e:
+        out[name] = {"error": str(e)}
+    print("PARTIAL", json.dumps(out), flush=True)
+print("FINAL", json.dumps(out), flush=True)

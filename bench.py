@@ -225,6 +225,66 @@ def bench_c2m(n_nodes=10000, n_batch=96, batch_count=1000,
         s.stop()
 
 
+def bench_c2m_1m(n_nodes=10000, n_jobs=10000, groups_per_job=10,
+                 group_count=10, workers=16):
+    """The north-star C2M at its ACTUAL size (BASELINE.json configs[2] /
+    north_star): 1M allocations over 100K task groups on 10K nodes,
+    through the full spine.  10,000 jobs x 10 task groups x count 10;
+    allocs sized so the cluster holds them (30 cpu / 60 mb each)."""
+    from nomad_tpu import mock
+
+    s = _server(workers=workers)
+    try:
+        t0 = time.time()
+        _fill_nodes(s, n_nodes)
+        log(f"C2M-1M world build ({n_nodes} nodes): {time.time()-t0:.1f}s")
+
+        def make_job():
+            j = mock.batch_job()
+            base = j.task_groups[0]
+            base.count = group_count
+            base.tasks[0].resources.cpu = 30
+            base.tasks[0].resources.memory_mb = 60
+            base.ephemeral_disk.size_mb = 0
+            tgs = []
+            for k in range(groups_per_job):
+                tg = base.copy() if k else base
+                tg.name = f"g{k}"
+                tgs.append(tg)
+            j.task_groups = tgs
+            return j
+
+        t0 = time.time()
+        _warm_engine(s, scan_job=make_job())
+        wj = make_job()
+        s.register_job(wj)
+        _wait_allocs(s.store, [wj], groups_per_job * group_count,
+                     timeout=300)
+        log(f"C2M-1M warm: {time.time()-t0:.1f}s")
+
+        want = n_jobs * groups_per_job * group_count
+        base_allocs = len(s.store._allocs)
+        t0 = time.time()
+        for _ in range(n_jobs):
+            s.register_job(make_job())
+        reg_dt = time.time() - t0
+        log(f"C2M-1M registered {n_jobs} jobs in {reg_dt:.1f}s")
+        deadline = time.time() + 3600
+        placed = 0
+        while time.time() < deadline:
+            placed = len(s.store._allocs) - base_allocs
+            if placed >= want:
+                break
+            time.sleep(1.0)
+        dt = time.time() - t0
+        log(f"C2M-1M spine: {placed}/{want} allocs in {dt:.1f}s "
+            f"({placed/dt:.0f} allocs/s on one chip; "
+            f"{n_jobs * groups_per_job} task groups)")
+        return placed / dt
+    finally:
+        s.stop()
+
+
 def bench_device_constrained(n_nodes=10000):
     """configs[3]: 10K nodes, half with GPU device groups; jobs with
     device requests and job anti-affinity."""
@@ -264,10 +324,10 @@ def bench_device_constrained(n_nodes=10000):
         s.stop()
 
 
-def bench_preemption_heavy(n_nodes=1000):
-    """configs[4]: cluster at ~95% utilization of low-priority work;
+def bench_preemption_heavy(n_nodes=10000, workers=48):
+    """configs[4]: 10K nodes at ~95% utilization of low-priority work;
     high-priority service jobs must preempt across priority tiers."""
-    s = _server(workers=8)
+    s = _server(workers=workers)
     try:
         cfg = s.store.scheduler_config
         cfg.preemption_config.service_scheduler_enabled = True
@@ -348,9 +408,11 @@ def main():
         kernel_rate = 0.0
 
     if os.environ.get("BENCH_ALL") == "1":
-        # the full BASELINE.json scenario suite (several minutes)
+        # the full BASELINE.json scenario suite (tens of minutes — the
+        # 1M-allocation C2M alone is minutes of wall time)
         for name, fn in (("dev_agent", bench_dev_agent_sim),
                          ("c2m", bench_c2m),
+                         ("c2m_1m", bench_c2m_1m),
                          ("device", bench_device_constrained),
                          ("preemption", bench_preemption_heavy)):
             try:

@@ -254,4 +254,12 @@ def required_capability(parts: List[str], method: str,
         return (CAP_LIST_JOBS, ns)
     if head == "event":
         return (CAP_READ_JOB, ns)
+    if head in ("services", "service"):
+        # nomad-native service registry (reference nsd endpoints use
+        # read-job / submit-job in the service's namespace)
+        return ((CAP_SUBMIT_JOB if write else CAP_READ_JOB), ns)
+    if head == "scaling":
+        return (CAP_LIST_JOBS, ns)
+    if head == "regions":
+        return (None, None)
     return (f"operator:{'write' if write else 'read'}", None)

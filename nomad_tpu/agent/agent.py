@@ -41,6 +41,35 @@ class Agent:
         self.client = None
         self.http: Optional["HTTPServer"] = None
         self._lock = threading.Lock()
+        # in-process log ring feeding /v1/agent/monitor (reference
+        # command/agent/monitor/monitor.go: a log broker the HTTP monitor
+        # endpoint streams from)
+        import collections
+        import logging
+
+        self.log_ring = collections.deque(maxlen=2048)  # (seq, line)
+        self._log_seq = 0
+        self._log_cv = threading.Condition()
+
+        agent = self
+
+        class _RingHandler(logging.Handler):
+            def emit(self, record):
+                try:
+                    line = self.format(record)
+                except Exception:               # noqa: BLE001
+                    return
+                with agent._log_cv:
+                    agent._log_seq += 1
+                    agent.log_ring.append((agent._log_seq, line))
+                    agent._log_cv.notify_all()
+
+        handler = _RingHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s [%(levelname)s] %(name)s: %(message)s"))
+        logging.getLogger("nomad_tpu").addHandler(handler)
+        logging.getLogger("nomad_tpu").setLevel(logging.INFO)
+        self._log_handler = handler
 
         if self.config.server_enabled:
             self.server = Server(
@@ -48,7 +77,8 @@ class Agent:
                     num_schedulers=self.config.num_schedulers,
                     enabled_schedulers=self.config.enabled_schedulers,
                     heartbeat_ttl=self.config.heartbeat_ttl,
-                    data_dir=self.config.data_dir),
+                    data_dir=self.config.data_dir,
+                    region=self.config.region),
                 name=self.config.name)
             if self.config.acl_enabled:
                 self.server.enable_acl()

@@ -247,7 +247,7 @@ class HTTPServer:
     # slashes (dispatched/periodic children), so scan from the end
     _JOB_SUBS = {"allocations", "evaluations", "deployments", "deployment",
                  "summary", "versions", "evaluate", "plan", "dispatch",
-                 "stability", "revert", "force"}
+                 "stability", "revert", "force", "scale"}
 
     @classmethod
     def _job_path(cls, parts):
@@ -284,6 +284,9 @@ class HTTPServer:
             return store.job_summary(ns, job_id)
         if sub == "versions":
             return store.job_versions(ns, job_id)
+        if sub == "scale":
+            return self._rpc("Job.ScaleStatus",
+                             {"namespace": ns, "job_id": job_id})
         raise HTTPError(404, f"no handler for job/{sub}")
 
     def _h_put_job_id(self, h, parts, q):
@@ -291,6 +294,16 @@ class HTTPServer:
         job_id, sub = self._job_path(parts)
         if sub is None:                      # update = register
             return self._h_put_jobs(h, ["jobs"], q)
+        if sub == "scale":
+            body = h._body()
+            target = body.get("Target", {}) or {}
+            return self._rpc("Job.Scale", {
+                "namespace": ns, "job_id": job_id,
+                "group": target.get("Group", body.get("group", "")),
+                "count": body.get("Count", body.get("count")),
+                "message": body.get("Message", ""),
+                "error": bool(body.get("Error", False)),
+                "meta": body.get("Meta")})
         if sub == "evaluate":
             job = self._rpc("Job.GetJob", {"namespace": ns, "job_id": job_id})
             if job is None:
@@ -508,37 +521,91 @@ class HTTPServer:
         if parts[1] == "health":
             return {"server": {"ok": self.agent.server is not None},
                     "client": {"ok": self.agent.client is not None}}
+        if parts[1] == "pprof":
+            return self._agent_pprof(h, parts, q)
+        if parts[1] == "monitor":
+            return self._agent_monitor(h, q)
         raise HTTPError(404, "unknown agent path")
+
+    def _agent_pprof(self, h, parts, q):
+        """/v1/agent/pprof/profile — CPU profile of this agent for
+        ?seconds= (cProfile stats text; the Python analog of the pprof
+        protobuf the reference serves, command/agent/http.go:379-381).
+        /v1/agent/pprof/goroutine — all-thread stack dump."""
+        kind = parts[2] if len(parts) > 2 else "profile"
+        if kind in ("goroutine", "threads"):
+            import sys
+            import threading as _threading
+            import traceback
+            names = {t.ident: t.name for t in _threading.enumerate()}
+            out = []
+            for tid, frame in sys._current_frames().items():
+                out.append(f"Thread {names.get(tid, tid)}:\n"
+                           + "".join(traceback.format_stack(frame)))
+            return {"stacks": "\n".join(out)}
+        if kind != "profile":
+            raise HTTPError(404, f"unknown pprof kind {kind}")
+        import cProfile
+        import io
+        import pstats
+        seconds = min(float(q.get("seconds", 1.0)), 30.0)
+        prof = cProfile.Profile()
+        prof.enable()
+        time.sleep(seconds)
+        prof.disable()
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative") \
+            .print_stats(60)
+        return {"seconds": seconds, "profile": buf.getvalue()}
+
+    def _agent_monitor(self, h, q):
+        """/v1/agent/monitor — chunked stream of this agent's log lines
+        (reference command/agent/agent_endpoint.go monitor)."""
+        deadline = time.time() + float(q.get("timeout", 5.0))
+        last_seq = 0
+        h.send_response(200)
+        h.send_header("Content-Type", "text/plain")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+        try:
+            # replay the ring, then follow by sequence number (the ring
+            # rotates; indexes would shift under the reader)
+            while time.time() < deadline:
+                snap = [(seq, line) for seq, line
+                        in list(self.agent.log_ring) if seq > last_seq]
+                new = [line for _, line in snap]
+                if new:
+                    last_seq = snap[-1][0]
+                    chunk = ("\n".join(new) + "\n").encode()
+                    h.wfile.write(hex(len(chunk))[2:].encode() + b"\r\n"
+                                  + chunk + b"\r\n")
+                    h.wfile.flush()
+                else:
+                    with self.agent._log_cv:
+                        self.agent._log_cv.wait(0.25)
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        return _STREAMED
 
     # ------------------------------------------------------------ search
 
     def _h_post_search(self, h, parts, q):
-        """Prefix search across contexts (reference nomad/search_endpoint.go)."""
+        """Prefix search via the server-side Search.PrefixSearch RPC
+        (reference nomad/search_endpoint.go); the agent only computes the
+        caller's namespace visibility from its ACL token."""
         body = h._body()
-        prefix = body.get("Prefix", "")
-        context = body.get("Context", "all")
-        store = self.agent.server.store
-        out = {}
-        truncations = {}
-        def add(name, ids):
-            matches = [i for i in ids if i.startswith(prefix)]
-            truncations[name] = len(matches) > 20
-            out[name] = matches[:20]
-        if context in ("all", "jobs"):
-            add("jobs", [j.id for j in store.jobs()
-                         if self._ns_visible(h, j.namespace)])
-        if context in ("all", "nodes"):
-            add("nodes", [n.id for n in store.nodes()])
-        if context in ("all", "evals"):
-            add("evals", [e.id for e in store.evals()
-                          if self._ns_visible(h, e.namespace)])
-        if context in ("all", "allocs"):
-            add("allocs", [a.id for a in store.allocs()
-                           if self._ns_visible(h, a.namespace)])
-        if context in ("all", "deployment"):
-            add("deployment", [d.id for d in store.deployments()
-                               if self._ns_visible(h, d.namespace)])
-        return {"Matches": out, "Truncations": truncations}
+        namespaces = None
+        if getattr(self.agent.server, "acl_enabled", False):
+            store = self.agent.server.store
+            namespaces = [ns for ns in store._namespaces
+                          if self._ns_visible(h, ns)]
+        resp = self._rpc("Search.PrefixSearch", {
+            "prefix": body.get("Prefix", ""),
+            "context": body.get("Context", "all"),
+            "namespaces": namespaces})
+        return {"Matches": resp["matches"],
+                "Truncations": resp["truncations"]}
 
     # ------------------------------------------------------------ metrics
 
@@ -726,6 +793,37 @@ class HTTPServer:
             "volume_id": vol_id,
             "force": q.get("force", "") == "true"})
         return {}
+
+    def _h_get_services(self, h, parts, q):
+        """GET /v1/services: grouped nomad-native service listing
+        (reference command/agent/service_registration_endpoint.go)."""
+        return self._rpc("Service.List",
+                         {"namespace": q.get("namespace")})
+
+    def _h_get_service_id(self, h, parts, q):
+        """GET /v1/service/<name>: instances of one service."""
+        return self._rpc("Service.GetService", {
+            "namespace": q.get("namespace", "default"),
+            "service_name": parts[1]})
+
+    def _h_delete_service_id(self, h, parts, q):
+        """DELETE /v1/service/<name>/<id>."""
+        if len(parts) < 3:
+            raise HTTPError(400, "service registration id required")
+        self._rpc("Service.Delete", {"id": parts[2]})
+        return {}
+
+    def _h_get_regions(self, h, parts, q):
+        return self._rpc("Status.Regions", {})
+
+    def _h_get_scaling(self, h, parts, q):
+        """GET /v1/scaling/policies | /v1/scaling/policy/<id>."""
+        if len(parts) >= 2 and parts[1] == "policies":
+            return self._rpc("Scaling.ListPolicies",
+                             {"namespace": q.get("namespace")})
+        if len(parts) >= 3 and parts[1] == "policy":
+            return self._rpc("Scaling.GetPolicy", {"id": parts[2]})
+        raise HTTPError(404, "no handler for scaling path")
 
     def _h_get_plugins(self, h, parts, q):
         return self._rpc("CSIPlugin.List", {})

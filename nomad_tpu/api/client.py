@@ -50,6 +50,7 @@ class ApiClient:
         self.volumes = Volumes(self)
         self.plugins = Plugins(self)
         self.system = SystemApi(self)
+        self.services = ServicesApi(self)
 
     # ------------------------------------------------------------- transport
 
@@ -159,6 +160,16 @@ class Jobs(_Section):
 
     def parse(self, hcl: str) -> dict:
         return self.c.put("/v1/jobs/parse", {"JobHCL": hcl})
+
+    def scale(self, job_id: str, group: str, count: Optional[int] = None,
+              message: str = "", error: bool = False,
+              meta: Optional[dict] = None) -> dict:
+        return self.c.put(f"/v1/job/{job_id}/scale", {
+            "Target": {"Group": group}, "Count": count,
+            "Message": message, "Error": error, "Meta": meta})
+
+    def scale_status(self, job_id: str) -> dict:
+        return self.c.get(f"/v1/job/{job_id}/scale")
 
 
 class Nodes(_Section):
@@ -320,7 +331,29 @@ class Plugins(_Section):
         return self.c.get(f"/v1/plugin/csi/{plugin_id}")
 
 
+class ServicesApi(_Section):
+    """Nomad-native service registry (/v1/services, /v1/service/:name —
+    reference api/services.go)."""
+
+    def list(self) -> List[dict]:
+        return self.c.get("/v1/services")
+
+    def get(self, name: str) -> List[dict]:
+        return self.c.get(f"/v1/service/{name}")
+
+    def delete(self, name: str, reg_id: str) -> dict:
+        return self.c.delete(f"/v1/service/{name}/{reg_id}")
+
+
 class SystemApi(_Section):
+    def regions(self) -> List[str]:
+        return self.c.get("/v1/regions")
+
+    def search(self, prefix: str, context: str = "all") -> dict:
+        return self.c.put("/v1/search",
+                          {"Prefix": prefix, "Context": context})
+
+
     def leader(self):
         return self.c.get("/v1/status/leader")
 

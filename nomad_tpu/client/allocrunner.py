@@ -18,7 +18,7 @@ class AllocRunner:
     def __init__(self, alloc, driver_registry, root_dir: str,
                  node=None, on_update: Optional[Callable] = None,
                  state_db=None, prev_alloc_dir: Optional[AllocDir] = None,
-                 csi_plugins=None):
+                 csi_plugins=None, rpc=None):
         self.alloc = alloc
         self.registry = driver_registry
         self.node = node
@@ -37,6 +37,8 @@ class AllocRunner:
         from nomad_tpu.client.csi import CSIHook
         self.csi_hook = CSIHook(alloc, self.alloc_dir.dir,
                                 plugins=csi_plugins)
+        from nomad_tpu.client.services import ServiceHook
+        self.service_hook = ServiceHook(alloc, node, rpc)
 
     def task_group(self):
         job = self.alloc.job
@@ -108,6 +110,9 @@ class AllocRunner:
                     return
             for t in mains:
                 self.task_runners[t.name].start()
+            # group/task service registration begins once tasks launch
+            # (groupservice_hook Prerun -> nsd register)
+            self.service_hook.start(self.task_states)
             if poststarts:
                 self._wait_any_running([self.task_runners[t.name]
                                         for t in mains])
@@ -128,6 +133,10 @@ class AllocRunner:
                 self.task_runners[t.name].kill()
             for t in prestart_side:
                 self.task_runners[t.name].join(5.0)
+            # deregister this alloc's services before poststop tasks run
+            # (nsd removes on alloc stop; queries must not see instances
+            # of an alloc that is winding down)
+            self.service_hook.stop()
             for t in poststops:
                 tr = self.task_runners[t.name]
                 tr.start()
@@ -234,6 +243,10 @@ class AllocRunner:
         update = tg.update if tg else None
         min_healthy = update.min_healthy_time_s if update else 10.0
         deadline = update.healthy_deadline_s if update else 300.0
+        # health_check = "checks": tasks running is not enough — every
+        # nomad service registration of the alloc must be passing too
+        # (reference allochealth/tracker.go watchConsulEvents analog)
+        use_checks = bool(update and update.health_check == "checks")
 
         def watch():
             start = time.time()
@@ -249,6 +262,8 @@ class AllocRunner:
                                              and not s.failed)
                     for s in states) and any(
                     s.state == "running" for s in states)
+                if mains_running and use_checks:
+                    mains_running = self.service_hook.all_passing()
                 if mains_running:
                     if healthy_since is None:
                         healthy_since = now
@@ -273,6 +288,7 @@ class AllocRunner:
 
     def stop(self, timeout_s: Optional[float] = None) -> None:
         """Kill all tasks (desired_status=stop path)."""
+        self.service_hook.stop()
         for tr in self.task_runners.values():
             tr.kill(timeout_s)
 

@@ -40,6 +40,12 @@ class ClientConfig:
     meta: Dict[str, str] = field(default_factory=dict)
     max_allocs_gc: int = 50                # GC threshold (gc.go)
     watch_interval: float = 0.2
+    # device plugin fingerprint stream (reference plugins/device/
+    # device.go:25-37): a callable returning the CURRENT [NodeDevice]
+    # list (with per-instance health); polled periodically, node
+    # re-registers on change so the servers see device health updates
+    device_fingerprint: Optional[Callable[[], list]] = None
+    device_poll_interval: float = 1.0
 
 
 class Client:
@@ -83,19 +89,55 @@ class Client:
 
     def start(self) -> None:
         self._restore()
+        if self.config.device_fingerprint is not None:
+            # seed the device set so the FIRST registration already
+            # carries the fingerprint
+            self._apply_device_fingerprint(register=False)
         resp = self.rpc("Node.Register", {"node": self.node})
         self._heartbeat_ttl = resp.get("heartbeat_ttl", 10.0)
         self.node.status = NodeStatus.READY
         self.rpc("Node.UpdateStatus",
                  {"node_id": self.node.id, "status": "ready"})
-        for target, name in ((self._heartbeat_loop, "hb"),
-                             (self._heartbeat_stop_loop, "hb-stop"),
-                             (self._watch_allocations, "alloc-watch"),
-                             (self._update_pusher, "alloc-update")):
+        loops = [(self._heartbeat_loop, "hb"),
+                 (self._heartbeat_stop_loop, "hb-stop"),
+                 (self._watch_allocations, "alloc-watch"),
+                 (self._update_pusher, "alloc-update")]
+        if self.config.device_fingerprint is not None:
+            loops.append((self._device_monitor_loop, "device-fp"))
+        for target, name in loops:
             t = threading.Thread(target=target, daemon=True,
                                  name=f"client-{name}")
             t.start()
             self._threads.append(t)
+
+    # -------------------------------------------------------- device health
+
+    def _device_snapshot(self):
+        return [(d.id, tuple(d.instance_ids), tuple(sorted(d.unhealthy_ids)))
+                for d in self.node.node_resources.devices]
+
+    def _apply_device_fingerprint(self, register: bool = True) -> bool:
+        """Poll the device fingerprint stream; on change, update the node
+        and (optionally) re-register so servers see the new health."""
+        try:
+            devices = self.config.device_fingerprint()
+        except Exception:                       # noqa: BLE001
+            return False
+        before = self._device_snapshot()
+        self.node.node_resources.devices = list(devices)
+        changed = self._device_snapshot() != before
+        if changed and register:
+            try:
+                self.rpc("Node.Register", {"node": self.node})
+            except Exception:                   # noqa: BLE001
+                pass
+        return changed
+
+    def _device_monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            if self._stop.wait(self.config.device_poll_interval):
+                return
+            self._apply_device_fingerprint()
 
     def stop(self) -> None:
         self._stop.set()
@@ -223,7 +265,7 @@ class Client:
         ar = AllocRunner(alloc, self.registry, self.alloc_dir_root,
                          node=self.node, on_update=self._on_alloc_update,
                          state_db=self.state_db,
-                         prev_alloc_dir=prev_dir)
+                         prev_alloc_dir=prev_dir, rpc=self.rpc)
         with self._ar_lock:
             self.alloc_runners[alloc.id] = ar
         self.state_db.put_alloc(alloc.id, {
@@ -310,7 +352,7 @@ class Client:
             ar = AllocRunner(alloc, self.registry, self.alloc_dir_root,
                              node=self.node,
                              on_update=self._on_alloc_update,
-                             state_db=self.state_db)
+                             state_db=self.state_db, rpc=self.rpc)
             with self._ar_lock:
                 self.alloc_runners[alloc.id] = ar
             ar.restore()

@@ -1,0 +1,138 @@
+"""Client-side nomad-native service registration hook (reference
+client/serviceregistration/nsd/nsd.go + the alloc runner's group_service
+hook, client/allocrunner/groupservice_hook.go).
+
+When an allocation's tasks are running, every `provider = "nomad"`
+service declared on the task group or its tasks registers with the
+servers (Service.Upsert); on stop/destroy the allocation's registrations
+delete (Service.DeleteByAlloc).  A lightweight check runner keeps each
+registration's health current: a check passes while its owning task is
+running — the simulator analog of nsd's tcp/http probes — and flips the
+registration to "critical" otherwise, which the deployment watcher
+consumes for `health_check = "checks"` task groups.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from nomad_tpu.structs.service import ServiceRegistration, registration_id
+
+
+class ServiceHook:
+    """Per-alloc registration lifecycle.  `rpc` is the client->server
+    callable; None disables the hook (server-side simulations that never
+    run a client)."""
+
+    def __init__(self, alloc, node, rpc: Optional[Callable],
+                 poll_interval: float = 0.2):
+        self.alloc = alloc
+        self.node = node
+        self.rpc = rpc
+        self.poll_interval = poll_interval
+        self._regs: Dict[str, ServiceRegistration] = {}
+        self._health: Dict[str, str] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ build
+
+    def _build(self, task_states) -> List[ServiceRegistration]:
+        job = self.alloc.job
+        tg = job.lookup_task_group(self.alloc.task_group) if job else None
+        if tg is None:
+            return []
+        ports = {}
+        for net in self.alloc.allocated_resources.shared_networks:
+            for p in list(net.reserved_ports) + list(net.dynamic_ports):
+                if p.label:
+                    ports[p.label] = p.value
+        out = []
+
+        def add(svc, task_name: str):
+            if getattr(svc, "provider", "nomad") not in ("nomad", ""):
+                return   # consul-provider services need a Consul agent
+            rid = registration_id(self.alloc.id, task_name, svc.name,
+                                  svc.port_label)
+            out.append(ServiceRegistration(
+                id=rid, service_name=svc.name,
+                namespace=self.alloc.namespace,
+                node_id=self.node.id if self.node else "",
+                datacenter=getattr(self.node, "datacenter", ""),
+                job_id=self.alloc.job_id, alloc_id=self.alloc.id,
+                tags=list(svc.tags),
+                address=getattr(self.node, "http_addr", "") or "127.0.0.1",
+                port=ports.get(svc.port_label, 0),
+                health=self._svc_health(svc, task_name, task_states)))
+
+        for svc in tg.services:
+            add(svc, "")
+        for t in tg.tasks:
+            for svc in getattr(t, "services", []) or []:
+                add(svc, t.name)
+        return out
+
+    def _svc_health(self, svc, task_name: str, task_states) -> str:
+        """Check verdict for one service: its checks pass while the
+        owning task (or any main task, for group services) is running."""
+        if task_name:
+            st = task_states.get(task_name)
+            running = st is not None and st.state == "running"
+        else:
+            running = any(s.state == "running"
+                          for s in task_states.values())
+        if not svc.checks:
+            return "passing"
+        return "passing" if running else "critical"
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, task_states_fn: Callable[[], dict]) -> None:
+        """Begin registration + health polling once tasks launch."""
+        if self.rpc is None:
+            return
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    regs = self._build(task_states_fn())
+                    changed = []
+                    for r in regs:
+                        if self._health.get(r.id) != r.health:
+                            self._health[r.id] = r.health
+                            changed.append(r)
+                            self._regs[r.id] = r
+                    if changed:
+                        self.rpc("Service.Upsert", {"services": changed})
+                except Exception:               # noqa: BLE001
+                    pass
+                if self._stop.wait(self.poll_interval):
+                    return
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="service-hook")
+        self._thread.start()
+
+    def all_passing(self) -> bool:
+        """Every built registration currently passing (deployment
+        health_check='checks' feed).  True when the alloc declares no
+        nomad services."""
+        if not self._health:
+            return True
+        return all(h == "passing" for h in self._health.values())
+
+    def has_services(self) -> bool:
+        return bool(self._health)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(1.0)
+        if self.rpc is not None and self._regs:
+            try:
+                self.rpc("Service.DeleteByAlloc",
+                         {"alloc_id": self.alloc.id})
+            except Exception:                   # noqa: BLE001
+                pass
+            self._regs.clear()

@@ -411,7 +411,41 @@ class Cli:
         self.p(_fmt_table(rows, ["Name", "Raft Status"]))
         return 0
 
+    def cmd_job_scale(self, args) -> int:
+        resp = self.api.jobs.scale(args.job_id, args.group,
+                                   count=args.count)
+        self.p(f"Evaluation ID: {resp.get('eval_id')}")
+        return 0
+
+    def cmd_job_scale_status(self, args) -> int:
+        st = self.api.jobs.scale_status(args.job_id)
+        rows = [[g, d["desired"], d["placed"], d["running"], d["healthy"]]
+                for g, d in sorted(st["task_groups"].items())]
+        self.p(_fmt_table(rows, ["Group", "Desired", "Placed", "Running",
+                                 "Healthy"]))
+        return 0
+
+    def cmd_service_list(self, args) -> int:
+        rows = [[s["service_name"], s["namespace"], s["instances"]]
+                for s in self.api.services.list()]
+        self.p(_fmt_table(rows, ["Service", "Namespace", "Instances"]))
+        return 0
+
+    def cmd_service_info(self, args) -> int:
+        rows = [[s.id, s.alloc_id[:8], s.address, s.port, s.health]
+                for s in self.api.services.get(args.name)]
+        self.p(_fmt_table(rows, ["ID", "Alloc", "Address", "Port",
+                                 "Health"]))
+        return 0
+
     def cmd_status(self, args) -> int:
+        if getattr(args, "prefix", None):
+            # server-side prefix search across contexts
+            m = self.api.system.search(args.prefix)["Matches"]
+            for ctx in sorted(m):
+                for i in m[ctx]:
+                    self.p(f"{ctx[:-1] if ctx.endswith('s') else ctx}\t{i}")
+            return 0
         return self.cmd_job_status(args)
 
     def cmd_operator_scheduler_get(self, args) -> int:
@@ -593,6 +627,14 @@ def build_parser() -> argparse.ArgumentParser:
     j.add_argument("payload_file", nargs="?")
     j.add_argument("-meta", action="append")
     j.set_defaults(fn="cmd_job_dispatch")
+    j = job.add_parser("scale")
+    j.add_argument("job_id")
+    j.add_argument("group")
+    j.add_argument("count", type=int)
+    j.set_defaults(fn="cmd_job_scale")
+    j = job.add_parser("scale-status")
+    j.add_argument("job_id")
+    j.set_defaults(fn="cmd_job_scale_status")
     j = job.add_parser("history")
     j.add_argument("job_id")
     j.set_defaults(fn="cmd_job_history")
@@ -739,7 +781,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     st = sub.add_parser("status", help="job status shorthand")
     st.add_argument("job_id", nargs="?")
+    st.add_argument("-prefix", default="",
+                    help="server-side prefix search across all contexts")
     st.set_defaults(fn="cmd_status")
+
+    svc = sub.add_parser("service",
+                         help="nomad-native service registry").add_subparsers(
+        dest="sub", required=True)
+    sv = svc.add_parser("list")
+    sv.set_defaults(fn="cmd_service_list")
+    sv = svc.add_parser("info")
+    sv.add_argument("name")
+    sv.set_defaults(fn="cmd_service_info")
     return p
 
 

@@ -40,6 +40,8 @@ class CoreScheduler:
             stats["nodes"] = self.node_gc(now, force)
         if gc_type in ("deployment-gc", "force-gc"):
             stats["deployments"] = self.deployment_gc(now, force)
+        if gc_type in ("service-gc", "force-gc"):
+            stats["services"] = self.service_gc()
         return stats
 
     # ------------------------------------------------------------- passes
@@ -109,6 +111,22 @@ class CoreScheduler:
                               {"node_id": node.id})
             n += 1
         return n
+
+    def service_gc(self) -> int:
+        """Orphaned nomad-service registrations: a client that dies
+        without deregistering leaves rows behind; sweep any registration
+        whose allocation is gone or terminal (reference
+        core_sched.go csiPluginGC analog for service_registrations)."""
+        store = self.server.store
+        doomed = []
+        for sr in store.services():
+            a = store.alloc_by_id(sr.alloc_id)
+            if a is None or a.terminal_status():
+                doomed.append(sr.id)
+        if doomed:
+            self.server.apply(MessageType.SERVICE_DEREGISTER,
+                              {"ids": doomed})
+        return len(doomed)
 
     def deployment_gc(self, now: float, force: bool = False) -> int:
         store = self.server.store

@@ -72,6 +72,19 @@ class PlanApplier:
                 continue
             try:
                 result = self._evaluate(pending.plan)
+                if commit_t is not None and commit_t.is_alive() and \
+                        self._result_rejected_something(pending.plan,
+                                                        result):
+                    # the in-flight commit's usage is counted twice
+                    # (store write + its overlay entry) until it pops;
+                    # a rejection in that window may be pure
+                    # over-reservation — settle the commit and give the
+                    # plan one clean second look before failing it back
+                    # to the scheduler (a full eval recompute)
+                    commit_t.join()
+                    self.stats["revalidated"] = \
+                        self.stats.get("revalidated", 0) + 1
+                    result = self._evaluate(pending.plan)
                 token = self._overlay_add(pending.plan, result)
             except Exception as e:            # noqa: BLE001
                 pending.future.set_exception(e)
@@ -86,6 +99,12 @@ class PlanApplier:
             commit_t.start()
         if commit_t is not None:
             commit_t.join()
+
+    @staticmethod
+    def _result_rejected_something(plan: Plan, result: PlanResult) -> bool:
+        want = sum(len(v) for v in plan.node_allocation.values())
+        got = sum(len(v) for v in result.node_allocation.values())
+        return got < want
 
     def _commit_and_resolve(self, pending, result: PlanResult,
                             token: int) -> None:

@@ -56,13 +56,15 @@ class ServerConfig:
                  enabled_schedulers: Optional[List[str]] = None,
                  heartbeat_ttl: float = 10.0,
                  gc_interval: float = 300.0,
-                 data_dir: Optional[str] = None):
+                 data_dir: Optional[str] = None,
+                 region: str = "global"):
         self.num_schedulers = num_schedulers
         self.enabled_schedulers = enabled_schedulers or \
             ["service", "batch", "system", "sysbatch"]
         self.heartbeat_ttl = heartbeat_ttl
         self.gc_interval = gc_interval
         self.data_dir = data_dir
+        self.region = region
 
 
 class Server:
@@ -112,6 +114,11 @@ class Server:
         from nomad_tpu.rpc.endpoints import Endpoints
         self.endpoints = Endpoints(self)
         self.membership = membership   # gossip (core.membership), optional
+        # multi-region federation: region -> peer handle (a Server object
+        # for in-process federation, or a server NAME reachable over the
+        # shared transport — the WAN-serf analog of nomad/serf.go)
+        self.region = self.config.region
+        self._region_peers: Dict[str, object] = {}
         if raft_transport is not None:
             raft_transport.register(f"rpc:{name}", self.endpoints.handle)
             data_dir = self.config.data_dir
@@ -164,6 +171,47 @@ class Server:
             from nomad_tpu.rpc.endpoints import RpcError
             raise RpcError("no_leader", "no cluster leader")
         return self._transport.call(self.name, f"rpc:{leader}", method, args)
+
+    # ------------------------------------------------------------- regions
+
+    def federate(self, other: "Server") -> None:
+        """Two-way in-process federation (reference: WAN serf join,
+        nomad/serf.go — each region learns a route to the other's
+        servers).  Transitive routes propagate so a three-region mesh
+        needs only pairwise joins."""
+        self._region_peers[other.region] = other
+        other._region_peers[self.region] = self
+        for r, p in list(other._region_peers.items()):
+            if r not in (self.region,) and r not in self._region_peers:
+                self._region_peers[r] = p
+        for r, p in list(self._region_peers.items()):
+            if r not in (other.region,) and r not in other._region_peers:
+                other._region_peers[r] = p
+
+    def federate_name(self, region: str, server_name: str) -> None:
+        """Transport-based federation route: RPCs for `region` forward to
+        `server_name` over the shared transport."""
+        self._region_peers[region] = server_name
+
+    def regions(self) -> List[str]:
+        return sorted({self.region, *self._region_peers})
+
+    def rpc_region(self, region: str, method: str, args: dict):
+        """Route an RPC to the right region's leader (reference
+        nomad/rpc.go:21 forwardRegion).  Local region short-circuits."""
+        if not region or region == self.region:
+            return self.rpc_leader(method, args)
+        peer = self._region_peers.get(region)
+        if peer is None:
+            from nomad_tpu.rpc.endpoints import RpcError
+            raise RpcError("no_region_path", region)
+        if isinstance(peer, str):
+            if self._transport is None:
+                from nomad_tpu.rpc.endpoints import RpcError
+                raise RpcError("no_region_path", region)
+            return self._transport.call(self.name, f"rpc:{peer}", method,
+                                        args)
+        return peer.rpc_leader(method, args)
 
     def _commit_plan(self, applied) -> int:
         return self.apply(MessageType.APPLY_PLAN_RESULTS,
@@ -395,7 +443,17 @@ class Server:
                    {"evals": [e.copy() for e in evals]})
 
     def register_job(self, job: Job) -> Evaluation:
-        """Job.Register (nomad/job_endpoint.go:81): upsert + eval."""
+        """Job.Register (nomad/job_endpoint.go:81): upsert + eval.  A job
+        whose region is not ours forwards to that region's servers
+        (job_endpoint.go forward via rpc.go forwardRegion)."""
+        if job.region and job.region != self.region:
+            resp = self.rpc_region(job.region, "Job.Register",
+                                   {"job": job})
+            return Evaluation(
+                id=resp["eval_id"], namespace=job.namespace,
+                job_id=job.id, type=job.type,
+                triggered_by=EvalTrigger.JOB_REGISTER,
+                status=EvalStatus.PENDING)
         index = self.apply(MessageType.JOB_REGISTER, {"job": job})
         # when the write was forwarded, the leader mutated a pickled copy;
         # pull the committed indexes back onto the caller's object so the
@@ -431,6 +489,76 @@ class Server:
         self.create_evals([ev])
         return ev
 
+    def scale_job(self, namespace: str, job_id: str, group: str,
+                  count: Optional[int] = None, message: str = "",
+                  error: bool = False, meta: Optional[dict] = None
+                  ) -> Optional[Evaluation]:
+        """Job.Scale (reference nomad/job_endpoint.go:967): adjust one
+        task group's count within its scaling-policy bounds by
+        registering the updated job (which creates the eval that
+        reschedules), and record a ScalingEvent either way (error=True
+        events are autoscaler annotations that never change counts)."""
+        import time as _t
+
+        from nomad_tpu.structs.job import ScalingEvent
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            raise ValueError(f"job {job_id!r} not found")
+        tg = job.lookup_task_group(group)
+        if tg is None:
+            raise ValueError(
+                f"task group {group!r} does not exist in job")
+        prev = tg.count
+        ev = None
+        if count is not None and not error:
+            if tg.scaling is not None and tg.scaling.enabled:
+                if count < tg.scaling.min:
+                    raise ValueError(
+                        f"group count was less than scaling policy "
+                        f"minimum: {count} < {tg.scaling.min}")
+                if tg.scaling.max and count > tg.scaling.max:
+                    raise ValueError(
+                        f"group count was greater than scaling policy "
+                        f"maximum: {count} > {tg.scaling.max}")
+            new_job = job.copy()
+            new_job.lookup_task_group(group).count = int(count)
+            ev = self.register_job(new_job)
+        event = ScalingEvent(
+            time=_t.time(), previous_count=prev, count=count,
+            message=message, error=error,
+            eval_id=ev.id if ev is not None else "", meta=meta or {})
+        self.apply(MessageType.SCALING_EVENT,
+                   {"namespace": namespace, "job_id": job_id,
+                    "group": group, "event": event})
+        return ev
+
+    def job_scale_status(self, namespace: str, job_id: str) -> Optional[dict]:
+        """Job.ScaleStatus (job_endpoint.go:2038): desired vs placed vs
+        healthy per group + the scaling-event log."""
+        job = self.store.job_by_id(namespace, job_id)
+        if job is None:
+            return None
+        allocs = self.store.allocs_by_job(namespace, job_id)
+        events = self.store.scaling_events_by_job(namespace, job_id)
+        groups = {}
+        for tg in job.task_groups:
+            live = [a for a in allocs if a.task_group == tg.name
+                    and not a.terminal_status()]
+            healthy = sum(1 for a in live if (a.deployment_status or {})
+                          .get("healthy") is True)
+            unhealthy = sum(1 for a in live if (a.deployment_status or {})
+                            .get("healthy") is False)
+            groups[tg.name] = {
+                "desired": tg.count, "placed": len(live),
+                "running": sum(1 for a in live
+                               if a.client_status == "running"),
+                "healthy": healthy, "unhealthy": unhealthy,
+                "events": events.get(tg.name, []),
+            }
+        return {"job_id": job_id, "namespace": namespace,
+                "job_modify_index": job.modify_index,
+                "job_stopped": job.stopped(), "task_groups": groups}
+
     def set_job_stability(self, namespace: str, job_id: str, version: int,
                           stable: bool) -> None:
         self.apply(MessageType.JOB_STABILITY,
@@ -439,8 +567,58 @@ class Server:
 
     def register_node(self, node: Node) -> None:
         """Node.Register (nomad/node_endpoint.go:79).  The leader's FSM
-        hook starts the TTL timer."""
+        hook starts the TTL timer.  A re-registration whose device
+        fingerprint marks instances unhealthy (the device plugin health
+        stream, plugins/device/device.go:25-37) migrates the allocations
+        holding those instances — dead hardware must not keep serving."""
+        prev = self.store.node_by_id(node.id)
+        newly_bad: set = set()
+        if prev is not None:
+            prev_bad = {i for d in prev.node_resources.devices
+                        for i in d.unhealthy_ids}
+            now_bad = {i for d in node.node_resources.devices
+                       for i in d.unhealthy_ids}
+            newly_bad = now_bad - prev_bad
         self.apply(MessageType.NODE_REGISTER, {"node": node})
+        if newly_bad:
+            self._migrate_device_allocs(node.id, newly_bad)
+
+    def _migrate_device_allocs(self, node_id: str, bad_ids: set) -> None:
+        """DesiredTransition(force_reschedule) + eval for every alloc on
+        the node holding a now-unhealthy device instance: the reconciler
+        replaces it, and the replacement lands on healthy hardware
+        because unhealthy instances carry no capacity."""
+        from nomad_tpu.structs.alloc import DesiredTransition
+        doomed = []
+        for a in self.store.allocs_by_node(node_id):
+            if a.terminal_status():
+                continue
+            held = {i for tr in a.allocated_resources.tasks.values()
+                    for d in tr.devices
+                    for i in d.get("device_ids", ())}
+            if held & bad_ids:
+                doomed.append(a)
+        if not doomed:
+            return
+        for a in doomed:
+            u = a.copy() if hasattr(a, "copy") else a
+            # force_reschedule: migrate only moves allocs on DRAINING
+            # nodes; a dead device on a healthy node needs the
+            # unconditional replace path (the `nomad alloc stop` flow)
+            u.desired_transition = DesiredTransition(force_reschedule=True)
+            self.apply(MessageType.ALLOC_UPDATE_DESIRED_TRANSITION,
+                       {"allocs": [u]})
+        evs = []
+        for (ns, job_id) in {(a.namespace, a.job_id) for a in doomed}:
+            job = self.store.job_by_id(ns, job_id)
+            if job is None:
+                continue
+            evs.append(Evaluation(
+                namespace=ns, priority=job.priority, type=job.type,
+                job_id=job_id, triggered_by=EvalTrigger.NODE_UPDATE,
+                status=EvalStatus.PENDING))
+        if evs:
+            self.create_evals(evs)
 
     def node_heartbeat(self, node_id: str) -> float:
         """Node.UpdateStatus heartbeat path: reset TTL; a down node

@@ -123,7 +123,11 @@ class Worker:
     def submit_plan(self, plan: Plan) -> PlanResult:
         plan.eval_token = getattr(self, "_token", "")
         pending = self.server.plan_queue.enqueue(plan)
-        return pending.future.result(timeout=30.0)
+        # generous: under full-cluster bursts (the 1M-alloc C2M) the
+        # serialized applier legitimately backs up for minutes; an eval
+        # failed on a timed-out future gets retried from scratch even
+        # though its plan still commits — pure wasted recompute
+        return pending.future.result(timeout=600.0)
 
     def create_evals(self, evals: List[Evaluation]) -> None:
         self.server.create_evals(evals)

@@ -72,6 +72,12 @@ class ClusterMatrix:
         # device-group id -> i32[N] instance capacity / committed usage
         self.device_caps: Dict[str, np.ndarray] = {}
         self.device_used: Dict[str, np.ndarray] = {}
+        # computed-class ordinal per row (-1 = empty row): lets blocked-eval
+        # class-eligibility reduce as a vectorized groupby instead of an
+        # O(N) Python node walk (reference EvalEligibility keying)
+        self.class_codes = np.full(cap, -1, dtype=np.int32)
+        self.class_names: List[str] = []
+        self._class_rank: Dict[str, int] = {}
         # generation counter bumped on any mutation (device cache invalidation)
         self.generation = 0
         # authoritative live-alloc usage, keyed by node id so it survives node
@@ -97,6 +103,8 @@ class ClusterMatrix:
         self.dyn_port_hi = np.concatenate([self.dyn_port_hi, np.full(old, 32000, np.int32)])
         self.node_ids.extend([None] * old)
         self._free_rows.extend(range(new - 1, old - 1, -1))
+        self.class_codes = np.concatenate(
+            [self.class_codes, np.full(old, -1, np.int32)])
         self.attrs.resize(new)
         for k in self.device_caps:
             self.device_caps[k] = np.concatenate(
@@ -123,6 +131,12 @@ class ClusterMatrix:
         self.capacity[row, RES_DISK] = res.disk_mb - rr.disk_mb
         self.capacity[row, RES_NET] = sum(n.mbits for n in res.networks)
         self.ready[row] = node.ready()
+        cc = getattr(node, "computed_class", "") or ""
+        code = self._class_rank.get(cc)
+        if code is None:
+            code = self._class_rank[cc] = len(self.class_names)
+            self.class_names.append(cc)
+        self.class_codes[row] = code
         self.attrs.set_node_row(row, node)
         # drivers become attr columns like the reference's driver.<name> attrs
         for name, info in node.drivers.items():
@@ -144,7 +158,8 @@ class ClusterMatrix:
         for dev in node.node_resources.devices:
             col = self.device_caps.setdefault(
                 dev.id, np.zeros(self._n_rows, dtype=np.int32))
-            col[row] = len(dev.instance_ids)
+            # unhealthy instances don't count as schedulable capacity
+            col[row] = len(dev.healthy_ids())
         self.dyn_port_lo[row] = res.min_dynamic_port
         self.dyn_port_hi[row] = res.max_dynamic_port
         words = np.zeros(_PORT_WORDS, dtype=np.uint32)
@@ -175,6 +190,7 @@ class ClusterMatrix:
         self.capacity[row] = 0
         self.used[row] = 0
         self.ready[row] = False
+        self.class_codes[row] = -1
         self.port_words[row] = 0
         for col in self.device_caps.values():
             col[row] = 0

@@ -49,6 +49,9 @@ class MessageType:
     ACL_POLICY_DELETE = "ACLPolicyDeleteRequest"
     ACL_TOKEN_UPSERT = "ACLTokenUpsertRequest"
     ACL_TOKEN_DELETE = "ACLTokenDeleteRequest"
+    SCALING_EVENT = "ScalingEventRequest"
+    SERVICE_REGISTER = "ServiceRegistrationUpsertRequest"
+    SERVICE_DEREGISTER = "ServiceRegistrationDeleteRequest"
     NOOP = "Noop"                  # leadership-establishment barrier entry
 
 
@@ -91,6 +94,9 @@ class NomadFSM:
             MessageType.ACL_POLICY_DELETE: self._apply_acl_policy_delete,
             MessageType.ACL_TOKEN_UPSERT: self._apply_acl_token_upsert,
             MessageType.ACL_TOKEN_DELETE: self._apply_acl_token_delete,
+            MessageType.SCALING_EVENT: self._apply_scaling_event,
+            MessageType.SERVICE_REGISTER: self._apply_service_register,
+            MessageType.SERVICE_DEREGISTER: self._apply_service_deregister,
             MessageType.NOOP: lambda index, p: None,
         }
         # optional table handlers registered by periphery subsystems
@@ -241,6 +247,17 @@ class NomadFSM:
     def _apply_acl_token_delete(self, index, p):
         self.store.delete_acl_token(index, p["accessor_id"])
 
+    def _apply_scaling_event(self, index, p):
+        self.store.upsert_scaling_event(
+            index, p["namespace"], p["job_id"], p["group"], p["event"])
+
+    def _apply_service_register(self, index, p):
+        self.store.upsert_service_registrations(index, p["services"])
+
+    def _apply_service_deregister(self, index, p):
+        self.store.delete_service_registrations(
+            index, p.get("ids"), alloc_id=p.get("alloc_id"))
+
     def snapshot(self) -> bytes:
         """Serialize the full store (reference nomadFSM.Snapshot →
         nomadSnapshot.Persist, nomad/fsm.go)."""
@@ -261,6 +278,9 @@ class NomadFSM:
                 "acl_tokens": list(s._acl_tokens.values()),
                 "csi_volumes": dict(s._csi_volumes),
                 "csi_plugins": dict(s._csi_plugins),
+                "scaling_events": {k: list(v) for k, v in
+                                   s._scaling_events.items()},
+                "services": list(s._services.values()),
                 "extra": {name: fn() for name, fn in
                           getattr(self, "snapshot_extra", {}).items()},
             }
@@ -300,6 +320,13 @@ class NomadFSM:
                 s._acl_by_secret[t.secret_id] = t
             s._csi_volumes = dict(data.get("csi_volumes", {}))
             s._csi_plugins = dict(data.get("csi_plugins", {}))
+            s._scaling_events = {k: list(v) for k, v in
+                                 data.get("scaling_events", {}).items()}
+            s._services = {}
+            s._services_by_alloc = defaultdict(set)
+            for sr in data.get("services", []):
+                s._services[sr.id] = sr
+                s._services_by_alloc[sr.alloc_id].add(sr.id)
             s.matrix = ClusterMatrix()
             s.matrix.lock = s._lock
             for n in data["nodes"]:

@@ -69,6 +69,14 @@ class Endpoints:
     # ------------------------------------------------------------- dispatch
 
     def handle(self, method: str, args: dict):
+        # cross-region forwarding (reference nomad/rpc.go:21
+        # forwardRegion): an explicit region that is not ours routes to
+        # that region's servers before any local processing
+        region = (args or {}).get("region")
+        if region and region != self.server.region:
+            fwd = dict(args)
+            fwd.pop("region", None)
+            return self.server.rpc_region(region, method, fwd)
         fn = self._methods.get(method)
         if fn is None:
             raise RpcError("unknown_method", method)
@@ -500,3 +508,132 @@ class Endpoints:
         if path:
             self.server.save_snapshot(path)
         return {"ok": True}
+
+    # ------------------------------------------------------------- search
+
+    def rpc_Search__PrefixSearch(self, args):
+        """Server-side prefix search across contexts (reference
+        nomad/search_endpoint.go:518 PrefixSearch; 20-match truncation
+        per context like truncateLimit).  `namespaces`: optional
+        visibility filter computed by the agent from the caller's ACL."""
+        prefix = args.get("prefix", "")
+        context = args.get("context", "all")
+        visible = args.get("namespaces")   # None = all namespaces
+        store = self.server.store
+
+        def ns_ok(ns):
+            return visible is None or ns in visible
+
+        out, trunc = {}, {}
+
+        def add(name, ids):
+            matches = sorted(i for i in ids if i.startswith(prefix))
+            trunc[name] = len(matches) > 20
+            out[name] = matches[:20]
+
+        if context in ("all", "jobs"):
+            add("jobs", [j.id for j in store.jobs() if ns_ok(j.namespace)])
+        if context in ("all", "nodes"):
+            add("nodes", [n.id for n in store.nodes()])
+        if context in ("all", "evals"):
+            add("evals", [e.id for e in store.evals()
+                          if ns_ok(e.namespace)])
+        if context in ("all", "allocs"):
+            add("allocs", [a.id for a in store.allocs()
+                           if ns_ok(a.namespace)])
+        if context in ("all", "deployment"):
+            add("deployment", [d.id for d in store.deployments()
+                               if ns_ok(d.namespace)])
+        if context in ("all", "plugins"):
+            add("plugins", [p.get("id", pid) if isinstance(p, dict) else pid
+                            for pid, p in store._csi_plugins.items()])
+        if context in ("all", "volumes"):
+            add("volumes", [vid for (ns, vid) in store._csi_volumes
+                            if ns_ok(ns)])
+        if context in ("all", "namespaces"):
+            add("namespaces", list(store._namespaces))
+        return {"matches": out, "truncations": trunc}
+
+    # ------------------------------------------------------------- scaling
+
+    def rpc_Job__Scale(self, args):
+        try:
+            ev = self.server.scale_job(
+                args.get("namespace", "default"), args["job_id"],
+                args["group"], count=args.get("count"),
+                message=args.get("message", ""),
+                error=bool(args.get("error", False)),
+                meta=args.get("meta"))
+        except ValueError as e:
+            raise RpcError("bad_request", str(e))
+        return {"eval_id": ev.id if ev is not None else None}
+
+    def rpc_Job__ScaleStatus(self, args):
+        st = self.server.job_scale_status(
+            args.get("namespace", "default"), args["job_id"])
+        if st is None:
+            raise RpcError("not_found", args["job_id"])
+        return st
+
+    def rpc_Scaling__ListPolicies(self, args):
+        """reference nomad/scaling_endpoint.go ListPolicies: one row per
+        (job, group) scaling stanza."""
+        out = []
+        for job, group, pol in self.server.store.scaling_policies(
+                args.get("namespace")):
+            out.append({
+                "id": f"{job.namespace}/{job.id}/{group}",
+                "namespace": job.namespace,
+                "target": {"Namespace": job.namespace, "Job": job.id,
+                           "Group": group},
+                "min": pol.min, "max": pol.max, "enabled": pol.enabled,
+            })
+        return out
+
+    def rpc_Scaling__GetPolicy(self, args):
+        pid = args["id"]
+        for job, group, pol in self.server.store.scaling_policies(None):
+            if f"{job.namespace}/{job.id}/{group}" == pid:
+                return {"id": pid, "namespace": job.namespace,
+                        "target": {"Namespace": job.namespace,
+                                   "Job": job.id, "Group": group},
+                        "min": pol.min, "max": pol.max,
+                        "enabled": pol.enabled, "policy": pol.policy}
+        raise RpcError("not_found", pid)
+
+    # ------------------------------------------------------------- services
+
+    def rpc_Service__Upsert(self, args):
+        self.server.apply(MessageType.SERVICE_REGISTER,
+                          {"services": args["services"]})
+        return {}
+
+    def rpc_Service__DeleteByAlloc(self, args):
+        self.server.apply(MessageType.SERVICE_DEREGISTER,
+                          {"alloc_id": args["alloc_id"]})
+        return {}
+
+    def rpc_Service__Delete(self, args):
+        self.server.apply(MessageType.SERVICE_DEREGISTER,
+                          {"ids": [args["id"]]})
+        return {}
+
+    def rpc_Service__List(self, args):
+        """Grouped {service_name: count} listing (reference
+        nomad/service_registration_endpoint.go List)."""
+        svcs = self.server.store.services(args.get("namespace"))
+        names = {}
+        for s in svcs:
+            names.setdefault((s.namespace, s.service_name), 0)
+            names[(s.namespace, s.service_name)] += 1
+        return [{"namespace": ns, "service_name": n, "instances": c}
+                for (ns, n), c in sorted(names.items())]
+
+    def rpc_Service__GetService(self, args):
+        return self.server.store.services_by_name(
+            args.get("namespace", "default"), args["service_name"])
+
+    # ------------------------------------------------------------- regions
+
+    def rpc_Status__Regions(self, args):
+        return self.server.regions()

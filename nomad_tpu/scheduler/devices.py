@@ -72,7 +72,8 @@ def assign_device_instances(node, allocs, request,
     for dev in node.node_resources.devices:
         if not dev.matches(request.name):
             continue
-        free = [i for i in dev.instance_ids if i not in used.get(dev.id, set())]
+        free = [i for i in dev.healthy_ids()
+                if i not in used.get(dev.id, set())]
         if len(free) >= request.count:
             # random choice among free instances: concurrent evals that
             # cannot see each other's in-flight assignments would all

@@ -239,7 +239,9 @@ class GenericScheduler:
 
     def _class_eligibility(self) -> Tuple[Dict[str, bool], bool]:
         """Which computed node classes were feasible (for unblock-on-capacity
-        keying; reference EvalEligibility, context.go:252-420)."""
+        keying; reference EvalEligibility, context.go:252-420) — a
+        vectorized groupby over the matrix's per-row class codes instead
+        of the reference's per-node memoized walk."""
         classes: Dict[str, bool] = {}
         escaped = False
         if self.job is None:
@@ -248,14 +250,27 @@ class GenericScheduler:
             if "unique." in c.ltarget or "unique." in c.rtarget:
                 escaped = True
         cm = self.state.matrix
+        codes = cm.class_codes
+        n_classes = len(cm.class_names)
+        if n_classes == 0:
+            return classes, escaped
+        valid = codes >= 0
         feas_union = getattr(self, "_last_feasible_union", None)
-        for node_id, row in cm.row_of.items():
-            node = self.state.node_by_id(node_id)
-            if node is None:
-                continue
-            ok = bool(feas_union[row]) if feas_union is not None else True
-            prev = classes.get(node.computed_class)
-            classes[node.computed_class] = bool(prev) or ok
+        if feas_union is not None and feas_union.shape[0] < codes.shape[0]:
+            # matrix grew since the stack compiled; unseen rows count as
+            # infeasible for this eval's view
+            grown = np.zeros(codes.shape[0], bool)
+            grown[:feas_union.shape[0]] = feas_union
+            feas_union = grown
+        present = np.bincount(codes[valid], minlength=n_classes) > 0
+        if feas_union is None:
+            ok = present
+        else:
+            ok = np.bincount(codes[valid],
+                             weights=feas_union[valid].astype(np.float64),
+                             minlength=n_classes) > 0
+        for c in np.flatnonzero(present):
+            classes[cm.class_names[c]] = bool(ok[c])
         return classes, escaped
 
     # ------------------------------------------------------------- placing
@@ -578,7 +593,7 @@ class GenericScheduler:
                 # one kernel round serves a batch of failed slots (each
                 # find round trip costs ~a tunnel RTT)
                 cache.extend(preemptor.find_many(
-                    groups[gi].feasible, groups[gi].demand, used, 16,
+                    groups[gi].feasible, groups[gi].demand, used, 64,
                     static_ports=groups[gi].static_ports,
                     feasible_pre_ports=groups[gi].feasible_pre_ports,
                     device_blocked=groups[gi].device_blocked))

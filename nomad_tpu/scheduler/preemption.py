@@ -206,14 +206,22 @@ class Preemptor:
         util_after = used - freed_all + demand[None, :]
         fit_all = _score_fit_np(cm.capacity, util_after) / 18.0
         best_row, best_score = -1, -np.inf
+        row_scores = []
         for row in rows:
             evicted = [self.cand_allocs[row][i]
                        for i in np.flatnonzero(picked[row])]
             p_score = preemption_score(net_priority(
                 [a.job.priority if a.job else 50 for a in evicted]))
             score = (float(fit_all[row]) + p_score) / 2.0
+            row_scores.append((score, int(row)))
             if score > best_score:
                 best_score, best_row = score, int(row)
+        # every met row, best-first, for find_many: eviction sets on
+        # distinct rows are disjoint, so one kernel round can serve a
+        # whole batch of failed slots instead of one
+        row_scores.sort(reverse=True)
+        self._last_ranked = [(row, picked, forced, remaining)
+                             for _, row in row_scores]
 
         protected = {self.cand_allocs[best_row][i].id
                      for i in forced.get(best_row, ())}

@@ -159,6 +159,13 @@ class StateStore:
         # CSI tables (reference schema.go csi_volumes / csi_plugins)
         self._csi_volumes: Dict[Tuple[str, str], object] = {}   # (ns, id)
         self._csi_plugins: Dict[str, object] = {}
+        # scaling event ring per (ns, job, group) (reference schema.go
+        # scaling_event; capped like structs.JobTrackedScalingEvents)
+        self._scaling_events: Dict[Tuple[str, str, str], List[object]] = {}
+        # nomad-native service registrations, keyed by registration id
+        # (reference schema.go service_registrations)
+        self._services: Dict[str, object] = {}
+        self._services_by_alloc: Dict[str, Set[str]] = defaultdict(set)
         self.matrix = ClusterMatrix()
         # readers outside the store (the placement engine's basis copies)
         # take this lock to avoid tearing a half-applied commit
@@ -460,6 +467,86 @@ class StateStore:
         with self._lock:
             return [self._evals[i]
                     for i in self._evals_by_job.get((namespace, job_id), ())]
+
+    # ---------------------------------------------------- scaling events
+
+    MAX_SCALING_EVENTS = 100   # reference structs.JobTrackedScalingEvents
+
+    def upsert_scaling_event(self, index: int, namespace: str, job_id: str,
+                             group: str, event) -> None:
+        with self._lock:
+            ring = self._scaling_events.setdefault(
+                (namespace, job_id, group), [])
+            ring.insert(0, event)
+            del ring[self.MAX_SCALING_EVENTS:]
+            self._bump(index)
+
+    def scaling_events_by_job(self, namespace: str, job_id: str):
+        """{group: [ScalingEvent, newest first]}"""
+        with self._lock:
+            return {g: list(ev) for (ns, jid, g), ev in
+                    self._scaling_events.items()
+                    if ns == namespace and jid == job_id}
+
+    def scaling_policies(self, namespace: Optional[str] = None):
+        """[(job, group, ScalingPolicy)] over live jobs (the reference
+        stores policies in their own table; here they live on the job,
+        the single source of truth)."""
+        with self._lock:
+            out = []
+            for j in self._jobs.values():
+                if namespace is not None and j.namespace != namespace:
+                    continue
+                if j.stopped():
+                    continue
+                for tg in j.task_groups:
+                    if tg.scaling is not None:
+                        out.append((j, tg.name, tg.scaling))
+            return out
+
+    # ----------------------------------------------- service registrations
+
+    def upsert_service_registrations(self, index: int, services) -> None:
+        """services: [ServiceRegistration] (reference
+        state_store_service_registration.go UpsertServiceRegistrations)."""
+        with self._lock:
+            for sr in services:
+                self._services[sr.id] = sr
+                self._services_by_alloc[sr.alloc_id].add(sr.id)
+            self._bump(index)
+        for sr in services:
+            self._notify("services", sr)
+
+    def delete_service_registrations(self, index: int, ids=None,
+                                     alloc_id: Optional[str] = None) -> None:
+        with self._lock:
+            doomed = set(ids or ())
+            if alloc_id is not None:
+                doomed |= self._services_by_alloc.get(alloc_id, set())
+            removed = []
+            for sid in doomed:
+                sr = self._services.pop(sid, None)
+                if sr is not None:
+                    self._services_by_alloc[sr.alloc_id].discard(sid)
+                    removed.append(sr)
+            self._bump(index)
+        for sr in removed:
+            self._notify("services", sr)
+
+    def services(self, namespace: Optional[str] = None):
+        with self._lock:
+            return [s for s in self._services.values()
+                    if namespace is None or s.namespace == namespace]
+
+    def services_by_name(self, namespace: str, name: str):
+        with self._lock:
+            return [s for s in self._services.values()
+                    if s.namespace == namespace and s.service_name == name]
+
+    def services_by_alloc(self, alloc_id: str):
+        with self._lock:
+            return [self._services[i]
+                    for i in self._services_by_alloc.get(alloc_id, ())]
 
     # ------------------------------------------------------------ allocs
 

@@ -173,6 +173,31 @@ class Service:
 
 
 @dataclass
+class ScalingPolicy:
+    """Task-group scaling bounds + external-autoscaler policy document
+    (reference nomad/structs/structs.go ScalingPolicy / TaskGroup.Scaling:
+    the server enforces min/max on Job.Scale; the policy body is opaque
+    to the scheduler and consumed by the autoscaler)."""
+    min: int = 0
+    max: int = 0
+    enabled: bool = True
+    policy: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class ScalingEvent:
+    """One scale action recorded against a (job, group) — the audit log
+    behind `nomad job scale-status` (structs.go ScalingEvent)."""
+    time: float = 0.0
+    previous_count: int = 0
+    count: Optional[int] = None
+    message: str = ""
+    error: bool = False
+    eval_id: str = ""
+    meta: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
 class Task:
     name: str = "task"
     driver: str = "mock"
@@ -229,6 +254,7 @@ class TaskGroup:
     max_client_disconnect_s: Optional[float] = None
     stop_after_client_disconnect_s: Optional[float] = None
     meta: Dict[str, str] = field(default_factory=dict)
+    scaling: Optional[ScalingPolicy] = None
 
     def copy(self) -> "TaskGroup":
         return replace(self, tasks=[t.copy() for t in self.tasks],

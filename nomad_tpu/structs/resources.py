@@ -53,16 +53,25 @@ class DeviceRequest:
 
 @dataclass
 class NodeDevice:
-    """An instance group of devices on a node (reference structs.NodeDeviceResource)."""
+    """An instance group of devices on a node (reference structs.NodeDeviceResource).
+    `unhealthy_ids` is fed by the client's device fingerprint stream
+    (reference plugins/device/device.go:25-37 Fingerprint — per-instance
+    Healthy flags): unhealthy instances stay listed (operators see them)
+    but are excluded from scheduling capacity and assignment."""
     vendor: str = ""
     type: str = ""            # e.g. "gpu", "fpga"
     name: str = ""            # model name
     instance_ids: List[str] = field(default_factory=list)
     attributes: Dict[str, object] = field(default_factory=dict)
+    unhealthy_ids: List[str] = field(default_factory=list)
 
     @property
     def id(self) -> str:
         return f"{self.vendor}/{self.type}/{self.name}"
+
+    def healthy_ids(self) -> List[str]:
+        bad = set(self.unhealthy_ids)
+        return [i for i in self.instance_ids if i not in bad]
 
     def matches(self, requested: str) -> bool:
         """Match semantics of structs.NodeDeviceResource.ID matching:
