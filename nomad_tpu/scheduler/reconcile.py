@@ -549,9 +549,18 @@ class AllocReconciler:
         upd["ignore"] += len(current_version) + max(
             len(destructive) - destructive_allowed, 0)
 
-        # --- deployment bookkeeping
+        # --- deployment bookkeeping.  hadRunning (reference
+        # reconcile.go computeGroup): a deployment is also created the
+        # first time a job version places allocs — not only for
+        # destructive updates — so initial registrations of service jobs
+        # with an update stanza are health-gated too.
+        had_current = any(
+            a.job is not None and a.job.version == self.job.version
+            and a.job.create_index == self.job.create_index
+            for a in all_allocs)
         if is_service and tg.update is not None:
-            self._ensure_deployment_state(tg, destructive, want_canaries, count)
+            self._ensure_deployment_state(tg, destructive, want_canaries,
+                                          count, had_current)
 
         # group is deployment-complete when nothing is pending
         complete = not destructive and not want_canaries and missing <= 0 \
@@ -561,13 +570,13 @@ class AllocReconciler:
     # -------------------------------------------------------- deployments
 
     def _ensure_deployment_state(self, tg: TaskGroup, destructive, want_canaries,
-                                 count) -> None:
+                                 count, had_current: bool) -> None:
         if self.deployment_failed or self.deployment_paused:
             return
-        needs = bool(destructive or want_canaries)
+        needs = bool(destructive or want_canaries or not had_current)
         d = self.results.deployment or self.deployment
         if d is None:
-            if not needs:
+            if not needs or count == 0:
                 return
             d = Deployment(
                 namespace=self.job.namespace, job_id=self.job_id,
@@ -592,6 +601,12 @@ class AllocReconciler:
     def _finalize_deployment(self, deployment_complete: bool) -> None:
         d = self.deployment
         if d is None or not deployment_complete:
+            return
+        # isDeploymentComplete (reference reconcile.go): structural
+        # completeness is not enough — every group must have reached its
+        # desired healthy count, else success is the watcher's call later.
+        if any(s.healthy_allocs < s.desired_total
+               for s in d.task_groups.values()):
             return
         if d.status == DeploymentStatus.RUNNING and not d.requires_promotion():
             self.results.deployment_updates.append({
