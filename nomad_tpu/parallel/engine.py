@@ -258,6 +258,11 @@ class PlacementEngine:
                       "resolve_s": 0.0, "cache_hits": 0, "cache_misses": 0,
                       "bulk_evals": 0}
         self._cache = _DeviceCache()
+        # serving readiness: compiled variants persist across processes
+        # (utils.enable_compile_cache docstring) — must be set before the
+        # first jit call of this process
+        from nomad_tpu.utils import enable_compile_cache
+        enable_compile_cache()
         self._thread = threading.Thread(
             target=self._run, name="placement-engine", daemon=True)
         self._thread.start()
@@ -336,33 +341,51 @@ class PlacementEngine:
                     inputs, demand=inputs.demand[:cut],
                     slot_tg=inputs.slot_tg[:cut],
                     slot_active=inputs.slot_active[:cut]))
-        for E in self.E_BUCKETS:
-            for inp_v in input_variants:
-                reqs = [_Request(cm=cm, inputs=inp_v, deltas=[],
-                                 spread_algorithm=False, future=Future())
-                        for _ in range(E)]
-                if mesh is not None:
-                    jax.block_until_ready(
-                        self._dispatch_group_sharded(reqs, mesh))
-                else:
-                    packed = self._dispatch_packed(
-                        reqs, E=E,
-                        basis=np.asarray(inp_v.used, np.float32),
-                        deltas_per_req=[[] for _ in reqs],
-                        capacity=np.asarray(inp_v.capacity))
-                    jax.block_until_ready(packed)
-            if bulk is not None:
-                breqs = [_BulkRequest(cm=cm, deltas=[],
-                                      spread_algorithm=False,
-                                      future=Future(), **bulk)
-                         for _ in range(E)]
-                if mesh is not None:
-                    out, _b, _d = self._dispatch_bulk_group_sharded(
-                        breqs, mesh)
-                    jax.block_until_ready(out)
-                else:
-                    packed, _basis, _d = self._dispatch_bulk_group(breqs)
-                    jax.block_until_ready(packed)
+        def scan_variant(E, inp_v):
+            reqs = [_Request(cm=cm, inputs=inp_v, deltas=[],
+                             spread_algorithm=False, future=Future())
+                    for _ in range(E)]
+            if mesh is not None:
+                jax.block_until_ready(
+                    self._dispatch_group_sharded(reqs, mesh))
+            else:
+                packed = self._dispatch_packed(
+                    reqs, E=E,
+                    basis=np.asarray(inp_v.used, np.float32),
+                    deltas_per_req=[[] for _ in reqs],
+                    capacity=np.asarray(inp_v.capacity))
+                jax.block_until_ready(packed)
+
+        def bulk_variant(E):
+            breqs = [_BulkRequest(cm=cm, deltas=[],
+                                  spread_algorithm=False,
+                                  future=Future(), **bulk)
+                     for _ in range(E)]
+            if mesh is not None:
+                out, _b, _d = self._dispatch_bulk_group_sharded(breqs, mesh)
+                jax.block_until_ready(out)
+            else:
+                packed, _basis, _d = self._dispatch_bulk_group(breqs)
+                jax.block_until_ready(packed)
+
+        # XLA compiles release the GIL and run concurrently per variant,
+        # cutting the grid from the sum of compile times toward the max.
+        # Each thunk also EXECUTES its variant (block_until_ready), so
+        # worker count bounds peak device memory: NOMAD_TPU_WARM_THREADS
+        # tunes it down to 1 (sequential) for memory-tight configs.
+        # (jit dispatch and the device cache are safe here: warmup thunks
+        # never write overlays, and stats are restored below.)
+        thunks = [(scan_variant, (E, v))
+                  for E in self.E_BUCKETS for v in input_variants]
+        if bulk is not None:
+            thunks += [(bulk_variant, (E,)) for E in self.E_BUCKETS]
+        workers = int(os.environ.get("NOMAD_TPU_WARM_THREADS", "4"))
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(
+                max_workers=max(1, min(workers, len(thunks)))) as ex:
+            futs = [ex.submit(fn, *a) for fn, a in thunks]
+            for f in futs:
+                f.result()
         self.stats.update(stats_before)
         self._cache.hits, self._cache.misses = cache_before
 
