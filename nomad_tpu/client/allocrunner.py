@@ -33,6 +33,7 @@ class AllocRunner:
         self._destroyed = False
         self._thread: Optional[threading.Thread] = None
         self._health_thread: Optional[threading.Thread] = None
+        self._health_gen = 0
         self.deployment_healthy: Optional[bool] = None
         from nomad_tpu.client.csi import CSIHook
         self.csi_hook = CSIHook(alloc, self.alloc_dir.dir,
@@ -247,11 +248,14 @@ class AllocRunner:
         # nomad service registration of the alloc must be passing too
         # (reference allochealth/tracker.go watchConsulEvents analog)
         use_checks = bool(update and update.health_check == "checks")
+        with self._lock:
+            self._health_gen += 1
+            gen = self._health_gen
 
         def watch():
             start = time.time()
             healthy_since = None
-            while not self._destroyed:
+            while not self._destroyed and gen == self._health_gen:
                 now = time.time()
                 states = [tr.state for tr in self.task_runners.values()]
                 if any(s.failed for s in states):
@@ -283,6 +287,23 @@ class AllocRunner:
     def _set_health(self, healthy: bool) -> None:
         self.deployment_healthy = healthy
         self.on_update(self)
+
+    def update(self, alloc) -> None:
+        """In-place update (alloc_runner.go Update): the server shipped a
+        new job version / deployment for a running alloc without
+        restarting its tasks.  Swap the alloc (service hook and taskenv
+        read it live) and, when the deployment changed, reset health and
+        re-arm the watcher so the new deployment's health is proven
+        fresh."""
+        old_dep = self.alloc.deployment_id
+        if alloc.job is None:
+            alloc.job = self.alloc.job
+        self.alloc = alloc
+        self.service_hook.alloc = alloc
+        if alloc.deployment_id and alloc.deployment_id != old_dep:
+            self.deployment_healthy = None
+            self._start_health_watcher()
+            self.on_update(self)
 
     # ------------------------------------------------------------ teardown
 

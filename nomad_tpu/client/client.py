@@ -282,6 +282,13 @@ class Client:
                                      AllocClientStatus.RUNNING):
             ar.alloc.desired_status = alloc.desired_status
             ar.stop()
+            return
+        # in-place update: new job version and/or deployment membership
+        # without a task restart (alloc_runner.go Update)
+        new_version = (alloc.job is not None and ar.alloc.job is not None
+                       and alloc.job.version != ar.alloc.job.version)
+        if new_version or alloc.deployment_id != ar.alloc.deployment_id:
+            ar.update(alloc)
         ar.alloc.desired_transition = alloc.desired_transition
 
     def _maybe_gc(self) -> None:

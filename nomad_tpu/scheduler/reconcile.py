@@ -434,10 +434,12 @@ class AllocReconciler:
             else:
                 destructive.append(a)
 
+        inplace_copies = []
         for a in inplace:
             u = a.copy()
             u.job = self.job
             res.inplace_update.append(u)
+            inplace_copies.append(u)
             upd["in_place_update"] += 1
         current_version += inplace
 
@@ -561,6 +563,18 @@ class AllocReconciler:
         if is_service and tg.update is not None:
             self._ensure_deployment_state(tg, destructive, want_canaries,
                                           count, had_current)
+            # in-place updates join the new deployment and re-prove
+            # health (reference allocUpdateFnInplace sets DeploymentID;
+            # the client's health tracker re-arms on the change) —
+            # without this the watcher counts them as never-healthy and
+            # fails a healthy rollout at the progress deadline
+            d = res.deployment or self.deployment
+            if (d is not None and d.job_version == self.job.version
+                    and tg.name in d.task_groups):
+                for u in inplace_copies:
+                    if u.deployment_id != d.id:
+                        u.deployment_id = d.id
+                        u.deployment_status = None
 
         # group is deployment-complete when nothing is pending
         complete = not destructive and not want_canaries and missing <= 0 \

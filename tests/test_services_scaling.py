@@ -228,3 +228,44 @@ def test_multi_region_federation():
     finally:
         a.stop()
         b.stop()
+
+
+def test_inplace_update_joins_new_deployment():
+    """An in-place-only job update (group meta change) creates a new
+    deployment; the running allocs join it without a restart, re-prove
+    health, and the deployment promotes (reference allocUpdateFnInplace
+    sets DeploymentID on the updated alloc)."""
+    s, c = _service_world()
+    try:
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 1
+        tg.tasks[0].driver = "mock_driver"
+        tg.tasks[0].config = {"run_for": 120.0}
+        tg.update = j.update
+        j.update.min_healthy_time_s = 0.1
+        s.register_job(j)
+
+        def dep_ok(version):
+            ds = [d for d in s.store.deployments()
+                  if d.job_id == j.id and d.job_version == version]
+            return any(d.status == "successful" for d in ds)
+        assert _wait(lambda: dep_ok(0))
+        first = {a.id for a in s.store.allocs_by_job("default", j.id)
+                 if not a.terminal_status()}
+        assert first
+
+        # in-place change: group meta only (tasks_updated == False)
+        j2 = j.copy()
+        j2.task_groups[0].meta = {"rev": "2"}
+        j2.task_groups[0].update = j2.update
+        s.register_job(j2)
+        assert _wait(lambda: dep_ok(1), timeout=45)
+        live = [a for a in s.store.allocs_by_job("default", j.id)
+                if not a.terminal_status()]
+        assert {a.id for a in live} == first, "in-place update restarted allocs"
+        d1 = next(d for d in s.store.deployments()
+                  if d.job_id == j.id and d.job_version == 1)
+        assert all(a.deployment_id == d1.id for a in live)
+    finally:
+        s.stop()
