@@ -27,6 +27,19 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def _log_plan_submit(scenario: str) -> dict:
+    """Per-scenario p99 plan-submit latency (the BASELINE.json metric is
+    evals/sec + p99 plan-submit; reference metric nomad.nomad.plan.submit).
+    Resets the series so scenarios don't pollute each other."""
+    from nomad_tpu.telemetry import global_metrics
+    s = global_metrics.take_sample("nomad.plan.submit")
+    ev = global_metrics.take_sample("nomad.plan.evaluate")
+    log(f"{scenario}: plan.submit p99 {s['p99']:.1f} ms "
+        f"(mean {s['mean']:.1f} ms, n={s['count']}); "
+        f"plan.evaluate p99 {ev['p99']:.1f} ms")
+    return s
+
+
 def _wait_allocs(store, jobs, want, timeout=300.0):
     t0 = time.time()
     while time.time() - t0 < timeout:
@@ -78,6 +91,7 @@ def bench_e2e_spine(n_nodes=1000, n_jobs=50, count=100, workers=48):
     log(f"e2e spine: placed {placed} allocs in {dt:.2f}s "
         f"({placed/dt:.0f} allocs/s, {n_jobs/dt:.1f} evals/s, "
         f"{workers} workers)")
+    _log_plan_submit("e2e_spine")
     assert placed == n_jobs * count, placed
     return placed / dt
 
@@ -188,6 +202,7 @@ def bench_dev_agent_sim():
         lat.sort()
         log(f"dev-agent sim: p50 register->placed latency "
             f"{lat[len(lat)//2]*1000:.0f} ms (6 allocs, 3 tgs, 5 nodes)")
+        _log_plan_submit("dev_agent")
         return lat[len(lat)//2]
     finally:
         s.stop()
@@ -220,6 +235,7 @@ def bench_c2m(n_nodes=10000, n_batch=96, batch_count=1000,
         dt = time.time() - t0
         log(f"C2M spine: {placed}/{want} allocs in {dt:.1f}s "
             f"({placed/dt:.0f} allocs/s)")
+        _log_plan_submit("c2m")
         return placed / dt
     finally:
         s.stop()
@@ -280,6 +296,7 @@ def bench_c2m_1m(n_nodes=10000, n_jobs=10000, groups_per_job=10,
         log(f"C2M-1M spine: {placed}/{want} allocs in {dt:.1f}s "
             f"({placed/dt:.0f} allocs/s on one chip; "
             f"{n_jobs * groups_per_job} task groups)")
+        _log_plan_submit("c2m_1m")
         return placed / dt
     finally:
         s.stop()
@@ -319,6 +336,7 @@ def bench_device_constrained(n_nodes=10000):
         dt = time.time() - t0
         log(f"device-constrained: {placed}/{want} GPU allocs in {dt:.1f}s "
             f"({placed/dt:.0f} allocs/s)")
+        _log_plan_submit("device")
         return placed / dt
     finally:
         s.stop()
@@ -356,6 +374,7 @@ def bench_preemption_heavy(n_nodes=10000, workers=48):
             if a.desired_status == "evict")
         log(f"preemption-heavy: {placed}/{want} high-prio allocs in "
             f"{dt:.1f}s ({placed/dt:.0f} allocs/s, {preempted} preempted)")
+        _log_plan_submit("preemption")
         return placed / dt
     finally:
         s.stop()

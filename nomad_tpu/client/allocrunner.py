@@ -259,7 +259,7 @@ class AllocRunner:
                 now = time.time()
                 states = [tr.state for tr in self.task_runners.values()]
                 if any(s.failed for s in states):
-                    self._set_health(False)
+                    self._set_health(False, gen)
                     return
                 mains_running = states and all(
                     s.state == "running" or (s.state == "dead"
@@ -272,20 +272,25 @@ class AllocRunner:
                     if healthy_since is None:
                         healthy_since = now
                     elif now - healthy_since >= min_healthy:
-                        self._set_health(True)
+                        self._set_health(True, gen)
                         return
                 else:
                     healthy_since = None
                 if now - start > deadline:
-                    self._set_health(False)
+                    self._set_health(False, gen)
                     return
                 time.sleep(0.05)
 
         self._health_thread = threading.Thread(target=watch, daemon=True)
         self._health_thread.start()
 
-    def _set_health(self, healthy: bool) -> None:
-        self.deployment_healthy = healthy
+    def _set_health(self, healthy: bool, gen: Optional[int] = None) -> None:
+        with self._lock:
+            # a watcher superseded by update() must not attribute its
+            # verdict to the NEW deployment
+            if gen is not None and gen != self._health_gen:
+                return
+            self.deployment_healthy = healthy
         self.on_update(self)
 
     def update(self, alloc) -> None:

@@ -288,7 +288,10 @@ class Client:
         new_version = (alloc.job is not None and ar.alloc.job is not None
                        and alloc.job.version != ar.alloc.job.version)
         if new_version or alloc.deployment_id != ar.alloc.deployment_id:
-            ar.update(alloc)
+            # copy before the runner aliases/mutates it: with in-process
+            # RPC the server hands us live store objects (_start_alloc
+            # copies for the same reason)
+            ar.update(alloc.copy() if hasattr(alloc, "copy") else alloc)
         ar.alloc.desired_transition = alloc.desired_transition
 
     def _maybe_gc(self) -> None:

@@ -1,0 +1,158 @@
+"""Artifact getter (reference client/allocrunner/taskrunner/getter/
+getter.go — go-getter behind a sandboxed API: source URL + options
+(checksum), client modes any/file/dir, destination relative to the task
+dir, archive auto-extraction).
+
+This environment has no network egress, so the wire schemes are
+`file://` URLs, bare local paths, and plain `http(s)://` for
+link-local/test servers (urllib, short timeout).  Everything else the
+reference getter does — env interpolation of source/destination,
+checksum verification before install, tar/zip unpacking in "any" mode,
+and refusing destinations that escape the task sandbox (the reference's
+helper/escapingfs guard) — is kept.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import tarfile
+import urllib.parse
+import urllib.request
+import zipfile
+from typing import Dict, Optional
+
+from nomad_tpu.client.taskenv import interpolate
+
+
+class ArtifactError(Exception):
+    """Fetch/verify failure; recoverable (the task restarts per policy),
+    matching the reference's GetError.Recoverable()."""
+
+
+_ARCHIVE_EXTS = (".tar.gz", ".tgz", ".tar.bz2", ".tar", ".zip")
+
+
+def _inside(root: str, path: str) -> str:
+    """Resolve `path` and require it stays under `root` (escapingfs)."""
+    real = os.path.realpath(path)
+    if not (real + os.sep).startswith(os.path.realpath(root) + os.sep):
+        raise ArtifactError(f"destination escapes task dir: {path}")
+    return real
+
+
+def _verify_checksum(path: str, spec: str) -> None:
+    """spec: '<algo>:<hexdigest>' (md5/sha1/sha256/sha512), the
+    go-getter checksum option format."""
+    try:
+        algo, want = spec.split(":", 1)
+        h = hashlib.new(algo)
+    except Exception as e:                          # noqa: BLE001
+        raise ArtifactError(f"bad checksum spec {spec!r}: {e}") from e
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    got = h.hexdigest()
+    if got.lower() != want.strip().lower():
+        raise ArtifactError(
+            f"checksum mismatch for {os.path.basename(path)}: "
+            f"got {algo}:{got}, want {spec}")
+
+
+def _fetch_to(src: str, dst_file: str) -> None:
+    parsed = urllib.parse.urlparse(src)
+    if parsed.scheme in ("http", "https"):
+        try:
+            with urllib.request.urlopen(src, timeout=30) as resp, \
+                    open(dst_file, "wb") as out:
+                shutil.copyfileobj(resp, out)
+        except Exception as e:                      # noqa: BLE001
+            raise ArtifactError(f"fetch {src}: {e}") from e
+        return
+    if parsed.scheme == "file":
+        src = parsed.path
+    if not os.path.exists(src):
+        raise ArtifactError(f"artifact source not found: {src}")
+    if os.path.isdir(src):
+        raise ArtifactError(f"source is a directory (use mode=dir): {src}")
+    shutil.copy(src, dst_file)       # copy, not copyfile: keep exec bits
+
+
+def _source_path(src: str) -> Optional[str]:
+    """Local filesystem path for file:// / bare-path sources, else None."""
+    parsed = urllib.parse.urlparse(src)
+    if parsed.scheme == "file":
+        return parsed.path
+    if parsed.scheme in ("http", "https"):
+        return None
+    return src
+
+
+def _extract(archive: str, dest_dir: str) -> None:
+    try:
+        if archive.endswith(".zip"):
+            with zipfile.ZipFile(archive) as z:
+                for m in z.namelist():
+                    _inside(dest_dir, os.path.join(dest_dir, m))
+                z.extractall(dest_dir)
+        else:
+            with tarfile.open(archive) as t:
+                for m in t.getmembers():
+                    _inside(dest_dir, os.path.join(dest_dir, m.name))
+                t.extractall(dest_dir, filter="data")
+    except ArtifactError:
+        raise
+    except Exception as e:                          # noqa: BLE001
+        raise ArtifactError(f"extract {archive}: {e}") from e
+
+
+def fetch_artifact(artifact: dict, task_dir: str,
+                   env: Optional[Dict[str, str]] = None,
+                   node=None, meta: Optional[Dict[str, str]] = None) -> str:
+    """Fetch one artifact into the task dir; returns the install path.
+
+    artifact keys (jobspec `artifact` block): source, destination
+    (default "local/"), mode ("any"|"file"|"dir"), options{checksum}.
+    source/destination take the full taskenv interpolation set
+    (${env.X}/${meta.X}/${attr.X}/${NOMAD_*}), same as templates.
+    """
+    env = env or {}
+    source = interpolate(str(artifact.get("source", "")), env, node, meta)
+    if not source:
+        raise ArtifactError("artifact has no source")
+    dest_rel = interpolate(str(artifact.get("destination", "local/")),
+                           env, node, meta)
+    mode = str(artifact.get("mode", "any") or "any")
+    options = artifact.get("options") or {}
+    checksum = options.get("checksum", "")
+
+    dest = _inside(task_dir, os.path.join(task_dir, dest_rel))
+    local_src = _source_path(source)
+
+    if mode == "dir" or (mode == "any" and local_src
+                         and os.path.isdir(local_src)):
+        if not local_src or not os.path.isdir(local_src):
+            raise ArtifactError(f"mode=dir needs a local dir: {source}")
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        shutil.copytree(local_src, dest, dirs_exist_ok=True)
+        return dest
+
+    base = os.path.basename(
+        urllib.parse.urlparse(source).path or source) or "artifact"
+    # "file" mode: destination IS the file path (go-getter ClientModeFile)
+    if mode == "file" and not dest_rel.endswith(("/", os.sep)):
+        target = dest
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+    else:
+        os.makedirs(dest, exist_ok=True)
+        target = _inside(task_dir, os.path.join(dest, base))
+
+    _fetch_to(source, target)
+    if checksum:
+        _verify_checksum(target, checksum)
+    if mode == "any" and target.endswith(_ARCHIVE_EXTS):
+        dest_dir = dest if os.path.isdir(dest) else os.path.dirname(dest)
+        _extract(target, dest_dir)
+        os.unlink(target)
+        return dest_dir
+    return target

@@ -3,8 +3,9 @@ task_runner_hooks.go:49-110 — the per-task lifecycle: hook pipeline,
 driver start, wait loop, restart tracking, state events pushed up).
 
 Hook pipeline here: validate -> taskdir -> dispatch_payload -> taskenv ->
-artifacts(no-op stub) -> templates (rendered with env interpolation) ->
-driver start.  Restart logic: client/allocrunner/taskrunner/restarts/.
+artifacts (client/getter.py) -> templates (rendered with env
+interpolation) -> driver start.  Restart logic:
+client/allocrunner/taskrunner/restarts/.
 """
 from __future__ import annotations
 
@@ -124,9 +125,32 @@ class TaskRunner:
             self._set_state("dead", failed=True)
 
     def _run(self) -> None:
-        # --- prestart hooks (task_runner_hooks.go:49)
+        # --- prestart hooks (task_runner_hooks.go:49).  Artifact fetch
+        # failures are recoverable (getter GetError.Recoverable): the
+        # restart policy applies instead of failing the task outright.
+        from nomad_tpu.client.getter import ArtifactError
         self._emit("Received", "Task received by client")
-        self._prestart()
+        while not self._kill.is_set():
+            try:
+                self._prestart()
+                break
+            except ArtifactError as e:
+                self._emit("Failed Artifact Download", str(e))
+                verdict, delay = self.restart_tracker.next(
+                    ExitResult(exit_code=-1, err=str(e)))
+                if verdict == "restart" and not self._kill.is_set():
+                    self.state.restarts += 1
+                    self._emit("Restarting",
+                               f"Task restarting in {delay:.1f}s")
+                    if self._kill.wait(delay):
+                        self._set_state("dead", failed=False)
+                        return
+                    continue
+                self._set_state("dead", failed=True)
+                return
+        else:
+            self._set_state("dead", failed=False)
+            return
         self._run_loop()
 
     def _prestart(self) -> None:
@@ -135,6 +159,7 @@ class TaskRunner:
         self.env = build_task_env(self.alloc, self.task, self.node,
                                   task_dir, self.ports,
                                   volumes=self.volumes)
+        self._artifact_hook(task_dir)
         self._template_hook(task_dir)
         self._task_dir = task_dir
 
@@ -284,6 +309,19 @@ class TaskRunner:
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         with open(dest, "wb") as fh:
             fh.write(job.payload)
+
+    def _artifact_hook(self, task_dir: str) -> None:
+        """Fetch declared artifacts into the task dir before templates
+        and driver start (taskrunner artifact_hook.go: emits Downloading
+        Artifacts, failure is recoverable -> restart policy applies)."""
+        if not self.task.artifacts:
+            return
+        from nomad_tpu.client.getter import fetch_artifact
+        self._emit("Downloading Artifacts",
+                   f"{len(self.task.artifacts)} artifact(s)")
+        for art in self.task.artifacts:
+            fetch_artifact(art, task_dir, self.env,
+                           node=self.node, meta=self.task.meta)
 
     def _template_hook(self, task_dir: str) -> None:
         """Render inline templates with env interpolation (the reference

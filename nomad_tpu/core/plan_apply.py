@@ -12,6 +12,7 @@ the state store in one indexed write.
 from __future__ import annotations
 
 import threading
+import time as _time
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -22,6 +23,7 @@ from nomad_tpu.state.store import AppliedPlanResults, StateStore
 from nomad_tpu.structs import Allocation, Node
 from nomad_tpu.structs.node import NodeStatus
 from nomad_tpu.structs.plan import Plan, PlanResult
+from nomad_tpu.telemetry import global_metrics
 
 
 class PlanApplier:
@@ -71,7 +73,9 @@ class PlanApplier:
             if pending is None:
                 continue
             try:
+                t0 = _time.time()
                 result = self._evaluate(pending.plan)
+                global_metrics.measure_since("nomad.plan.evaluate", t0)
                 if commit_t is not None and commit_t.is_alive() and \
                         self._result_rejected_something(pending.plan,
                                                         result):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import List, Optional
 
 from nomad_tpu.core.plan_queue import LeadershipLostError
@@ -20,6 +21,7 @@ from nomad_tpu.rpc.endpoints import RpcError
 from nomad_tpu.scheduler import factory
 from nomad_tpu.structs import Evaluation, EvalStatus
 from nomad_tpu.structs.plan import Plan, PlanResult
+from nomad_tpu.telemetry import global_metrics
 
 log = logging.getLogger(__name__)
 
@@ -102,7 +104,10 @@ class Worker:
         ev = ev.copy()
         try:
             sched = factory.new_scheduler(ev.type, snap, self)
+            t0 = time.time()
             sched.process(ev)
+            global_metrics.measure_since(
+                f"nomad.worker.invoke_scheduler.{ev.type}", t0)
         except TRANSIENT_ERRORS:
             raise
         except Exception as e:                      # noqa: BLE001
@@ -122,12 +127,15 @@ class Worker:
 
     def submit_plan(self, plan: Plan) -> PlanResult:
         plan.eval_token = getattr(self, "_token", "")
+        t0 = time.time()
         pending = self.server.plan_queue.enqueue(plan)
         # generous: under full-cluster bursts (the 1M-alloc C2M) the
         # serialized applier legitimately backs up for minutes; an eval
         # failed on a timed-out future gets retried from scratch even
         # though its plan still commits — pure wasted recompute
-        return pending.future.result(timeout=600.0)
+        res = pending.future.result(timeout=600.0)
+        global_metrics.measure_since("nomad.plan.submit", t0)
+        return res
 
     def create_evals(self, evals: List[Evaluation]) -> None:
         self.server.create_evals(evals)
@@ -179,7 +187,10 @@ class RemoteWorker(Worker):
 
     def submit_plan(self, plan: Plan) -> PlanResult:
         plan.eval_token = getattr(self, "_token", "")
-        return self._rpc("Plan.Submit", {"plan": plan})
+        t0 = time.time()
+        res = self._rpc("Plan.Submit", {"plan": plan})
+        global_metrics.measure_since("nomad.plan.submit", t0)
+        return res
 
     def reblock_eval(self, ev: Evaluation) -> None:
         self._rpc("Eval.Reblock", {"eval": ev})

@@ -570,6 +570,8 @@ class AllocReconciler:
             # fails a healthy rollout at the progress deadline
             d = res.deployment or self.deployment
             if (d is not None and d.job_version == self.job.version
+                    and not self.deployment_failed
+                    and not self.deployment_paused
                     and tg.name in d.task_groups):
                 for u in inplace_copies:
                     if u.deployment_id != d.id:

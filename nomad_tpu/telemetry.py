@@ -66,6 +66,13 @@ class MetricsRegistry:
     def measure_since(self, name: str, start: float) -> None:
         self.add_sample(name, (time.time() - start) * 1000.0)  # ms
 
+    def take_sample(self, name: str) -> dict:
+        """Summary of one timing series, then reset it — per-window
+        measurement (bench scenarios, tests)."""
+        with self._lock:
+            s = self._samples.pop(name, None)
+        return s.summary() if s is not None else _Sample().summary()
+
     class _Timer:
         __slots__ = ("reg", "name", "start")
 

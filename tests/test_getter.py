@@ -1,0 +1,145 @@
+"""Artifact getter (reference client/allocrunner/taskrunner/getter/
+getter_test.go: file fetch, checksum pass/fail, dir mode, archive
+extraction, sandbox escape rejection)."""
+import hashlib
+import os
+import tarfile
+
+import pytest
+
+from nomad_tpu.client.getter import ArtifactError, fetch_artifact
+
+
+@pytest.fixture()
+def world(tmp_path):
+    src = tmp_path / "src"
+    task = tmp_path / "task"
+    src.mkdir()
+    task.mkdir()
+    return src, task
+
+
+def test_fetch_local_file(world):
+    src, task = world
+    f = src / "payload.bin"
+    f.write_bytes(b"hello artifact")
+    out = fetch_artifact({"source": str(f)}, str(task))
+    assert out == str(task / "local" / "payload.bin")
+    assert open(out, "rb").read() == b"hello artifact"
+
+
+def test_fetch_file_url_and_env_interp(world):
+    src, task = world
+    f = src / "data.txt"
+    f.write_text("x")
+    art = {"source": "file://" + str(src) + "/${NOMAD_META_name}.txt",
+           "destination": "local/deps/"}
+    out = fetch_artifact(art, str(task), {"NOMAD_META_name": "data"})
+    assert out == str(task / "local" / "deps" / "data.txt")
+
+
+def test_checksum_pass_and_fail(world):
+    src, task = world
+    f = src / "blob"
+    f.write_bytes(b"abc123")
+    digest = hashlib.sha256(b"abc123").hexdigest()
+    ok = fetch_artifact(
+        {"source": str(f), "options": {"checksum": f"sha256:{digest}"}},
+        str(task))
+    assert os.path.exists(ok)
+    with pytest.raises(ArtifactError, match="checksum mismatch"):
+        fetch_artifact(
+            {"source": str(f), "destination": "local/two/",
+             "options": {"checksum": "sha256:" + "0" * 64}},
+            str(task))
+
+
+def test_dir_mode(world):
+    src, task = world
+    (src / "tree").mkdir()
+    (src / "tree" / "a.txt").write_text("a")
+    (src / "tree" / "sub").mkdir()
+    (src / "tree" / "sub" / "b.txt").write_text("b")
+    out = fetch_artifact(
+        {"source": str(src / "tree"), "mode": "dir",
+         "destination": "local/tree"}, str(task))
+    assert open(os.path.join(out, "sub", "b.txt")).read() == "b"
+
+
+def test_archive_auto_extract(world):
+    src, task = world
+    (src / "inner.txt").write_text("inside")
+    tar = src / "bundle.tar.gz"
+    with tarfile.open(tar, "w:gz") as t:
+        t.add(src / "inner.txt", arcname="inner.txt")
+    out = fetch_artifact({"source": str(tar)}, str(task))
+    assert open(os.path.join(out, "inner.txt")).read() == "inside"
+    assert not os.path.exists(os.path.join(out, "bundle.tar.gz"))
+
+
+def test_file_mode_renames(world):
+    src, task = world
+    (src / "tool").write_text("#!/bin/sh\n")
+    out = fetch_artifact(
+        {"source": str(src / "tool"), "mode": "file",
+         "destination": "local/bin/mytool"}, str(task))
+    assert out == str(task / "local" / "bin" / "mytool")
+
+
+def test_sandbox_escape_rejected(world):
+    src, task = world
+    (src / "f").write_text("x")
+    with pytest.raises(ArtifactError, match="escapes"):
+        fetch_artifact({"source": str(src / "f"),
+                        "destination": "../../outside/"}, str(task))
+
+
+def test_missing_source(world):
+    _, task = world
+    with pytest.raises(ArtifactError, match="not found"):
+        fetch_artifact({"source": "/nope/missing.bin"}, str(task))
+
+
+def test_task_consumes_artifact_e2e(tmp_path):
+    """A raw_exec task fetches an artifact and reads it (artifact hook
+    wired into the taskrunner prestart pipeline)."""
+    import time
+
+    from nomad_tpu.client.client import Client, ClientConfig
+    from nomad_tpu.core.server import Server, ServerConfig
+    from nomad_tpu.structs.job import Job, Task, TaskGroup
+
+    art_src = tmp_path / "artifact.txt"
+    art_src.write_text("artifact-content")
+    proof = tmp_path / "proof.txt"
+
+    s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=3600.0,
+                            gc_interval=3600.0))
+    s.start()
+    c = Client(ClientConfig(node_name="getter-client",
+                            data_dir=str(tmp_path / "client"),
+                            drivers=["raw_exec"]),
+               rpc=s.rpc_leader)
+    c.start()
+    try:
+        t = Task(name="t", driver="raw_exec",
+                 config={"command": "/bin/sh",
+                         "args": ["-c",
+                                  "cp ${NOMAD_TASK_DIR}/artifact.txt "
+                                  + str(proof)]})
+        t.artifacts = [{"source": str(art_src), "destination": "local/"}]
+        job = Job(id=f"art-{time.time_ns()}", name="art", type="batch",
+                  task_groups=[TaskGroup(name="g", count=1, tasks=[t])])
+        job.canonicalize()
+        s.register_job(job)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            allocs = s.store.allocs_by_job("default", job.id)
+            if any(a.client_status == "complete" for a in allocs):
+                break
+            time.sleep(0.1)
+        assert proof.read_text() == "artifact-content", \
+            [(a.client_status, a.task_states)
+             for a in s.store.allocs_by_job("default", job.id)]
+    finally:
+        s.stop()
