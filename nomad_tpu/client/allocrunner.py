@@ -40,6 +40,7 @@ class AllocRunner:
                                 plugins=csi_plugins)
         from nomad_tpu.client.services import ServiceHook
         self.service_hook = ServiceHook(alloc, node, rpc)
+        self.rpc = rpc
 
     def task_group(self):
         job = self.alloc.job
@@ -79,7 +80,7 @@ class AllocRunner:
                     self.alloc, task, self.registry.get(task.driver),
                     self.alloc_dir, node=self.node,
                     on_state=self._on_task_state, state_db=self.state_db,
-                    ports=ports, volumes=csi_mounts)
+                    ports=ports, volumes=csi_mounts, rpc=self.rpc)
                 self.task_runners[task.name] = tr
 
             self._start_health_watcher()

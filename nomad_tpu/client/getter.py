@@ -133,6 +133,10 @@ def fetch_artifact(artifact: dict, task_dir: str,
                          and os.path.isdir(local_src)):
         if not local_src or not os.path.isdir(local_src):
             raise ArtifactError(f"mode=dir needs a local dir: {source}")
+        if checksum:
+            # silently skipping verification would be worse than failing
+            raise ArtifactError(
+                "checksum is not supported for directory artifacts")
         os.makedirs(os.path.dirname(dest), exist_ok=True)
         shutil.copytree(local_src, dest, dirs_exist_ok=True)
         return dest

@@ -10,6 +10,7 @@ client/allocrunner/taskrunner/restarts/.
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -59,7 +60,7 @@ class TaskRunner:
     def __init__(self, alloc, task, driver: Driver, alloc_dir,
                  node=None, on_state: Optional[Callable] = None,
                  state_db=None, ports: Optional[Dict[str, int]] = None,
-                 volumes: Optional[Dict[str, str]] = None):
+                 volumes: Optional[Dict[str, str]] = None, rpc=None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -69,6 +70,7 @@ class TaskRunner:
         self.state_db = state_db
         self.ports = ports or {}
         self.volumes = volumes or {}    # CSI alias -> host mount path
+        self.rpc = rpc                  # client->server (vault/templates)
         self.state = TaskState()
         self.handle: Optional[TaskHandle] = None
         self.restart_tracker = RestartTracker(
@@ -76,6 +78,13 @@ class TaskRunner:
         self._kill = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.env: Dict[str, str] = {}
+        self.vault_token: str = ""
+        self._vault_thread: Optional[threading.Thread] = None
+        self._tmpl_thread: Optional[threading.Thread] = None
+        # set by the vault/template watchers: restart WITHOUT counting
+        # against the restart policy (reference template/vault change_mode
+        # restarts are not policy failures)
+        self._restart_requested = threading.Event()
 
     def _restart_policy(self) -> RestartPolicy:
         job = self.alloc.job
@@ -130,7 +139,10 @@ class TaskRunner:
         # restart policy applies instead of failing the task outright.
         from nomad_tpu.client.getter import ArtifactError
         self._emit("Received", "Task received by client")
-        while not self._kill.is_set():
+        while True:
+            if self._kill.is_set():
+                self._set_state("dead", failed=False)
+                return
             try:
                 self._prestart()
                 break
@@ -138,19 +150,19 @@ class TaskRunner:
                 self._emit("Failed Artifact Download", str(e))
                 verdict, delay = self.restart_tracker.next(
                     ExitResult(exit_code=-1, err=str(e)))
-                if verdict == "restart" and not self._kill.is_set():
-                    self.state.restarts += 1
-                    self._emit("Restarting",
-                               f"Task restarting in {delay:.1f}s")
-                    if self._kill.wait(delay):
-                        self._set_state("dead", failed=False)
-                        return
-                    continue
-                self._set_state("dead", failed=True)
-                return
-        else:
-            self._set_state("dead", failed=False)
-            return
+                if self._kill.is_set():
+                    # a deliberate stop mid-retry is not a failure
+                    self._set_state("dead", failed=False)
+                    return
+                if verdict != "restart":
+                    self._set_state("dead", failed=True)
+                    return
+                self.state.restarts += 1
+                self._emit("Restarting",
+                           f"Task restarting in {delay:.1f}s")
+                if self._kill.wait(delay):
+                    self._set_state("dead", failed=False)
+                    return
         self._run_loop()
 
     def _prestart(self) -> None:
@@ -159,6 +171,7 @@ class TaskRunner:
         self.env = build_task_env(self.alloc, self.task, self.node,
                                   task_dir, self.ports,
                                   volumes=self.volumes)
+        self._vault_hook(task_dir)
         self._artifact_hook(task_dir)
         self._template_hook(task_dir)
         self._task_dir = task_dir
@@ -194,6 +207,14 @@ class TaskRunner:
             if self._kill.is_set():
                 self._emit("Killed", "Task killed by client")
                 break
+            if self._restart_requested.is_set():
+                # vault/template change_mode restart: not a failure, does
+                # not count against the restart policy
+                self._restart_requested.clear()
+                self.state.restarts += 1
+                self._emit("Restarting",
+                           "Template with change_mode restart re-rendered")
+                continue
             if result.successful():
                 self._emit("Terminated", "Exit Code: 0")
                 # batch/sysbatch tasks complete on success; service/system
@@ -323,15 +344,166 @@ class TaskRunner:
             fetch_artifact(art, task_dir, self.env,
                            node=self.node, meta=self.task.meta)
 
-    def _template_hook(self, task_dir: str) -> None:
-        """Render inline templates with env interpolation (the reference
-        uses consul-template; env/meta refs are the subset covered)."""
+    # ------------------------------------------------------ vault/templates
+
+    def _vault_hook(self, task_dir: str) -> None:
+        """Derive a per-task secrets token and keep it renewed
+        (reference taskrunner/vault_hook.go: token to secrets/
+        vault_token + VAULT_TOKEN env; renewal at half-TTL; on renewal
+        failure re-derive and apply the vault change_mode)."""
+        if not self.task.vault or self.rpc is None:
+            return
+        grant = self.rpc("Secrets.Derive",
+                         {"alloc_id": self.alloc.id,
+                          "task": self.task.name})
+        self._install_token(task_dir, grant)
+        if self._vault_thread is None or not self._vault_thread.is_alive():
+            self._vault_thread = threading.Thread(
+                target=self._vault_renew_loop,
+                args=(task_dir, float(grant.get("ttl_s", 3600.0))),
+                daemon=True, name=f"vault-{self.task.name}")
+            self._vault_thread.start()
+
+    def _install_token(self, task_dir: str, grant: dict) -> None:
+        self.vault_token = grant["token"]
+        self.env["VAULT_TOKEN"] = self.vault_token
+        path = os.path.join(task_dir, "secrets", "vault_token")
+        with open(path, "w") as fh:
+            fh.write(self.vault_token)
+        os.chmod(path, 0o600)
+
+    def _vault_renew_loop(self, task_dir: str, ttl_s: float) -> None:
+        interval = max(min(ttl_s / 2.0, 60.0), 0.05)
+        misses = 0
+        while not self._kill.wait(interval):
+            if self.state.state == "dead":
+                return                               # task is gone
+            try:
+                self.rpc("Secrets.Renew", {"token": self.vault_token})
+                misses = 0
+                continue
+            except Exception:                        # noqa: BLE001
+                # one blip (leader election, transient RPC) is not a
+                # lost lease — the reference retries before re-deriving
+                misses += 1
+                if misses < 3:
+                    continue
+            # lease lost: re-derive, reinstall, re-render dependent
+            # templates, then apply change_mode (default restart)
+            try:
+                grant = self.rpc("Secrets.Derive",
+                                 {"alloc_id": self.alloc.id,
+                                  "task": self.task.name})
+            except Exception:                        # noqa: BLE001
+                continue                             # server will retry us
+            misses = 0
+            try:
+                self._install_token(task_dir, grant)
+                self._render_templates(task_dir)
+                self._apply_change_mode(
+                    self.task.vault.get("change_mode", "restart"),
+                    self.task.vault.get("change_signal", "SIGHUP"),
+                    "Vault token re-derived")
+            except Exception as e:                   # noqa: BLE001
+                self._emit("Vault Re-derive Failed", str(e))
+
+    def _apply_change_mode(self, mode: str, sig: str, why: str) -> None:
+        if mode == "noop" or self.handle is None:
+            return
+        if mode == "signal":
+            import signal as _signal
+            signum = getattr(_signal, sig, _signal.SIGHUP)
+            fn = getattr(self.driver, "signal_task", None)
+            if fn is not None:
+                self._emit("Signaling", f"{why}: {sig}")
+                try:
+                    fn(self.handle, int(signum))
+                    return
+                except Exception:                    # noqa: BLE001
+                    pass                             # fall through: restart
+        self._restart_requested.set()
+        self.driver.stop_task(self.handle, self.task.kill_timeout_s)
+
+    _SECRET_RE = re.compile(
+        r'\{\{\s*(?:with\s+)?secret\s+"([^"]+)"\s+"([^"]+)"\s*\}\}')
+
+    def _render_one(self, tmpl: dict, task_dir: str) -> Dict[str, int]:
+        """Render a template; returns {secret_path: version} it read."""
+        data = tmpl.get("data", "")
+        dest = tmpl.get("destination", "local/template.out")
+        versions: Dict[str, int] = {}
+
+        def sub(m: "re.Match") -> str:
+            path, field_ = m.group(1), m.group(2)
+            if self.rpc is None:
+                return ""
+            got = self.rpc("Secrets.Read",
+                           {"path": path, "token": self.vault_token})
+            versions[path] = got["version"]
+            return str(got["data"].get(field_, ""))
+
+        rendered = self._SECRET_RE.sub(sub, data)
+        rendered = interpolate(rendered, self.env, self.node,
+                               self.task.meta)
+        out = os.path.join(task_dir, dest)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as fh:
+            fh.write(rendered)
+        return versions
+
+    def _render_templates(self, task_dir: str) -> Dict[str, int]:
+        versions: Dict[str, int] = {}
         for tmpl in self.task.templates or []:
-            data = tmpl.get("data", "")
-            dest = tmpl.get("destination", "local/template.out")
-            rendered = interpolate(data, self.env, self.node,
-                                   self.task.meta)
-            path = os.path.join(task_dir, dest)
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(path, "w") as fh:
-                fh.write(rendered)
+            versions.update(self._render_one(tmpl, task_dir))
+        return versions
+
+    def _template_hook(self, task_dir: str) -> None:
+        """Render inline templates (reference taskrunner/template/
+        template.go via consul-template): env/meta/attr interpolation
+        plus `{{ secret "path" "field" }}` reads through the task's
+        vault token.  Templates that read secrets are watched — a
+        version bump re-renders and applies the template change_mode
+        (restart | signal | noop, reference TemplateChangeMode*)."""
+        watched: Dict[int, Dict[str, int]] = {}
+        for i, tmpl in enumerate(self.task.templates or []):
+            versions = self._render_one(tmpl, task_dir)
+            if versions:
+                watched[i] = versions
+        if watched and self.rpc is not None and (
+                self._tmpl_thread is None
+                or not self._tmpl_thread.is_alive()):
+            self._tmpl_thread = threading.Thread(
+                target=self._template_watch_loop, args=(task_dir, watched),
+                daemon=True, name=f"tmpl-{self.task.name}")
+            self._tmpl_thread.start()
+
+    def _template_watch_loop(self, task_dir: str,
+                             watched: Dict[int, Dict[str, int]]) -> None:
+        poll = float(os.environ.get("NOMAD_TPU_TEMPLATE_POLL_S", "0.5"))
+        while not self._kill.wait(poll):
+            if self.state.state == "dead":
+                return                               # task is gone
+            for i, versions in watched.items():
+                tmpl = (self.task.templates or [])[i]
+                changed = False
+                for path, ver in versions.items():
+                    try:
+                        got = self.rpc("Secrets.Version",
+                                       {"path": path,
+                                        "token": self.vault_token})
+                    except Exception:                # noqa: BLE001
+                        continue                     # token mid-rotation
+                    if got["version"] != ver:
+                        changed = True
+                if not changed:
+                    continue
+                try:
+                    watched[i] = self._render_one(tmpl, task_dir)
+                except Exception:                    # noqa: BLE001
+                    continue
+                self._emit("Template Re-rendered",
+                           tmpl.get("destination", ""))
+                self._apply_change_mode(
+                    tmpl.get("change_mode", "restart"),
+                    tmpl.get("change_signal", "SIGHUP"),
+                    "Template re-rendered")

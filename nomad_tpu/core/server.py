@@ -31,6 +31,7 @@ from nomad_tpu.core.heartbeat import HeartbeatTracker
 from nomad_tpu.core.periodic import PeriodicDispatcher
 from nomad_tpu.core.plan_apply import PlanApplier
 from nomad_tpu.core.plan_queue import PlanQueue
+from nomad_tpu.core.secrets import SecretsProvider
 from nomad_tpu.core.worker import Worker
 from nomad_tpu.raft import (
     FileSnapshotStore,
@@ -99,6 +100,9 @@ class Server:
         self.deployment_watcher = DeploymentWatcher(self)
         from nomad_tpu.core.volumes import VolumeWatcher
         self.volume_watcher = VolumeWatcher(self)
+        # Vault-shaped secrets (core/secrets.py): leases are leader-local
+        # like the reference's external-Vault client state, not raft state
+        self.secrets = SecretsProvider()
         self.drainer = NodeDrainer(self)
         self.periodic = PeriodicDispatcher(self)
         self.core_scheduler = CoreScheduler(self)

@@ -321,6 +321,10 @@ class Endpoints:
         evals = []
         seen_jobs = set()
         for u in updates:
+            # terminal allocs lose their secrets leases (vault.go
+            # RevokeTokens on alloc stop/GC)
+            if u.client_status in ("complete", "failed", "lost"):
+                self.server.secrets.revoke_for_alloc(u.id)
             if u.client_status != "failed":
                 continue
             stored = self.server.store.alloc_by_id(u.id)
@@ -632,6 +636,67 @@ class Endpoints:
     def rpc_Service__GetService(self, args):
         return self.server.store.services_by_name(
             args.get("namespace", "default"), args["service_name"])
+
+    # ------------------------------------------------------------- secrets
+
+    def _require_leader(self):
+        s = self.server
+        if s.raft is not None and not s.leader:
+            raise NotLeaderError(s.raft.leader_id)
+
+    def rpc_Secrets__Put(self, args):
+        """Admin write into the embedded KV (the stand-in for seeding
+        Vault; reference operators do this against Vault directly)."""
+        self._require_leader()
+        return {"version": self.server.secrets.put(
+            args["path"], dict(args.get("data") or {}))}
+
+    def rpc_Secrets__Derive(self, args):
+        """Per-task token derivation (reference nomad/vault.go
+        CreateToken via client_endpoint DeriveVaultToken): policies come
+        from the task's vault stanza in the server's own state, never
+        from the caller."""
+        self._require_leader()
+        alloc = self.server.store.alloc_by_id(args["alloc_id"])
+        if alloc is None or alloc.job is None:
+            raise RpcError("not_found", "alloc or its job")
+        if alloc.terminal_status() or alloc.client_terminal_status():
+            # revocation on stop must not be bypassed by a re-derive
+            raise RpcError("invalid", "alloc is terminal")
+        tg = alloc.job.lookup_task_group(alloc.task_group)
+        task = next((t for t in (tg.tasks if tg else [])
+                     if t.name == args["task"]), None)
+        if task is None or not task.vault:
+            raise RpcError("invalid", "task has no vault stanza")
+        policies = list(task.vault.get("policies") or [])
+        ttl = task.vault.get("ttl_s")
+        return self.server.secrets.derive_token(
+            alloc.id, task.name, policies,
+            float(ttl) if ttl else None)
+
+    def rpc_Secrets__Renew(self, args):
+        self._require_leader()
+        try:
+            return self.server.secrets.renew(args["token"])
+        except Exception as e:                       # noqa: BLE001
+            raise RpcError("invalid", str(e))
+
+    def rpc_Secrets__Read(self, args):
+        self._require_leader()
+        try:
+            data, version = self.server.secrets.read(
+                args["path"], args.get("token", ""))
+        except Exception as e:                       # noqa: BLE001
+            raise RpcError("invalid", str(e))
+        return {"data": data, "version": version}
+
+    def rpc_Secrets__Version(self, args):
+        self._require_leader()
+        try:
+            return {"version": self.server.secrets.version(
+                args["path"], args.get("token", ""))}
+        except Exception as e:                       # noqa: BLE001
+            raise RpcError("invalid", str(e))
 
     # ------------------------------------------------------------- regions
 

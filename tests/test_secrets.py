@@ -1,0 +1,172 @@
+"""Vault-shaped secrets: provider leases/policies, per-task token
+derivation, template rendering of secrets, re-render on change
+(reference nomad/vault.go, taskrunner/vault_hook.go,
+taskrunner/template/template.go)."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu.core.secrets import SecretsError, SecretsProvider
+
+
+def test_provider_put_read_versions():
+    p = SecretsProvider()
+    assert p.put("db/creds", {"user": "u", "password": "p1"}) == 1
+    tok = p.derive_token("a1", "t", ["db"])["token"]
+    data, ver = p.read("db/creds", tok)
+    assert data == {"user": "u", "password": "p1"} and ver == 1
+    assert p.put("db/creds", {"user": "u", "password": "p2"}) == 2
+    assert p.version("db/creds", tok) == 2
+
+
+def test_provider_policy_prefix_enforced():
+    p = SecretsProvider()
+    p.put("db/creds", {"x": "1"})
+    p.put("other/creds", {"x": "2"})
+    tok = p.derive_token("a1", "t", ["db"])["token"]
+    assert p.read("db/creds", tok)[0] == {"x": "1"}
+    with pytest.raises(SecretsError, match="do not cover"):
+        p.read("other/creds", tok)
+
+
+def test_provider_renew_and_revoke():
+    p = SecretsProvider()
+    p.put("db/x", {"k": "v"})
+    grant = p.derive_token("a1", "t", ["db"], ttl_s=0.2)
+    tok = grant["token"]
+    assert p.renew(tok)["renewals"] == 1
+    time.sleep(0.25)
+    with pytest.raises(SecretsError, match="expired"):
+        p.renew(tok)
+    tok2 = p.derive_token("a1", "t", ["db"])["token"]
+    assert p.revoke_for_alloc("a1") >= 1
+    with pytest.raises(SecretsError):
+        p.read("db/x", tok2)
+
+
+def _world(tmp_path):
+    from nomad_tpu.client.client import Client, ClientConfig
+    from nomad_tpu.core.server import Server, ServerConfig
+    s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=3600.0,
+                            gc_interval=3600.0))
+    s.start()
+    c = Client(ClientConfig(node_name="secrets-client",
+                            data_dir=str(tmp_path / "client"),
+                            drivers=["mock", "mock_driver", "raw_exec"]),
+               rpc=s.rpc_leader)
+    c.start()
+    return s, c
+
+
+def _wait(cond, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_task_gets_token_and_rendered_secret(tmp_path, monkeypatch):
+    """End-to-end: vault stanza -> token in secrets/vault_token, a
+    template reads the secret, and a secret update re-renders it
+    (change_mode=noop so the file can be checked without a restart)."""
+    monkeypatch.setenv("NOMAD_TPU_TEMPLATE_POLL_S", "0.1")
+    from nomad_tpu.structs.job import Job, Task, TaskGroup
+    s, c = _world(tmp_path)
+    try:
+        s.endpoints.handle("Secrets.Put", {
+            "path": "db/creds", "data": {"password": "hunter2"}})
+        t = Task(name="t", driver="mock_driver",
+                 config={"run_for": 60.0})
+        t.vault = {"policies": ["db"]}
+        t.templates = [{
+            "data": 'PW={{ secret "db/creds" "password" }}',
+            "destination": "local/db.env",
+            "change_mode": "noop"}]
+        job = Job(id=f"vault-{time.time_ns()}", name="v", type="service",
+                  task_groups=[TaskGroup(name="g", count=1, tasks=[t])])
+        job.canonicalize()
+        s.register_job(job)
+        assert _wait(lambda: any(
+            a.client_status == "running"
+            for a in s.store.allocs_by_job("default", job.id)))
+
+        ar = next(iter(c.alloc_runners.values()))
+        task_dir = ar.alloc_dir.task_dir("t")
+        token_file = os.path.join(task_dir, "secrets", "vault_token")
+        assert _wait(lambda: os.path.exists(token_file))
+        token = open(token_file).read()
+        assert len(token) == 36
+        rendered = os.path.join(task_dir, "local", "db.env")
+        assert open(rendered).read() == "PW=hunter2"
+
+        # rotation: put a new version; the watcher re-renders
+        s.endpoints.handle("Secrets.Put", {
+            "path": "db/creds", "data": {"password": "correct-horse"}})
+        assert _wait(lambda: open(rendered).read() == "PW=correct-horse",
+                     10.0)
+    finally:
+        s.stop()
+
+
+def test_template_change_mode_restart(tmp_path, monkeypatch):
+    """A secret rotation restarts the task when change_mode=restart,
+    without counting against the restart policy."""
+    monkeypatch.setenv("NOMAD_TPU_TEMPLATE_POLL_S", "0.1")
+    from nomad_tpu.structs.job import Job, Task, TaskGroup
+    s, c = _world(tmp_path)
+    try:
+        s.endpoints.handle("Secrets.Put", {
+            "path": "app/cfg", "data": {"rev": "1"}})
+        t = Task(name="t", driver="mock_driver",
+                 config={"run_for": 60.0})
+        t.vault = {"policies": ["app"]}
+        t.templates = [{
+            "data": 'REV={{ secret "app/cfg" "rev" }}',
+            "destination": "local/app.cfg"}]     # default: restart
+        job = Job(id=f"vault-r-{time.time_ns()}", name="vr",
+                  type="service",
+                  task_groups=[TaskGroup(name="g", count=1, tasks=[t])])
+        job.canonicalize()
+        s.register_job(job)
+        assert _wait(lambda: any(
+            a.client_status == "running"
+            for a in s.store.allocs_by_job("default", job.id)))
+        ar = next(iter(c.alloc_runners.values()))
+        tr = ar.task_runners["t"]
+        assert tr.state.restarts == 0
+
+        s.endpoints.handle("Secrets.Put", {
+            "path": "app/cfg", "data": {"rev": "2"}})
+        assert _wait(lambda: tr.state.restarts >= 1, 15.0)
+        assert _wait(lambda: tr.state.state == "running", 15.0)
+        task_dir = ar.alloc_dir.task_dir("t")
+        assert open(os.path.join(task_dir, "local",
+                                 "app.cfg")).read() == "REV=2"
+        # the alloc stayed healthy: restart was not a policy failure
+        assert not tr.state.failed
+    finally:
+        s.stop()
+
+
+def test_derive_requires_vault_stanza(tmp_path):
+    from nomad_tpu.rpc.endpoints import RpcError
+    from nomad_tpu.structs.job import Job, Task, TaskGroup
+    s, c = _world(tmp_path)
+    try:
+        t = Task(name="t", driver="mock_driver", config={"run_for": 30.0})
+        job = Job(id=f"nv-{time.time_ns()}", name="nv", type="service",
+                  task_groups=[TaskGroup(name="g", count=1, tasks=[t])])
+        job.canonicalize()
+        s.register_job(job)
+        assert _wait(lambda: any(
+            a.client_status == "running"
+            for a in s.store.allocs_by_job("default", job.id)))
+        alloc = s.store.allocs_by_job("default", job.id)[0]
+        with pytest.raises(RpcError, match="no vault stanza"):
+            s.endpoints.handle("Secrets.Derive",
+                               {"alloc_id": alloc.id, "task": "t"})
+    finally:
+        s.stop()
