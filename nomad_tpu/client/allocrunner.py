@@ -357,7 +357,7 @@ class AllocRunner:
                 self.alloc, task, self.registry.get(task.driver),
                 self.alloc_dir, node=self.node,
                 on_state=self._on_task_state, state_db=self.state_db,
-                ports=ports)
+                ports=ports, rpc=self.rpc)
             self.task_runners[task.name] = tr
             if task.name in saved:
                 state, failed, restarts, handle = saved[task.name]

@@ -81,6 +81,10 @@ class TaskRunner:
         self.vault_token: str = ""
         self._vault_thread: Optional[threading.Thread] = None
         self._tmpl_thread: Optional[threading.Thread] = None
+        # template index -> {secret path: version} it rendered; shared by
+        # the prestart render, the renew-loop re-render, and the watcher
+        # so one rotation triggers exactly one change_mode application
+        self._tmpl_versions: Dict[int, Dict[str, int]] = {}
         # set by the vault/template watchers: restart WITHOUT counting
         # against the restart policy (reference template/vault change_mode
         # restarts are not policy failures)
@@ -138,6 +142,7 @@ class TaskRunner:
         # failures are recoverable (getter GetError.Recoverable): the
         # restart policy applies instead of failing the task outright.
         from nomad_tpu.client.getter import ArtifactError
+        from nomad_tpu.rpc.endpoints import RpcError
         self._emit("Received", "Task received by client")
         while True:
             if self._kill.is_set():
@@ -146,8 +151,13 @@ class TaskRunner:
             try:
                 self._prestart()
                 break
-            except ArtifactError as e:
-                self._emit("Failed Artifact Download", str(e))
+            # artifact fetch AND vault/template RPC failures (leader
+            # election, secret not yet written) are recoverable — the
+            # restart policy applies, the task is not failed outright
+            except (ArtifactError, RpcError) as e:
+                self._emit("Failed Artifact Download"
+                           if isinstance(e, ArtifactError)
+                           else "Prestart Hook Failed", str(e))
                 verdict, delay = self.restart_tracker.next(
                     ExitResult(exit_code=-1, err=str(e)))
                 if self._kill.is_set():
@@ -207,14 +217,17 @@ class TaskRunner:
             if self._kill.is_set():
                 self._emit("Killed", "Task killed by client")
                 break
-            if self._restart_requested.is_set():
+            if self._restart_requested.is_set() and result.signal != 0:
                 # vault/template change_mode restart: not a failure, does
-                # not count against the restart policy
+                # not count against the restart policy.  Gated on a
+                # signal exit (our stop_task) so a genuine crash racing
+                # the watcher still goes through the policy below.
                 self._restart_requested.clear()
                 self.state.restarts += 1
                 self._emit("Restarting",
                            "Template with change_mode restart re-rendered")
                 continue
+            self._restart_requested.clear()
             if result.successful():
                 self._emit("Terminated", "Exit Code: 0")
                 # batch/sysbatch tasks complete on success; service/system
@@ -279,6 +292,19 @@ class TaskRunner:
             return False
         self.handle = handle
         self._set_state("running")
+        # a recovered task never re-runs _prestart, so re-arm the vault
+        # renewal + template watcher here (best-effort: the task is
+        # already running with its old token/templates on disk)
+        try:
+            task_dir = self.alloc_dir.task_dir(self.task.name)
+            self.env = build_task_env(self.alloc, self.task, self.node,
+                                      task_dir, self.ports,
+                                      volumes=self.volumes)
+            self._vault_hook(task_dir)
+            self._template_hook(task_dir)
+            self._task_dir = task_dir
+        except Exception as e:                       # noqa: BLE001
+            self._emit("Hook Recovery Failed", str(e))
         self._thread = threading.Thread(
             target=self._wait_recovered, daemon=True,
             name=f"task-recovered-{self.task.name}")
@@ -451,11 +477,13 @@ class TaskRunner:
             fh.write(rendered)
         return versions
 
-    def _render_templates(self, task_dir: str) -> Dict[str, int]:
-        versions: Dict[str, int] = {}
-        for tmpl in self.task.templates or []:
-            versions.update(self._render_one(tmpl, task_dir))
-        return versions
+    def _render_templates(self, task_dir: str) -> None:
+        """(Re-)render every template, refreshing the shared version
+        map so the watcher doesn't double-fire on the same rotation."""
+        for i, tmpl in enumerate(self.task.templates or []):
+            versions = self._render_one(tmpl, task_dir)
+            if versions:
+                self._tmpl_versions[i] = versions
 
     def _template_hook(self, task_dir: str) -> None:
         """Render inline templates (reference taskrunner/template/
@@ -464,26 +492,22 @@ class TaskRunner:
         vault token.  Templates that read secrets are watched — a
         version bump re-renders and applies the template change_mode
         (restart | signal | noop, reference TemplateChangeMode*)."""
-        watched: Dict[int, Dict[str, int]] = {}
-        for i, tmpl in enumerate(self.task.templates or []):
-            versions = self._render_one(tmpl, task_dir)
-            if versions:
-                watched[i] = versions
-        if watched and self.rpc is not None and (
+        self._render_templates(task_dir)
+        if self._tmpl_versions and self.rpc is not None and (
                 self._tmpl_thread is None
                 or not self._tmpl_thread.is_alive()):
             self._tmpl_thread = threading.Thread(
-                target=self._template_watch_loop, args=(task_dir, watched),
+                target=self._template_watch_loop, args=(task_dir,),
                 daemon=True, name=f"tmpl-{self.task.name}")
             self._tmpl_thread.start()
 
-    def _template_watch_loop(self, task_dir: str,
-                             watched: Dict[int, Dict[str, int]]) -> None:
+    def _template_watch_loop(self, task_dir: str) -> None:
         poll = float(os.environ.get("NOMAD_TPU_TEMPLATE_POLL_S", "0.5"))
         while not self._kill.wait(poll):
             if self.state.state == "dead":
                 return                               # task is gone
-            for i, versions in watched.items():
+            for i in list(self._tmpl_versions):
+                versions = self._tmpl_versions[i]
                 tmpl = (self.task.templates or [])[i]
                 changed = False
                 for path, ver in versions.items():
@@ -498,7 +522,8 @@ class TaskRunner:
                 if not changed:
                     continue
                 try:
-                    watched[i] = self._render_one(tmpl, task_dir)
+                    self._tmpl_versions[i] = self._render_one(
+                        tmpl, task_dir)
                 except Exception:                    # noqa: BLE001
                     continue
                 self._emit("Template Re-rendered",

@@ -102,11 +102,27 @@ class Cli:
 
     # ------------------------------------------------------------- job
 
+    @staticmethod
+    def _job_vars(args) -> dict:
+        """-var NAME=VALUE + -var-file files (HCL `name = value` lines,
+        reference jobspec2 VarFiles/Vars)."""
+        out = {}
+        for path in getattr(args, "var_file", None) or []:
+            from nomad_tpu.jobspec.expr import evaluate
+            from nomad_tpu.jobspec.hcl import parse_hcl
+            root = parse_hcl(open(path).read())
+            evaluate(root)        # var files may use functions/locals
+            out.update(root.attrs)
+        for kv in getattr(args, "var", None) or []:
+            name, _, value = kv.partition("=")
+            out[name] = value
+        return out
+
     def cmd_job_run(self, args) -> int:
         from nomad_tpu.api.codec import from_wire
         from nomad_tpu.jobspec import parse_job_file
         from nomad_tpu.structs import Job
-        job = parse_job_file(args.file)
+        job = parse_job_file(args.file, self._job_vars(args))
         if args.check_index is not None:
             job.job_modify_index = args.check_index
         from nomad_tpu.api.codec import to_wire
@@ -188,7 +204,7 @@ class Cli:
 
     def cmd_job_plan(self, args) -> int:
         from nomad_tpu.jobspec import parse_job_file
-        job = parse_job_file(args.file)
+        job = parse_job_file(args.file, self._job_vars(args))
         resp = self.api.jobs.plan(job)
         ann = resp.get("annotations") or {}
         tg_updates = (ann.get("desired_tg_updates") or {})
@@ -250,7 +266,7 @@ class Cli:
     def cmd_job_validate(self, args) -> int:
         from nomad_tpu.jobspec import parse_job_file
         try:
-            job = parse_job_file(args.file)
+            job = parse_job_file(args.file, self._job_vars(args))
         except Exception as e:                      # noqa: BLE001
             self.p(f"Job validation errors: {e}")
             return 1
@@ -601,6 +617,10 @@ def build_parser() -> argparse.ArgumentParser:
         dest="sub", required=True)
     j = job.add_parser("run")
     j.add_argument("file")
+    j.add_argument("-var", action="append", dest="var",
+                   default=[], metavar="NAME=VALUE")
+    j.add_argument("-var-file", action="append",
+                   dest="var_file", default=[])
     j.add_argument("-detach", action="store_true")
     j.add_argument("-check-index", type=int, default=None,
                    dest="check_index")
@@ -615,12 +635,20 @@ def build_parser() -> argparse.ArgumentParser:
     j.set_defaults(fn="cmd_job_stop")
     j = job.add_parser("plan")
     j.add_argument("file")
+    j.add_argument("-var", action="append", dest="var",
+                   default=[], metavar="NAME=VALUE")
+    j.add_argument("-var-file", action="append",
+                   dest="var_file", default=[])
     j.set_defaults(fn="cmd_job_plan")
     j = job.add_parser("inspect")
     j.add_argument("job_id")
     j.set_defaults(fn="cmd_job_inspect")
     j = job.add_parser("validate")
     j.add_argument("file")
+    j.add_argument("-var", action="append", dest="var",
+                   default=[], metavar="NAME=VALUE")
+    j.add_argument("-var-file", action="append",
+                   dest="var_file", default=[])
     j.set_defaults(fn="cmd_job_validate")
     j = job.add_parser("dispatch")
     j.add_argument("job_id")

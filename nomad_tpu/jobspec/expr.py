@@ -1,0 +1,295 @@
+"""HCL2 expression evaluation: variables, locals, and a function set
+(reference: jobspec2/parse.go ParseWithConfig — variable blocks with
+type/default, -var/-var-file overrides, locals, and the cty stdlib
+function table in jobspec2/functions.go).
+
+The parser (jobspec/hcl.py) leaves `var.x` / `local.y` references and
+`fn(...)` calls as Ref/Call nodes and keeps `${...}` text inside
+strings; `evaluate()` resolves both across the whole tree before struct
+mapping.  Interpolation segments whose root the evaluator does not own
+(env., attr., node., meta., NOMAD_*, secret, ...) stay literal — they
+belong to the client's taskenv/template layer, same split as the
+reference (parse-time cty evaluation vs runtime taskenv.ReplaceEnv).
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import re
+from typing import Any, Dict, List, Optional
+
+from nomad_tpu.jobspec.hcl import Call, HclBlock, HclParseError, Ref
+
+# ------------------------------------------------------------- functions
+
+
+def _fmt(spec: str, *args: Any) -> str:
+    """Go-style format verbs reduced to the common set (%s %d %v %f)."""
+    out = []
+    i = 0
+    ai = 0
+    while i < len(spec):
+        c = spec[i]
+        if c != "%":
+            out.append(c)
+            i += 1
+            continue
+        if i + 1 < len(spec) and spec[i + 1] == "%":
+            out.append("%")
+            i += 2
+            continue
+        m = re.match(r"%[-0-9.]*[sdvfq]", spec[i:])
+        if m is None or ai >= len(args):
+            out.append(c)
+            i += 1
+            continue
+        verb = m.group(0)[-1]
+        a = args[ai]
+        ai += 1
+        if verb == "d":
+            out.append(str(int(a)))
+        elif verb == "f":
+            out.append(str(float(a)))
+        elif verb == "q":
+            out.append(json.dumps(str(a)))
+        else:
+            out.append(_to_str(a))
+        i += len(m.group(0))
+    return "".join(out)
+
+
+def _to_str(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    if isinstance(v, (list, dict)):
+        return json.dumps(v)
+    return str(v)
+
+
+FUNCTIONS: Dict[str, Any] = {
+    # strings
+    "format": _fmt,
+    "upper": lambda s: str(s).upper(),
+    "lower": lambda s: str(s).lower(),
+    "title": lambda s: str(s).title(),
+    "trimspace": lambda s: str(s).strip(),
+    "trimprefix": lambda s, p: str(s)[len(p):]
+    if str(s).startswith(p) else str(s),
+    "trimsuffix": lambda s, p: str(s)[:-len(p)]
+    if p and str(s).endswith(p) else str(s),
+    "replace": lambda s, a, b: str(s).replace(a, b),
+    "split": lambda sep, s: str(s).split(sep),
+    "join": lambda sep, xs: str(sep).join(_to_str(x) for x in xs),
+    "substr": lambda s, off, ln: str(s)[off:off + ln]
+    if ln >= 0 else str(s)[off:],
+    "indent": lambda n, s: ("\n" + " " * n).join(str(s).split("\n")),
+    "chomp": lambda s: re.sub(r"\n+$", "", str(s)),
+    # collections
+    "concat": lambda *ls: [x for l in ls for x in l],
+    "length": lambda x: len(x),
+    "contains": lambda xs, v: v in xs,
+    "element": lambda xs, i: xs[int(i) % len(xs)],
+    "index": lambda xs, v: list(xs).index(v),
+    "keys": lambda m: sorted(m.keys()),
+    "values": lambda m: [m[k] for k in sorted(m.keys())],
+    "lookup": lambda m, k, *d: m.get(k, d[0] if d else None),
+    "merge": lambda *ms: {k: v for m in ms for k, v in m.items()},
+    "flatten": lambda xs: [y for x in xs
+                           for y in (x if isinstance(x, list) else [x])],
+    "distinct": lambda xs: list(dict.fromkeys(xs)),
+    "compact": lambda xs: [x for x in xs if x not in ("", None)],
+    "reverse": lambda xs: list(reversed(xs)),
+    "sort": lambda xs: sorted(xs),
+    "range": lambda *a: list(range(*(int(x) for x in a))),
+    "coalesce": lambda *xs: next(
+        (x for x in xs if x not in (None, "")), None),
+    "coalescelist": lambda *ls: next((l for l in ls if l), []),
+    # numbers
+    "abs": lambda x: abs(x),
+    "ceil": lambda x: int(-(-x // 1)),
+    "floor": lambda x: int(x // 1),
+    "min": lambda *xs: min(xs),
+    "max": lambda *xs: max(xs),
+    "pow": lambda a, b: a ** b,
+    "parseint": lambda s, base=10: int(str(s), int(base)),
+    # encoding
+    "jsonencode": lambda v: json.dumps(v, separators=(",", ":")),
+    "jsondecode": lambda s: json.loads(s),
+    "base64encode": lambda s: base64.b64encode(
+        str(s).encode()).decode(),
+    "base64decode": lambda s: base64.b64decode(str(s)).decode(),
+    "md5": lambda s: hashlib.md5(str(s).encode()).hexdigest(),
+    "sha1": lambda s: hashlib.sha1(str(s).encode()).hexdigest(),
+    "sha256": lambda s: hashlib.sha256(str(s).encode()).hexdigest(),
+    # type conversion
+    "tostring": _to_str,
+    "tonumber": lambda s: float(s) if "." in str(s) else int(s),
+    "tobool": lambda s: s if isinstance(s, bool)
+    else str(s).lower() == "true",
+}
+
+
+# ------------------------------------------------------------ evaluation
+
+
+class _Scope:
+    def __init__(self, variables: Dict[str, Any], locals_: Dict[str, Any]):
+        self.variables = variables
+        self.locals = locals_
+
+    def resolve(self, name: str, line: int = 0) -> Any:
+        root, _, rest = name.partition(".")
+        if root == "var":
+            if rest not in self.variables:
+                raise HclParseError(f"undefined variable {rest!r}", line)
+            return self.variables[rest]
+        if root == "local":
+            if rest not in self.locals:
+                raise HclParseError(f"undefined local {rest!r}", line)
+            return self.locals[rest]
+        raise HclParseError(f"unknown reference {name!r}", line)
+
+
+_INTERP_RE = re.compile(r"\$\{([^{}]+)\}")
+# roots the parse-time evaluator owns; anything else is runtime
+_OWNED_ROOT_RE = re.compile(r"^\s*(var\.|local\.|[a-z_][\w]*\s*\()")
+
+
+def _eval(v: Any, scope: _Scope) -> Any:
+    if isinstance(v, Ref):
+        return _eval(scope.resolve(v.name, v.line), scope)
+    if isinstance(v, Call):
+        fn = FUNCTIONS.get(v.name)
+        if fn is None:
+            raise HclParseError(f"unknown function {v.name!r}", v.line)
+        args = [_eval(a, scope) for a in v.args]
+        try:
+            return fn(*args)
+        except HclParseError:
+            raise
+        except Exception as e:                       # noqa: BLE001
+            raise HclParseError(f"{v.name}(...): {e}", v.line)
+    if isinstance(v, str):
+        return _eval_interp(v, scope)
+    if isinstance(v, list):
+        return [_eval(x, scope) for x in v]
+    if isinstance(v, dict):
+        return {k: _eval(x, scope) for k, x in v.items()}
+    return v
+
+
+def _eval_interp(s: str, scope: _Scope) -> Any:
+    """Evaluate ${...} segments the evaluator owns; leave runtime
+    segments (${env.X}, ${attr.X}, ${NOMAD_*}, ...) literal."""
+    segs = list(_INTERP_RE.finditer(s))
+    owned = [m for m in segs if _OWNED_ROOT_RE.match(m.group(1))]
+    if not owned:
+        return s
+    # whole-string single segment keeps its native type (HCL semantics)
+    if len(segs) == 1 and segs[0].group(0) == s:
+        return _eval_segment(segs[0].group(1), scope)
+
+    def sub(m: "re.Match") -> str:
+        if not _OWNED_ROOT_RE.match(m.group(1)):
+            return m.group(0)
+        return _to_str(_eval_segment(m.group(1), scope))
+    return _INTERP_RE.sub(sub, s)
+
+
+def _eval_segment(text: str, scope: _Scope) -> Any:
+    from nomad_tpu.jobspec.hcl import _Parser, _tokenize
+    p = _Parser(_tokenize(text.strip()))
+    val = p.parse_value()
+    return _eval(val, scope)
+
+
+def _coerce(value: Any, type_: str, name: str) -> Any:
+    if type_ in ("", "any", None):
+        return value
+    try:
+        if type_ == "string":
+            return _to_str(value)
+        if type_ == "number":
+            return value if isinstance(value, (int, float)) \
+                else (float(value) if "." in str(value) else int(value))
+        if type_ == "bool":
+            return value if isinstance(value, bool) \
+                else str(value).lower() == "true"
+        if type_.startswith("list"):
+            return list(value) if not isinstance(value, str) \
+                else json.loads(value)
+        if type_.startswith("map") or type_.startswith("object"):
+            return dict(value) if not isinstance(value, str) \
+                else json.loads(value)
+    except Exception as e:                           # noqa: BLE001
+        raise HclParseError(
+            f"variable {name!r}: cannot convert to {type_}: {e}", 0)
+    return value
+
+
+def evaluate(root: HclBlock,
+             var_values: Optional[Dict[str, Any]] = None) -> None:
+    """Resolve variable/locals blocks and every Ref/Call/interpolation
+    in `root`, in place.  `var_values`: CLI/API overrides (-var)."""
+    overrides = dict(var_values or {})
+    variables: Dict[str, Any] = {}
+    for vb in root.all("variable"):
+        name = vb.labels[0] if vb.labels else ""
+        if not name:
+            raise HclParseError("variable block needs a name", vb.line)
+        type_ = vb.get("type", "")
+        if isinstance(type_, Call):      # `type = list(string)`
+            type_ = type_.name
+        elif isinstance(type_, Ref):
+            type_ = type_.name
+        if name in overrides:
+            variables[name] = _coerce(overrides.pop(name), str(type_),
+                                      name)
+        elif "default" in vb.attrs:
+            variables[name] = vb.attrs["default"]
+        else:
+            raise HclParseError(
+                f"variable {name!r} has no value (set -var {name}=...)",
+                vb.line)
+    if overrides:
+        raise HclParseError(
+            f"undeclared variables: {sorted(overrides)}", 0)
+
+    scope = _Scope(variables, {})
+    # defaults may themselves use functions/other vars
+    for name in list(variables):
+        variables[name] = _eval(variables[name], scope)
+
+    # locals: ordered evaluation with dependency retries (HCL allows
+    # any order; a small fixpoint pass covers chains without a graph)
+    pending: List[tuple] = []
+    for lb in root.all("locals"):
+        pending.extend(lb.attrs.items())
+    for _ in range(len(pending) + 1):
+        if not pending:
+            break
+        still = []
+        for name, raw in pending:
+            try:
+                scope.locals[name] = _eval(raw, scope)
+            except HclParseError:
+                still.append((name, raw))
+        if len(still) == len(pending):
+            name, raw = still[0]
+            scope.locals[name] = _eval(raw, scope)   # raise for real
+        pending = still
+
+    root.blocks = [b for b in root.blocks
+                   if b.type not in ("variable", "locals")]
+    _eval_block(root, scope)
+
+
+def _eval_block(block: HclBlock, scope: _Scope) -> None:
+    block.labels = [_to_str(_eval(l, scope)) for l in block.labels]
+    for k in list(block.attrs):
+        block.attrs[k] = _eval(block.attrs[k], scope)
+    for child in block.blocks:
+        _eval_block(child, scope)

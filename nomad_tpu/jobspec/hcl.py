@@ -19,6 +19,35 @@ class HclParseError(ValueError):
         self.line = line
 
 
+class Ref:
+    """An unresolved expression reference (`var.x`, `local.y`) — the
+    evaluator (jobspec/expr.py) resolves it; reaching struct mapping
+    unresolved is an error."""
+
+    __slots__ = ("name", "line")
+
+    def __init__(self, name: str, line: int = 0):
+        self.name = name
+        self.line = line
+
+    def __repr__(self):
+        return f"Ref({self.name!r})"
+
+
+class Call:
+    """An unresolved function call (`format("x-%s", var.y)`)."""
+
+    __slots__ = ("name", "args", "line")
+
+    def __init__(self, name: str, args: List[Any], line: int = 0):
+        self.name = name
+        self.args = args
+        self.line = line
+
+    def __repr__(self):
+        return f"Call({self.name!r}, {self.args!r})"
+
+
 class HclBlock:
     """A block: `type "label1" "label2" { attrs + child blocks }`."""
 
@@ -55,7 +84,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<string>"(?:\\.|[^"\\])*")
   | (?P<number>-?\d+(?:\.\d+)?(?![\w.]))
   | (?P<ident>[A-Za-z_][\w.-]*)
-  | (?P<punct>[{}\[\]=,:\n])
+  | (?P<punct>[{}\[\]=,:()\n])
 """, re.X | re.S)
 
 
@@ -207,6 +236,23 @@ class _Parser:
                 return False
             if val == "null":
                 return None
+            nk, nv, _nl = self.peek(skip_nl=False)
+            if nk == "punct" and nv == "(":
+                # function call: format("x-%s", var.y)
+                self.next()
+                args = []
+                while True:
+                    k2, v2, _l2 = self.peek()
+                    if k2 == "punct" and v2 == ")":
+                        self.next()
+                        break
+                    args.append(self.parse_value())
+                    k3, v3, _l3 = self.peek()
+                    if k3 == "punct" and v3 == ",":
+                        self.next()
+                return Call(val, args, line)
+            if val.split(".", 1)[0] in ("var", "local"):
+                return Ref(val, line)        # resolved by jobspec/expr.py
             return val                       # bare identifier -> string
         if kind == "punct" and val == "[":
             items = []

@@ -58,14 +58,19 @@ def parse_duration(val: Any, default: float = 0.0) -> float:
     return total
 
 
-def parse_job_file(path: str) -> Job:
+def parse_job_file(path: str, var_values: Optional[Dict[str, Any]] = None
+                   ) -> Job:
     with open(path) as fh:
-        return parse_job(fh.read())
+        return parse_job(fh.read(), var_values)
 
 
-def parse_job(src: str) -> Job:
-    """Parse an HCL jobspec into a canonicalized Job."""
+def parse_job(src: str, var_values: Optional[Dict[str, Any]] = None) -> Job:
+    """Parse an HCL jobspec into a canonicalized Job.  `var_values`
+    overrides `variable` block defaults (CLI -var / API Variables;
+    reference jobspec2/parse.go ParseWithConfig)."""
+    from nomad_tpu.jobspec.expr import evaluate
     root = parse_hcl(src)
+    evaluate(root, var_values)
     jb = root.first("job")
     if jb is None:
         raise HclParseError("no 'job' block found", 0)

@@ -164,3 +164,48 @@ def test_error_paths(agent):
     assert code == 1
     code, _ = run_cli(agent, "alloc", "status", "bogus")
     assert code == 1
+
+
+def test_job_validate_and_run_with_vars(agent, tmp_path_factory):
+    """A jobspec using variables/locals/functions round-trips through
+    job validate and job run -var (VERDICT r4 item 10)."""
+    spec = tmp_path_factory.mktemp("vars") / "varjob.nomad"
+    spec.write_text('''
+variable "name" { type = string }
+variable "replicas" {
+  type    = number
+  default = 2
+}
+locals { full = format("%s-svc", var.name) }
+job "var-demo" {
+  type = "service"
+  meta { rendered = local.full }
+  group "g" {
+    count = var.replicas
+    task "t" {
+      driver = "mock_driver"
+      config { run_for = 30 }
+    }
+  }
+}
+''')
+    code, out = run_cli(agent, "job", "validate",
+                        "-var", "name=alpha", str(spec))
+    assert code == 0 and "successful" in out
+
+    # missing required var fails validation
+    code, out = run_cli(agent, "job", "validate", str(spec))
+    assert code == 1 and "no value" in out
+
+    code, out = run_cli(agent, "job", "run", "-detach",
+                        "-var", "name=alpha", str(spec))
+    assert code == 0
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        job = agent.server.store.job_by_id("default", "var-demo")
+        if job is not None:
+            break
+        time.sleep(0.1)
+    assert job is not None
+    assert job.meta["rendered"] == "alpha-svc"
+    assert job.task_groups[0].count == 2

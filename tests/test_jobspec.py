@@ -241,3 +241,109 @@ EOT
 ''')
     assert root.attrs["a"] == 1
     assert root.attrs["b"] == "line1\nline2"
+
+
+# ------------------------------------------------------- HCL2 expressions
+
+def test_variables_locals_functions():
+    """jobspec2/parse.go ParseWithConfig: variable blocks + -var
+    overrides, locals, and the cty function set."""
+    src = '''
+variable "region" {
+  type    = string
+  default = "us-east"
+}
+variable "count" {
+  type    = number
+  default = 3
+}
+locals {
+  svc_name = format("web-%s", var.region)
+  doubled  = max(var.count, 2)
+}
+job "api" {
+  type        = "service"
+  datacenters = [var.region]
+  meta {
+    service = local.svc_name
+    upper   = upper(local.svc_name)
+    joined  = join(",", concat(["a"], ["b", "c"]))
+  }
+  group "g" {
+    count = local.doubled
+    task "t" {
+      driver = "mock_driver"
+      env {
+        REGION = "${var.region}"
+        MIXED  = "pre-${var.region}-post"
+        RUNTIME = "${NOMAD_TASK_DIR}/x"
+      }
+    }
+  }
+}
+'''
+    job = parse_job(src)
+    assert job.datacenters == ["us-east"]
+    assert job.meta["service"] == "web-us-east"
+    assert job.meta["upper"] == "WEB-US-EAST"
+    assert job.meta["joined"] == "a,b,c"
+    tg = job.task_groups[0]
+    assert tg.count == 3
+    env = tg.tasks[0].env
+    assert env["REGION"] == "us-east"
+    assert env["MIXED"] == "pre-us-east-post"
+    # runtime interpolation stays literal for the client's taskenv
+    assert env["RUNTIME"] == "${NOMAD_TASK_DIR}/x"
+
+
+def test_variable_overrides_and_errors():
+    src = '''
+variable "who" { type = string }
+job "j" {
+  type = "batch"
+  group "g" {
+    task "t" {
+      driver = "mock_driver"
+      meta { who = var.who }
+    }
+  }
+}
+'''
+    job = parse_job(src, {"who": "ops"})
+    assert job.task_groups[0].tasks[0].meta["who"] == "ops"
+    with pytest.raises(HclParseError, match="has no value"):
+        parse_job(src)
+    with pytest.raises(HclParseError, match="undeclared"):
+        parse_job(src, {"who": "x", "nope": "y"})
+
+
+def test_locals_dependency_chain_and_functions():
+    from nomad_tpu.jobspec.hcl import parse_hcl as ph
+    from nomad_tpu.jobspec.expr import evaluate
+    root = ph('''
+locals {
+  c = upper(local.b)
+  b = format("%s-%d", local.a, 2)
+  a = "x"
+}
+v1 = local.c
+v2 = length([1, 2, 3])
+v3 = jsonencode({k = "v"})
+v4 = coalesce("", "fallback")
+v5 = replace("a.b.c", ".", "-")
+''')
+    evaluate(root)
+    assert root.attrs["v1"] == "X-2"
+    assert root.attrs["v2"] == 3
+    assert root.attrs["v3"] == '{"k":"v"}'
+    assert root.attrs["v4"] == "fallback"
+    assert root.attrs["v5"] == "a-b-c"
+
+
+def test_unknown_function_and_var():
+    from nomad_tpu.jobspec.expr import evaluate
+    from nomad_tpu.jobspec.hcl import parse_hcl as ph
+    with pytest.raises(HclParseError, match="unknown function"):
+        evaluate(ph('x = frobnicate("a")'))
+    with pytest.raises(HclParseError, match="undefined variable"):
+        evaluate(ph('x = var.missing'))
