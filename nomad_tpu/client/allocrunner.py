@@ -18,7 +18,7 @@ class AllocRunner:
     def __init__(self, alloc, driver_registry, root_dir: str,
                  node=None, on_update: Optional[Callable] = None,
                  state_db=None, prev_alloc_dir: Optional[AllocDir] = None,
-                 csi_plugins=None, rpc=None):
+                 csi_plugins=None, rpc=None, device_manager=None):
         self.alloc = alloc
         self.registry = driver_registry
         self.node = node
@@ -41,10 +41,29 @@ class AllocRunner:
         from nomad_tpu.client.services import ServiceHook
         self.service_hook = ServiceHook(alloc, node, rpc)
         self.rpc = rpc
+        self.device_manager = device_manager
 
     def task_group(self):
         job = self.alloc.job
         return job.lookup_task_group(self.alloc.task_group) if job else None
+
+    def _reserve_devices(self):
+        """-> {task_name: env} or None after failing the alloc."""
+        out: Dict[str, Dict[str, str]] = {}
+        if self.device_manager is None:
+            return out
+        tasks = getattr(self.alloc.allocated_resources, "tasks", None) or {}
+        try:
+            for tname, tres in tasks.items():
+                if tres.devices:
+                    out[tname] = self.device_manager.reserve(
+                        self.alloc.id, tres.devices)
+        except Exception as e:                       # noqa: BLE001
+            self.device_manager.free(self.alloc.id)
+            self._set_status(AllocClientStatus.FAILED,
+                             f"device reservation failed: {e}")
+            return None
+        return out
 
     # ------------------------------------------------------------ lifecycle
 
@@ -74,13 +93,21 @@ class AllocRunner:
                                  "no task group in alloc job")
                 return
 
+            # device reservation before any task starts (devicemanager
+            # Reserve; the scheduler picked the instance ids, the client
+            # enforces exclusivity and hands the env to the task)
+            dev_env = self._reserve_devices()
+            if dev_env is None:
+                return                               # reservation failed
+
             ports = self._port_map()
             for task in tg.tasks:
                 tr = TaskRunner(
                     self.alloc, task, self.registry.get(task.driver),
                     self.alloc_dir, node=self.node,
                     on_state=self._on_task_state, state_db=self.state_db,
-                    ports=ports, volumes=csi_mounts, rpc=self.rpc)
+                    ports=ports, volumes=csi_mounts, rpc=self.rpc,
+                    extra_env=dev_env.get(task.name))
                 self.task_runners[task.name] = tr
 
             self._start_health_watcher()
@@ -202,6 +229,10 @@ class AllocRunner:
         else:
             self.client_status = AllocClientStatus.PENDING
 
+    def _free_devices(self) -> None:
+        if self.device_manager is not None:
+            self.device_manager.free(self.alloc.id)
+
     def _finalize_status(self) -> None:
         with self._lock:
             self._aggregate_status()
@@ -217,6 +248,10 @@ class AllocRunner:
                     for tr in [self.task_runners.get(t.name)] if tr)
                 if mains_dead:
                     self.client_status = AllocClientStatus.COMPLETE
+        if self.client_status in (AllocClientStatus.COMPLETE,
+                                  AllocClientStatus.FAILED,
+                                  AllocClientStatus.LOST):
+            self._free_devices()
         self.on_update(self)
 
     def _fail_remaining(self, desc: str) -> None:
@@ -228,6 +263,11 @@ class AllocRunner:
         with self._lock:
             self.client_status = status
             self.client_description = desc
+        if status in (AllocClientStatus.COMPLETE, AllocClientStatus.FAILED,
+                      AllocClientStatus.LOST):
+            # every terminal path releases device instances, or the
+            # replacement alloc gets assigned still-held ids
+            self._free_devices()
         self.on_update(self)
 
     def task_states(self):
@@ -337,6 +377,9 @@ class AllocRunner:
             tr.join(2.0)
             if tr.handle is not None:
                 tr.driver.destroy_task(tr.handle)
+        # free only after the processes are down — freeing first would
+        # let a new alloc double-use a still-running accelerator
+        self._free_devices()
         self.alloc_dir.destroy()
         if self.state_db is not None:
             self.state_db.delete_alloc(self.alloc.id)
@@ -350,6 +393,13 @@ class AllocRunner:
         if tg is None:
             return
         self.alloc_dir.build()
+        # repopulate device accounting for a still-running alloc so new
+        # placements cannot double-book its instances; a failure here
+        # (plugin config shrank) already failed the alloc — do NOT
+        # recover tasks, or status aggregation would mask it
+        dev_env = self._reserve_devices()
+        if dev_env is None:
+            return
         ports = self._port_map()
         saved = self.state_db.get_task_states(self.alloc.id)
         for task in tg.tasks:
@@ -357,7 +407,8 @@ class AllocRunner:
                 self.alloc, task, self.registry.get(task.driver),
                 self.alloc_dir, node=self.node,
                 on_state=self._on_task_state, state_db=self.state_db,
-                ports=ports, rpc=self.rpc)
+                ports=ports, rpc=self.rpc,
+                extra_env=dev_env.get(task.name))
             self.task_runners[task.name] = tr
             if task.name in saved:
                 state, failed, restarts, handle = saved[task.name]

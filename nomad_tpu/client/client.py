@@ -46,6 +46,10 @@ class ClientConfig:
     # re-registers on change so the servers see device health updates
     device_fingerprint: Optional[Callable[[], list]] = None
     device_poll_interval: float = 1.0
+    # device plugin specs (client/devicemanager): each dict builds a
+    # FakeDevicePlugin (vendor/type/name + count|instance_ids) that the
+    # node fingerprints and the client reserves instances from
+    device_plugins: List[dict] = field(default_factory=list)
 
 
 class Client:
@@ -59,6 +63,10 @@ class Client:
         self.alloc_dir_root = os.path.join(self.data_dir, "allocs")
         self.state_db = ClientStateDB(
             os.path.join(self.data_dir, "client_state.db"))
+        from nomad_tpu.client.devices import (DeviceManager,
+                                              FakeDevicePlugin)
+        self.device_manager = DeviceManager(
+            [FakeDevicePlugin(s) for s in config.device_plugins])
         self.node = self._build_node()
         self.alloc_runners: Dict[str, AllocRunner] = {}
         self._ar_lock = threading.Lock()
@@ -81,6 +89,7 @@ class Client:
         )
         node.meta = dict(self.config.meta)
         fingerprint_node(node, self.registry.fingerprints())
+        node.node_resources.devices = self.device_manager.fingerprint()
         from nomad_tpu.structs.node import compute_node_class
         node.computed_class = compute_node_class(node)
         return node
@@ -124,7 +133,8 @@ class Client:
         except Exception:                       # noqa: BLE001
             return False
         before = self._device_snapshot()
-        self.node.node_resources.devices = list(devices)
+        self.node.node_resources.devices = \
+            self.device_manager.fingerprint() + list(devices)
         changed = self._device_snapshot() != before
         if changed and register:
             try:
@@ -265,7 +275,8 @@ class Client:
         ar = AllocRunner(alloc, self.registry, self.alloc_dir_root,
                          node=self.node, on_update=self._on_alloc_update,
                          state_db=self.state_db,
-                         prev_alloc_dir=prev_dir, rpc=self.rpc)
+                         prev_alloc_dir=prev_dir, rpc=self.rpc,
+                         device_manager=self.device_manager)
         with self._ar_lock:
             self.alloc_runners[alloc.id] = ar
         self.state_db.put_alloc(alloc.id, {
@@ -362,7 +373,8 @@ class Client:
             ar = AllocRunner(alloc, self.registry, self.alloc_dir_root,
                              node=self.node,
                              on_update=self._on_alloc_update,
-                             state_db=self.state_db, rpc=self.rpc)
+                             state_db=self.state_db, rpc=self.rpc,
+                             device_manager=self.device_manager)
             with self._ar_lock:
                 self.alloc_runners[alloc.id] = ar
             ar.restore()

@@ -1,0 +1,155 @@
+"""Client device plugin manager (reference: client/devicemanager/
+manager.go — dispenses device plugins, streams fingerprints, and
+reserves instances at task start; plugins/device/device.go:25-37 —
+the Fingerprint/Reserve plugin interface).
+
+Plugins here are in-process objects (the framework's plugin registry is
+in-process by design); `FakeDevicePlugin` materializes devices from
+agent/client config so a node fingerprints and reserves real
+client-side state without physical hardware — the reference's
+device-plugin e2e tests do the same with its fake device plugin.
+
+Reservation: the scheduler picks concrete instance ids server-side
+(scheduler/devices.py) and ships them on the alloc
+(AllocatedTaskResources.devices).  The client-side manager is the
+enforcement point: it tracks in-use instances, rejects double
+reservations (a torn plan or buggy server must not oversubscribe a
+local accelerator), and returns the env the task needs to see its
+devices (reference device.Reserve -> ContainerReservation envs)."""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from nomad_tpu.structs.resources import NodeDevice
+
+
+class DeviceReservationError(Exception):
+    pass
+
+
+class DevicePlugin:
+    """plugins/device/device.go DevicePlugin: Fingerprint + Reserve."""
+
+    def fingerprint(self) -> List[NodeDevice]:
+        raise NotImplementedError
+
+    def reserve(self, instance_ids: List[str]) -> Dict[str, str]:
+        """-> env vars the task needs (ContainerReservation.Envs)."""
+        raise NotImplementedError
+
+
+class FakeDevicePlugin(DevicePlugin):
+    """Config-built plugin: spec keys vendor/type/name plus either
+    `count` (ids generated) or `instance_ids`, optional `attributes`,
+    `env_var` (default NOMAD_DEVICE_<TYPE>), `unhealthy_ids`."""
+
+    def __init__(self, spec: dict):
+        self.vendor = spec.get("vendor", "nomad")
+        self.type = spec.get("type", "gpu")
+        self.name = spec.get("name", self.type)
+        ids = list(spec.get("instance_ids") or [])
+        if not ids:
+            ids = [f"{self.name}-{i}" for i in range(int(
+                spec.get("count", 1)))]
+        self.instance_ids = ids
+        self.attributes = dict(spec.get("attributes") or {})
+        self.unhealthy_ids = list(spec.get("unhealthy_ids") or [])
+        self.env_var = spec.get(
+            "env_var", f"NOMAD_DEVICE_{self.type.upper()}")
+
+    def fingerprint(self) -> List[NodeDevice]:
+        return [NodeDevice(
+            vendor=self.vendor, type=self.type, name=self.name,
+            instance_ids=list(self.instance_ids),
+            attributes=dict(self.attributes),
+            unhealthy_ids=list(self.unhealthy_ids))]
+
+    def reserve(self, instance_ids: List[str]) -> Dict[str, str]:
+        unknown = [i for i in instance_ids
+                   if i not in self.instance_ids]
+        if unknown:
+            raise DeviceReservationError(
+                f"unknown instances for {self.key()}: {unknown}")
+        return {self.env_var: ",".join(sorted(instance_ids))}
+
+    def key(self) -> str:
+        return f"{self.vendor}/{self.type}/{self.name}"
+
+
+class DeviceManager:
+    """Fingerprint aggregation + instance accounting for one client."""
+
+    def __init__(self, plugins: Optional[List[DevicePlugin]] = None):
+        self.plugins: Dict[str, DevicePlugin] = {}
+        for p in plugins or []:
+            self.plugins[_plugin_key(p)] = p
+        # instance id -> alloc id holding it, per plugin key
+        self._in_use: Dict[str, Dict[str, str]] = {}
+        self._lock = threading.Lock()
+
+    def fingerprint(self) -> List[NodeDevice]:
+        out: List[NodeDevice] = []
+        for p in self.plugins.values():
+            try:
+                out.extend(p.fingerprint())
+            except Exception:                        # noqa: BLE001
+                continue                 # a broken plugin hides itself
+        return out
+
+    def reserve(self, alloc_id: str,
+                devices: List[dict]) -> Dict[str, str]:
+        """Reserve an alloc's scheduler-assigned instances; returns the
+        merged task env.  All-or-nothing: a conflict releases anything
+        taken in this call."""
+        env: Dict[str, str] = {}
+        taken: List[tuple] = []
+        with self._lock:
+            try:
+                for d in devices:
+                    key = (f"{d.get('vendor', '')}/{d.get('type', '')}/"
+                           f"{d.get('name', '')}")
+                    plugin = self.plugins.get(key)
+                    if plugin is None:
+                        raise DeviceReservationError(
+                            f"no device plugin for {key}")
+                    used = self._in_use.setdefault(key, {})
+                    ids = list(d.get("device_ids") or [])
+                    for i in ids:
+                        holder = used.get(i)
+                        if holder is not None and holder != alloc_id:
+                            raise DeviceReservationError(
+                                f"instance {i} of {key} already held "
+                                f"by alloc {holder[:8]}")
+                    env.update(plugin.reserve(ids))
+                    for i in ids:
+                        used[i] = alloc_id
+                        taken.append((key, i))
+            except Exception:
+                for key, i in taken:
+                    self._in_use.get(key, {}).pop(i, None)
+                raise
+        return env
+
+    def free(self, alloc_id: str) -> int:
+        """Release every instance an alloc holds (alloc stop/destroy)."""
+        n = 0
+        with self._lock:
+            for used in self._in_use.values():
+                drop = [i for i, a in used.items() if a == alloc_id]
+                for i in drop:
+                    del used[i]
+                n += len(drop)
+        return n
+
+    def in_use(self) -> Dict[str, List[str]]:
+        with self._lock:
+            return {k: sorted(v) for k, v in self._in_use.items() if v}
+
+
+def _plugin_key(p: DevicePlugin) -> str:
+    if hasattr(p, "key"):
+        return p.key()
+    fps = p.fingerprint()
+    return (f"{fps[0].vendor}/{fps[0].type}/{fps[0].name}"
+            if fps else repr(p))

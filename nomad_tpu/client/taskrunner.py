@@ -60,7 +60,8 @@ class TaskRunner:
     def __init__(self, alloc, task, driver: Driver, alloc_dir,
                  node=None, on_state: Optional[Callable] = None,
                  state_db=None, ports: Optional[Dict[str, int]] = None,
-                 volumes: Optional[Dict[str, str]] = None, rpc=None):
+                 volumes: Optional[Dict[str, str]] = None, rpc=None,
+                 extra_env: Optional[Dict[str, str]] = None):
         self.alloc = alloc
         self.task = task
         self.driver = driver
@@ -71,6 +72,7 @@ class TaskRunner:
         self.ports = ports or {}
         self.volumes = volumes or {}    # CSI alias -> host mount path
         self.rpc = rpc                  # client->server (vault/templates)
+        self.extra_env = extra_env or {}   # device reservations etc.
         self.state = TaskState()
         self.handle: Optional[TaskHandle] = None
         self.restart_tracker = RestartTracker(
@@ -181,6 +183,7 @@ class TaskRunner:
         self.env = build_task_env(self.alloc, self.task, self.node,
                                   task_dir, self.ports,
                                   volumes=self.volumes)
+        self.env.update(self.extra_env)
         self._vault_hook(task_dir)
         self._artifact_hook(task_dir)
         self._template_hook(task_dir)
@@ -300,6 +303,7 @@ class TaskRunner:
             self.env = build_task_env(self.alloc, self.task, self.node,
                                       task_dir, self.ports,
                                       volumes=self.volumes)
+            self.env.update(self.extra_env)
             self._vault_hook(task_dir)
             self._template_hook(task_dir)
             self._task_dir = task_dir

@@ -13,6 +13,9 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the persistent compile cache is for TPU serving; sharing it with the
+# CPU test platform risks AOT feature-mismatch loads (SIGILL warnings)
+os.environ.setdefault("NOMAD_TPU_JAX_CACHE", "0")
 
 import jax
 

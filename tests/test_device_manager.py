@@ -1,0 +1,133 @@
+"""Client-side device plugin manager (reference client/devicemanager/
+manager.go + plugins/device/device.go:25-37): fingerprint from
+config-built plugins, node reports devices, scheduler assigns
+instances, client reserves them and hands the env to the task."""
+import time
+
+import pytest
+
+from nomad_tpu.client.devices import (
+    DeviceManager,
+    DeviceReservationError,
+    FakeDevicePlugin,
+)
+
+
+def test_fake_plugin_fingerprint_and_reserve():
+    p = FakeDevicePlugin({"vendor": "nvidia", "type": "gpu",
+                          "name": "a100", "count": 2})
+    devs = p.fingerprint()
+    assert devs[0].instance_ids == ["a100-0", "a100-1"]
+    env = p.reserve(["a100-1"])
+    assert env == {"NOMAD_DEVICE_GPU": "a100-1"}
+    with pytest.raises(DeviceReservationError):
+        p.reserve(["nope"])
+
+
+def test_manager_exclusivity_and_free():
+    m = DeviceManager([FakeDevicePlugin(
+        {"vendor": "nvidia", "type": "gpu", "name": "a100",
+         "instance_ids": ["g0", "g1"]})])
+    spec = [{"vendor": "nvidia", "type": "gpu", "name": "a100",
+             "device_ids": ["g0"]}]
+    env = m.reserve("alloc-1", spec)
+    assert env == {"NOMAD_DEVICE_GPU": "g0"}
+    # double-booking by another alloc is rejected
+    with pytest.raises(DeviceReservationError, match="already held"):
+        m.reserve("alloc-2", spec)
+    # idempotent for the same alloc (restore path)
+    m.reserve("alloc-1", spec)
+    assert m.free("alloc-1") == 1
+    m.reserve("alloc-2", spec)
+
+
+def test_manager_all_or_nothing():
+    m = DeviceManager([FakeDevicePlugin(
+        {"vendor": "n", "type": "gpu", "name": "g",
+         "instance_ids": ["g0"]})])
+    m.reserve("a1", [{"vendor": "n", "type": "gpu", "name": "g",
+                      "device_ids": ["g0"]}])
+    with pytest.raises(DeviceReservationError):
+        m.reserve("a2", [
+            {"vendor": "n", "type": "fpga", "name": "f",
+             "device_ids": ["f0"]},          # no plugin -> whole call fails
+        ])
+    assert m.in_use() == {"n/gpu/g": ["g0"]}
+
+
+def _wait(cond, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_device_job_end_to_end(tmp_path):
+    """configs[3]-shaped: the CLIENT fingerprints devices (not the
+    server), a device-requesting job schedules onto it, and the task
+    sees its reserved instances in env."""
+    from nomad_tpu.client.client import Client, ClientConfig
+    from nomad_tpu.core.server import Server, ServerConfig
+    from nomad_tpu.structs.job import Job, Task, TaskGroup
+    from nomad_tpu.structs.resources import DeviceRequest
+
+    s = Server(ServerConfig(num_schedulers=1, heartbeat_ttl=3600.0,
+                            gc_interval=3600.0))
+    s.start()
+    c = Client(ClientConfig(
+        node_name="dev-client",
+        data_dir=str(tmp_path / "client"),
+        drivers=["raw_exec", "mock", "mock_driver"],
+        device_plugins=[{"vendor": "nvidia", "type": "gpu",
+                         "name": "a100", "count": 2}]),
+        rpc=s.rpc_leader)
+    c.start()
+    try:
+        # the server sees client-reported devices
+        node = s.store.node_by_id(c.node.id)
+        assert node is not None
+        assert node.node_resources.devices[0].instance_ids == \
+            ["a100-0", "a100-1"]
+
+        proof = tmp_path / "devices.txt"
+        t = Task(name="t", driver="raw_exec",
+                 config={"command": "/bin/sh",
+                         "args": ["-c",
+                                  f'echo "$NOMAD_DEVICE_GPU" > {proof}'
+                                  '; sleep 30']})
+        t.resources.devices = [DeviceRequest(name="gpu", count=2)]
+        job = Job(id=f"dev-{time.time_ns()}", name="dev", type="service",
+                  task_groups=[TaskGroup(name="g", count=1, tasks=[t])])
+        job.canonicalize()
+        s.register_job(job)
+        assert _wait(lambda: any(
+            a.client_status == "running"
+            for a in s.store.allocs_by_job("default", job.id))), \
+            [(a.client_status, a.task_states, a.client_description)
+             for a in s.store.allocs_by_job("default", job.id)]
+        assert _wait(lambda: proof.exists() and proof.read_text().strip())
+        assert proof.read_text().strip() == "a100-0,a100-1"
+        # client-side accounting holds the instances
+        assert c.device_manager.in_use() == {
+            "nvidia/gpu/a100": ["a100-0", "a100-1"]}
+
+        # a second device job cannot place (no free instances)
+        j2 = Job(id=f"dev2-{time.time_ns()}", name="d2", type="service",
+                 task_groups=[TaskGroup(name="g", count=1, tasks=[
+                     Task(name="t", driver="mock_driver",
+                          config={"run_for": 5.0})])])
+        j2.task_groups[0].tasks[0].resources.devices = [
+            DeviceRequest(name="gpu", count=1)]
+        j2.canonicalize()
+        s.register_job(j2)
+        time.sleep(2.0)
+        assert not any(a.client_status == "running"
+                       for a in s.store.allocs_by_job("default", j2.id))
+
+        # stopping the first job frees the instances
+        s.deregister_job("default", job.id)
+        assert _wait(lambda: c.device_manager.in_use() == {}, 15.0)
+    finally:
+        s.stop()
