@@ -21,6 +21,10 @@ class AgentConfig:
     dev_mode: bool = True
     http_host: str = "127.0.0.1"
     http_port: int = 4646                 # reference default port
+    # address other nodes should use to reach this agent's HTTP API
+    # (reference `advertise { http = ... }`); defaults to a best-effort
+    # guess — REQUIRED for cross-node alloc fs/logs when binding 0.0.0.0
+    http_advertise: Optional[str] = None
     num_schedulers: int = 4
     enabled_schedulers: List[str] = field(
         default_factory=lambda: ["service", "batch", "system", "sysbatch"])
@@ -106,6 +110,32 @@ class Agent:
         self.http = HTTPServer(self, host=self.config.http_host,
                                port=self.config.http_port)
         self.http.start()
+        if self.client is not None:
+            # advertise this agent's HTTP address on the node so servers
+            # can forward fs/log reads (Node.HTTPAddr)
+            self.client.node.http_addr = self._advertise_addr()
+            try:
+                self.client.rpc("Node.Register",
+                                {"node": self.client.node})
+            except Exception:               # noqa: BLE001
+                pass
+
+    def _advertise_addr(self) -> str:
+        if self.config.http_advertise:
+            return self.config.http_advertise
+        host = self.http.host
+        if host in ("0.0.0.0", "::", ""):
+            # wildcard bind is unreachable from other nodes — guess the
+            # primary interface address (advertise { http } overrides)
+            import socket
+            try:
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                s.connect(("10.255.255.255", 1))
+                host = s.getsockname()[0]
+                s.close()
+            except OSError:
+                host = "127.0.0.1"
+        return f"{host}:{self.http.port}"
 
     def stop(self) -> None:
         if self.http is not None:

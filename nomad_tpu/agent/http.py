@@ -507,6 +507,167 @@ class HTTPServer:
             return self._rpc("Status.Peers", {})
         raise HTTPError(404, "unknown status path")
 
+    # ------------------------------------------------------------ client fs
+
+    def _h_get_client_id(self, h, parts, q):
+        """/v1/client/fs/{ls,stat,cat,logs}/<alloc_id> — alloc filesystem
+        and task log access (reference client/fs_endpoint.go +
+        command/agent/fs_endpoint.go).  Requests for allocs on another
+        node forward to that node's advertised agent address (the
+        reference's server->client streaming hop)."""
+        import os
+
+        if len(parts) < 4 or parts[1] != "fs":
+            raise HTTPError(404, "expected /v1/client/fs/<verb>/<alloc>")
+        verb, alloc_id = parts[2], parts[3]
+        client = self.agent.client
+        root = None
+        if client is not None:
+            cand = os.path.join(client.alloc_dir_root, alloc_id)
+            if os.path.isdir(cand):
+                root = cand
+        if root is None:
+            # one forwarding hop only: a forwarded request that still
+            # finds no local dir must 404, not bounce again (self-proxy
+            # loop when a combined agent's alloc dir is already gone)
+            if h.headers.get("X-Nomad-Forwarded"):
+                raise HTTPError(404,
+                                f"allocation {alloc_id} not on this node")
+            return self._proxy_fs(h, parts, q)
+
+        def resolve(rel: str) -> str:
+            p = os.path.realpath(os.path.join(root, rel.lstrip("/")))
+            if not (p + os.sep).startswith(os.path.realpath(root) + os.sep) \
+                    and p != os.path.realpath(root):
+                raise HTTPError(403, "path escapes allocation directory")
+            return p
+
+        if verb == "ls":
+            d = resolve(q.get("path", "/"))
+            if not os.path.isdir(d):
+                raise HTTPError(404, f"not a directory: {q.get('path')}")
+            out = []
+            for name in sorted(os.listdir(d)):
+                st = os.stat(os.path.join(d, name))
+                out.append({"Name": name,
+                            "IsDir": os.path.isdir(os.path.join(d, name)),
+                            "Size": st.st_size, "ModTime": st.st_mtime})
+            return out
+        if verb == "stat":
+            p = resolve(q.get("path", "/"))
+            if not os.path.exists(p):
+                raise HTTPError(404, f"no such file: {q.get('path')}")
+            st = os.stat(p)
+            return {"Name": os.path.basename(p), "IsDir": os.path.isdir(p),
+                    "Size": st.st_size, "ModTime": st.st_mtime}
+        if verb == "cat":
+            p = resolve(q.get("path", "/"))
+            if not os.path.isfile(p):
+                raise HTTPError(404, f"no such file: {q.get('path')}")
+            with open(p, "rb") as fh:
+                data = fh.read()
+            return self._raw_reply(h, data)
+        if verb == "logs":
+            return self._client_logs(h, q, root)
+        raise HTTPError(404, f"unknown fs verb {verb!r}")
+
+    @staticmethod
+    def _raw_reply(h, data: bytes):
+        h.send_response(200)
+        h.send_header("Content-Type", "application/octet-stream")
+        h.send_header("Content-Length", str(len(data)))
+        h.end_headers()
+        try:
+            h.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        return _STREAMED
+
+    def _client_logs(self, h, q, root: str):
+        """?task=&type=stdout|stderr&offset=&origin=start|end&follow="""
+        import os
+
+        from nomad_tpu.client.logmon import log_size, read_log
+        task = q.get("task", "")
+        kind = q.get("type", "stdout")
+        if kind not in ("stdout", "stderr"):
+            raise HTTPError(400, "type must be stdout or stderr")
+        logs_dir = os.path.join(root, "alloc", "logs")
+        offset = int(q.get("offset", 0))
+        if q.get("origin", "start") == "end":
+            offset = max(0, log_size(logs_dir, task, kind) - offset)
+        if q.get("follow", "") not in ("true", "1"):
+            data, _ = read_log(logs_dir, task, kind, offset)
+            return self._raw_reply(h, data)
+        # follow: chunked stream of appended bytes until timeout/close
+        deadline = time.time() + float(q.get("timeout", 30.0))
+        h.send_response(200)
+        h.send_header("Content-Type", "application/octet-stream")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+        try:
+            while time.time() < deadline:
+                data, offset = read_log(logs_dir, task, kind, offset)
+                if data:
+                    h.wfile.write(hex(len(data))[2:].encode() + b"\r\n"
+                                  + data + b"\r\n")
+                    h.wfile.flush()
+                else:
+                    time.sleep(0.25)
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        return _STREAMED
+
+    def _proxy_fs(self, h, parts, q):
+        """Forward an fs request to the agent on the alloc's node."""
+        import urllib.request
+
+        server = self.agent.server
+        if server is None:
+            raise HTTPError(404, "allocation not on this node")
+        alloc = server.store.alloc_by_id(parts[3])
+        if alloc is None:
+            raise HTTPError(404, f"unknown allocation {parts[3]}")
+        node = server.store.node_by_id(alloc.node_id)
+        addr = getattr(node, "http_addr", "") if node else ""
+        if not addr:
+            raise HTTPError(
+                404, "allocation's node advertises no HTTP address")
+        url = (f"http://{addr}/v1/" + "/".join(parts)
+               + ("?" + urllib.parse.urlencode(q) if q else ""))
+        req = urllib.request.Request(
+            url, headers={"X-Nomad-Forwarded": "1"})
+        # connect BEFORE writing any response bytes: upstream errors
+        # must map to clean statuses, not corrupt a half-sent stream
+        try:
+            resp = urllib.request.urlopen(req, timeout=60.0)
+        except urllib.error.HTTPError as e:
+            raise HTTPError(e.code, e.read().decode(errors="replace"))
+        except Exception as e:                       # noqa: BLE001
+            raise HTTPError(502, f"fs forward to {addr} failed: {e}")
+        try:
+            with resp:
+                h.send_response(resp.status)
+                h.send_header("Content-Type",
+                              resp.headers.get("Content-Type",
+                                               "application/octet-stream"))
+                h.send_header("Transfer-Encoding", "chunked")
+                h.end_headers()
+                while True:
+                    chunk = resp.read(65536)
+                    if not chunk:
+                        break
+                    h.wfile.write(hex(len(chunk))[2:].encode() + b"\r\n"
+                                  + chunk + b"\r\n")
+                    h.wfile.flush()
+                h.wfile.write(b"0\r\n\r\n")
+        except Exception:                            # noqa: BLE001
+            # headers already sent: truncate the stream, never write a
+            # second status line into it
+            pass
+        return _STREAMED
+
     def _h_get_agent(self, h, parts, q):
         if parts[1] == "self":
             cfg = self.agent.config

@@ -225,6 +225,46 @@ class Allocations(_Section):
     def stop(self, alloc_id: str) -> dict:
         return self.c.put(f"/v1/allocation/{alloc_id}/stop", {})
 
+    # ------------------------------------------------------ fs / logs
+
+    def logs(self, alloc_id: str, task: str, type_: str = "stdout",
+             offset: int = 0, origin: str = "start") -> bytes:
+        """One-shot task log read (api/fs.go Logs non-follow)."""
+        return self.c._request(
+            "GET", f"/v1/client/fs/logs/{alloc_id}",
+            {"task": task, "type": type_, "offset": str(offset),
+             "origin": origin}, raw=True)
+
+    def logs_follow(self, alloc_id: str, task: str,
+                    type_: str = "stdout", timeout: float = 30.0):
+        """Generator of appended log chunks (api/fs.go Logs follow)."""
+        import urllib.request
+        url = (f"{self.c.address}/v1/client/fs/logs/{alloc_id}?"
+               + urllib.parse.urlencode(
+                   {"task": task, "type": type_, "follow": "true",
+                    "origin": "end", "offset": "0",
+                    "timeout": str(timeout)}))
+        req = urllib.request.Request(url)
+        if self.c.token:
+            req.add_header("X-Nomad-Token", self.c.token)
+        with urllib.request.urlopen(req, timeout=timeout + 10.0) as resp:
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return
+                yield chunk
+
+    def fs_list(self, alloc_id: str, path: str = "/") -> List[dict]:
+        return self.c.get(f"/v1/client/fs/ls/{alloc_id}", {"path": path})
+
+    def fs_stat(self, alloc_id: str, path: str) -> dict:
+        return self.c.get(f"/v1/client/fs/stat/{alloc_id}",
+                          {"path": path})
+
+    def fs_cat(self, alloc_id: str, path: str) -> bytes:
+        return self.c._request("GET", f"/v1/client/fs/cat/{alloc_id}",
+                               {"path": path}, raw=True)
+
 
 class Deployments(_Section):
     def list(self) -> List[Deployment]:

@@ -110,7 +110,8 @@ class Client:
         loops = [(self._heartbeat_loop, "hb"),
                  (self._heartbeat_stop_loop, "hb-stop"),
                  (self._watch_allocations, "alloc-watch"),
-                 (self._update_pusher, "alloc-update")]
+                 (self._update_pusher, "alloc-update"),
+                 (self._log_janitor_loop, "log-janitor")]
         if self.config.device_fingerprint is not None:
             loops.append((self._device_monitor_loop, "device-fp"))
         for target, name in loops:
@@ -142,6 +143,32 @@ class Client:
             except Exception:                   # noqa: BLE001
                 pass
         return changed
+
+    def _log_janitor_loop(self) -> None:
+        """Rotate oversized task log files written by direct-append
+        drivers (logmon.rotate_copytruncate; the exec executor rotates
+        its own in-process)."""
+        from nomad_tpu.client.logmon import (DEFAULT_MAX_FILE_SIZE,
+                                             DEFAULT_MAX_FILES,
+                                             rotate_copytruncate)
+        import os as _os
+        while not self._stop.wait(10.0):
+            with self._ar_lock:
+                runners = list(self.alloc_runners.values())
+            for ar in runners:
+                tg = ar.task_group()
+                for task in (tg.tasks if tg else []):
+                    lcfg = (task.config or {}).get("logs") or {}
+                    max_size = int(lcfg.get("max_file_size_mb", 0)) \
+                        * 1024 * 1024 or DEFAULT_MAX_FILE_SIZE
+                    max_files = int(lcfg.get("max_files", 0)) \
+                        or DEFAULT_MAX_FILES
+                    logs_dir = ar.alloc_dir.logs_dir()
+                    for kind in ("stdout", "stderr"):
+                        rotate_copytruncate(
+                            _os.path.join(logs_dir,
+                                          f"{task.name}.{kind}"),
+                            max_size, max_files)
 
     def _device_monitor_loop(self) -> None:
         while not self._stop.is_set():

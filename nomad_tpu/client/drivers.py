@@ -177,6 +177,12 @@ class RawExecDriver(Driver):
         args = [str(command)] + [str(a) for a in cfg.get("args", [])]
         logs_dir = os.path.join(os.path.dirname(task_dir), "alloc", "logs")
         os.makedirs(logs_dir, exist_ok=True)
+        # the child appends straight to the log file (O_APPEND): zero
+        # extra processes and the stream survives client restarts.
+        # Rotation is out-of-band — the client's log janitor
+        # copy-truncates oversized files (logmon.rotate_copytruncate),
+        # trading logmon.go's dedicated pump process for the logrotate
+        # copytruncate discipline
         stdout = open(os.path.join(logs_dir, f"{task.name}.stdout"), "ab")
         stderr = open(os.path.join(logs_dir, f"{task.name}.stderr"), "ab")
         try:
@@ -349,6 +355,7 @@ class ExecDriver(Driver):
         os.makedirs(logs_dir, exist_ok=True)
         run_dir = os.path.join(os.path.dirname(task_dir), "exec")
         os.makedirs(run_dir, exist_ok=True)
+        lcfg = cfg.get("logs") or {}
         spec = {
             "id": handle.id[:8],
             "command": str(command),
@@ -357,6 +364,9 @@ class ExecDriver(Driver):
             "cwd": task_dir,
             "stdout": os.path.join(logs_dir, f"{task.name}.stdout"),
             "stderr": os.path.join(logs_dir, f"{task.name}.stderr"),
+            "log_max_size":
+                int(lcfg.get("max_file_size_mb", 10)) * 1024 * 1024,
+            "log_max_files": int(lcfg.get("max_files", 10)),
             "cpu_shares": task.resources.cpu if task.resources else 0,
             "memory_mb": task.resources.memory_mb if task.resources else 0,
             "socket": os.path.join(run_dir, f"{handle.id[:8]}.sock"),
@@ -372,8 +382,9 @@ class ExecDriver(Driver):
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         except OSError as e:
             raise DriverError(f"failed to launch executor: {e}")
-        # readiness: executor writes <spec>.ready once serving
-        deadline = time.time() + 10.0
+        # readiness: executor writes <spec>.ready once serving (generous
+        # deadline: interpreter start stretches under full-machine load)
+        deadline = time.time() + 30.0
         while not os.path.exists(spec_path + ".ready"):
             if proc.poll() is not None:
                 raise DriverError("executor died during startup")

@@ -94,8 +94,14 @@ class Executor:
         self.cg = Cgroup(spec.get("id", str(os.getpid())),
                          int(spec.get("cpu_shares", 0) or 0),
                          int(spec.get("memory_mb", 0) or 0))
-        stdout = open(spec["stdout"], "ab") if spec.get("stdout") else None
-        stderr = open(spec["stderr"], "ab") if spec.get("stderr") else None
+        from nomad_tpu.client.logmon import open_log_pipe
+        max_size = int(spec.get("log_max_size",
+                                10 * 1024 * 1024))
+        max_files = int(spec.get("log_max_files", 10))
+        stdout = open_log_pipe(spec["stdout"], max_size, max_files) \
+            if spec.get("stdout") else None
+        stderr = open_log_pipe(spec["stderr"], max_size, max_files) \
+            if spec.get("stderr") else None
         env = dict(spec.get("env") or {})
         cg = self.cg
 
@@ -112,10 +118,10 @@ class Executor:
             env={**os.environ, **env},
             stdout=stdout, stderr=stderr,
             preexec_fn=_enter_cgroup)
-        if stdout:
-            stdout.close()
-        if stderr:
-            stderr.close()
+        if stdout is not None:
+            os.close(stdout)
+        if stderr is not None:
+            os.close(stderr)
         threading.Thread(target=self._reap, daemon=True).start()
 
     def _reap(self) -> None:

@@ -1,0 +1,236 @@
+"""Log rotation + fs read helpers (reference: client/logmon/logmon.go —
+the per-task process that pumps stdout/stderr through rotating files
+under alloc/logs; client/lib/fifo is the transport there, an os.pipe
+here; and client/fs_endpoint.go's file read/stream primitives).
+
+Writers, two disciplines by process model:
+- the exec driver's detached executor pumps the child's pipe through a
+  RotatingFile in-process (the executor survives client restarts, so
+  the pump does too);
+- raw_exec children append straight to the log file, and the client's
+  log janitor rotates oversized files out-of-band via
+  rotate_copytruncate (an in-client pipe pump would die with the
+  client and SIGPIPE recovered tasks).
+The active file keeps the flat reference name (`<task>.stdout`) so
+existing paths stay valid; rotations move it to `<task>.stdout.1`,
+`.2`, ... (oldest pruned past max_files, with a `.pruned` byte ledger
+keeping logical offsets absolute).
+
+Readers: `log_files()` lists a task's log fragments oldest-first;
+`read_log()` returns bytes at a logical offset spanning fragments —
+the fs endpoint's cat/logs/follow primitives build on it."""
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import List, Optional, Tuple
+
+DEFAULT_MAX_FILE_SIZE = 10 * 1024 * 1024    # logmon's 10MB default
+DEFAULT_MAX_FILES = 10
+
+
+class RotatingFile:
+    """Append-only writer with size-based rotation."""
+
+    def __init__(self, path: str,
+                 max_size: int = DEFAULT_MAX_FILE_SIZE,
+                 max_files: int = DEFAULT_MAX_FILES):
+        self.path = path
+        self.max_size = max(1, max_size)
+        self.max_files = max(1, max_files)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._fh = open(path, "ab")
+        self._size = self._fh.tell()
+        self._lock = threading.Lock()
+
+    def write(self, data: bytes) -> None:
+        with self._lock:
+            self._fh.write(data)
+            # flush per chunk: tail -f readers must see lines as the
+            # task emits them, not at rotation boundaries
+            self._fh.flush()
+            self._size += len(data)
+            if self._size >= self.max_size:
+                self._rotate()
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        nums = _fragment_indexes(self.path)
+        nxt = (nums[-1] + 1) if nums else 1
+        os.replace(self.path, f"{self.path}.{nxt}")
+        nums.append(nxt)
+        # prune oldest beyond max_files (the active file counts as one),
+        # recording the dropped byte count so logical offsets stay
+        # absolute — without the ledger a follower's offset silently
+        # skips data whenever a fragment is pruned
+        pruned = _pruned_bytes(self.path)
+        while len(nums) + 1 > self.max_files:
+            old = nums.pop(0)
+            frag = f"{self.path}.{old}"
+            try:
+                pruned += os.path.getsize(frag)
+                os.unlink(frag)
+            except OSError:
+                pass
+        _write_pruned(self.path, pruned)
+        self._fh = open(self.path, "ab")
+        self._size = 0
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except Exception:                        # noqa: BLE001
+                pass
+
+
+def rotate_copytruncate(path: str,
+                        max_size: int = DEFAULT_MAX_FILE_SIZE,
+                        max_files: int = DEFAULT_MAX_FILES) -> bool:
+    """Out-of-band rotation for files whose writer holds an O_APPEND fd
+    (raw_exec children write the log directly — a restart-safe, zero-
+    process design; an in-process pipe pump would die with the client
+    and SIGPIPE recovered tasks).  logrotate's copytruncate discipline:
+    copy the live file to the next fragment, truncate it in place (the
+    writer's next append lands at the new EOF).  Bytes written between
+    the copy and the truncate can be lost — the standard copytruncate
+    trade-off.  Returns True when a rotation happened."""
+    try:
+        if os.path.getsize(path) < max_size:
+            return False
+    except OSError:
+        return False
+    nums = _fragment_indexes(path)
+    nxt = (nums[-1] + 1) if nums else 1
+    import shutil
+    try:
+        shutil.copyfile(path, f"{path}.{nxt}")
+        with open(path, "ab") as fh:
+            fh.truncate(0)
+    except OSError:
+        return False
+    nums.append(nxt)
+    pruned = _pruned_bytes(path)
+    while len(nums) + 1 > max_files:
+        old = nums.pop(0)
+        frag = f"{path}.{old}"
+        try:
+            pruned += os.path.getsize(frag)
+            os.unlink(frag)
+        except OSError:
+            pass
+    _write_pruned(path, pruned)
+    return True
+
+
+def open_log_pipe(path: str,
+                  max_size: int = DEFAULT_MAX_FILE_SIZE,
+                  max_files: int = DEFAULT_MAX_FILES) -> int:
+    """Create the write end of a logmon pipeline: returns an fd the
+    child process writes to; a daemon pump thread drains it into a
+    RotatingFile at `path`.  The pump exits when the child closes its
+    end (process exit).  Only for callers that outlive the task (the
+    detached executor); client-side callers use rotate_copytruncate."""
+    r, w = os.pipe()
+    rf = RotatingFile(path, max_size, max_files)
+
+    def pump():
+        try:
+            while True:
+                chunk = os.read(r, 65536)
+                if not chunk:
+                    return
+                rf.write(chunk)
+        except OSError:
+            pass
+        finally:
+            os.close(r)
+            rf.close()
+
+    threading.Thread(target=pump, daemon=True,
+                     name=f"logmon-{os.path.basename(path)}").start()
+    return w
+
+
+# ---------------------------------------------------------------- readers
+
+
+def _pruned_bytes(path: str) -> int:
+    try:
+        with open(path + ".pruned") as fh:
+            return int(fh.read().strip() or 0)
+    except (OSError, ValueError):
+        return 0
+
+
+def _write_pruned(path: str, n: int) -> None:
+    try:
+        with open(path + ".pruned", "w") as fh:
+            fh.write(str(n))
+    except OSError:
+        pass
+
+
+def _fragment_indexes(path: str) -> List[int]:
+    d = os.path.dirname(path)
+    base = os.path.basename(path)
+    pat = re.compile(re.escape(base) + r"\.(\d+)$")
+    out = []
+    try:
+        for name in os.listdir(d):
+            m = pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+    except OSError:
+        pass
+    return sorted(out)
+
+
+def log_files(logs_dir: str, task: str, kind: str) -> List[str]:
+    """A task's stdout/stderr fragments, oldest first, active last."""
+    base = os.path.join(logs_dir, f"{task}.{kind}")
+    paths = [f"{base}.{n}" for n in _fragment_indexes(base)]
+    if os.path.exists(base):
+        paths.append(base)
+    return paths
+
+
+def log_size(logs_dir: str, task: str, kind: str) -> int:
+    """Logical size since log start — INCLUDES pruned bytes, so offsets
+    stay absolute across rotation pruning."""
+    base = os.path.join(logs_dir, f"{task}.{kind}")
+    return _pruned_bytes(base) + sum(
+        os.path.getsize(p) for p in log_files(logs_dir, task, kind))
+
+
+def read_log(logs_dir: str, task: str, kind: str, offset: int = 0,
+             limit: Optional[int] = None) -> Tuple[bytes, int]:
+    """Read from the logical concatenation of a task's log fragments.
+    -> (data, next_offset).  Negative offset = from the end (tail).
+    Offsets are absolute since log start; offsets pointing into pruned
+    history resume at the oldest surviving byte."""
+    total = log_size(logs_dir, task, kind)
+    if offset < 0:
+        offset = max(0, total + offset)
+    out = bytearray()
+    pos = _pruned_bytes(os.path.join(logs_dir, f"{task}.{kind}"))
+    offset = max(offset, pos)
+    want = limit if limit is not None else total
+    for p in log_files(logs_dir, task, kind):
+        try:
+            size = os.path.getsize(p)
+        except OSError:
+            continue
+        if pos + size <= offset:
+            pos += size
+            continue
+        start = max(0, offset - pos)
+        with open(p, "rb") as fh:
+            fh.seek(start)
+            chunk = fh.read(want - len(out))
+        out.extend(chunk)
+        pos += size
+        if len(out) >= want:
+            break
+    return bytes(out), offset + len(out)

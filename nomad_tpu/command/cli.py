@@ -42,8 +42,10 @@ class Cli:
         self.api = api
         self.out = out
 
-    def p(self, *args) -> None:
-        print(*args, file=self.out)
+    def p(self, *args, end: str = "\n") -> None:
+        print(*args, file=self.out, end=end)
+        if end != "\n":
+            self.out.flush()
 
     # ------------------------------------------------------------- agent
 
@@ -373,6 +375,48 @@ class Cli:
     def cmd_alloc_stop(self, args) -> int:
         resp = self.api.allocations.stop(args.alloc_id)
         self.p(f"==> Evaluation \"{_short(resp['eval_id'])}\" created")
+        return 0
+
+    def _resolve_task(self, alloc_id: str, task: str) -> str:
+        if task:
+            return task
+        a = self.api.allocations.info(alloc_id)
+        names = sorted((a.task_states or {}).keys())
+        if len(names) != 1:
+            raise SystemExit(
+                f"allocation has {len(names)} tasks; pass one of "
+                f"{names}")
+        return names[0]
+
+    def cmd_alloc_logs(self, args) -> int:
+        """alloc logs [-stderr] [-f] <alloc_id> [task] (reference
+        command/alloc_logs.go over client/fs_endpoint.go)."""
+        kind = "stderr" if args.stderr else "stdout"
+        task = self._resolve_task(args.alloc_id, args.task)
+        if not args.follow:
+            data = self.api.allocations.logs(args.alloc_id, task, kind)
+            self.p(data.decode(errors="replace"), end="")
+            return 0
+        try:
+            for chunk in self.api.allocations.logs_follow(
+                    args.alloc_id, task, kind,
+                    timeout=args.follow_timeout):
+                self.p(chunk.decode(errors="replace"), end="")
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    def cmd_alloc_fs(self, args) -> int:
+        """alloc fs <alloc_id> [path] — ls for dirs, cat for files."""
+        path = args.path or "/"
+        st = self.api.allocations.fs_stat(args.alloc_id, path)
+        if st.get("IsDir"):
+            for e in self.api.allocations.fs_list(args.alloc_id, path):
+                kind = "dir " if e.get("IsDir") else "file"
+                self.p(f"{kind}  {e.get('Size', 0):>10}  {e['Name']}")
+        else:
+            data = self.api.allocations.fs_cat(args.alloc_id, path)
+            self.p(data.decode(errors="replace"), end="")
         return 0
 
     # ------------------------------------------------------------- deployment
@@ -708,6 +752,18 @@ def build_parser() -> argparse.ArgumentParser:
     a = al.add_parser("stop")
     a.add_argument("alloc_id")
     a.set_defaults(fn="cmd_alloc_stop")
+    a = al.add_parser("logs")
+    a.add_argument("alloc_id")
+    a.add_argument("task", nargs="?", default="")
+    a.add_argument("-stderr", action="store_true")
+    a.add_argument("-f", action="store_true", dest="follow")
+    a.add_argument("-follow-timeout", type=float, default=30.0,
+                   dest="follow_timeout")
+    a.set_defaults(fn="cmd_alloc_logs")
+    a = al.add_parser("fs")
+    a.add_argument("alloc_id")
+    a.add_argument("path", nargs="?", default="/")
+    a.set_defaults(fn="cmd_alloc_fs")
 
     dep = sub.add_parser("deployment",
                          help="deployment commands").add_subparsers(

@@ -89,6 +89,10 @@ class Node:
     csi_node_plugins: Dict[str, dict] = field(default_factory=dict)
     csi_controller_plugins: Dict[str, dict] = field(default_factory=dict)
     computed_class: str = ""
+    # advertised agent HTTP address ("host:port") — the server-side fs
+    # endpoints forward alloc fs/log reads here (reference Node.HTTPAddr,
+    # client/fs_endpoint.go forwarding)
+    http_addr: str = ""
     create_index: int = 0
     modify_index: int = 0
 

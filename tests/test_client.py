@@ -120,7 +120,13 @@ def test_raw_exec_driver(tmp_path):
     drv.start_task(h, task, {"FOO": "bar"}, task_dir)
     res = drv.wait_task(h)
     assert res.successful()
-    out = open(os.path.join(ad.logs_dir(), "sh.stdout")).read()
+    # the detached logmon pump drains the pipe asynchronously
+    path = os.path.join(ad.logs_dir(), "sh.stdout")
+    deadline = time.time() + 5.0
+    out = ""
+    while time.time() < deadline and "hello-bar" not in out:
+        out = open(path).read()
+        time.sleep(0.05)
     assert "hello-bar" in out
 
 

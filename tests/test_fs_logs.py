@@ -1,0 +1,186 @@
+"""Log rotation + client fs/logs endpoints (reference client/logmon/
+logmon.go, client/fs_endpoint.go, command/agent/fs_endpoint.go,
+command/alloc_logs.go)."""
+import io
+import os
+import threading
+import time
+
+import pytest
+
+from nomad_tpu.client.logmon import (
+    RotatingFile,
+    log_files,
+    log_size,
+    open_log_pipe,
+    read_log,
+)
+
+
+def test_rotating_file(tmp_path):
+    base = str(tmp_path / "t.stdout")
+    rf = RotatingFile(base, max_size=10, max_files=3)
+    for i in range(8):
+        rf.write(b"x" * 10)      # each write triggers a rotation
+    rf.close()
+    frags = log_files(str(tmp_path), "t", "stdout")
+    # active file + 2 rotated = max_files
+    assert len(frags) == 3
+    # logical size stays absolute: pruned bytes still count
+    assert log_size(str(tmp_path), "t", "stdout") == 80
+    # an offset inside pruned history resumes at the oldest survivor
+    data, nxt = read_log(str(tmp_path), "t", "stdout", 0)
+    assert data == b"x" * 20 and nxt == 80
+
+
+def test_read_log_spans_fragments(tmp_path):
+    base = str(tmp_path / "t.stdout")
+    rf = RotatingFile(base, max_size=4, max_files=10)
+    rf.write(b"abcd")            # rotates
+    rf.write(b"efgh")            # rotates
+    rf.write(b"ij")
+    rf.close()
+    data, nxt = read_log(str(tmp_path), "t", "stdout", 0)
+    assert data == b"abcdefghij" and nxt == 10
+    data, _ = read_log(str(tmp_path), "t", "stdout", 3, limit=4)
+    assert data == b"defg"
+    data, _ = read_log(str(tmp_path), "t", "stdout", -4)
+    assert data == b"ghij"
+
+
+def test_log_pipe_pumps(tmp_path):
+    base = str(tmp_path / "p.stdout")
+    fd = open_log_pipe(base, max_size=1 << 20)
+    os.write(fd, b"hello from the child\n")
+    os.close(fd)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if os.path.exists(base) and os.path.getsize(base) > 0:
+            break
+        time.sleep(0.02)
+    assert open(base, "rb").read() == b"hello from the child\n"
+
+
+@pytest.fixture()
+def dev_agent():
+    from nomad_tpu.agent import Agent, AgentConfig
+    a = Agent(AgentConfig(http_port=0, num_schedulers=2,
+                          heartbeat_ttl=600.0, client_enabled=True))
+    a.start()
+    yield a
+    a.stop()
+
+
+def _run_job(agent, script, job_id=None, run_secs=None):
+    from nomad_tpu.structs.job import Job, Task, TaskGroup
+    job = Job(id=job_id or f"logs-{time.time_ns()}", name="l",
+              type="service",
+              task_groups=[TaskGroup(name="g", count=1, tasks=[
+                  Task(name="t", driver="raw_exec",
+                       config={"command": "/bin/sh",
+                               "args": ["-c", script]})])])
+    job.canonicalize()
+    agent.server.register_job(job)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        allocs = agent.server.store.allocs_by_job("default", job.id)
+        live = [a for a in allocs if a.client_status == "running"]
+        if live:
+            return live[0]
+        time.sleep(0.1)
+    raise AssertionError(
+        [(a.client_status, a.task_states) for a in allocs])
+
+
+def test_fs_logs_endpoints_and_cli(dev_agent):
+    alloc = _run_job(dev_agent,
+                     'echo line-1; echo err-1 >&2; sleep 60')
+    from nomad_tpu.api.client import ApiClient
+    api = ApiClient(dev_agent.http_addr)
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if api.allocations.logs(alloc.id, "t").strip():
+            break
+        time.sleep(0.1)
+    assert api.allocations.logs(alloc.id, "t") == b"line-1\n"
+    assert api.allocations.logs(alloc.id, "t", "stderr") == b"err-1\n"
+
+    # fs ls / stat / cat
+    entries = api.allocations.fs_list(alloc.id, "alloc/logs")
+    names = [e["Name"] for e in entries]
+    assert "t.stdout" in names and "t.stderr" in names
+    assert api.allocations.fs_cat(
+        alloc.id, "alloc/logs/t.stdout") == b"line-1\n"
+    st = api.allocations.fs_stat(alloc.id, "alloc/logs/t.stdout")
+    assert st["Size"] == 7 and not st["IsDir"]
+
+    # sandbox: escaping paths rejected
+    from nomad_tpu.api.client import ApiError
+    with pytest.raises(ApiError):
+        api.allocations.fs_cat(alloc.id, "../../../etc/passwd")
+
+    # CLI one-shot
+    from nomad_tpu.command.cli import main
+    out = io.StringIO()
+    code = main(["-address", dev_agent.http_addr,
+                 "alloc", "logs", alloc.id], out=out)
+    assert code == 0 and out.getvalue() == "line-1\n"
+    out = io.StringIO()
+    code = main(["-address", dev_agent.http_addr,
+                 "alloc", "fs", alloc.id, "alloc/logs"], out=out)
+    assert code == 0 and "t.stdout" in out.getvalue()
+
+
+def test_logs_tail_follow(dev_agent):
+    """tail -f semantics: a follower sees lines appended AFTER it
+    attached (origin=end)."""
+    alloc = _run_job(
+        dev_agent,
+        'echo early; sleep 2; echo late-1; sleep 0.3; echo late-2; '
+        'sleep 60')
+    from nomad_tpu.api.client import ApiClient
+    api = ApiClient(dev_agent.http_addr)
+
+    got = []
+    done = threading.Event()
+
+    def follow():
+        try:
+            for chunk in api.allocations.logs_follow(
+                    alloc.id, "t", timeout=8.0):
+                got.append(chunk)
+                if b"late-2" in b"".join(got):
+                    return
+        finally:
+            done.set()
+
+    t = threading.Thread(target=follow, daemon=True)
+    t.start()
+    assert done.wait(15.0)
+    data = b"".join(got)
+    assert b"late-1\n" in data and b"late-2\n" in data
+
+
+def test_rotate_copytruncate(tmp_path):
+    """The client log janitor's rotation for direct-append writers."""
+    from nomad_tpu.client.logmon import rotate_copytruncate
+    base = str(tmp_path / "t.stdout")
+    # a writer holding an O_APPEND fd, janitor rotating behind it
+    fh = open(base, "ab")
+    fh.write(b"a" * 30)
+    fh.flush()
+    assert rotate_copytruncate(base, max_size=20, max_files=3)
+    assert os.path.getsize(base) == 0
+    fh.write(b"b" * 30)        # O_APPEND lands at new EOF
+    fh.flush()
+    assert os.path.getsize(base) == 30
+    assert rotate_copytruncate(base, max_size=20, max_files=3)
+    fh.write(b"c" * 5)
+    fh.flush()
+    fh.close()
+    data, _ = read_log(str(tmp_path), "t", "stdout", 0)
+    assert data == b"a" * 30 + b"b" * 30 + b"c" * 5
+    assert log_size(str(tmp_path), "t", "stdout") == 65
+    # not over the limit -> no-op
+    assert not rotate_copytruncate(base, max_size=20, max_files=3)
