@@ -227,6 +227,14 @@ def required_capability(parts: List[str], method: str,
         return (CAP_LIST_JOBS if head == "jobs" else CAP_READ_JOB, ns)
     if head in ("allocations", "allocation"):
         return ((CAP_ALLOC_LIFECYCLE if write else CAP_READ_JOB), ns)
+    if head == "client":
+        # /v1/client/fs/* (fs_endpoint.go): logs need read-logs, the
+        # rest of the filesystem needs read-fs; the handler re-checks
+        # against the alloc's own namespace
+        if parts[1:2] == ["fs"]:
+            cap = CAP_READ_LOGS if parts[2:3] == ["logs"] else CAP_READ_FS
+            return (cap, ns)
+        return (f"node:{'write' if write else 'read'}", None)
     if head in ("evaluations", "evaluation", "deployments", "deployment"):
         return ((CAP_SUBMIT_JOB if write else CAP_READ_JOB), ns)
     if head in ("nodes", "node"):

@@ -520,6 +520,16 @@ class HTTPServer:
         if len(parts) < 4 or parts[1] != "fs":
             raise HTTPError(404, "expected /v1/client/fs/<verb>/<alloc>")
         verb, alloc_id = parts[2], parts[3]
+        # re-check the capability against the alloc's OWN namespace when
+        # this agent can see the record (the ?namespace= param is only
+        # the caller's claim, same discipline as the alloc endpoints)
+        if self.agent.server is not None:
+            alloc = self.agent.server.store.alloc_by_id(alloc_id)
+            if alloc is not None:
+                from nomad_tpu.acl.policy import CAP_READ_FS, CAP_READ_LOGS
+                self._require_ns_cap(
+                    h, alloc.namespace,
+                    CAP_READ_LOGS if verb == "logs" else CAP_READ_FS)
         client = self.agent.client
         root = None
         if client is not None:
@@ -548,7 +558,10 @@ class HTTPServer:
                 raise HTTPError(404, f"not a directory: {q.get('path')}")
             out = []
             for name in sorted(os.listdir(d)):
-                st = os.stat(os.path.join(d, name))
+                try:
+                    st = os.lstat(os.path.join(d, name))
+                except OSError:
+                    continue       # raced deletion / dangling symlink
                 out.append({"Name": name,
                             "IsDir": os.path.isdir(os.path.join(d, name)),
                             "Size": st.st_size, "ModTime": st.st_mtime})
@@ -636,12 +649,18 @@ class HTTPServer:
                 404, "allocation's node advertises no HTTP address")
         url = (f"http://{addr}/v1/" + "/".join(parts)
                + ("?" + urllib.parse.urlencode(q) if q else ""))
-        req = urllib.request.Request(
-            url, headers={"X-Nomad-Forwarded": "1"})
+        headers = {"X-Nomad-Forwarded": "1"}
+        token = h.headers.get("X-Nomad-Token", "")
+        if token:
+            headers["X-Nomad-Token"] = token   # ACLs check on both hops
+        req = urllib.request.Request(url, headers=headers)
+        # socket timeout must outlast a quiet follow window, or an idle
+        # tail-follow is silently truncated mid-stream
+        timeout = float(q.get("timeout", 30.0)) + 30.0
         # connect BEFORE writing any response bytes: upstream errors
         # must map to clean statuses, not corrupt a half-sent stream
         try:
-            resp = urllib.request.urlopen(req, timeout=60.0)
+            resp = urllib.request.urlopen(req, timeout=timeout)
         except urllib.error.HTTPError as e:
             raise HTTPError(e.code, e.read().decode(errors="replace"))
         except Exception as e:                       # noqa: BLE001

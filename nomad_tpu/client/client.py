@@ -158,17 +158,26 @@ class Client:
             for ar in runners:
                 tg = ar.task_group()
                 for task in (tg.tasks if tg else []):
-                    lcfg = (task.config or {}).get("logs") or {}
-                    max_size = int(lcfg.get("max_file_size_mb", 0)) \
-                        * 1024 * 1024 or DEFAULT_MAX_FILE_SIZE
-                    max_files = int(lcfg.get("max_files", 0)) \
-                        or DEFAULT_MAX_FILES
-                    logs_dir = ar.alloc_dir.logs_dir()
-                    for kind in ("stdout", "stderr"):
-                        rotate_copytruncate(
-                            _os.path.join(logs_dir,
-                                          f"{task.name}.{kind}"),
-                            max_size, max_files)
+                    # only direct-append drivers: the exec executor owns
+                    # its rotation in-process, and racing it would
+                    # clobber fragments
+                    if task.driver != "raw_exec":
+                        continue
+                    try:
+                        lcfg = (task.config or {}).get("logs") or {}
+                        max_size = int(lcfg.get("max_file_size_mb", 0)) \
+                            * 1024 * 1024 or DEFAULT_MAX_FILE_SIZE
+                        max_files = int(lcfg.get("max_files", 0)) \
+                            or DEFAULT_MAX_FILES
+                        logs_dir = ar.alloc_dir.logs_dir()
+                        for kind in ("stdout", "stderr"):
+                            rotate_copytruncate(
+                                _os.path.join(logs_dir,
+                                              f"{task.name}.{kind}"),
+                                max_size, max_files)
+                    except Exception:                # noqa: BLE001
+                        continue    # one bad logs config must not kill
+                                    # rotation for the whole node
 
     def _device_monitor_loop(self) -> None:
         while not self._stop.is_set():

@@ -579,8 +579,9 @@ def _bulk_loop(capacity, used0, feasible, affinity, has_affinity, desired,
 
     c0 = (used0, coll0, jnp.int32(0), jnp.zeros(N, jnp.int32),
           jnp.array(False), jnp.int32(0))
-    used_f, coll_f, placed, assign, _, _ = jax.lax.while_loop(cond, body, c0)
-    return used_f, coll_f, assign, placed
+    used_f, coll_f, placed, assign, _, waves = \
+        jax.lax.while_loop(cond, body, c0)
+    return used_f, coll_f, assign, placed, waves
 
 
 def _bulk_tail(capacity, used_f, coll_f, feasible, affinity, has_affinity,
@@ -614,7 +615,7 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
     used, col R assign, col R+1 scores, col R+2 scalars in rows 0-2.
     Integers are value-encoded (exact below 2^24); bitcast encodings
     become denormals that TPU hardware flushes to zero."""
-    used_f, coll_f, assign, placed = _bulk_loop(
+    used_f, coll_f, assign, placed, waves = _bulk_loop(
         capacity, used0, feasible, affinity, has_affinity, desired,
         penalty, coll0, demand, count, spread_algorithm, max_waves)
     final_scores, n_eval, n_exh = _bulk_tail(
@@ -623,7 +624,7 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
     as_f = lambda x: x.astype(jnp.float32)
     scalars = jnp.zeros(capacity.shape[0], jnp.float32) \
         .at[0].set(as_f(placed)).at[1].set(as_f(n_eval)) \
-        .at[2].set(as_f(n_exh))
+        .at[2].set(as_f(n_exh)).at[3].set(as_f(waves))
     return jnp.concatenate([used_f, as_f(assign)[:, None],
                             final_scores[:, None], scalars[:, None]],
                            axis=-1)
@@ -713,7 +714,7 @@ def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
         delta_vals = l[3 + R + D:].reshape(D, R)
         delta_mat = jnp.zeros_like(used).at[delta_rows].add(
             delta_vals, mode="drop")
-        used_f, coll_f, assign, placed = _bulk_loop(
+        used_f, coll_f, assign, placed, waves = _bulk_loop(
             capacity, used + delta_mat, feasible, affinity, has_aff,
             desired, penalty, coll0, demand, count, spread_algorithm,
             max_waves)
@@ -723,7 +724,8 @@ def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
         as_f = lambda x: x.astype(jnp.float32)
         out = jnp.concatenate([
             as_f(assign), scores,
-            jnp.stack([as_f(placed), as_f(n_eval), as_f(n_exh)])])
+            jnp.stack([as_f(placed), as_f(n_eval), as_f(n_exh),
+                       as_f(waves)])])
         return used_f - delta_mat, out
 
     used_final, packed = jax.lax.scan(eval_step, used0, (hstack, light))
@@ -733,23 +735,25 @@ def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
 def unpack_bulk_batch(packed: np.ndarray):
     """Host inverse of place_bulk_batch_jit's per-eval rows: returns
     (assign i32[E, N], scores f32[E, N], placed i32[E], n_eval i32[E],
-    n_exh i32[E])."""
-    N = (packed.shape[1] - 3) // 2
+    n_exh i32[E], waves i32[E])."""
+    N = (packed.shape[1] - 4) // 2
     assign = np.rint(packed[:, :N]).astype(np.int32)
     scores = packed[:, N:2 * N]
     s = np.rint(packed[:, 2 * N:]).astype(np.int32)
-    return assign, scores, s[:, 0], s[:, 1], s[:, 2]
+    return assign, scores, s[:, 0], s[:, 1], s[:, 2], s[:, 3]
 
 
 def unpack_bulk(packed: np.ndarray):
     """Host inverse of place_bulk_jit's packed leaf: returns
-    (assign i32[N], placed, n_eval, n_exh, scores f32[N], used f32[N,R])."""
+    (assign i32[N], placed, n_eval, n_exh, scores f32[N], waves,
+    used f32[N,R]) — `used` stays last so `*_, used` callers survive
+    field additions."""
     R = packed.shape[1] - 3
     used = packed[:, :R]
     assign = np.rint(packed[:, R]).astype(np.int32)
     scores = packed[:, R + 1]
-    s = np.rint(packed[:3, R + 2]).astype(np.int32)
-    return assign, int(s[0]), int(s[1]), int(s[2]), scores, used
+    s = np.rint(packed[:4, R + 2]).astype(np.int32)
+    return assign, int(s[0]), int(s[1]), int(s[2]), scores, int(s[3]), used
 
 
 def place_eval(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceResult:

@@ -256,7 +256,7 @@ class PlacementEngine:
                       "max_batch_seen": 0, "tickets_open": 0,
                       "stack_s": 0.0, "put_s": 0.0, "device_s": 0.0,
                       "resolve_s": 0.0, "cache_hits": 0, "cache_misses": 0,
-                      "bulk_evals": 0}
+                      "bulk_evals": 0, "waves": 0, "max_waves_seen": 0}
         self._cache = _DeviceCache()
         # serving readiness: compiled variants persist across processes
         # (utils.enable_compile_cache docstring) — must be set before the
@@ -813,11 +813,12 @@ class PlacementEngine:
             mesh, cap_dev,
             basis, feas, aff, hasa, des, pen, coll, dem, cnt,
             drows, dvals, spread_algorithm=reqs[0].spread_algorithm)
-        assign, scores, placed, n_eval, n_exh, _used = out
+        assign, scores, placed, n_eval, n_exh, waves, _used = out
         self.stats["put_s"] += _time.time() - t0
         self.stats["sharded_evals"] = (
             self.stats.get("sharded_evals", 0) + len(reqs))
-        return (assign, scores, placed, n_eval, n_exh), basis, deltas_per
+        return (assign, scores, placed, n_eval, n_exh, waves), \
+            basis, deltas_per
 
     # ---------------------------------------------------------- bulk path
 
@@ -884,12 +885,17 @@ class PlacementEngine:
         empty for an overflow singleton whose deltas were folded into
         `basis` (re-applying r.deltas there would double-count)."""
         if isinstance(packed, tuple):       # sharded path: raw field tuple
-            assign, scores, placed, n_eval, n_exh = \
+            assign, scores, placed, n_eval, n_exh, waves = \
                 [np.asarray(x) for x in packed]
             assign = assign.astype(np.int32)
         else:
-            assign, scores, placed, n_eval, n_exh = \
+            assign, scores, placed, n_eval, n_exh, waves = \
                 unpack_bulk_batch(np.asarray(packed))
+        # wave-count visibility: a workload that degrades toward one
+        # placement per wave shows up here instead of as mystery latency
+        self.stats["waves"] += int(np.sum(waves))
+        self.stats["max_waves_seen"] = max(self.stats["max_waves_seen"],
+                                           int(np.max(waves, initial=0)))
         u = basis.copy()
         N = u.shape[0]
         for i, r in enumerate(reqs):

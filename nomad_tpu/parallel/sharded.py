@@ -319,8 +319,8 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
     shard, then resolves the global greedy order from two all_gathered
     [N] vectors (wave-start score + per-node run), every device deriving
     the identical per-node placement so only its own rows mutate.
-    Returns (assign i32[E, N], scores f32[E, N], placed/n_eval/n_exh
-    i32[E] each, used_final sharded)."""
+    Returns (assign i32[E, N], scores f32[E, N], placed/n_eval/n_exh/
+    waves i32[E] each, used_final sharded)."""
     from nomad_tpu.ops.place import (
         _bulk_scores,
         bulk_run_lengths as _bulk_run_lengths,
@@ -403,7 +403,7 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
             c0 = (used, coll0, jnp.int32(0),
                   jnp.zeros(n_local, jnp.int32), jnp.array(False),
                   jnp.int32(0))
-            used_f, coll_f, placed, assign, _, _ = \
+            used_f, coll_f, placed, assign, _, waves = \
                 jax.lax.while_loop(cond, wave, c0)
 
             # final scores + metrics via the shared scoring stack
@@ -414,7 +414,8 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
             n_eval = jax.lax.psum(jnp.sum(feasible), "nodes")
             n_exh = jax.lax.psum(jnp.sum(feasible & ~fits_f), "nodes")
             out = (assign, scores, placed.astype(jnp.int32),
-                   n_eval.astype(jnp.int32), n_exh.astype(jnp.int32))
+                   n_eval.astype(jnp.int32), n_exh.astype(jnp.int32),
+                   waves.astype(jnp.int32))
             return used_f - delta_local, out
 
         used_final, outs = jax.lax.scan(
@@ -431,7 +432,7 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
                     P(None, "nodes"), P(None, "nodes"), P(None, None),
                     P(None), P(None, None), P(None, None, None))
         out_specs = (P(None, "nodes"), P(None, "nodes"), P(None), P(None),
-                     P(None), P("nodes", None))
+                     P(None), P(None), P("nodes", None))
         fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                                    out_specs=out_specs, check_vma=False))
         _SERVING_FN_CACHE[key] = fn

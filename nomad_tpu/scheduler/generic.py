@@ -727,7 +727,7 @@ class GenericScheduler:
             bool(g.has_affinity), np.int32(max(g.tg.count, 1)), penalty,
             coll0, g.demand.astype(np.float32), np.int32(len(prs)),
             spread_algorithm=stack.spread_algorithm)
-        assign, placed, n_eval, n_exh, scores, used_f = \
+        assign, placed, n_eval, n_exh, scores, _waves, used_f = \
             unpack_bulk(jax.device_get(packed))
         # device_get arrays are read-only; later host bookkeeping
         # (preemption, sticky adds) mutates the usage matrix in place

@@ -56,7 +56,7 @@ def _run_both(cm, count, cpu=500, mem=256, existing=None):
         g.feasible, g.affinity.astype(np.float32), bool(g.has_affinity),
         np.int32(max(tg.count, 1)), np.zeros(cm.n_rows, bool), coll0,
         g.demand.astype(np.float32), np.int32(count))
-    assign, placed, n_eval, n_exh, scores, used_f = unpack_bulk(
+    assign, placed, n_eval, n_exh, scores, waves, used_f = unpack_bulk(
         jax.device_get(packed))
     return scan_counts, np.asarray(assign).astype(np.int64), int(placed)
 
