@@ -242,7 +242,7 @@ def bench_c2m(n_nodes=10000, n_batch=96, batch_count=1000,
 
 
 def bench_c2m_1m(n_nodes=10000, n_jobs=10000, groups_per_job=10,
-                 group_count=10, workers=16):
+                 group_count=10, workers=48):
     """The north-star C2M at its ACTUAL size (BASELINE.json configs[2] /
     north_star): 1M allocations over 100K task groups on 10K nodes,
     through the full spine.  10,000 jobs x 10 task groups x count 10;

@@ -378,14 +378,15 @@ def _unpack_light(l: jax.Array, S: int, R: int, D: int):
 
 @functools.partial(jax.jit, static_argnames=("dims", "spread_algorithm"))
 def place_batch_packed_jit(capacity: jax.Array,     # f32[N, R]
+                           used0: jax.Array,        # f32[N, R] (device)
                            heavy: tuple,            # E x f32[Lh] (device)
-                           dyn: jax.Array,          # f32[N*R + E*Ll]
+                           dyn: jax.Array,          # f32[E*Ll]
                            dims: tuple,             # (G, N, K, Vp1, S, D)
                            spread_algorithm: bool = False):
     """Chained batch placement over the packed transport: `heavy` is a
     tuple of E device-resident per-eval blocks (cache hits ship nothing),
-    `dyn` is the one always-shipped leaf (usage basis + per-eval light
-    blocks).
+    `used0` the device-resident usage basis (dirty rows shipped by the
+    engine), `dyn` the per-eval light blocks.
 
     Chaining (a `lax.scan` over the eval axis, carrying f32[N, R] usage)
     makes the batch exactly equivalent to sequential worker processing:
@@ -401,8 +402,7 @@ def place_batch_packed_jit(capacity: jax.Array,     # f32[N, R]
     R = capacity.shape[1]
     E = len(heavy)
     hstack = jnp.stack(heavy)
-    used0 = dyn[:N * R].reshape(N, R)
-    light = dyn[N * R:].reshape(E, -1)
+    light = dyn.reshape(E, -1)
 
     def eval_step(used, hl):
         h, l = hl
@@ -644,10 +644,27 @@ def pack_bulk_heavy(feasible, affinity, penalty, coll0) -> np.ndarray:
 
 
 def bulk_heavy_digest(feasible, affinity, penalty, coll0) -> bytes:
+    """Content fingerprint of one bulk request's node-axis tensors.
+    All-zero fields (the common fresh-job case: no affinities, no
+    penalties, no existing co-placements) hash as a 1-byte marker, and
+    bools hash bit-packed — hashing dominated the device-cache HIT path
+    at C2M-1M rates otherwise."""
     import hashlib
     h = hashlib.blake2b(digest_size=16)
-    for a in (feasible, affinity, penalty, coll0):
-        h.update(np.ascontiguousarray(a).tobytes())
+    h.update(np.packbits(np.asarray(feasible, bool)).tobytes())
+    # tag bytes frame each variable-length segment: without them,
+    # (full||marker) and (marker||full) byte streams could collide
+    for tag, a in ((b"\x01", affinity), (b"\x02", coll0)):
+        if np.any(a):
+            h.update(tag + b"F")
+            h.update(np.ascontiguousarray(a).tobytes())
+        else:
+            h.update(tag + b"0")
+    if np.any(penalty):
+        h.update(b"\x03F")
+        h.update(np.packbits(np.asarray(penalty, bool)).tobytes())
+    else:
+        h.update(b"\x030")
     return h.digest()
 
 
@@ -673,12 +690,25 @@ def pack_bulk_light(has_affinity, desired, count, demand, deltas,
     return out
 
 
+# sparse bulk output: assignments of a count<=SPARSE_CAP eval fit in
+# SPARSE_CAP (row, count) pairs + the scores AT those rows.  A dense
+# [N] assign+scores row is ~2N floats of D2H per eval — on a
+# high-latency/low-bandwidth runtime link that transfer, not the
+# kernel, dominated C2M-1M serving.
+SPARSE_CAP = 128
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("D", "spread_algorithm", "max_waves"))
+                   static_argnames=("D", "sparse_out", "spread_algorithm",
+                                    "max_waves"))
 def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
-                         heavy: tuple,           # E x f32[4N] (device)
-                         dyn: jax.Array,         # f32[N*R + E*Ll]
+                         used0: jax.Array,      # f32[N, R] (device basis)
+                         heavy: jax.Array,      # f32[E, 4N] (device, stacked
+                         #   OUTSIDE jit: a 128-element tuple argument
+                         #   costs ~0.4s/call in pjit arg processing)
+                         dyn: jax.Array,         # f32[E*Ll] light blocks
                          D: int,
+                         sparse_out: bool = False,
                          spread_algorithm: bool = False,
                          max_waves: int = 65536):
     """Chained batch of E wavefront bulk evals in ONE dispatch: a
@@ -691,14 +721,17 @@ def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
     wavefront and are backed out of the carry after, matching the
     serialized bulk path where uncommitted stops of one eval are never
     visible to another (only *placements* chain forward, mirroring the
-    engine's in-flight overlay).  Returns (packed f32[E, 2N+3] — per
-    eval assign[N], final_scores[N], then (placed, n_eval, n_exh) — and
-    the final usage, left device-resident)."""
+    engine's in-flight overlay).
+
+    used0 is a DEVICE-RESIDENT basis (engine ships dirty rows only).
+    Returns (packed, used_final device-resident).  packed per eval:
+    dense [2N+4] (assign[N], scores[N], placed/n_eval/n_exh/waves) or,
+    with sparse_out, [3*SPARSE_CAP+4] (rows, counts, row_scores,
+    scalars) — for count <= SPARSE_CAP only."""
     N, R = capacity.shape
-    E = len(heavy)
-    hstack = jnp.stack(heavy)
-    used0 = dyn[:N * R].reshape(N, R)
-    light = dyn[N * R:].reshape(E, -1)
+    E = heavy.shape[0]
+    hstack = heavy
+    light = dyn.reshape(E, -1)
 
     def eval_step(used, hl):
         h, l = hl
@@ -722,24 +755,64 @@ def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
             capacity, used_f, coll_f, feasible, affinity, has_aff,
             desired, penalty, demand, spread_algorithm)
         as_f = lambda x: x.astype(jnp.float32)
-        out = jnp.concatenate([
-            as_f(assign), scores,
-            jnp.stack([as_f(placed), as_f(n_eval), as_f(n_exh),
-                       as_f(waves)])])
+        scalars = jnp.stack([as_f(placed), as_f(n_eval), as_f(n_exh),
+                             as_f(waves)])
+        if sparse_out:
+            # scatter-compaction, NOT top_k: a sort over the node axis
+            # per chained eval (~4ms at 16K rows) would dominate the
+            # whole wavefront.  Nonzero-assign rows get consecutive
+            # slots via a prefix count; everything else lands in the
+            # dropped overflow slot.
+            mask = assign > 0
+            pos = jnp.cumsum(mask) - 1
+            tgt = jnp.where(mask, jnp.minimum(pos, SPARSE_CAP),
+                            SPARSE_CAP)
+            rows_o = jnp.full(SPARSE_CAP + 1, N, jnp.float32) \
+                .at[tgt].set(jnp.arange(N, dtype=jnp.float32))
+            counts_o = jnp.zeros(SPARSE_CAP + 1, jnp.float32) \
+                .at[tgt].set(as_f(assign))
+            scores_o = jnp.zeros(SPARSE_CAP + 1, jnp.float32) \
+                .at[tgt].set(scores)
+            # overflow slot holds junk from every masked-out row; the
+            # sliced-off SPARSE_CAP+1 slot absorbs it
+            out = jnp.concatenate([
+                rows_o[:SPARSE_CAP], counts_o[:SPARSE_CAP],
+                scores_o[:SPARSE_CAP], scalars])
+        else:
+            out = jnp.concatenate([as_f(assign), scores, scalars])
         return used_f - delta_mat, out
 
     used_final, packed = jax.lax.scan(eval_step, used0, (hstack, light))
     return packed, used_final
 
 
-def unpack_bulk_batch(packed: np.ndarray):
-    """Host inverse of place_bulk_batch_jit's per-eval rows: returns
-    (assign i32[E, N], scores f32[E, N], placed i32[E], n_eval i32[E],
-    n_exh i32[E], waves i32[E])."""
-    N = (packed.shape[1] - 4) // 2
+def unpack_bulk_batch(packed: np.ndarray, n_rows: int,
+                      sparse: bool = False):
+    """Host inverse of place_bulk_batch_jit's per-eval rows (both
+    formats; sparse rows densify host-side — numpy, no transfer):
+    returns (assign i32[E, N], scores f32[E, N], placed i32[E],
+    n_eval i32[E], n_exh i32[E], waves i32[E]).  Dense scores default
+    to -inf at unassigned rows in the sparse format (consumers only
+    read scores at assigned rows)."""
+    E, W = packed.shape
+    s = np.rint(packed[:, -4:]).astype(np.int32)
+    if sparse:
+        rows = np.rint(packed[:, :SPARSE_CAP]).astype(np.int64)
+        counts = np.rint(
+            packed[:, SPARSE_CAP:2 * SPARSE_CAP]).astype(np.int32)
+        rscores = packed[:, 2 * SPARSE_CAP:3 * SPARSE_CAP]
+        assign = np.zeros((E, n_rows), np.int32)
+        scores = np.full((E, n_rows), -np.inf, np.float32)
+        e_idx = np.repeat(np.arange(E), SPARSE_CAP)
+        r_idx = rows.ravel()
+        c = counts.ravel()
+        keep = c > 0
+        assign[e_idx[keep], r_idx[keep]] = c[keep]
+        scores[e_idx[keep], r_idx[keep]] = rscores.ravel()[keep]
+        return assign, scores, s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+    N = (W - 4) // 2
     assign = np.rint(packed[:, :N]).astype(np.int32)
     scores = packed[:, N:2 * N]
-    s = np.rint(packed[:, 2 * N:]).astype(np.int32)
     return assign, scores, s[:, 0], s[:, 1], s[:, 2], s[:, 3]
 
 

@@ -38,6 +38,7 @@ import numpy as np
 
 from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS, pad_to_bucket
 from nomad_tpu.ops.place import (
+    SPARSE_CAP,
     PlaceInputs,
     PlaceResult,
     bulk_heavy_digest,
@@ -59,6 +60,22 @@ from nomad_tpu.ops.place import (
 # them into a pre-applied basis instead (rare: deltas are one eval's
 # stops + sticky preplacements).
 _DELTA_BUCKET = 64
+
+# dirty-row buckets for device-basis updates (each size is one small
+# compile of the scatter below)
+_BASIS_ROW_BUCKETS = (64, 512, 4096)
+
+
+_apply_rows_fn = None
+
+
+def _apply_basis_rows_jit(dev, rows, vals):
+    global _apply_rows_fn
+    if _apply_rows_fn is None:
+        import jax
+        _apply_rows_fn = jax.jit(
+            lambda d, r, v: d.at[r].set(v, mode="drop"))
+    return _apply_rows_fn(dev, rows, vals)
 # canonical slot-axis buckets, same rationale: per-eval slot counts vary
 # (retries place the remainder), and every distinct S was a compile
 _S_BUCKETS = (16, 128, 1024)
@@ -215,14 +232,19 @@ class PlacementEngine:
 
     # eval-axis compile buckets: lax.scan compile cost is E-independent
     # (one While body), so buckets only bound padding waste — scan-path
-    # pad evals still run their S slot steps, bulk pads exit immediately
+    # pad evals still run their S slot steps, bulk pads exit immediately.
+    # Bulk chains run longer (pads are free and each dispatch pays a
+    # runtime-link round trip, so more evals per trip wins at C2M-1M
+    # rates); scan chains stay shorter (pad evals still scan S slots).
     E_BUCKETS = (1, 8, 16, 48)
+    BULK_E_BUCKETS = (1, 8, 16, 48, 128, 512)
 
-    def __init__(self, max_batch: int = 48,
+    def __init__(self, max_batch: int = 512,
                  shard_min_nodes: Optional[int] = None):
-        # batches are sliced at max_batch before grouping, so every group
-        # must fit the largest compile bucket
-        self.max_batch = min(max_batch, self.E_BUCKETS[-1])
+        # batches are sliced at max_batch before grouping; scan-path
+        # groups re-chunk to their largest compile bucket below
+        self.max_batch = min(max_batch, self.BULK_E_BUCKETS[-1])
+        self.scan_max_batch = self.E_BUCKETS[-1]
         # multi-chip serving: when >1 device is visible, dispatches whose
         # node axis reaches shard_min_nodes (and divides the device
         # count) route through the ('nodes',)-mesh kernels — the
@@ -258,6 +280,10 @@ class PlacementEngine:
                       "resolve_s": 0.0, "cache_hits": 0, "cache_misses": 0,
                       "bulk_evals": 0, "waves": 0, "max_waves_seen": 0}
         self._cache = _DeviceCache()
+        # (id(cm), N) -> (last shipped host basis, device basis); LRU
+        from collections import OrderedDict
+        self._basis_dev: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._basis_dev_lock = threading.Lock()
         # serving readiness: compiled variants persist across processes
         # (utils.enable_compile_cache docstring) — must be set before the
         # first jit call of this process
@@ -284,17 +310,19 @@ class PlacementEngine:
             self._cv.notify()
         return req.future.result()
 
-    def place_bulk(self, cm, *, feasible, affinity, has_affinity, desired,
-                   penalty, coll0, demand, count,
-                   deltas: Optional[Sequence[Tuple[int, np.ndarray]]] = None,
-                   spread_algorithm: bool = False):
-        """Wavefront bulk placement of `count` identical slots, batched
-        with concurrent bulk evals into one chained device dispatch
-        (ops.place.place_bulk_batch_jit).  Blocks; returns (assign i32[N],
-        placed, nodes_evaluated, nodes_exhausted, scores f32[N],
-        used_after f32[N, R], ticket).  The caller MUST `complete(ticket)`
-        once the plan is submitted (ticket may be None if nothing
-        placed)."""
+    def place_bulk_begin(self, cm, *, feasible, affinity, has_affinity,
+                         desired, penalty, coll0, demand, count,
+                         deltas: Optional[Sequence[Tuple[int, np.ndarray]]]
+                         = None,
+                         spread_algorithm: bool = False) -> Future:
+        """Enqueue a bulk wavefront placement and return its Future
+        (result tuple = place_bulk's).  Lets a multi-group eval submit
+        EVERY eligible group before waiting: the engine chains them (and
+        other workers' evals) into one device dispatch instead of one
+        blocking round trip per group — the C2M-1M path, where jobs are
+        many small groups.  FIFO order + the engine thread's resolve-
+        before-next-dispatch discipline preserve exact chained
+        semantics."""
         req = _BulkRequest(
             cm=cm, feasible=np.asarray(feasible, bool),
             affinity=np.asarray(affinity, np.float32),
@@ -309,7 +337,24 @@ class PlacementEngine:
                 raise RuntimeError("placement engine stopped")
             self._queue.append(req)
             self._cv.notify()
-        return req.future.result()
+        return req.future
+
+    def place_bulk(self, cm, *, feasible, affinity, has_affinity, desired,
+                   penalty, coll0, demand, count,
+                   deltas: Optional[Sequence[Tuple[int, np.ndarray]]] = None,
+                   spread_algorithm: bool = False):
+        """Wavefront bulk placement of `count` identical slots, batched
+        with concurrent bulk evals into one chained device dispatch
+        (ops.place.place_bulk_batch_jit).  Blocks; returns (assign i32[N],
+        placed, nodes_evaluated, nodes_exhausted, scores f32[N], ticket).
+        Callers derive usage from `assign` (sparse) — the engine returns
+        no usage matrix.  The caller MUST `complete(ticket)` once the
+        plan is submitted (ticket may be None if nothing placed)."""
+        return self.place_bulk_begin(
+            cm, feasible=feasible, affinity=affinity,
+            has_affinity=has_affinity, desired=desired, penalty=penalty,
+            coll0=coll0, demand=demand, count=count, deltas=deltas,
+            spread_algorithm=spread_algorithm).result()
 
     def warmup(self, cm, inputs: Optional[PlaceInputs] = None,
                bulk: Optional[dict] = None) -> None:
@@ -357,16 +402,26 @@ class PlacementEngine:
                 jax.block_until_ready(packed)
 
         def bulk_variant(E):
-            breqs = [_BulkRequest(cm=cm, deltas=[],
-                                  spread_algorithm=False,
-                                  future=Future(), **bulk)
-                     for _ in range(E)]
-            if mesh is not None:
-                out, _b, _d = self._dispatch_bulk_group_sharded(breqs, mesh)
-                jax.block_until_ready(out)
-            else:
-                packed, _basis, _d = self._dispatch_bulk_group(breqs)
-                jax.block_until_ready(packed)
+            # separate compiles serving mixes: sparse vs dense output
+            # (count <=/> SPARSE_CAP) x delta-free (D=0) vs delta-
+            # carrying (D=_DELTA_BUCKET) light blocks
+            dummy_delta = [(0, np.zeros(NUM_RESOURCE_DIMS, np.float32))]
+            for count in {min(bulk["count"], SPARSE_CAP),
+                          max(bulk["count"], SPARSE_CAP + 1)}:
+                for deltas in ([], dummy_delta):
+                    spec = dict(bulk, count=count)
+                    breqs = [_BulkRequest(cm=cm, deltas=list(deltas),
+                                          spread_algorithm=False,
+                                          future=Future(), **spec)
+                             for _ in range(E)]
+                    if mesh is not None:
+                        out, _b, _d = self._dispatch_bulk_group_sharded(
+                            breqs, mesh)
+                        jax.block_until_ready(out)
+                    else:
+                        packed, _basis, _d = \
+                            self._dispatch_bulk_group(breqs)
+                        jax.block_until_ready(packed)
 
         # XLA compiles release the GIL and run concurrently per variant,
         # cutting the grid from the sum of compile times toward the max.
@@ -378,7 +433,7 @@ class PlacementEngine:
         thunks = [(scan_variant, (E, v))
                   for E in self.E_BUCKETS for v in input_variants]
         if bulk is not None:
-            thunks += [(bulk_variant, (E,)) for E in self.E_BUCKETS]
+            thunks += [(bulk_variant, (E,)) for E in self.BULK_E_BUCKETS]
         workers = int(os.environ.get("NOMAD_TPU_WARM_THREADS", "4"))
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(
@@ -499,6 +554,42 @@ class PlacementEngine:
 
     # ------------------------------------------------------------- overlay
 
+    def _device_basis(self, cm, basis: np.ndarray):
+        """Device-resident usage basis, updated by DIRTY ROWS only.
+
+        The basis (cm.used + overlay) mutates a few hundred rows per
+        plan cycle; re-shipping the full [N, R] matrix every dispatch
+        was the dominant H2D cost on the high-latency runtime link
+        (C2M-1M: ~0.5s/dispatch).  Diff against the last-shipped host
+        copy, scatter the changed rows into the device copy (bucketed
+        pad, mode=drop), full-ship only on shape change or >25%% churn."""
+        import jax
+        key = (id(cm), basis.shape[0])
+        with self._basis_dev_lock:
+            last, dev = self._basis_dev.get(key, (None, None))
+            B = None
+            if last is not None:
+                changed = np.nonzero(np.any(last != basis, axis=1))[0]
+                if changed.size == 0:
+                    self._basis_dev.move_to_end(key)
+                    return dev
+                if changed.size <= basis.shape[0] // 4:
+                    B = next((b for b in _BASIS_ROW_BUCKETS
+                              if b >= changed.size), None)
+            if B is None:
+                dev = jax.device_put(basis)      # first use / large churn
+            else:
+                rows = np.full(B, basis.shape[0], np.int32)
+                rows[:changed.size] = changed
+                vals = np.zeros((B, basis.shape[1]), np.float32)
+                vals[:changed.size] = basis[changed]
+                dev = _apply_basis_rows_jit(dev, rows, vals)
+            self._basis_dev[key] = (basis.copy(), dev)
+            self._basis_dev.move_to_end(key)
+            while len(self._basis_dev) > 4:      # stale cm epochs (LRU)
+                self._basis_dev.popitem(last=False)
+            return dev
+
     def _basis_for(self, cm) -> np.ndarray:
         """cm.used + in-flight overlay (copy).  The committed matrix is
         copied under ITS owner's lock: a copy taken mid-commit would see
@@ -597,7 +688,7 @@ class PlacementEngine:
 
         if isinstance(reqs[0], _BulkRequest):
             mesh = self._mesh_for(reqs[0].feasible.shape[0])
-            for part in self._split_bulk(reqs):
+            for part in self._split_bulk(reqs, sharded=mesh is not None):
                 if mesh is not None:
                     packed, basis, dper = \
                         self._dispatch_bulk_group_sharded(part, mesh)
@@ -641,12 +732,17 @@ class PlacementEngine:
                 self._run_single(r)
             self.stats["single_evals"] += len(reqs)
             return
-        if mesh is not None:
-            packed = self._dispatch_group_sharded(reqs, mesh)
-        else:
-            packed = self._dispatch_group(reqs)
-        self.stats["batched_evals"] += len(reqs)
-        self._fetch_resolve_scan(reqs, packed)
+        # scan chains cap at their own bucket (queue slices can exceed it
+        # now that bulk chains run longer); chunks chain through the
+        # overlay between dispatches
+        for i in range(0, len(reqs), self.scan_max_batch):
+            chunk = reqs[i:i + self.scan_max_batch]
+            if mesh is not None:
+                packed = self._dispatch_group_sharded(chunk, mesh)
+            else:
+                packed = self._dispatch_group(chunk)
+            self.stats["batched_evals"] += len(chunk)
+            self._fetch_resolve_scan(chunk, packed)
 
     def _fetch_resolve_scan(self, reqs: List[_Request], packed) -> None:
         import jax
@@ -771,7 +867,7 @@ class PlacementEngine:
 
         cm = reqs[0].cm
         N = reqs[0].feasible.shape[0]
-        E = next(b for b in self.E_BUCKETS if b >= len(reqs))
+        E = next(b for b in self.BULK_E_BUCKETS if b >= len(reqs))
         capacity = cm.capacity[:N]
         basis = self._basis_for(cm)[:N]
         deltas_per = [r.deltas for r in reqs]
@@ -822,33 +918,49 @@ class PlacementEngine:
 
     # ---------------------------------------------------------- bulk path
 
-    def _split_bulk(self, reqs: List[_BulkRequest]):
+    def _split_bulk(self, reqs: List[_BulkRequest], sharded: bool = False):
         # oversized-delta requests go alone so their deltas can fold into
-        # the part's private basis copy (fixed delta bucket, no compile)
-        fits, overflow = [], []
+        # the part's private basis copy (fixed delta bucket, no compile);
+        # small-count (sparse-output) and large-count (dense) requests
+        # split so a part compiles one output format and small evals
+        # never pay the dense [2N] D2H row
+        # ...and delta-free requests (the fresh-placement common case)
+        # split from delta-carrying ones: their D=0 light block is ~50x
+        # smaller, which matters at 512-eval chains on a slow link.
+        # The sharded kernel has ONE (dense, fixed-D) format — splitting
+        # there would only multiply mesh round trips.
+        fits_s0, fits_s, fits_d, overflow = [], [], [], []
         for r in reqs:
-            (overflow if len(r.deltas) > _DELTA_BUCKET else fits).append(r)
+            if len(r.deltas) > _DELTA_BUCKET:
+                overflow.append(r)
+            elif not sharded and r.count <= SPARSE_CAP:
+                (fits_s0 if not r.deltas else fits_s).append(r)
+            else:
+                fits_d.append(r)
         for r in overflow:
             yield [r]
-        for i in range(0, len(fits), self.max_batch):
-            yield fits[i:i + self.max_batch]
+        for fits in (fits_s0, fits_s, fits_d):
+            for i in range(0, len(fits), self.max_batch):
+                yield fits[i:i + self.max_batch]
 
     def _dispatch_bulk_group(self, reqs: List[_BulkRequest]):
         import jax
 
         cm = reqs[0].cm
         N = reqs[0].feasible.shape[0]
-        E = next(b for b in self.E_BUCKETS if b >= len(reqs))
+        E = next(b for b in self.BULK_E_BUCKETS if b >= len(reqs))
         # rows are stable across matrix re-bucketing (growth only pads
         # the node axis), so the enqueue-time world is the prefix slice
         capacity = cm.capacity[:N]
         basis = self._basis_for(cm)[:N]
-        D = _DELTA_BUCKET
         deltas_per = [r.deltas for r in reqs]
-        if len(reqs) == 1 and len(reqs[0].deltas) > D:
+        if len(reqs) == 1 and len(reqs[0].deltas) > _DELTA_BUCKET:
             # singleton overflow part (_split_bulk): fold into the
             # private basis copy instead of forking a compile variant
             deltas_per = [_fold_overflow(basis, reqs[0].deltas)]
+        # D=0 when nothing ships deltas (the fresh-placement common
+        # case; _split_bulk separates delta-free parts)
+        D = _DELTA_BUCKET if any(deltas_per) else 0
 
         t0 = _time.time()
         lights = [pack_bulk_light(r.has_affinity, r.desired, r.count,
@@ -859,18 +971,31 @@ class PlacementEngine:
             # padded evals have count=0: the wavefront loop exits at once
             lights += [np.zeros(Ll, np.float32)] * (E - len(reqs))
         basis = np.ascontiguousarray(basis, dtype=np.float32)
-        dyn = np.concatenate([basis.ravel()] + lights)
+        dyn = np.concatenate(lights)
         self.stats["stack_s"] += _time.time() - t0
         t0 = _time.time()
         cap_dev = self._cache.capacity(capacity)
+        used_dev = self._device_basis(cm, basis)
+        self.stats["put_basis_s"] = self.stats.get("put_basis_s", 0.0) \
+            + (_time.time() - t0)
+        t1 = _time.time()
         heavy = [self._cache.bulk_heavy(r) for r in reqs]
         heavy += [heavy[0]] * (E - len(reqs))
+        self.stats["put_heavy_s"] = self.stats.get("put_heavy_s", 0.0) \
+            + (_time.time() - t1)
         self.stats["cache_hits"] = self._cache.hits
         self.stats["cache_misses"] = self._cache.misses
+        t1 = _time.time()
         dyn_dev = jax.device_put(dyn)
+        sparse = all(r.count <= SPARSE_CAP for r in reqs)
+        import jax.numpy as jnp
+        hstack = jnp.stack(heavy)     # on-device; one array argument
         packed, _used_final = place_bulk_batch_jit(
-            cap_dev, tuple(heavy), dyn_dev, D,
+            cap_dev, used_dev, hstack, dyn_dev, D,
+            sparse_out=sparse,
             spread_algorithm=reqs[0].spread_algorithm)
+        self.stats["put_kernel_s"] = self.stats.get("put_kernel_s", 0.0) \
+            + (_time.time() - t1)
         self.stats["put_s"] += _time.time() - t0
         return packed, basis, deltas_per
 
@@ -889,30 +1014,27 @@ class PlacementEngine:
                 [np.asarray(x) for x in packed]
             assign = assign.astype(np.int32)
         else:
+            sparse = all(r.count <= SPARSE_CAP for r in reqs)
             assign, scores, placed, n_eval, n_exh, waves = \
-                unpack_bulk_batch(np.asarray(packed))
+                unpack_bulk_batch(np.asarray(packed), basis.shape[0],
+                                  sparse=sparse)
         # wave-count visibility: a workload that degrades toward one
         # placement per wave shows up here instead of as mystery latency
         self.stats["waves"] += int(np.sum(waves))
         self.stats["max_waves_seen"] = max(self.stats["max_waves_seen"],
                                            int(np.max(waves, initial=0)))
-        u = basis.copy()
-        N = u.shape[0]
         for i, r in enumerate(reqs):
-            own = u.copy()
-            for row, vec in deltas_per[i]:
-                if row < N:
-                    own[row] += vec
-            placements = np.outer(assign[i].astype(np.float32), r.demand)
-            own += placements
-            u += placements
+            # sparse contributions only — no per-request [N, R] copies:
+            # at 512-eval chains those copies dominated resolve, and the
+            # scheduler reconstructs its cumulative usage from assigns
+            rows = np.flatnonzero(assign[i])
             contribs = [(int(row), r.demand * float(assign[i][row]))
-                        for row in np.flatnonzero(assign[i])]
+                        for row in rows]
             ticket = self.register_external(r.cm, contribs) \
                 if contribs else None
             r.future.set_result(
                 (assign[i], int(placed[i]), int(n_eval[i]),
-                 int(n_exh[i]), scores[i], own, ticket))
+                 int(n_exh[i]), scores[i], ticket))
 
     def _run_single(self, r: _Request) -> None:
         """Lone request: packed E=1 dispatch through the same device
@@ -976,21 +1098,21 @@ class PlacementEngine:
         Ll = lights[0].shape[0]
         if E > len(reqs):
             lights += [np.zeros(Ll, np.float32)] * (E - len(reqs))
-        dyn = np.concatenate(
-            [np.ascontiguousarray(basis, dtype=np.float32).ravel()]
-            + lights)
+        basis = np.ascontiguousarray(basis, dtype=np.float32)
+        dyn = np.concatenate(lights)
         self.stats["stack_s"] += _time.time() - t0
         # cache resolution inside the put window: misses device_put the
         # heavy bytes, and that transfer cost belongs in put_s
         t0 = _time.time()
         cap_dev = self._cache.capacity(capacity)
+        used_dev = self._device_basis(reqs[0].cm, basis)
         heavy = [self._cache.heavy(r.inputs) for r in reqs]
         heavy += [heavy[0]] * (E - len(reqs))   # pads place nothing
         self.stats["cache_hits"] = self._cache.hits
         self.stats["cache_misses"] = self._cache.misses
         dyn_dev = jax.device_put(dyn)
         packed, _used_final = place_batch_packed_jit(
-            cap_dev, tuple(heavy), dyn_dev, (G, N, K, Vp1, S, D),
+            cap_dev, used_dev, tuple(heavy), dyn_dev, (G, N, K, Vp1, S, D),
             spread_algorithm=reqs[0].spread_algorithm)
         self.stats["put_s"] += _time.time() - t0
         return packed

@@ -378,20 +378,25 @@ class GenericScheduler:
                         continue
             slot_requests.append(pr)
 
-        # --- bulk path: groups with MANY identical slots and no
+        # --- bulk path: groups of identical slots with no
         # placement-coupled constraints (spreads / distinct_*) place via
         # the wavefront kernel in O(waves) steps instead of an
         # O(slots) scan — the C2M-scale path (ops.place.place_bulk_jit).
-        # Concurrent bulk evals coalesce into one chained device dispatch
-        # (engine.place_bulk -> place_bulk_batch_jit), so the threshold
-        # only guards against wavefront overhead on tiny counts where the
-        # O(slots) scan is just as cheap.
-        BULK_MIN = 64
+        # The eval submits EVERY eligible group before waiting
+        # (place_bulk_begin), so a many-small-group job (the C2M-1M
+        # shape: 10 groups x count 10) is ONE chained device dispatch
+        # batched with other workers' evals, not one blocking round trip
+        # per group; FIFO + the engine's resolve-before-next-dispatch
+        # keep group g+1 scoring against g's placements.
+        BULK_MIN = 2
         by_group: Dict[int, List[PlacementRequest]] = {}
         for pr in slot_requests:
             by_group.setdefault(tg_index[pr.task_group], []).append(pr)
         bulk_results: List[Tuple[int, List[PlacementRequest], object]] = []
         scan_requests: List[PlacementRequest] = []
+        from nomad_tpu.parallel.engine import get_engine
+        eng = get_engine()
+        pending_bulk: List[Tuple[int, List[PlacementRequest], object]] = []
         for gi, prs in by_group.items():
             g = groups[gi]
             from nomad_tpu.scheduler.stack import group_dynamic_port_count
@@ -406,16 +411,34 @@ class GenericScheduler:
             if not eligible:
                 scan_requests.extend(prs)
                 continue
+            if eng is not None:
+                fut = self._place_bulk_begin(eng, cm, g, prs,
+                                             allocs_by_tg, penalty_nodes,
+                                             deltas, stack)
+                pending_bulk.append((gi, prs, fut))
+                continue
             bulk, ticket = self._place_bulk(cm, job, g, prs, allocs_by_tg,
                                             penalty_nodes, deltas, stack)
             bulk_results.append((gi, prs, bulk))
             if ticket is not None:
                 self._ext_tickets.append(ticket)
-            # subsequent groups + host bookkeeping see this usage (the
-            # engine sees it through the overlay ticket, NOT deltas —
-            # deltas stay stops/preplacements only, or the engine would
-            # double-count)
-            assign, _placed, _ne, _nx, _scores, used = bulk
+        for gi, prs, fut in pending_bulk:
+            assign, placed, n_eval, n_exh, scores, ticket = fut.result()
+            bulk_results.append(
+                (gi, prs, (assign, placed, n_eval, n_exh, scores)))
+            if ticket is not None:
+                self._ext_tickets.append(ticket)
+        # cumulative usage for the scan path + host bookkeeping: apply
+        # EVERY bulk group's placements (engine dispatch may reorder
+        # parts, so no single returned matrix is complete; the engine
+        # itself sees this usage through the overlay tickets)
+        if bulk_results:
+            used = used.copy()
+            for gi, _prs, bulk in bulk_results:
+                assign = bulk[0]
+                d = groups[gi].demand.astype(np.float32)
+                for row in np.flatnonzero(assign):
+                    used[row] += d * float(assign[row])
         slot_requests = scan_requests
 
         slots = [tg_index[pr.task_group] for pr in slot_requests]
@@ -644,7 +667,7 @@ class GenericScheduler:
 
         # bulk-kernel placements: expand per-node counts onto requests
         for gi, prs, bulk in bulk_results:
-            assign, placed, n_eval, n_exh, bscores, _used_f = bulk
+            assign, placed, n_eval, n_exh, bscores = bulk
             target_rows: List[int] = []
             for row in np.flatnonzero(assign):
                 target_rows.extend([int(row)] * int(assign[row]))
@@ -676,6 +699,37 @@ class GenericScheduler:
                              alt_rows=alts)
                     account_device_evictions(row, extra)
 
+    @staticmethod
+    def _bulk_node_fields(cm, g, allocs_by_tg, penalty_nodes):
+        """(penalty bool[N], coll0 i32[N]) for one bulk group."""
+        N = cm.n_rows
+        penalty = np.zeros(N, bool)
+        for nid in (penalty_nodes or {}).get(g.tg.name, ()):
+            row = cm.row_of.get(nid)
+            if row is not None:
+                penalty[row] = True
+        coll0 = np.zeros(N, np.int32)
+        for a in allocs_by_tg.get(g.tg.name, []):
+            row = cm.row_of.get(a.node_id)
+            if row is not None:
+                coll0[row] += 1
+        return penalty, coll0
+
+    def _place_bulk_begin(self, eng, cm, g, prs, allocs_by_tg,
+                          penalty_nodes, deltas, stack):
+        """Enqueue one group's wavefront placement; returns the engine
+        Future (see engine.place_bulk_begin for ordering semantics)."""
+        penalty, coll0 = self._bulk_node_fields(cm, g, allocs_by_tg,
+                                                penalty_nodes)
+        return eng.place_bulk_begin(
+            cm, feasible=g.feasible,
+            affinity=g.affinity.astype(np.float32),
+            has_affinity=bool(g.has_affinity),
+            desired=max(g.tg.count, 1), penalty=penalty,
+            coll0=coll0, demand=g.demand.astype(np.float32),
+            count=len(prs), deltas=deltas,
+            spread_algorithm=stack.spread_algorithm)
+
     def _place_bulk(self, cm, job, g, prs, allocs_by_tg, penalty_nodes,
                     deltas, stack):
         """Wavefront placement of len(prs) identical slots of group `g`.
@@ -692,19 +746,11 @@ class GenericScheduler:
 
         eng = get_engine()
         N = cm.n_rows
-        penalty = np.zeros(N, bool)
-        for nid in (penalty_nodes or {}).get(g.tg.name, ()):
-            row = cm.row_of.get(nid)
-            if row is not None:
-                penalty[row] = True
-        coll0 = np.zeros(N, np.int32)
-        for a in allocs_by_tg.get(g.tg.name, []):
-            row = cm.row_of.get(a.node_id)
-            if row is not None:
-                coll0[row] += 1
+        penalty, coll0 = self._bulk_node_fields(cm, g, allocs_by_tg,
+                                                penalty_nodes)
 
         if eng is not None:
-            assign, placed, n_eval, n_exh, scores, used_f, ticket = \
+            assign, placed, n_eval, n_exh, scores, ticket = \
                 eng.place_bulk(
                     cm, feasible=g.feasible,
                     affinity=g.affinity.astype(np.float32),
@@ -713,8 +759,7 @@ class GenericScheduler:
                     coll0=coll0, demand=g.demand.astype(np.float32),
                     count=len(prs), deltas=deltas,
                     spread_algorithm=stack.spread_algorithm)
-            return ((assign, placed, n_eval, n_exh, scores, used_f),
-                    ticket)
+            return ((assign, placed, n_eval, n_exh, scores), ticket)
 
         base = cm.used.copy()
         for row, vec in deltas:       # this eval's stops/preplacements
@@ -727,12 +772,10 @@ class GenericScheduler:
             bool(g.has_affinity), np.int32(max(g.tg.count, 1)), penalty,
             coll0, g.demand.astype(np.float32), np.int32(len(prs)),
             spread_algorithm=stack.spread_algorithm)
-        assign, placed, n_eval, n_exh, scores, _waves, used_f = \
+        assign, placed, n_eval, n_exh, scores, _waves, _used_f = \
             unpack_bulk(jax.device_get(packed))
-        # device_get arrays are read-only; later host bookkeeping
-        # (preemption, sticky adds) mutates the usage matrix in place
         return ((assign, int(placed), int(n_eval), int(n_exh),
-                 np.asarray(scores), np.array(used_f)), None)
+                 np.asarray(scores)), None)
 
     def _fail_placement(self, pr: PlacementRequest, metric: AllocMetric,
                         reason: str) -> None:

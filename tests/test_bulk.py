@@ -185,8 +185,7 @@ def test_engine_bulk_batch_matches_serial():
         # chained: total assignment counts equal the serial totals and
         # respect capacity
         total = np.zeros(N, np.int64)
-        for assign, placed, n_eval, n_exh, scores, used_after, ticket \
-                in results:
+        for assign, placed, n_eval, n_exh, scores, ticket in results:
             assert placed == 12
             total += assign
             engine.complete(ticket)
@@ -216,7 +215,7 @@ def test_engine_bulk_overflow_deltas_not_double_counted():
 
     engine = PlacementEngine()
     try:
-        assign, placed, n_eval, n_exh, scores, used_after, ticket = \
+        assign, placed, n_eval, n_exh, scores, ticket = \
             engine.place_bulk(
                 cm, feasible=np.ones(N, bool),
                 affinity=np.zeros(N, np.float32), has_affinity=False,
@@ -224,11 +223,12 @@ def test_engine_bulk_overflow_deltas_not_double_counted():
                 coll0=np.zeros(N, np.int32), demand=demand, count=4,
                 deltas=deltas)
         assert placed == 4
-        expected = cm.used.astype(np.float32).copy()
-        for row, v in deltas:
-            expected[row] += v
-        expected += np.outer(assign.astype(np.float32), demand)
-        np.testing.assert_allclose(used_after, expected, rtol=1e-6)
+        # the in-flight overlay must carry the PLACEMENTS only — folded
+        # deltas (this eval's private stops) never register there
+        overlay = engine._overlays[id(cm)]
+        expected = np.outer(assign.astype(np.float32), demand)
+        np.testing.assert_allclose(overlay[:, :expected.shape[1]],
+                                   expected, rtol=1e-6)
         engine.complete(ticket)
     finally:
         engine.stop()

@@ -187,7 +187,7 @@ def test_engine_sharded_serving_parity():
             zero, bg.demand.astype(np.float32), np.int32(30))
         ref_assign, ref_placed, *_ = unpack_bulk(jax.device_get(packed))
 
-        assign, placed, n_eval, n_exh, scores, used_after, tkt = \
+        assign, placed, n_eval, n_exh, scores, tkt = \
             eng.place_bulk(cm, feasible=bg.feasible,
                            affinity=bg.affinity, has_affinity=bg.has_affinity,
                            desired=30, penalty=np.zeros(N, bool),
