@@ -1,0 +1,107 @@
+"""Minimal read-only web UI (the honest stand-in for the reference's
+Ember app, ui/app/ ~34k LoC): one dependency-free HTML page served at
+/ui that polls the existing /v1 API (jobs, nodes, allocations,
+deployments, members) and renders live tables.  Everything it shows
+comes through the same HTTP API any client uses — no private hooks."""
+
+UI_HTML = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>nomad-tpu</title>
+<style>
+  :root { --bg:#0f1419; --panel:#171d24; --fg:#d7dde4; --dim:#8594a5;
+          --acc:#22b573; --warn:#e0a030; --bad:#e05252; --line:#252d37; }
+  * { box-sizing:border-box; }
+  body { margin:0; font:14px/1.5 ui-monospace,SFMono-Regular,Menlo,monospace;
+         background:var(--bg); color:var(--fg); }
+  header { display:flex; align-items:baseline; gap:16px;
+           padding:14px 22px; border-bottom:1px solid var(--line); }
+  header h1 { font-size:16px; margin:0; color:var(--acc); }
+  header .stat { color:var(--dim); }
+  header .stat b { color:var(--fg); }
+  main { padding:18px 22px; display:grid; gap:20px; }
+  section { background:var(--panel); border:1px solid var(--line);
+            border-radius:6px; padding:12px 16px; }
+  h2 { font-size:13px; margin:0 0 8px; text-transform:uppercase;
+       letter-spacing:.08em; color:var(--dim); }
+  table { width:100%; border-collapse:collapse; }
+  th, td { text-align:left; padding:4px 10px 4px 0; white-space:nowrap;
+           overflow:hidden; text-overflow:ellipsis; max-width:320px; }
+  th { color:var(--dim); font-weight:normal; border-bottom:1px solid
+       var(--line); }
+  .ok   { color:var(--acc); }
+  .warn { color:var(--warn); }
+  .bad  { color:var(--bad); }
+  .dim  { color:var(--dim); }
+  #err { color:var(--bad); padding:4px 22px; display:none; }
+</style>
+</head>
+<body>
+<header>
+  <h1>nomad-tpu</h1>
+  <span class="stat">leader <b id="leader">-</b></span>
+  <span class="stat">nodes <b id="n-nodes">-</b></span>
+  <span class="stat">jobs <b id="n-jobs">-</b></span>
+  <span class="stat">allocs <b id="n-allocs">-</b></span>
+  <span class="stat dim" id="updated"></span>
+</header>
+<div id="err"></div>
+<main>
+  <section><h2>Jobs</h2><table id="jobs"></table></section>
+  <section><h2>Allocations</h2><table id="allocs"></table></section>
+  <section><h2>Nodes</h2><table id="nodes"></table></section>
+  <section><h2>Deployments</h2><table id="deploys"></table></section>
+</main>
+<script>
+const get = p => fetch(p).then(r => { if (!r.ok) throw new Error(p + ": " +
+  r.status); return r.json(); });
+const cls = s => ({running:"ok", ready:"ok", complete:"dim",
+  successful:"ok", pending:"warn", initializing:"warn", failed:"bad",
+  down:"bad", lost:"bad", dead:"dim"})[s] || "";
+const cell = v => `<td>${v == null ? "" : v}</td>`;
+const scell = s => `<td class="${cls(s)}">${s || ""}</td>`;
+const short = id => (id || "").slice(0, 8);
+function render(tbl, head, rows) {
+  document.getElementById(tbl).innerHTML =
+    "<tr>" + head.map(h => `<th>${h}</th>`).join("") + "</tr>" +
+    rows.map(r => "<tr>" + r.join("") + "</tr>").join("");
+}
+async function tick() {
+  try {
+    const [jobs, nodes, allocs, deploys, leader] = await Promise.all([
+      get("/v1/jobs"), get("/v1/nodes"), get("/v1/allocations"),
+      get("/v1/deployments"), get("/v1/status/leader")]);
+    document.getElementById("err").style.display = "none";
+    document.getElementById("leader").textContent = leader || "-";
+    document.getElementById("n-nodes").textContent = nodes.length;
+    document.getElementById("n-jobs").textContent = jobs.length;
+    document.getElementById("n-allocs").textContent = allocs.length;
+    document.getElementById("updated").textContent =
+      "updated " + new Date().toLocaleTimeString();
+    render("jobs", ["ID", "Type", "Priority", "Status"],
+      jobs.slice(0, 200).map(j => [cell(j.ID), cell(j.Type),
+        cell(j.Priority), scell(j.Status)]));
+    render("allocs", ["ID", "Job", "Group", "Node", "Desired", "Client"],
+      allocs.slice(0, 200).map(a => [cell(short(a.ID)), cell(a.JobID),
+        cell(a.TaskGroup), cell(short(a.NodeID)),
+        scell(a.DesiredStatus), scell(a.ClientStatus)]));
+    render("nodes", ["ID", "Name", "DC", "Class", "Status", "Eligibility"],
+      nodes.slice(0, 200).map(n => [cell(short(n.ID)), cell(n.Name),
+        cell(n.Datacenter), cell(n.NodeClass || "-"), scell(n.Status),
+        scell(n.SchedulingEligibility)]));
+    render("deploys", ["ID", "Job", "Status", "Description"],
+      deploys.slice(0, 200).map(d => [cell(short(d.ID)), cell(d.JobID),
+        scell(d.Status), cell(d.StatusDescription)]));
+  } catch (e) {
+    const el = document.getElementById("err");
+    el.textContent = String(e);
+    el.style.display = "block";
+  }
+}
+tick();
+setInterval(tick, 2000);
+</script>
+</body>
+</html>
+"""

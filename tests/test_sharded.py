@@ -255,3 +255,84 @@ def test_e2e_spine_sharded_matches_single_device():
     sharded = run_spine(shard=True)
     single = run_spine(shard=False)
     assert sharded == single
+
+
+def test_engine_sharded_c2m_scale_mixed_batch():
+    """VERDICT r4 item 5: the engine's sharded serving paths at C2M
+    node scale — N=10,240 (16,384 padded rows) sharded 8 ways — with a
+    MIXED eval batch (small-count bulk, large-count bulk, spread scan),
+    asserting placement parity with the single-device engine."""
+    from concurrent.futures import Future
+
+    from nomad_tpu.parallel.engine import PlacementEngine, _Request
+
+    cm = _mixed_world(10_240)
+    N = cm.n_rows
+    assert N % 8 == 0
+
+    # bulk groups: one small-count (sparse-output class), one large
+    bj = mock.batch_job()
+    btg = bj.task_groups[0]
+    btg.count = 10
+    btg.ephemeral_disk.size_mb = 0
+    bst = DenseStack(cm)
+    bg_small = bst.compile_group(bj, btg)
+    bj2 = mock.batch_job()
+    btg2 = bj2.task_groups[0]
+    btg2.count = 200
+    btg2.ephemeral_disk.size_mb = 0
+    bg_large = DenseStack(cm).compile_group(bj2, btg2)
+
+    # scan eval: spreads active
+    count = 40
+    sj = _mixed_job(count)
+    st = DenseStack(cm)
+    groups = [st.compile_group(sj, tg) for tg in sj.task_groups]
+    scan_inp = st.build_inputs(sj, groups, [0] * count, {})
+
+    zero = np.zeros(N, np.int32)
+
+    def run(shard_min):
+        eng = PlacementEngine(shard_min_nodes=shard_min)
+        out = {}
+        try:
+            a1, p1, *_rest1, t1 = eng.place_bulk(
+                cm, feasible=bg_small.feasible,
+                affinity=bg_small.affinity,
+                has_affinity=bg_small.has_affinity, desired=10,
+                penalty=np.zeros(N, bool), coll0=zero,
+                demand=bg_small.demand, count=10)
+            eng.complete(t1)
+            a2, p2, *_rest2, t2 = eng.place_bulk(
+                cm, feasible=bg_large.feasible,
+                affinity=bg_large.affinity,
+                has_affinity=bg_large.has_affinity, desired=200,
+                penalty=np.zeros(N, bool), coll0=zero,
+                demand=bg_large.demand, count=200)
+            eng.complete(t2)
+            req = _Request(cm=cm, inputs=scan_inp, deltas=[],
+                           spread_algorithm=False, future=Future())
+            eng._dispatch([req])
+            res, t3 = req.future.result(timeout=300)
+            eng.complete(t3)
+            out = {"a1": a1, "p1": p1, "a2": a2, "p2": p2,
+                   "scan_nodes": np.asarray(res.node[:count]).copy(),
+                   "scan_scores": np.asarray(res.score[:count]).copy(),
+                   "sharded": eng.stats.get("sharded_evals", 0)}
+        finally:
+            eng.stop()
+        return out
+
+    sharded = run(shard_min=8)         # mesh active at this N
+    single = run(shard_min=1 << 30)    # mesh disabled
+
+    assert sharded["sharded"] >= 1
+    assert single["sharded"] == 0
+    assert sharded["p1"] == single["p1"] == 10
+    assert sharded["p2"] == single["p2"] == 200
+    np.testing.assert_array_equal(sharded["a1"], single["a1"])
+    np.testing.assert_array_equal(sharded["a2"], single["a2"])
+    np.testing.assert_array_equal(sharded["scan_nodes"],
+                                  single["scan_nodes"])
+    np.testing.assert_allclose(sharded["scan_scores"],
+                               single["scan_scores"], rtol=1e-5)
