@@ -159,6 +159,67 @@ void validate_plan(const float* capacity, const float* used,
     }
 }
 
-int32_t nomad_native_abi_version(void) { return 1; }
+// ---------------------------------------------------------------------
+// Bulk alloc materialization: the host-side commit path's per-alloc
+// Python loop replaced by one call per dispatch.
+//
+// expand_pairs: flatten the resolved sparse bulk output — (row, count,
+// score) triples from the device kernel — into per-alloc row/score
+// arrays in placement order.  Returns the number of allocs written, or
+// -1 if the total would exceed `cap` (caller sized the outputs wrong).
+int32_t expand_pairs(const int32_t* rows, const int32_t* counts,
+                     const float* scores, int n,
+                     int32_t* out_rows, float* out_scores, int32_t cap) {
+    int32_t w = 0;
+    for (int k = 0; k < n; ++k) {
+        int32_t c = counts[k];
+        if (c <= 0) continue;
+        if (w + c > cap) return -1;
+        int32_t r = rows[k];
+        float s = scores[k];
+        for (int32_t j = 0; j < c; ++j) {
+            out_rows[w] = r;
+            out_scores[w] = s;
+            ++w;
+        }
+    }
+    return w;
+}
+
+// format_uuids: batch-format n 16-byte random blocks into the canonical
+// 36-char 8-4-4-4-12 form (same layout as utils.generate_uuid, which
+// hex-formats os.urandom(16)).  out must hold 36*n bytes.
+void format_uuids(const uint8_t* rnd, int n, char* out) {
+    static const char hexd[] = "0123456789abcdef";
+    for (int i = 0; i < n; ++i) {
+        const uint8_t* b = rnd + (size_t)i * 16;
+        char* o = out + (size_t)i * 36;
+        int pos = 0;
+        for (int j = 0; j < 16; ++j) {
+            uint8_t v = b[j];
+            *o++ = hexd[v >> 4];
+            *o++ = hexd[v & 15];
+            pos += 2;
+            if (pos == 8 || pos == 12 || pos == 16 || pos == 20)
+                *o++ = '-';
+        }
+    }
+}
+
+// scatter_add_rank1: used[rows[k]] += counts[k] * demand — the resolve
+// path's overlay/usage update for a bulk eval, without materializing the
+// [K, dims] delta matrix on the Python side.
+void scatter_add_rank1(float* used, int dims, const int32_t* rows,
+                       const int32_t* counts, const float* demand,
+                       int n) {
+    for (int k = 0; k < n; ++k) {
+        float c = (float)counts[k];
+        if (c == 0.0f) continue;
+        float* dst = used + (size_t)rows[k] * dims;
+        for (int d = 0; d < dims; ++d) dst[d] += c * demand[d];
+    }
+}
+
+int32_t nomad_native_abi_version(void) { return 2; }
 
 }  // extern "C"

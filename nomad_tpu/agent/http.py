@@ -547,9 +547,18 @@ class HTTPServer:
 
         def resolve(rel: str) -> str:
             p = os.path.realpath(os.path.join(root, rel.lstrip("/")))
-            if not (p + os.sep).startswith(os.path.realpath(root) + os.sep) \
-                    and p != os.path.realpath(root):
+            real_root = os.path.realpath(root)
+            if not (p + os.sep).startswith(real_root + os.sep) \
+                    and p != real_root:
                 raise HTTPError(403, "path escapes allocation directory")
+            # secrets dirs are invisible to the fs API even inside the
+            # alloc dir (reference client/allocdir escapingfs + the
+            # secrets-dir guard, fs_endpoint.go): layout is
+            # <alloc>/<task>/secrets — reject any resolved path whose
+            # second component under the alloc root is "secrets"
+            rel_parts = os.path.relpath(p, real_root).split(os.sep)
+            if len(rel_parts) >= 2 and rel_parts[1] == "secrets":
+                raise HTTPError(403, "path is in a secrets directory")
             return p
 
         if verb == "ls":

@@ -383,9 +383,7 @@ class TaskRunner:
         failure re-derive and apply the vault change_mode)."""
         if not self.task.vault or self.rpc is None:
             return
-        grant = self.rpc("Secrets.Derive",
-                         {"alloc_id": self.alloc.id,
-                          "task": self.task.name})
+        grant = self.rpc("Secrets.Derive", self._derive_args())
         self._install_token(task_dir, grant)
         if self._vault_thread is None or not self._vault_thread.is_alive():
             self._vault_thread = threading.Thread(
@@ -393,6 +391,13 @@ class TaskRunner:
                 args=(task_dir, float(grant.get("ttl_s", 3600.0))),
                 daemon=True, name=f"vault-{self.task.name}")
             self._vault_thread.start()
+
+    def _derive_args(self) -> dict:
+        """Secrets.Derive payload: the node's identity rides along so the
+        server can verify the caller really hosts the alloc."""
+        return {"alloc_id": self.alloc.id, "task": self.task.name,
+                "node_id": getattr(self.node, "id", ""),
+                "node_secret_id": getattr(self.node, "secret_id", "")}
 
     def _install_token(self, task_dir: str, grant: dict) -> None:
         self.vault_token = grant["token"]
@@ -421,9 +426,7 @@ class TaskRunner:
             # lease lost: re-derive, reinstall, re-render dependent
             # templates, then apply change_mode (default restart)
             try:
-                grant = self.rpc("Secrets.Derive",
-                                 {"alloc_id": self.alloc.id,
-                                  "task": self.task.name})
+                grant = self.rpc("Secrets.Derive", self._derive_args())
             except Exception:                        # noqa: BLE001
                 continue                             # server will retry us
             misses = 0

@@ -6,11 +6,26 @@ The single point where optimistic scheduler output meets ground truth:
 every placement is re-validated against the latest committed state (the
 incremental ClusterMatrix *is* that state, so validation is vectorized
 array math instead of the reference's per-node EvaluatePool fan-out), nodes
-that fail are partially rejected, and the surviving plan is committed to
-the state store in one indexed write.
+that fail are partially rejected, and the surviving plans are committed to
+the state store in coalesced indexed writes.
+
+Lock discipline (the commit pipeline):
+  * `_lock` covers ONLY evaluation ordering — the snapshot a plan is
+    validated against plus its overlay registration must be atomic so
+    plan N+1 sees plan N's accepted effects.
+  * `_commit_lock` covers ONLY commit ordering — indexed store/raft
+    writes stay strictly sequential.
+  * All per-plan Python work (diff flattening, alloc serialization into
+    AppliedPlanResults, future resolution, ticket release) happens off
+    both locks, on the background commit thread.
+Plans drained together from the queue (`dequeue_batch`) are committed as
+ONE batched write — one lock acquisition, one raft apply, one index —
+mirroring the reference's optimistic pipeline (plan_apply.go:71-178)
+with coalescing layered on top.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time as _time
 from typing import Dict, List, Optional, Set, Tuple
@@ -41,6 +56,11 @@ class PlanApplier:
         # the server creates PreemptionEvals here, outside the raft lock
         self.on_preempted = None
         self._lock = threading.Lock()
+        self._commit_lock = threading.Lock()
+        # plans coalesced per commit (one indexed write for the whole
+        # batch); the 48-worker C2M legs drive queue depth well past 1
+        self.batch_n = max(1, int(os.environ.get(
+            "NOMAD_TPU_PLAN_BATCH", "16")))
         # pipelining overlay: accepted-but-not-yet-committed plan effects,
         # keyed by plan eval token/id (reference plan_apply.go:71-178
         # evaluates plan N+1 against a snapshot with plan N applied while
@@ -56,49 +76,67 @@ class PlanApplier:
     def apply(self, plan: Plan) -> PlanResult:
         with self._lock:
             result = self._evaluate(plan)
+            token = self._overlay_add(plan, result)
+        # flatten + commit off the evaluation lock; the overlay entry
+        # keeps the accepted effects visible to concurrent evaluations
+        # until the store write lands
+        try:
             self._commit(plan, result)
-            return result
+        finally:
+            with self._overlay_lock:
+                self._overlay.pop(token, None)
+        return result
 
     def run_loop(self, queue, stop_event: threading.Event) -> None:
         """Leader plan-apply loop draining the PlanQueue.
 
-        Pipelined (plan_apply.go:71-178): while plan N's commit (raft
-        apply) is in flight on a background thread, plan N+1 is already
-        being evaluated against committed state + the in-flight overlay.
-        Commits stay strictly ordered — the next commit starts only after
-        the previous one finishes."""
+        Pipelined (plan_apply.go:71-178): while batch N's commit (raft
+        apply) is in flight on a background thread, batch N+1's plans are
+        already being evaluated against committed state + the in-flight
+        overlays.  Adjacent plans drained together coalesce into ONE
+        indexed commit.  Commits stay strictly ordered — the next commit
+        starts only after the previous one finishes."""
         commit_t: Optional[threading.Thread] = None
         while not stop_event.is_set():
-            pending = queue.dequeue(timeout=0.1)
-            if pending is None:
+            batch = queue.dequeue_batch(self.batch_n, timeout=0.1)
+            if not batch:
                 continue
-            try:
-                t0 = _time.time()
-                result = self._evaluate(pending.plan)
-                global_metrics.measure_since("nomad.plan.evaluate", t0)
-                if commit_t is not None and commit_t.is_alive() and \
-                        self._result_rejected_something(pending.plan,
-                                                        result):
-                    # the in-flight commit's usage is counted twice
-                    # (store write + its overlay entry) until it pops;
-                    # a rejection in that window may be pure
-                    # over-reservation — settle the commit and give the
-                    # plan one clean second look before failing it back
-                    # to the scheduler (a full eval recompute)
-                    commit_t.join()
-                    self.stats["revalidated"] = \
-                        self.stats.get("revalidated", 0) + 1
+            staged: List[tuple] = []
+            for pending in batch:
+                try:
+                    t0 = _time.time()
                     result = self._evaluate(pending.plan)
-                token = self._overlay_add(pending.plan, result)
-            except Exception as e:            # noqa: BLE001
-                pending.future.set_exception(e)
+                    global_metrics.measure_since("nomad.plan.evaluate", t0)
+                    if commit_t is not None and commit_t.is_alive() and \
+                            self._result_rejected_something(pending.plan,
+                                                            result):
+                        # the in-flight commit's usage is counted twice
+                        # (store write + its overlay entry) until it pops;
+                        # a rejection in that window may be pure
+                        # over-reservation — settle the commit and give
+                        # the plan one clean second look before failing it
+                        # back to the scheduler (a full eval recompute).
+                        # Plans staged in THIS batch are overlay-only, so
+                        # they are never double-counted.
+                        commit_t.join()
+                        self.stats["revalidated"] = \
+                            self.stats.get("revalidated", 0) + 1
+                        result = self._evaluate(pending.plan)
+                    token = self._overlay_add(pending.plan, result)
+                except Exception as e:            # noqa: BLE001
+                    pending.future.set_exception(e)
+                    continue
+                staged.append((pending, result, token))
+            if not staged:
                 continue
             if commit_t is not None:
                 commit_t.join()
                 self.stats["pipelined"] += 1
+            if len(staged) > 1:
+                self.stats["coalesced"] = \
+                    self.stats.get("coalesced", 0) + len(staged)
             commit_t = threading.Thread(
-                target=self._commit_and_resolve,
-                args=(pending, result, token),
+                target=self._commit_batch_and_resolve, args=(staged,),
                 name="plan-commit", daemon=True)
             commit_t.start()
         if commit_t is not None:
@@ -110,16 +148,41 @@ class PlanApplier:
         got = sum(len(v) for v in result.node_allocation.values())
         return got < want
 
-    def _commit_and_resolve(self, pending, result: PlanResult,
-                            token: int) -> None:
+    def _commit_batch_and_resolve(self, staged: List[tuple]) -> None:
+        """Commit a batch of evaluated plans as ONE indexed write, then
+        resolve every submitter's future.  All flattening/serialization
+        happens here, off the evaluation lock; overlay entries pop only
+        after the write lands (never a double-free window)."""
         try:
-            self._commit(pending.plan, result)
-            pending.future.set_result(result)
-        except Exception as e:                # noqa: BLE001
-            pending.future.set_exception(e)
+            entries = [(pending, result,
+                        self._applied_for(pending.plan, result))
+                       for pending, result, _token in staged]
+            applied_list = [ap for _, _, ap in entries if ap is not None]
+            index = None
+            if applied_list:
+                with self._commit_lock:
+                    if self._commit_fn is not None:
+                        index = self._commit_fn(
+                            applied_list if len(applied_list) > 1
+                            else applied_list[0])
+                    else:
+                        index = self.store.latest_index + 1
+                        self.store.upsert_plan_results_many(
+                            index, applied_list)
+            for pending, result, applied in entries:
+                try:
+                    self._post_commit(pending.plan, result, applied, index)
+                    pending.future.set_result(result)
+                except Exception as e:            # noqa: BLE001
+                    pending.future.set_exception(e)
+        except Exception as e:                    # noqa: BLE001
+            for pending, _result, _token in staged:
+                if not pending.future.done():
+                    pending.future.set_exception(e)
         finally:
             with self._overlay_lock:
-                self._overlay.pop(token, None)
+                for _pending, _result, token in staged:
+                    self._overlay.pop(token, None)
 
     # ------------------------------------------------------------- overlay
 
@@ -345,13 +408,16 @@ class PlanApplier:
 
     # ------------------------------------------------------------- commit
 
-    def _commit(self, plan: Plan, result: PlanResult) -> None:
-        store = self.store
+    @staticmethod
+    def _applied_for(plan: Plan,
+                     result: PlanResult) -> Optional["AppliedPlanResults"]:
+        """Flatten an evaluated plan into its raft payload; None for a
+        no-op plan (nothing to write)."""
         if (not result.node_allocation and not result.node_update
                 and not result.node_preemptions and result.deployment is None
                 and not result.deployment_updates):
-            return
-        applied = AppliedPlanResults(
+            return None
+        return AppliedPlanResults(
             alloc_updates=[a for v in result.node_update.values() for a in v],
             allocs_to_place=[a for v in result.node_allocation.values() for a in v],
             allocs_preempted=[a for v in result.node_preemptions.values() for a in v],
@@ -359,21 +425,22 @@ class PlanApplier:
             deployment_updates=result.deployment_updates,
             eval_id=plan.eval_id,
         )
-        if self._commit_fn is not None:
-            index = self._commit_fn(applied)
-        else:
-            index = store.latest_index + 1
-            store.upsert_plan_results(index, applied)
-        # release the scheduler's in-flight overlay tickets NOW: the
-        # usage just became committed state, and any window where both
-        # the store and the overlay count it makes concurrent kernels
-        # see phantom usage
+
+    def _post_commit(self, plan: Plan, result: PlanResult,
+                     applied: Optional["AppliedPlanResults"],
+                     index: Optional[int]) -> None:
+        """Per-plan bookkeeping after the store write: release the
+        scheduler's in-flight overlay tickets NOW — the usage just became
+        committed state, and any window where both the store and the
+        overlay count it makes concurrent kernels see phantom usage."""
         if plan.engine_tickets:
             from nomad_tpu.parallel.engine import get_engine
             eng = get_engine()
             if eng is not None:
                 for t in plan.engine_tickets:
                     eng.complete(t)
+        if applied is None:
+            return
         result.alloc_index = index
         self.stats["applied"] += 1
         if applied.allocs_preempted and self.on_preempted is not None:
@@ -381,6 +448,18 @@ class PlanApplier:
                 self.on_preempted(applied.allocs_preempted)
             except Exception:                  # noqa: BLE001
                 pass
+
+    def _commit(self, plan: Plan, result: PlanResult) -> None:
+        applied = self._applied_for(plan, result)
+        index = None
+        if applied is not None:
+            with self._commit_lock:
+                if self._commit_fn is not None:
+                    index = self._commit_fn(applied)
+                else:
+                    index = self.store.latest_index + 1
+                    self.store.upsert_plan_results(index, applied)
+        self._post_commit(plan, result, applied, index)
 
 
 def _alloc_ports(a: Allocation) -> List[int]:

@@ -66,6 +66,25 @@ class PlanQueue:
             self.stats["depth"] = len(self._heap)
             return pending
 
+    def dequeue_batch(self, max_n: int,
+                      timeout: Optional[float] = None
+                      ) -> List[PendingPlan]:
+        """One blocking wait, then drain up to max_n queued plans in
+        priority order.  The applier coalesces adjacent plans from a
+        wide worker pool into one commit instead of one store/raft
+        round trip per plan."""
+        with self._lock:
+            if not self._lock.wait_for(
+                    lambda: self._heap or not self.enabled,
+                    timeout=timeout):
+                return []
+            out: List[PendingPlan] = []
+            while self._heap and len(out) < max_n:
+                _, _, pending = heapq.heappop(self._heap)
+                out.append(pending)
+            self.stats["depth"] = len(self._heap)
+            return out
+
     def depth(self) -> int:
         with self._lock:
             return len(self._heap)

@@ -218,6 +218,11 @@ class Server:
         return peer.rpc_leader(method, args)
 
     def _commit_plan(self, applied) -> int:
+        """Commit applier output through the raft write path.  `applied`
+        is one AppliedPlanResults or a LIST of them — the applier
+        coalesces adjacent plans from the queue into one log entry (one
+        raft apply, one index) and the FSM fans the batch out to the
+        store under a single lock acquisition."""
         return self.apply(MessageType.APPLY_PLAN_RESULTS,
                           {"results": applied})
 
@@ -576,6 +581,11 @@ class Server:
         stream, plugins/device/device.go:25-37) migrates the allocations
         holding those instances — dead hardware must not keep serving."""
         prev = self.store.node_by_id(node.id)
+        if prev is not None and prev.secret_id and node.secret_id \
+                and prev.secret_id != node.secret_id:
+            # reference node_endpoint.go:141 — a re-registration may not
+            # rotate another node's identity out from under it
+            raise ValueError(f"node secret ID does not match: {node.id}")
         newly_bad: set = set()
         if prev is not None:
             prev_bad = {i for d in prev.node_resources.devices

@@ -8,7 +8,10 @@ mtime under native/build/), exposing:
   ports_check(port_words, row, ports, freed) -> bool
   ports_set(port_words, row, ports, value)
   scatter_add(used, rows, deltas)
+  scatter_add_rank1(used, rows, counts, demand)
   validate_plan(...) -> bool[G]     (the EvaluatePool equivalent)
+  expand_pairs(rows, counts, scores) -> (i32[K], f32[K])
+  format_uuids(n) -> list[str]      (batch generate_uuid)
 
 Falls back to numpy implementations when no C++ toolchain is available
 (`NATIVE_AVAILABLE` tells you which path is live).
@@ -82,7 +85,7 @@ def _load() -> Optional[ctypes.CDLL]:
         except OSError:
             return None
         lib.nomad_native_abi_version.restype = ctypes.c_int32
-        if lib.nomad_native_abi_version() != 1:
+        if lib.nomad_native_abi_version() != 2:
             return None
         lib.allocs_fit_dense.argtypes = [
             _f32p, _f32p, _f32p, ctypes.c_int, ctypes.c_int, _u8p]
@@ -102,6 +105,14 @@ def _load() -> Optional[ctypes.CDLL]:
             _f32p, _f32p, _u32p, ctypes.c_int, ctypes.c_int,
             _i32p, _f32p, _f32p, _i32p, _i32p, _i32p, _i32p,
             ctypes.c_int, _u8p]
+        lib.expand_pairs.restype = ctypes.c_int32
+        lib.expand_pairs.argtypes = [
+            _i32p, _i32p, _f32p, ctypes.c_int, _i32p, _f32p,
+            ctypes.c_int32]
+        lib.format_uuids.argtypes = [
+            _u8p, ctypes.c_int, ctypes.c_char_p]
+        lib.scatter_add_rank1.argtypes = [
+            _f32p, ctypes.c_int, _i32p, _i32p, _f32p, ctypes.c_int]
         _lib = lib
         NATIVE_AVAILABLE = True
         return lib
@@ -245,3 +256,67 @@ def validate_plan(capacity: np.ndarray, used: np.ndarray,
                       capacity.shape[1], rows_a, demand, freed,
                       ports_a, ports_off, freed_a, freed_off, g, out)
     return out.astype(bool)
+
+
+def expand_pairs(rows: np.ndarray, counts: np.ndarray,
+                 scores: Optional[np.ndarray] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten resolved sparse bulk output — (row, count, score)
+    triples — into per-alloc (rows i32[K], scores f32[K]) arrays in
+    placement order; K = counts.clip(0).sum().  The bulk materializer's
+    one-call-per-dispatch expansion."""
+    rows_a = np.ascontiguousarray(rows, np.int32)
+    counts_a = np.ascontiguousarray(counts, np.int32)
+    if scores is None:
+        scores_a = np.zeros(rows_a.shape[0], np.float32)
+    else:
+        scores_a = np.ascontiguousarray(scores, np.float32)
+    total = int(np.clip(counts_a, 0, None).sum())
+    lib = _load()
+    if lib is None or total == 0:
+        keep = counts_a > 0
+        return (np.repeat(rows_a[keep], counts_a[keep]),
+                np.repeat(scores_a[keep], counts_a[keep]))
+    out_rows = np.empty(total, np.int32)
+    out_scores = np.empty(total, np.float32)
+    w = lib.expand_pairs(rows_a, counts_a, scores_a, rows_a.shape[0],
+                         out_rows, out_scores, total)
+    if w != total:                      # defensive; cap == exact total
+        keep = counts_a > 0
+        return (np.repeat(rows_a[keep], counts_a[keep]),
+                np.repeat(scores_a[keep], counts_a[keep]))
+    return out_rows, out_scores
+
+
+def format_uuids(n: int) -> List[str]:
+    """n fresh uuid strings in one call, byte-identical in format to
+    utils.generate_uuid (hex of os.urandom(16), 8-4-4-4-12)."""
+    if n <= 0:
+        return []
+    rnd = np.frombuffer(os.urandom(16 * n), np.uint8)
+    lib = _load()
+    if lib is None:
+        h = rnd.tobytes().hex()
+        return [f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}"
+                for s in (h[i * 32:(i + 1) * 32] for i in range(n))]
+    out = ctypes.create_string_buffer(36 * n)
+    lib.format_uuids(np.ascontiguousarray(rnd), n, out)
+    raw = out.raw
+    return [raw[i * 36:(i + 1) * 36].decode("ascii") for i in range(n)]
+
+
+def scatter_add_rank1(used: np.ndarray, rows: np.ndarray,
+                      counts: np.ndarray, demand: np.ndarray) -> None:
+    """used[rows[k]] += counts[k] * demand in place, without building
+    the [K, dims] delta matrix."""
+    rows_a = np.ascontiguousarray(rows, np.int32)
+    counts_a = np.ascontiguousarray(counts, np.int32)
+    demand_a = np.ascontiguousarray(demand, np.float32)
+    lib = _load()
+    if lib is None or not used.flags["C_CONTIGUOUS"] \
+            or used.dtype != np.float32:
+        np.add.at(used, rows_a,
+                  counts_a[:, None].astype(used.dtype) * demand_a)
+        return
+    lib.scatter_add_rank1(used, used.shape[1], rows_a, counts_a,
+                          demand_a, rows_a.shape[0])

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from nomad_tpu import native as _native
 from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS, pad_to_bucket
 from nomad_tpu.ops.place import (
     SPARSE_CAP,
@@ -470,6 +471,37 @@ class PlacementEngine:
             self.stats["tickets_open"] = len(self._tickets)
         return ticket
 
+    def register_external_sparse(self, cm, rows: np.ndarray,
+                                 counts: np.ndarray,
+                                 demand: np.ndarray) -> int:
+        """register_external for a resolved bulk eval without the
+        per-row Python loop: overlay[rows[k]] += counts[k] * demand in
+        one native scatter.  Ticket contribs stay in sparse form so
+        complete() reverses them with the same rank-1 scatter."""
+        rows = np.ascontiguousarray(rows, np.int32)
+        counts = np.ascontiguousarray(counts, np.int32)
+        with self._overlay_lock:
+            key = id(cm)
+            overlay = self._overlays.get(key)
+            n = cm.used.shape[0]
+            if overlay is None or overlay.shape[0] < n:
+                grown = np.zeros((n, NUM_RESOURCE_DIMS), np.float32)
+                if overlay is not None:
+                    grown[:overlay.shape[0]] = overlay
+                overlay = self._overlays[key] = grown
+            keep = rows < overlay.shape[0]
+            if not keep.all():
+                rows, counts = rows[keep], counts[keep]
+            d = np.zeros(overlay.shape[1], np.float32)
+            d[:min(len(demand), len(d))] = \
+                np.asarray(demand, np.float32)[:len(d)]
+            _native.scatter_add_rank1(overlay, rows, counts, d)
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._tickets[ticket] = (key, ("rank1", rows, counts, d))
+            self.stats["tickets_open"] = len(self._tickets)
+        return ticket
+
     def basis_for(self, cm) -> np.ndarray:
         """Public view of committed usage + in-flight overlay."""
         return self._basis_for(cm)
@@ -531,9 +563,17 @@ class PlacementEngine:
                     cm_key, contrib = entry
                     overlay = self._overlays.get(cm_key)
                     if overlay is not None:
-                        for row, vec in contrib:
-                            if row < overlay.shape[0]:
-                                overlay[row] -= vec
+                        if isinstance(contrib, tuple) \
+                                and contrib[0] == "rank1":
+                            _, rows, counts, d = contrib
+                            keep = rows < overlay.shape[0]
+                            _native.scatter_add_rank1(
+                                overlay, rows[keep], -counts[keep],
+                                d[:overlay.shape[1]])
+                        else:
+                            for row, vec in contrib:
+                                if row < overlay.shape[0]:
+                                    overlay[row] -= vec
                     self.stats["tickets_open"] = len(self._tickets)
                     if not self._tickets:
                         # nothing in flight: drop overlays entirely so
@@ -1026,12 +1066,12 @@ class PlacementEngine:
         for i, r in enumerate(reqs):
             # sparse contributions only — no per-request [N, R] copies:
             # at 512-eval chains those copies dominated resolve, and the
-            # scheduler reconstructs its cumulative usage from assigns
+            # scheduler reconstructs its cumulative usage from assigns.
+            # One rank-1 scatter per eval instead of a per-row loop.
             rows = np.flatnonzero(assign[i])
-            contribs = [(int(row), r.demand * float(assign[i][row]))
-                        for row in rows]
-            ticket = self.register_external(r.cm, contribs) \
-                if contribs else None
+            ticket = self.register_external_sparse(
+                r.cm, rows, assign[i][rows], r.demand) \
+                if rows.size else None
             r.future.set_result(
                 (assign[i], int(placed[i]), int(n_eval[i]),
                  int(n_exh[i]), scores[i], ticket))

@@ -192,7 +192,13 @@ class NomadFSM:
     # --- plans / deployments / config
 
     def _apply_plan_results(self, index, p):
-        self.store.upsert_plan_results(index, p["results"])
+        # the applier coalesces adjacent plans into one log entry: a
+        # list payload commits the whole batch in one store write
+        results = p["results"]
+        if isinstance(results, list):
+            self.store.upsert_plan_results_many(index, results)
+        else:
+            self.store.upsert_plan_results(index, results)
 
     def _apply_deployment_upsert(self, index, p):
         self.store.upsert_deployment(index, p["deployment"])

@@ -287,11 +287,23 @@ class Endpoints:
             if self.server.leader else self.server.config.heartbeat_ttl
         return {"eval_ids": [e.id for e in evals], "heartbeat_ttl": ttl}
 
+    @staticmethod
+    def _redact_node(node):
+        """Strip the node secret before it leaves the servers (reference
+        node_endpoint.go GetNode clears Node.SecretID)."""
+        if node is None or not getattr(node, "secret_id", ""):
+            return node
+        import copy
+        node = copy.copy(node)
+        node.secret_id = ""
+        return node
+
     def rpc_Node__List(self, args):
-        return self.server.store.nodes()
+        return [self._redact_node(n) for n in self.server.store.nodes()]
 
     def rpc_Node__GetNode(self, args):
-        return self.server.store.node_by_id(args["node_id"])
+        return self._redact_node(
+            self.server.store.node_by_id(args["node_id"]))
 
     def rpc_Node__GetAllocs(self, args):
         return self.server.store.allocs_by_node(args["node_id"])
@@ -646,8 +658,14 @@ class Endpoints:
 
     def rpc_Secrets__Put(self, args):
         """Admin write into the embedded KV (the stand-in for seeding
-        Vault; reference operators do this against Vault directly)."""
+        Vault; reference operators do this against Vault directly).
+        With ACLs on, only a management token may seed secrets."""
         self._require_leader()
+        if self.server.acl_enabled:
+            acl = self.server.resolve_token(args.get("token", ""))
+            if acl is None or not acl.management:
+                raise RpcError("permission_denied",
+                               "Secrets.Put requires a management token")
         return {"version": self.server.secrets.put(
             args["path"], dict(args.get("data") or {}))}
 
@@ -655,11 +673,23 @@ class Endpoints:
         """Per-task token derivation (reference nomad/vault.go
         CreateToken via client_endpoint DeriveVaultToken): policies come
         from the task's vault stanza in the server's own state, never
-        from the caller."""
+        from the caller.  The caller must prove it IS the node the alloc
+        runs on — node id + node secret (node_endpoint.go
+        deriveVaultToken NodeSecretID check) — so a compromised alloc
+        cannot mint tokens for tasks on other machines."""
         self._require_leader()
+        import hmac
+        node = self.server.store.node_by_id(args.get("node_id", ""))
+        secret = args.get("node_secret_id", "")
+        if (node is None or not node.secret_id or not secret
+                or not hmac.compare_digest(node.secret_id, secret)):
+            raise RpcError("permission_denied", "node secret mismatch")
         alloc = self.server.store.alloc_by_id(args["alloc_id"])
         if alloc is None or alloc.job is None:
             raise RpcError("not_found", "alloc or its job")
+        if alloc.node_id != node.id:
+            raise RpcError("permission_denied",
+                           "alloc does not run on the requesting node")
         if alloc.terminal_status() or alloc.client_terminal_status():
             # revocation on stop must not be bypassed by a re-derive
             raise RpcError("invalid", "alloc is terminal")

@@ -19,7 +19,11 @@ import numpy as np
 from nomad_tpu.encode.matrixizer import comparable_vec
 
 from nomad_tpu.scheduler import factory
-from nomad_tpu.scheduler.placement import PortClaims, build_allocation
+from nomad_tpu.scheduler.placement import (
+    PortClaims,
+    build_allocation,
+    materialize_bulk_allocs,
+)
 from nomad_tpu.scheduler.reconcile import AllocReconciler, PlacementRequest
 from nomad_tpu.scheduler.stack import DenseStack
 from nomad_tpu.scheduler.util import (
@@ -433,12 +437,14 @@ class GenericScheduler:
         # parts, so no single returned matrix is complete; the engine
         # itself sees this usage through the overlay tickets)
         if bulk_results:
+            from nomad_tpu import native as _native_mod
             used = used.copy()
             for gi, _prs, bulk in bulk_results:
                 assign = bulk[0]
-                d = groups[gi].demand.astype(np.float32)
-                for row in np.flatnonzero(assign):
-                    used[row] += d * float(assign[row])
+                rows_nz = np.flatnonzero(assign)
+                _native_mod.scatter_add_rank1(
+                    used, rows_nz, assign[rows_nz],
+                    groups[gi].demand.astype(np.float32))
         slot_requests = scan_requests
 
         slots = [tg_index[pr.task_group] for pr in slot_requests]
@@ -665,22 +671,53 @@ class GenericScheduler:
             place_on(pr, row, metric_for(None), preempted=extra)
             account_device_evictions(row, extra)
 
-        # bulk-kernel placements: expand per-node counts onto requests
+        # bulk-kernel placements: one native expand_pairs call flattens
+        # each group's (row, count, score) triples to per-alloc arrays,
+        # and plain new placements materialize through the batch
+        # constructor instead of K build_allocation round trips
         for gi, prs, bulk in bulk_results:
             assign, placed, n_eval, n_exh, bscores = bulk
-            target_rows: List[int] = []
-            for row in np.flatnonzero(assign):
-                target_rows.extend([int(row)] * int(assign[row]))
-            for pr, row in zip(prs, target_rows):
-                m = AllocMetric()
-                m.nodes_evaluated = n_eval
-                m.nodes_exhausted = n_exh
-                if cm.node_ids[row]:
-                    m.populate_score_meta([{
-                        "node_id": cm.node_ids[row],
-                        "norm_score": round(float(bscores[row]), 6)}])
-                place_on(pr, row, m)
-            for pr in prs[len(target_rows):]:
+            from nomad_tpu import native as _native_mod
+            rows_nz = np.flatnonzero(assign)
+            flat_rows, flat_scores = _native_mod.expand_pairs(
+                rows_nz, assign[rows_nz], np.asarray(bscores)[rows_nz])
+            n_placed = min(len(flat_rows), len(prs))
+            tg = job.task_groups[gi]
+            fast = (n_placed > 0
+                    and not tg.networks
+                    and not any(t.resources.networks for t in tg.tasks)
+                    and all(pr.previous_alloc is None
+                            and not pr.is_canary
+                            and not pr.is_rescheduling
+                            for pr in prs[:n_placed]))
+            if fast:
+                dep_id = ""
+                if deployment is not None \
+                        and tg.name in deployment.task_groups:
+                    dep_id = deployment.id
+                node_names = {}
+                for row in rows_nz:
+                    row = int(row)
+                    node = self.state.node_by_id(cm.node_ids[row])
+                    node_names[row] = node.name if node else ""
+                for alloc in materialize_bulk_allocs(
+                        job, tg, [pr.name for pr in prs[:n_placed]],
+                        flat_rows[:n_placed], flat_scores[:n_placed],
+                        cm.node_ids, node_names, self.eval.id, dep_id,
+                        int(n_eval), int(n_exh), now):
+                    self.plan.append_alloc(alloc, None)
+            else:
+                for pr, row, sc in zip(prs, flat_rows, flat_scores):
+                    row = int(row)
+                    m = AllocMetric()
+                    m.nodes_evaluated = n_eval
+                    m.nodes_exhausted = n_exh
+                    if cm.node_ids[row]:
+                        m.populate_score_meta([{
+                            "node_id": cm.node_ids[row],
+                            "norm_score": round(float(sc), 6)}])
+                    place_on(pr, row, m)
+            for pr in prs[n_placed:]:
                 m = AllocMetric()
                 m.nodes_evaluated = n_eval
                 m.nodes_exhausted = n_exh

@@ -164,6 +164,81 @@ def build_allocation(
     return alloc
 
 
+def materialize_bulk_allocs(
+    job: Job,
+    tg: TaskGroup,
+    names: List[str],
+    rows: np.ndarray,
+    scores: np.ndarray,
+    node_ids: List[str],
+    node_names: Dict[int, str],
+    eval_id: str,
+    deployment_id: str,
+    n_eval: int,
+    n_exh: int,
+    now: float,
+) -> List[Allocation]:
+    """Batch materialization for the bulk wavefront path: the resolved
+    sparse output (already expanded to per-alloc `rows`/`scores` by
+    native.expand_pairs) becomes Allocation records in one pass.
+
+    Bulk-eligible groups have no ports, devices, or networks, so every
+    alloc's resources are identical — ONE immutable AllocatedResources
+    template is shared across the batch (read-only everywhere downstream,
+    and it makes comparable_resources() memoization hit group-wide).
+    Per-row AllocMetric instances are likewise shared by allocs landing
+    on the same node.  uuids come from one native format_uuids call
+    instead of K generate_uuid round trips."""
+    from nomad_tpu import native as _native
+
+    k_total = len(names)
+    ids = _native.format_uuids(k_total)
+    tasks = {
+        t.name: AllocatedTaskResources(
+            cpu_shares=t.resources.cpu,
+            memory_mb=t.resources.memory_mb,
+            memory_max_mb=t.resources.memory_max_mb,
+            networks=[], devices=[])
+        for t in tg.tasks}
+    shared_res = AllocatedResources(
+        tasks=tasks, shared_disk_mb=tg.ephemeral_disk.size_mb,
+        shared_networks=[], shared_ports=[])
+    metric_by_row: Dict[int, AllocMetric] = {}
+    out: List[Allocation] = []
+    for k in range(k_total):
+        row = int(rows[k])
+        m = metric_by_row.get(row)
+        if m is None:
+            m = AllocMetric()
+            m.nodes_evaluated = n_eval
+            m.nodes_exhausted = n_exh
+            nid = node_ids[row]
+            if nid:
+                m.populate_score_meta([{
+                    "node_id": nid,
+                    "norm_score": round(float(scores[k]), 6)}])
+            m.allocation_time_s = 0.0
+            metric_by_row[row] = m
+        out.append(Allocation(
+            id=ids[k],
+            namespace=job.namespace,
+            eval_id=eval_id,
+            name=names[k],
+            node_id=node_ids[row],
+            node_name=node_names.get(row, ""),
+            job_id=job.id,
+            job=job,
+            task_group=tg.name,
+            allocated_resources=shared_res,
+            desired_status=AllocDesiredStatus.RUN,
+            client_status=AllocClientStatus.PENDING,
+            metrics=m,
+            deployment_id=deployment_id,
+            create_time=now,
+            modify_time=now))
+    return out
+
+
 def _materialize_net(net: NetworkResource, row: int, ports: PortClaims,
                      freed: Set[int]) -> Optional[NetworkResource]:
     out = net.copy()

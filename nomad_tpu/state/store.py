@@ -915,42 +915,64 @@ class StateStore:
                 state=csistructs.CLAIM_STATE_TAKEN))
             vol.modify_index = index
 
+    def _upsert_plan_result_locked(self, index: int,
+                                   result: "AppliedPlanResults",
+                                   touched: list) -> None:
+        """One plan's writes; caller holds self._lock and notifies for
+        `touched` after releasing it."""
+        for a in result.alloc_updates:      # stops/evicts
+            existing = self._allocs.get(a.id)
+            if existing is not None and a.job is None:
+                a.job = existing.job
+            self._insert_alloc(index, a)
+            touched.append(a)
+        for a in result.allocs_to_place:    # placements
+            self._insert_alloc(index, a)
+            self._take_csi_claims_for_alloc(index, a)
+            touched.append(a)
+        for a in result.allocs_preempted:
+            existing = self._allocs.get(a.id)
+            if existing is not None and a.job is None:
+                a.job = existing.job
+            self._insert_alloc(index, a)
+            touched.append(a)
+        if result.deployment is not None:
+            d = result.deployment
+            if d.id not in self._deployments:
+                d.create_index = index
+            d.modify_index = index
+            self._deployments[d.id] = d
+        for upd in result.deployment_updates:
+            d = self._deployments.get(upd["deployment_id"])
+            if d is not None:
+                d = d.copy()
+                d.status = upd["status"]
+                d.status_description = upd.get("description", "")
+                d.modify_index = index
+                self._deployments[d.id] = d
+
     def upsert_plan_results(self, index: int, result: "AppliedPlanResults") -> None:
         """Apply a committed plan (reference UpsertPlanResults,
         state_store.go:337): denormalize stopped/preempted allocs, insert
         placements, attach deployment updates."""
-        touched = []
+        touched: list = []
         with self._lock:
-            for a in result.alloc_updates:      # stops/evicts
-                existing = self._allocs.get(a.id)
-                if existing is not None and a.job is None:
-                    a.job = existing.job
-                self._insert_alloc(index, a)
-                touched.append(a)
-            for a in result.allocs_to_place:    # placements
-                self._insert_alloc(index, a)
-                self._take_csi_claims_for_alloc(index, a)
-                touched.append(a)
-            for a in result.allocs_preempted:
-                existing = self._allocs.get(a.id)
-                if existing is not None and a.job is None:
-                    a.job = existing.job
-                self._insert_alloc(index, a)
-                touched.append(a)
-            if result.deployment is not None:
-                d = result.deployment
-                if d.id not in self._deployments:
-                    d.create_index = index
-                d.modify_index = index
-                self._deployments[d.id] = d
-            for upd in result.deployment_updates:
-                d = self._deployments.get(upd["deployment_id"])
-                if d is not None:
-                    d = d.copy()
-                    d.status = upd["status"]
-                    d.status_description = upd.get("description", "")
-                    d.modify_index = index
-                    self._deployments[d.id] = d
+            self._upsert_plan_result_locked(index, result, touched)
+            self._bump(index)
+        for a in touched:
+            self._notify("allocs", a)
+
+    def upsert_plan_results_many(self, index: int,
+                                 results) -> None:
+        """Apply a coalesced batch of committed plans under ONE lock
+        acquisition and ONE index bump — the applier's batch commit.
+        Plans in a batch touch disjoint alloc ids (each scheduler eval
+        owns its placements), so sharing an index is safe: upserts are
+        keyed by alloc id and create_index is preserved on update."""
+        touched: list = []
+        with self._lock:
+            for result in results:
+                self._upsert_plan_result_locked(index, result, touched)
             self._bump(index)
         for a in touched:
             self._notify("allocs", a)

@@ -13,6 +13,7 @@ from nomad_tpu.structs.resources import (
     NetworkResource,
     NodeDevice,
 )
+from nomad_tpu.utils import generate_uuid
 
 
 class NodeStatus:
@@ -93,6 +94,10 @@ class Node:
     # endpoints forward alloc fs/log reads here (reference Node.HTTPAddr,
     # client/fs_endpoint.go forwarding)
     http_addr: str = ""
+    # per-node shared secret, proven back to the servers on Secrets.Derive
+    # (reference Node.SecretID, node_endpoint.go deriveTokenInternal); never
+    # returned by Node.GetNode/Node.List
+    secret_id: str = field(default_factory=generate_uuid)
     create_index: int = 0
     modify_index: int = 0
 

@@ -7,6 +7,34 @@ import os
 _cache_enabled = False
 
 
+def _machine_cache_key() -> str:
+    """Short digest of the TARGET MACHINE's features, used to partition
+    the persistent compile cache: an AOT-cached executable deserialized
+    on a host with a different ISA/accelerator can SIGILL or miscompute
+    (observed as cross-host reuse warnings in multichip runs).  Keyed on
+    arch + CPU feature flags + accelerator selection, all readable
+    without forcing JAX backend init."""
+    import hashlib
+    import platform
+
+    parts = [platform.machine(), platform.system()]
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    parts.append(" ".join(sorted(line.split(":", 1)[1]
+                                                 .split())))
+                    break
+    except OSError:
+        pass
+    # accelerator identity without initializing a backend: the env vars
+    # that select it are what distinguishes cache-incompatible hosts
+    for var in ("JAX_PLATFORMS", "JAX_PLATFORM_NAME", "TPU_ACCELERATOR_TYPE",
+                "TPU_VERSION", "TPU_CHIPS_PER_HOST_BOUNDS"):
+        parts.append(f"{var}={os.environ.get(var, '')}")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Point JAX at an on-disk compilation cache so a fresh process
     deserializes the placement-kernel variant grid (~100ms/executable)
@@ -15,8 +43,11 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     for an XLA-compiled scheduler the equivalent serving-readiness lever
     is a persistent compile cache + AOT warmup.
 
-    Defaults to `<repo root>/.jax_cache`; override with
-    NOMAD_TPU_JAX_CACHE_DIR, disable with NOMAD_TPU_JAX_CACHE=0.
+    The cache lives in a per-machine-feature subdirectory (see
+    _machine_cache_key) so executables never cross incompatible hosts.
+
+    Defaults to `<repo root>/.jax_cache/<machine-key>`; override the root
+    with NOMAD_TPU_JAX_CACHE_DIR, disable with NOMAD_TPU_JAX_CACHE=0.
     Returns the cache dir in use (None when disabled)."""
     global _cache_enabled
     if os.environ.get("NOMAD_TPU_JAX_CACHE", "1") == "0":
@@ -24,9 +55,10 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     if _cache_enabled:
         import jax
         return jax.config.jax_compilation_cache_dir
-    path = (path or os.environ.get("NOMAD_TPU_JAX_CACHE_DIR")
+    root = (path or os.environ.get("NOMAD_TPU_JAX_CACHE_DIR")
             or os.path.join(os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    path = os.path.join(root, _machine_cache_key())
     try:
         import jax
         os.makedirs(path, exist_ok=True)

@@ -165,8 +165,44 @@ def test_derive_requires_vault_stanza(tmp_path):
             a.client_status == "running"
             for a in s.store.allocs_by_job("default", job.id)))
         alloc = s.store.allocs_by_job("default", job.id)[0]
+        # the wrong node secret is rejected before any policy checks
+        with pytest.raises(RpcError, match="node secret"):
+            s.endpoints.handle("Secrets.Derive",
+                               {"alloc_id": alloc.id, "task": "t",
+                                "node_id": c.node.id,
+                                "node_secret_id": "not-the-secret"})
         with pytest.raises(RpcError, match="no vault stanza"):
             s.endpoints.handle("Secrets.Derive",
-                               {"alloc_id": alloc.id, "task": "t"})
+                               {"alloc_id": alloc.id, "task": "t",
+                                "node_id": c.node.id,
+                                "node_secret_id": c.node.secret_id})
+    finally:
+        s.stop()
+
+
+def test_node_secret_redacted_and_put_acl_gated(tmp_path):
+    """Node.SecretID never leaves the servers via Node.List/GetNode, and
+    with ACLs on Secrets.Put demands a management token."""
+    from nomad_tpu.rpc.endpoints import RpcError
+    s, c = _world(tmp_path)
+    try:
+        assert _wait(lambda: s.store.node_by_id(c.node.id) is not None)
+        assert s.store.node_by_id(c.node.id).secret_id  # store keeps it
+        listed = s.endpoints.handle("Node.List", {})
+        assert listed and all(n.secret_id == "" for n in listed)
+        got = s.endpoints.handle("Node.GetNode", {"node_id": c.node.id})
+        assert got.secret_id == ""
+        # the redaction copies; the authoritative record is untouched
+        assert s.store.node_by_id(c.node.id).secret_id
+
+        s.enable_acl()
+        tok = s.bootstrap_acl()
+        with pytest.raises(RpcError, match="management"):
+            s.endpoints.handle("Secrets.Put",
+                               {"path": "x/y", "data": {"k": "v"}})
+        out = s.endpoints.handle("Secrets.Put",
+                                 {"path": "x/y", "data": {"k": "v"},
+                                  "token": tok.secret_id})
+        assert out["version"] == 1
     finally:
         s.stop()
