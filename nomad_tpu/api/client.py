@@ -7,6 +7,7 @@ Uses urllib only — the agent is local/cluster-internal.
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -31,13 +32,20 @@ class ApiError(Exception):
 
 
 class ApiClient:
+    # status codes a GET may safely retry: the request either never ran
+    # or is safe to re-run (reads only)
+    RETRYABLE_STATUSES = (502, 503, 504)
+
     def __init__(self, address: str = "http://127.0.0.1:4646",
                  token: str = "", namespace: str = "default",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, retries: int = 2,
+                 retry_backoff: float = 0.1):
         self.address = address.rstrip("/")
         self.token = token
         self.namespace = namespace
         self.timeout = timeout
+        self.retries = retries
+        self.retry_backoff = retry_backoff
         self.last_index = 0
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
@@ -69,13 +77,36 @@ class ApiClient:
         req.add_header("Content-Type", "application/json")
         if self.token:
             req.add_header("X-Nomad-Token", self.token)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                payload = resp.read()
-                self.last_index = int(
-                    resp.headers.get("X-Nomad-Index") or 0)
-        except urllib.error.HTTPError as e:
-            raise ApiError(e.code, e.read().decode(errors="replace"))
+        # only idempotent reads retry; writes surface their error — the
+        # server may have applied them before the connection dropped
+        attempts_left = self.retries if method == "GET" else 0
+        delay = self.retry_backoff
+        while True:
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as resp:
+                    payload = resp.read()
+                    self.last_index = int(
+                        resp.headers.get("X-Nomad-Index") or 0)
+                break
+            except urllib.error.HTTPError as e:
+                body_text = e.read().decode(errors="replace")
+                if attempts_left <= 0 or \
+                        e.code not in self.RETRYABLE_STATUSES:
+                    raise ApiError(e.code, body_text)
+                retry_after = e.headers.get("Retry-After") \
+                    if e.headers else None
+                try:
+                    wait = float(retry_after) if retry_after else delay
+                except ValueError:
+                    wait = delay
+                time.sleep(min(wait, 2.0))
+            except (urllib.error.URLError, ConnectionError):
+                if attempts_left <= 0:
+                    raise
+                time.sleep(min(delay, 2.0))
+            attempts_left -= 1
+            delay = min(delay * 2.0, 2.0)
         if raw:
             return payload
         return json.loads(payload) if payload else None

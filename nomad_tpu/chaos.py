@@ -1,0 +1,175 @@
+"""Seeded chaos layer: deterministic fault injection for the commit
+pipeline and control plane.
+
+A `ChaosRegistry` holds per-fault-point firing rates driven by one seeded
+RNG, so a soak run with a fixed seed draws a reproducible fault schedule.
+The registry is installed process-wide (`install()`) or picked up from the
+`NOMAD_TPU_CHAOS` environment variable at import.
+
+Spec grammar (semicolon-separated `key=value` pairs):
+
+    NOMAD_TPU_CHAOS="seed=42;rpc.drop=0.05;rpc.delay=0.02;delay_ms=5"
+
+where `seed` (int) seeds the RNG, `delay_ms` (float) sets the injected
+latency for `rpc.delay`, and every other key must be one of the named
+fault points below with a rate in [0, 1].
+
+Fault points and their injection sites:
+
+    rpc.drop                  rpc/tcp.py, raft/transport.py — connection
+                              dropped before the request is sent
+    rpc.delay                 same sites — `delay_ms` of extra latency
+    raft.partition            raft/transport.py — raft traffic
+                              (vote/append/snapshot) fails Unreachable
+    plan.crash_before_commit  core/plan_apply.py — applier dies after
+                              evaluation, before the store/raft write
+    plan.crash_after_commit   core/plan_apply.py — applier dies after the
+                              write lands, before futures resolve
+    broker.lease_expire       core/broker.py — a dequeue lease expires
+                              immediately (worker's ack/plan goes stale)
+    native.fail               native/__init__.py — a native kernel call
+                              raises (drives the circuit breaker)
+
+Zero-overhead-when-disabled contract: `active` is None unless a registry
+is installed; every injection site guards with `if chaos.active is not
+None` (one module-attribute load) before doing any work.  The module
+draws from its own `random.Random` — installing chaos never perturbs the
+global `random` stream.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+FAULT_POINTS = (
+    "rpc.drop",
+    "rpc.delay",
+    "raft.partition",
+    "plan.crash_before_commit",
+    "plan.crash_after_commit",
+    "broker.lease_expire",
+    "native.fail",
+)
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (never raised by real failures)."""
+
+    def __init__(self, point: str):
+        super().__init__(f"chaos: injected fault at {point!r}")
+        self.point = point
+
+
+class ChaosRegistry:
+    """Per-point firing rates over one seeded RNG.
+
+    `should(point)` draws once from the RNG iff the point has a non-zero
+    rate, so runs with the same seed and the same rate map produce the
+    same decision sequence per point-check order.  Thread interleaving
+    can reorder which caller gets which draw; the schedule stays
+    reproducible in distribution, which is what the soak asserts on.
+    """
+
+    def __init__(self, seed: int = 0,
+                 rates: Optional[Dict[str, float]] = None,
+                 delay_ms: float = 2.0):
+        rates = dict(rates or {})
+        for point, rate in rates.items():
+            if point not in FAULT_POINTS:
+                raise ValueError(f"unknown chaos fault point {point!r} "
+                                 f"(known: {', '.join(FAULT_POINTS)})")
+            if not 0.0 <= float(rate) <= 1.0:
+                raise ValueError(f"chaos rate for {point!r} must be in "
+                                 f"[0, 1], got {rate!r}")
+        self.seed = int(seed)
+        self.delay_ms = float(delay_ms)
+        self.rates = {p: float(rates.get(p, 0.0)) for p in FAULT_POINTS}
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self.stats: Dict[str, int] = defaultdict(int)
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "ChaosRegistry":
+        """Parse the `NOMAD_TPU_CHAOS` grammar (see module docstring)."""
+        seed = 0
+        delay_ms = 2.0
+        rates: Dict[str, float] = {}
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(
+                    f"bad chaos spec element {part!r}: want key=value")
+            key, _, value = part.partition("=")
+            key, value = key.strip(), value.strip()
+            if key == "seed":
+                seed = int(value)
+            elif key == "delay_ms":
+                delay_ms = float(value)
+            else:
+                rates[key] = float(value)   # key validated by __init__
+        return cls(seed=seed, rates=rates, delay_ms=delay_ms)
+
+    def spec(self) -> str:
+        """Round-trip back to the env-var grammar."""
+        parts = [f"seed={self.seed}", f"delay_ms={self.delay_ms:g}"]
+        parts += [f"{p}={r:g}" for p, r in self.rates.items() if r > 0.0]
+        return ";".join(parts)
+
+    def should(self, point: str) -> bool:
+        rate = self.rates.get(point, 0.0)
+        if rate <= 0.0:
+            return False
+        with self._lock:
+            hit = self._rng.random() < rate
+            if hit:
+                self.stats[point] += 1
+        return hit
+
+
+# The installed registry; None = chaos disabled (the common case).
+# Injection sites read this module attribute directly so the disabled
+# fast path is a load + identity check.
+active: Optional[ChaosRegistry] = None
+
+
+def install(registry: Optional[ChaosRegistry]) -> Optional[ChaosRegistry]:
+    """Install (or, with None, remove) the process-wide registry.
+    Returns the previous one so callers can restore it."""
+    global active
+    prev = active
+    active = registry
+    return prev
+
+
+def uninstall() -> Optional[ChaosRegistry]:
+    return install(None)
+
+
+def should(point: str) -> bool:
+    reg = active
+    return reg is not None and reg.should(point)
+
+
+def fire(point: str) -> None:
+    """Raise ChaosError if `point` fires.  Call sites that need a
+    domain-specific exception type use should() and raise their own."""
+    reg = active
+    if reg is not None and reg.should(point):
+        raise ChaosError(point)
+
+
+def maybe_delay(point: str = "rpc.delay") -> None:
+    reg = active
+    if reg is not None and reg.should(point):
+        time.sleep(reg.delay_ms / 1000.0)
+
+
+_env_spec = os.environ.get("NOMAD_TPU_CHAOS", "")
+if _env_spec:
+    active = ChaosRegistry.from_spec(_env_spec)

@@ -22,6 +22,7 @@ import uuid
 from collections import defaultdict, deque
 from typing import Dict, List, Optional, Set, Tuple
 
+from nomad_tpu import chaos
 from nomad_tpu.structs import Evaluation
 
 FAILED_QUEUE = "_failed"
@@ -143,7 +144,14 @@ class EvalBroker:
                     heapq.heappop(self._ready[best_q])
                     ev = best[2]
                     token = str(uuid.uuid4())
-                    self._unack[token] = _Lease(ev, token, _time.time() + self.nack_timeout)
+                    expires = _time.time() + self.nack_timeout
+                    if chaos.active is not None and \
+                            chaos.active.should("broker.lease_expire"):
+                        # hand out an already-expired lease: the next timer
+                        # poll auto-nacks it, so the worker's eventual ack
+                        # or plan submit sees a stale token
+                        expires = _time.time()
+                    self._unack[token] = _Lease(ev, token, expires)
                     self.stats["dequeued"] += 1
                     return ev, token
                 remaining = deadline - _time.time()
@@ -215,6 +223,9 @@ class EvalBroker:
 
     def outstanding(self, eval_id: str) -> Optional[str]:
         with self._lock:
+            # settle expired leases first so a stale token is never
+            # reported as live (the plan-submit gate relies on this)
+            self._poll_timers_locked()
             for token, lease in self._unack.items():
                 if lease.eval.id == eval_id:
                     return token

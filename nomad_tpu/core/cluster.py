@@ -69,6 +69,15 @@ class Cluster:
         self.transport.set_down(server.name)
         server.stop()
 
+    def isolate(self, server: Server) -> None:
+        """Cut a live member off the network (it keeps running — the
+        asymmetric failure that forces a leader step-down, unlike kill)."""
+        self.transport.set_down(server.name)
+
+    def heal(self, server: Server) -> None:
+        """Reconnect a member isolated with isolate()."""
+        self.transport.set_down(server.name, down=False)
+
     def wait_replication(self, index: int, timeout: float = 5.0) -> bool:
         """Wait until every live member's store reaches `index`."""
         deadline = time.monotonic() + timeout

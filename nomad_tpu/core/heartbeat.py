@@ -69,6 +69,15 @@ class HeartbeatTracker:
                     # not kill the heartbeat loop for the whole tenure
                     import logging
                     logging.getLogger(__name__).exception("invalidate")
+                    # the node was already popped from _deadlines; without
+                    # a retry deadline it would stay tracked-as-alive
+                    # forever despite the missed TTL.  Re-arm a short one
+                    # (unless the node re-heartbeated meanwhile).
+                    retry = _time.time() + min(self.ttl, 1.0)
+                    with self._lock:
+                        if node_id not in self._deadlines:
+                            self._deadlines[node_id] = retry
+                            heapq.heappush(self._heap, (retry, node_id))
             self._stop.wait(self.tick)
 
     def _invalidate(self, node_id: str) -> None:

@@ -34,6 +34,7 @@ import numpy as np
 
 from nomad_tpu.encode.matrixizer import comparable_vec, NUM_RESOURCE_DIMS
 
+from nomad_tpu import chaos
 from nomad_tpu.state.store import AppliedPlanResults, StateStore
 from nomad_tpu.structs import Allocation, Node
 from nomad_tpu.structs.node import NodeStatus
@@ -160,6 +161,8 @@ class PlanApplier:
             applied_list = [ap for _, _, ap in entries if ap is not None]
             index = None
             if applied_list:
+                if chaos.active is not None:
+                    chaos.fire("plan.crash_before_commit")
                 with self._commit_lock:
                     if self._commit_fn is not None:
                         index = self._commit_fn(
@@ -169,6 +172,11 @@ class PlanApplier:
                         index = self.store.latest_index + 1
                         self.store.upsert_plan_results_many(
                             index, applied_list)
+                if chaos.active is not None:
+                    # the write landed but futures have not resolved: the
+                    # submitter sees an error, retries, and the plan-id
+                    # dedup in the store makes the replay a no-op
+                    chaos.fire("plan.crash_after_commit")
             for pending, result, applied in entries:
                 try:
                     self._post_commit(pending.plan, result, applied, index)
@@ -424,6 +432,7 @@ class PlanApplier:
             deployment=result.deployment,
             deployment_updates=result.deployment_updates,
             eval_id=plan.eval_id,
+            plan_id=getattr(plan, "plan_id", ""),
         )
 
     def _post_commit(self, plan: Plan, result: PlanResult,
@@ -453,12 +462,16 @@ class PlanApplier:
         applied = self._applied_for(plan, result)
         index = None
         if applied is not None:
+            if chaos.active is not None:
+                chaos.fire("plan.crash_before_commit")
             with self._commit_lock:
                 if self._commit_fn is not None:
                     index = self._commit_fn(applied)
                 else:
                     index = self.store.latest_index + 1
                     self.store.upsert_plan_results(index, applied)
+            if chaos.active is not None:
+                chaos.fire("plan.crash_after_commit")
         self._post_commit(plan, result, applied, index)
 
 

@@ -58,7 +58,8 @@ class ServerConfig:
                  heartbeat_ttl: float = 10.0,
                  gc_interval: float = 300.0,
                  data_dir: Optional[str] = None,
-                 region: str = "global"):
+                 region: str = "global",
+                 failed_eval_followup_delay: float = 60.0):
         self.num_schedulers = num_schedulers
         self.enabled_schedulers = enabled_schedulers or \
             ["service", "batch", "system", "sysbatch"]
@@ -66,6 +67,7 @@ class ServerConfig:
         self.gc_interval = gc_interval
         self.data_dir = data_dir
         self.region = region
+        self.failed_eval_followup_delay = failed_eval_followup_delay
 
 
 class Server:
@@ -217,14 +219,36 @@ class Server:
                                         args)
         return peer.rpc_leader(method, args)
 
+    def enqueue_plan(self, plan):
+        """Plan-queue enqueue gated on the submitting worker still holding
+        its eval lease (reference planner token check, plan_endpoint.go):
+        if the lease expired (auto-nack) or moved to another worker, this
+        plan is from a superseded scheduling pass and must not commit."""
+        if plan.eval_id and plan.eval_token:
+            if self.broker.outstanding(plan.eval_id) != plan.eval_token:
+                from nomad_tpu.rpc.endpoints import RpcError
+                raise RpcError(
+                    "stale_eval_token",
+                    f"eval {plan.eval_id}: lease expired or reassigned")
+        return self.plan_queue.enqueue(plan)
+
     def _commit_plan(self, applied) -> int:
         """Commit applier output through the raft write path.  `applied`
         is one AppliedPlanResults or a LIST of them — the applier
         coalesces adjacent plans from the queue into one log entry (one
         raft apply, one index) and the FSM fans the batch out to the
-        store under a single lock acquisition."""
-        return self.apply(MessageType.APPLY_PLAN_RESULTS,
-                          {"results": applied})
+        store under a single lock acquisition.
+
+        Deliberately NOT leader-forwarded (apply_local, not apply): the
+        eval-token gate runs at enqueue time against THIS server's
+        broker, so a plan stranded in the applier when leadership moves
+        must fail with NotLeaderError — forwarding it would commit a
+        deposed leader's plan on the new leader, whose broker may have
+        already redelivered the eval and committed a competing plan
+        (double placement).  The failed future nacks the eval and it
+        reschedules under the new leader's gate."""
+        return self.apply_local(MessageType.APPLY_PLAN_RESULTS,
+                                {"results": applied})
 
     def next_index(self) -> int:
         with self._raft_lock:
@@ -279,6 +303,11 @@ class Server:
                     w = Worker(self, i, self.config.enabled_schedulers)
                     w.start()
                     self.workers.append(w)
+            if self.raft is not None:
+                # barrier before reading the store: a fresh leader may
+                # still be replaying committed entries, and restoring
+                # evals from a stale view would drop the tail of them
+                self.raft.barrier(5.0)
             self._restore_evals()
             t = threading.Thread(target=self._failed_eval_reaper,
                                  args=(stop,), name="eval-reaper", daemon=True)
@@ -389,7 +418,8 @@ class Server:
                 namespace=ev.namespace, priority=ev.priority, type=ev.type,
                 job_id=ev.job_id, triggered_by=EvalTrigger.FAILED_FOLLOW_UP,
                 status=EvalStatus.PENDING,
-                wait_until=_time.time() + 60.0)
+                wait_until=_time.time() +
+                self.config.failed_eval_followup_delay)
             self.create_evals([follow])
             self.broker.ack(ev.id, token)
 

@@ -10,6 +10,7 @@ plans go to the plan queue and the worker blocks on the applier's result.
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from typing import List, Optional
@@ -40,6 +41,9 @@ class Worker:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._snapshot = None
+        # store index the scheduling snapshot must reach before this
+        # worker's current eval may be processed (set at dequeue)
+        self._wait_index = 0
         self.stats = {"processed": 0, "failed": 0}
 
     # ------------------------------------------------------------- lifecycle
@@ -62,6 +66,15 @@ class Worker:
             if got is None:
                 continue
             ev, token = got
+            if self._stop.is_set():
+                # stop() landed while the dequeue was in flight: hand the
+                # lease back so a live worker gets the eval now rather
+                # than after the nack timeout
+                try:
+                    self._nack(ev.id, token)
+                except TRANSIENT_ERRORS:
+                    pass
+                break
             try:
                 self.process_eval(ev, token)
             except TRANSIENT_ERRORS:
@@ -82,7 +95,10 @@ class Worker:
     def _dequeue(self):
         ev, token = self.server.broker.dequeue(
             self.enabled_schedulers, timeout=0.1)
-        return None if ev is None else (ev, token)
+        if ev is None:
+            return None
+        self._wait_index = self.server.store.latest_index
+        return ev, token
 
     def _ack(self, eval_id: str, token: str) -> bool:
         return self.server.broker.ack(eval_id, token)
@@ -94,8 +110,12 @@ class Worker:
 
     def process_eval(self, ev: Evaluation, token: str) -> None:
         server = self.server
+        # _wait_index covers redelivery: a plan may already have committed
+        # for this eval (crash-after-commit nack, lease expiry, failover)
+        # at an index past the eval's own, and scheduling from an older
+        # snapshot would double-place the job
         snap = server.store.snapshot_min_index(
-            max(ev.modify_index, ev.snapshot_index))
+            max(ev.modify_index, ev.snapshot_index, self._wait_index))
         if snap is None:
             self._nack(ev.id, token)
             return
@@ -128,7 +148,7 @@ class Worker:
     def submit_plan(self, plan: Plan) -> PlanResult:
         plan.eval_token = getattr(self, "_token", "")
         t0 = time.time()
-        pending = self.server.plan_queue.enqueue(plan)
+        pending = self.server.enqueue_plan(plan)
         # generous: under full-cluster bursts (the 1M-alloc C2M) the
         # serialized applier legitimately backs up for minutes; an eval
         # failed on a timed-out future gets retried from scratch even
@@ -159,8 +179,29 @@ class RemoteWorker(Worker):
     snapshot — the reference's every-server worker pool (worker.go:81-85,
     Eval.Dequeue / Plan.Submit RPCs)."""
 
-    def _rpc(self, method: str, args: dict):
-        return self.server.rpc_leader(method, args)
+    # RpcError kinds worth retrying: the request was rejected before it
+    # executed (election in progress / forwarded to a dead leader).  Any
+    # other kind (stale_eval_token, internal, ...) is a real answer.
+    _RETRYABLE_KINDS = frozenset({"no_leader", "not_leader"})
+
+    def _rpc(self, method: str, args: dict, deadline: float = 5.0):
+        """rpc_leader with exponential backoff + jitter across leadership
+        churn.  Retried requests never double-execute: dequeue/ack/nack
+        are lease-guarded and Plan.Submit dedups on plan_id."""
+        dl = time.monotonic() + deadline
+        delay = 0.02
+        while True:
+            try:
+                return self.server.rpc_leader(method, args)
+            except TRANSIENT_ERRORS as e:
+                if isinstance(e, RpcError) and \
+                        e.kind not in self._RETRYABLE_KINDS:
+                    raise
+                if self._stop.is_set() or time.monotonic() >= dl:
+                    raise
+                sleep = min(delay, max(0.0, dl - time.monotonic()))
+                self._stop.wait(sleep * (0.5 + random.random() * 0.5))
+                delay = min(delay * 2.0, 0.5)
 
     def _dequeue(self):
         try:
@@ -172,6 +213,7 @@ class RemoteWorker(Worker):
             return None
         if resp is None:
             return None
+        self._wait_index = resp.get("wait_index", 0)
         return resp["eval"], resp["token"]
 
     def _ack(self, eval_id: str, token: str) -> bool:
@@ -179,11 +221,20 @@ class RemoteWorker(Worker):
                          {"eval_id": eval_id, "token": token})["ok"]
 
     def _nack(self, eval_id: str, token: str) -> bool:
-        try:
-            return self._rpc("Eval.Nack",
-                             {"eval_id": eval_id, "token": token})["ok"]
-        except TRANSIENT_ERRORS:
-            return False   # lease expires server-side; eval redelivers
+        # bounded retry: a prompt nack redelivers in seconds where the
+        # lease-expiry fallback costs the full nack_timeout
+        delay = 0.02
+        for attempt in range(3):
+            try:
+                return self._rpc("Eval.Nack",
+                                 {"eval_id": eval_id, "token": token},
+                                 deadline=1.0)["ok"]
+            except TRANSIENT_ERRORS:
+                if attempt == 2 or self._stop.is_set():
+                    break
+                self._stop.wait(delay * (0.5 + random.random() * 0.5))
+                delay = min(delay * 2.0, 0.25)
+        return False   # lease expires server-side; eval redelivers
 
     def submit_plan(self, plan: Plan) -> PlanResult:
         plan.eval_token = getattr(self, "_token", "")

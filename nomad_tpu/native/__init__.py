@@ -27,6 +27,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from nomad_tpu import chaos
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "native",
                                      "nomad_native.cpp"))
@@ -118,6 +120,50 @@ def _load() -> Optional[ctypes.CDLL]:
         return lib
 
 
+class CircuitBreaker:
+    """Trips to the Python fallback after `threshold` consecutive native
+    failures — a bad build or ABI drift fails on every call, and one trip
+    beats paying an exception (or a crash risk) per call.  `reset()`
+    closes the circuit again (e.g. after a rebuild)."""
+
+    def __init__(self, threshold: int = 3):
+        self.threshold = max(1, int(threshold))
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self.open = False
+        self.stats = {"failures": 0, "trips": 0}
+
+    def record_ok(self) -> None:
+        if self._consecutive:
+            with self._lock:
+                self._consecutive = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            self.stats["failures"] += 1
+            if not self.open and self._consecutive >= self.threshold:
+                self.open = True
+                self.stats["trips"] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self.open = False
+
+
+breaker = CircuitBreaker(
+    int(os.environ.get("NOMAD_TPU_NATIVE_BREAKER", "3")))
+
+
+def _native_lib() -> Optional[ctypes.CDLL]:
+    """The library iff the circuit is closed; every native call site goes
+    through here so an open breaker routes everything to Python."""
+    if breaker.open:
+        return None
+    return _load()
+
+
 _EMPTY_I32 = np.zeros(0, np.int32)
 
 
@@ -128,13 +174,19 @@ def allocs_fit(capacity: np.ndarray, used: np.ndarray,
     capacity = np.ascontiguousarray(capacity, np.float32)
     used = np.ascontiguousarray(used, np.float32)
     demand = np.ascontiguousarray(demand, np.float32)
-    lib = _load()
-    if lib is None:
-        return np.all(used + demand <= capacity + 1e-6, axis=1)
-    out = np.empty(capacity.shape[0], np.uint8)
-    lib.allocs_fit_dense(capacity, used, demand,
-                         capacity.shape[0], capacity.shape[1], out)
-    return out.astype(bool)
+    lib = _native_lib()
+    if lib is not None:
+        try:
+            if chaos.active is not None:
+                chaos.fire("native.fail")
+            out = np.empty(capacity.shape[0], np.uint8)
+            lib.allocs_fit_dense(capacity, used, demand,
+                                 capacity.shape[0], capacity.shape[1], out)
+            breaker.record_ok()
+            return out.astype(bool)
+        except Exception:                          # noqa: BLE001
+            breaker.record_failure()
+    return np.all(used + demand <= capacity + 1e-6, axis=1)
 
 
 def score_fit(capacity: np.ndarray, used: np.ndarray,
@@ -143,18 +195,24 @@ def score_fit(capacity: np.ndarray, used: np.ndarray,
     capacity = np.ascontiguousarray(capacity, np.float32)
     used = np.ascontiguousarray(used, np.float32)
     demand = np.ascontiguousarray(demand, np.float32)
-    lib = _load()
-    if lib is None:
-        cap = np.maximum(capacity[:, :2], 1e-9)
-        free = np.clip((cap - (used[:, :2] + demand[:2])) / cap, 0.0, 1.0)
-        exp = 1.0 - free if spread else free
-        total = np.power(10.0, exp).sum(axis=1)
-        total = np.where((capacity[:, :2] <= 0).any(axis=1), 40.0, total)
-        return np.clip((20.0 - total) / 18.0, 0.0, 1.0).astype(np.float32)
-    out = np.empty(capacity.shape[0], np.float32)
-    lib.score_fit_dense(capacity, used, demand, capacity.shape[0],
-                        capacity.shape[1], int(spread), out)
-    return out
+    lib = _native_lib()
+    if lib is not None:
+        try:
+            if chaos.active is not None:
+                chaos.fire("native.fail")
+            out = np.empty(capacity.shape[0], np.float32)
+            lib.score_fit_dense(capacity, used, demand, capacity.shape[0],
+                                capacity.shape[1], int(spread), out)
+            breaker.record_ok()
+            return out
+        except Exception:                          # noqa: BLE001
+            breaker.record_failure()
+    cap = np.maximum(capacity[:, :2], 1e-9)
+    free = np.clip((cap - (used[:, :2] + demand[:2])) / cap, 0.0, 1.0)
+    exp = 1.0 - free if spread else free
+    total = np.power(10.0, exp).sum(axis=1)
+    total = np.where((capacity[:, :2] <= 0).any(axis=1), 40.0, total)
+    return np.clip((20.0 - total) / 18.0, 0.0, 1.0).astype(np.float32)
 
 
 def ports_check(port_words: np.ndarray, row: int,
@@ -163,42 +221,57 @@ def ports_check(port_words: np.ndarray, row: int,
     """All `ports` free on `row` (ports in `freed` count as free)?"""
     ports_a = np.asarray(list(ports), np.int32)
     freed_a = np.asarray(list(freed), np.int32)
-    lib = _load()
-    if lib is None:
-        seen = set()
-        for p in ports_a:
-            p = int(p)
-            if p in seen:
+    lib = _native_lib()
+    if lib is not None:
+        try:
+            if chaos.active is not None:
+                chaos.fire("native.fail")
+            pw = np.ascontiguousarray(port_words, np.uint32)
+            ok = bool(lib.ports_check(pw, pw.shape[1], row,
+                                      ports_a, len(ports_a),
+                                      freed_a, len(freed_a)))
+            breaker.record_ok()
+            return ok
+        except Exception:                          # noqa: BLE001
+            breaker.record_failure()
+    seen = set()
+    for p in ports_a:
+        p = int(p)
+        if p in seen:
+            return False
+        seen.add(p)
+        if p < 0 or (p >> 5) >= port_words.shape[1]:
+            return False
+        if (port_words[row, p >> 5] >> np.uint32(p & 31)) & 1:
+            if p not in set(int(x) for x in freed_a):
                 return False
-            seen.add(p)
-            if p < 0 or (p >> 5) >= port_words.shape[1]:
-                return False
-            if (port_words[row, p >> 5] >> np.uint32(p & 31)) & 1:
-                if p not in set(int(x) for x in freed_a):
-                    return False
-        return True
-    port_words = np.ascontiguousarray(port_words, np.uint32)
-    return bool(lib.ports_check(port_words, port_words.shape[1], row,
-                                ports_a, len(ports_a),
-                                freed_a, len(freed_a)))
+    return True
 
 
 def ports_set(port_words: np.ndarray, row: int,
               ports: Sequence[int], value: bool) -> None:
     ports_a = np.asarray(list(ports), np.int32)
-    lib = _load()
-    if lib is None or not port_words.flags["C_CONTIGUOUS"]:
-        for p in ports_a:
-            p = int(p)
-            if p < 0 or (p >> 5) >= port_words.shape[1]:
-                continue
-            if value:
-                port_words[row, p >> 5] |= np.uint32(1 << (p & 31))
-            else:
-                port_words[row, p >> 5] &= ~np.uint32(1 << (p & 31))
-        return
-    lib.ports_set(port_words, port_words.shape[1], row,
-                  ports_a, len(ports_a), int(value))
+    lib = _native_lib()
+    if lib is not None and port_words.flags["C_CONTIGUOUS"]:
+        # per-port bit sets are idempotent, so retrying the whole batch in
+        # Python after a mid-call native failure is safe
+        try:
+            if chaos.active is not None:
+                chaos.fire("native.fail")
+            lib.ports_set(port_words, port_words.shape[1], row,
+                          ports_a, len(ports_a), int(value))
+            breaker.record_ok()
+            return
+        except Exception:                          # noqa: BLE001
+            breaker.record_failure()
+    for p in ports_a:
+        p = int(p)
+        if p < 0 or (p >> 5) >= port_words.shape[1]:
+            continue
+        if value:
+            port_words[row, p >> 5] |= np.uint32(1 << (p & 31))
+        else:
+            port_words[row, p >> 5] &= ~np.uint32(1 << (p & 31))
 
 
 def scatter_add(used: np.ndarray, rows: Sequence[int],
@@ -206,11 +279,21 @@ def scatter_add(used: np.ndarray, rows: Sequence[int],
     """used[rows[k]] += deltas[k] in place."""
     rows_a = np.asarray(list(rows), np.int32)
     deltas = np.ascontiguousarray(deltas, np.float32)
-    lib = _load()
-    if lib is None or not used.flags["C_CONTIGUOUS"]:
-        np.add.at(used, rows_a, deltas)
-        return
-    lib.scatter_add(used, used.shape[1], rows_a, deltas, len(rows_a))
+    lib = _native_lib()
+    if lib is not None and used.flags["C_CONTIGUOUS"]:
+        # += is not idempotent, so failures must surface before the native
+        # call touches `used`: ctypes argtype errors and injected faults
+        # both raise pre-entry
+        try:
+            if chaos.active is not None:
+                chaos.fire("native.fail")
+            lib.scatter_add(used, used.shape[1], rows_a, deltas,
+                            len(rows_a))
+            breaker.record_ok()
+            return
+        except Exception:                          # noqa: BLE001
+            breaker.record_failure()
+    np.add.at(used, rows_a, deltas)
 
 
 def validate_plan(capacity: np.ndarray, used: np.ndarray,
@@ -236,26 +319,33 @@ def validate_plan(capacity: np.ndarray, used: np.ndarray,
         freed_off[i + 1] = len(flat_freed)
     ports_a = np.asarray(flat_ports, np.int32) if flat_ports else _EMPTY_I32
     freed_a = np.asarray(flat_freed, np.int32) if flat_freed else _EMPTY_I32
-    lib = _load()
-    if lib is None:
-        out = np.zeros(g, bool)
-        for i in range(g):
-            r = int(rows_a[i])
-            if r < 0:
-                continue
-            fits = np.all(used[r] + demand[i] - freed[i]
-                          <= capacity[r] + 1e-6)
-            out[i] = fits and ports_check(
-                port_words, r, group_ports[i], group_freed_ports[i])
-        return out
-    capacity = np.ascontiguousarray(capacity, np.float32)
-    used = np.ascontiguousarray(used, np.float32)
-    port_words = np.ascontiguousarray(port_words, np.uint32)
-    out = np.empty(g, np.uint8)
-    lib.validate_plan(capacity, used, port_words, port_words.shape[1],
-                      capacity.shape[1], rows_a, demand, freed,
-                      ports_a, ports_off, freed_a, freed_off, g, out)
-    return out.astype(bool)
+    lib = _native_lib()
+    if lib is not None:
+        try:
+            if chaos.active is not None:
+                chaos.fire("native.fail")
+            cap_c = np.ascontiguousarray(capacity, np.float32)
+            used_c = np.ascontiguousarray(used, np.float32)
+            pw_c = np.ascontiguousarray(port_words, np.uint32)
+            out = np.empty(g, np.uint8)
+            lib.validate_plan(cap_c, used_c, pw_c, pw_c.shape[1],
+                              cap_c.shape[1], rows_a, demand, freed,
+                              ports_a, ports_off, freed_a, freed_off, g,
+                              out)
+            breaker.record_ok()
+            return out.astype(bool)
+        except Exception:                          # noqa: BLE001
+            breaker.record_failure()
+    out = np.zeros(g, bool)
+    for i in range(g):
+        r = int(rows_a[i])
+        if r < 0:
+            continue
+        fits = np.all(used[r] + demand[i] - freed[i]
+                      <= capacity[r] + 1e-6)
+        out[i] = fits and ports_check(
+            port_words, r, group_ports[i], group_freed_ports[i])
+    return out
 
 
 def expand_pairs(rows: np.ndarray, counts: np.ndarray,
@@ -272,20 +362,24 @@ def expand_pairs(rows: np.ndarray, counts: np.ndarray,
     else:
         scores_a = np.ascontiguousarray(scores, np.float32)
     total = int(np.clip(counts_a, 0, None).sum())
-    lib = _load()
-    if lib is None or total == 0:
-        keep = counts_a > 0
-        return (np.repeat(rows_a[keep], counts_a[keep]),
-                np.repeat(scores_a[keep], counts_a[keep]))
-    out_rows = np.empty(total, np.int32)
-    out_scores = np.empty(total, np.float32)
-    w = lib.expand_pairs(rows_a, counts_a, scores_a, rows_a.shape[0],
-                         out_rows, out_scores, total)
-    if w != total:                      # defensive; cap == exact total
-        keep = counts_a > 0
-        return (np.repeat(rows_a[keep], counts_a[keep]),
-                np.repeat(scores_a[keep], counts_a[keep]))
-    return out_rows, out_scores
+    lib = _native_lib()
+    if lib is not None and total > 0:
+        try:
+            if chaos.active is not None:
+                chaos.fire("native.fail")
+            out_rows = np.empty(total, np.int32)
+            out_scores = np.empty(total, np.float32)
+            w = lib.expand_pairs(rows_a, counts_a, scores_a,
+                                 rows_a.shape[0], out_rows, out_scores,
+                                 total)
+            breaker.record_ok()
+            if w == total:              # defensive; cap == exact total
+                return out_rows, out_scores
+        except Exception:                          # noqa: BLE001
+            breaker.record_failure()
+    keep = counts_a > 0
+    return (np.repeat(rows_a[keep], counts_a[keep]),
+            np.repeat(scores_a[keep], counts_a[keep]))
 
 
 def format_uuids(n: int) -> List[str]:
@@ -294,15 +388,22 @@ def format_uuids(n: int) -> List[str]:
     if n <= 0:
         return []
     rnd = np.frombuffer(os.urandom(16 * n), np.uint8)
-    lib = _load()
-    if lib is None:
-        h = rnd.tobytes().hex()
-        return [f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}"
-                for s in (h[i * 32:(i + 1) * 32] for i in range(n))]
-    out = ctypes.create_string_buffer(36 * n)
-    lib.format_uuids(np.ascontiguousarray(rnd), n, out)
-    raw = out.raw
-    return [raw[i * 36:(i + 1) * 36].decode("ascii") for i in range(n)]
+    lib = _native_lib()
+    if lib is not None:
+        try:
+            if chaos.active is not None:
+                chaos.fire("native.fail")
+            out = ctypes.create_string_buffer(36 * n)
+            lib.format_uuids(np.ascontiguousarray(rnd), n, out)
+            raw = out.raw
+            breaker.record_ok()
+            return [raw[i * 36:(i + 1) * 36].decode("ascii")
+                    for i in range(n)]
+        except Exception:                          # noqa: BLE001
+            breaker.record_failure()
+    h = rnd.tobytes().hex()
+    return [f"{s[:8]}-{s[8:12]}-{s[12:16]}-{s[16:20]}-{s[20:]}"
+            for s in (h[i * 32:(i + 1) * 32] for i in range(n))]
 
 
 def scatter_add_rank1(used: np.ndarray, rows: np.ndarray,
@@ -312,11 +413,17 @@ def scatter_add_rank1(used: np.ndarray, rows: np.ndarray,
     rows_a = np.ascontiguousarray(rows, np.int32)
     counts_a = np.ascontiguousarray(counts, np.int32)
     demand_a = np.ascontiguousarray(demand, np.float32)
-    lib = _load()
-    if lib is None or not used.flags["C_CONTIGUOUS"] \
-            or used.dtype != np.float32:
-        np.add.at(used, rows_a,
-                  counts_a[:, None].astype(used.dtype) * demand_a)
-        return
-    lib.scatter_add_rank1(used, used.shape[1], rows_a, counts_a,
-                          demand_a, rows_a.shape[0])
+    lib = _native_lib()
+    if lib is not None and used.flags["C_CONTIGUOUS"] \
+            and used.dtype == np.float32:
+        try:
+            if chaos.active is not None:
+                chaos.fire("native.fail")
+            lib.scatter_add_rank1(used, used.shape[1], rows_a, counts_a,
+                                  demand_a, rows_a.shape[0])
+            breaker.record_ok()
+            return
+        except Exception:                          # noqa: BLE001
+            breaker.record_failure()
+    np.add.at(used, rows_a,
+              counts_a[:, None].astype(used.dtype) * demand_a)

@@ -287,6 +287,7 @@ class NomadFSM:
                 "scaling_events": {k: list(v) for k, v in
                                    s._scaling_events.items()},
                 "services": list(s._services.values()),
+                "applied_plan_ids": list(s._applied_plan_ids),
                 "extra": {name: fn() for name, fn in
                           getattr(self, "snapshot_extra", {}).items()},
             }
@@ -343,6 +344,8 @@ class NomadFSM:
                 s._allocs_by_node[a.node_id].add(a.id)
                 s._allocs_by_eval[a.eval_id].add(a.id)
                 s.matrix.upsert_alloc(a)
+            s._applied_plan_ids = list(data.get("applied_plan_ids", []))
+            s._applied_plan_ids_set = set(s._applied_plan_ids)
             s.latest_index = data["latest_index"]
             s._snapshot_cache = None
             s._index_cv.notify_all()

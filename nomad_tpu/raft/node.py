@@ -149,7 +149,22 @@ class RaftNode:
         return index
 
     def barrier(self, timeout: float = 10.0) -> None:
-        """Wait until everything committed so far is applied locally."""
+        """Flush the log and wait for it to apply locally (best-effort).
+
+        On a leader this pushes a no-op through the full append/commit/
+        apply path (hashicorp/raft Barrier): when it returns, every entry
+        committed before the call has been applied — including prior-term
+        entries that only BECOME committed via a new-term write.  The
+        plain commit_index wait is not enough for a fresh leader: its
+        commit_index can lag entries a deposed leader already replicated
+        to a majority, and acting on pre-barrier state (e.g. restoring
+        evals) would miss their effects."""
+        if self.is_leader:
+            try:
+                self.apply("Noop", None, timeout=timeout)
+                return   # future resolves only after local FSM apply
+            except Exception:                       # noqa: BLE001
+                pass     # deposed or timed out: fall back to local wait
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._lock:

@@ -21,9 +21,28 @@ import socketserver
 import threading
 from typing import Callable, Dict, Optional, Set, Tuple
 
+from nomad_tpu import chaos
+
 
 class Unreachable(Exception):
     pass
+
+
+_RAFT_METHODS = frozenset(
+    {"request_vote", "append_entries", "install_snapshot"})
+
+
+def _chaos_check(src: str, dst: str, method: str) -> None:
+    """Shared transport fault points: rpc.drop hits any remote call,
+    raft.partition only consensus traffic."""
+    reg = chaos.active
+    if reg is None or src == dst:
+        return
+    chaos.maybe_delay()
+    if reg.should("rpc.drop"):
+        raise Unreachable(f"{src}->{dst}: chaos rpc.drop")
+    if method in _RAFT_METHODS and reg.should("raft.partition"):
+        raise Unreachable(f"{src}->{dst}: chaos raft.partition")
 
 
 class InMemTransport:
@@ -66,6 +85,8 @@ class InMemTransport:
                        or dst in self._partitions.get(src, ()))
         if handler is None or blocked:
             raise Unreachable(f"{src}->{dst}")
+        if chaos.active is not None:
+            _chaos_check(src, dst, method)
         # wire round-trip: no shared mutable state between servers
         args = pickle.loads(pickle.dumps(args))
         out = handler(method, args)
@@ -170,6 +191,8 @@ class TcpTransport:
     def call(self, src: str, dst: str, method: str, args: dict) -> dict:
         from nomad_tpu.rpc.tcp import _recv_frame, _send_frame
 
+        if chaos.active is not None:
+            _chaos_check(src, dst, method)
         handler = self._local(dst)
         if handler is not None:
             # local shortcut still round-trips through pickle so local

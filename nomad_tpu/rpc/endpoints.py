@@ -389,7 +389,14 @@ class Endpoints:
             args["schedulers"], timeout=args.get("timeout", 0.1))
         if ev is None:
             return None
-        return {"eval": ev, "token": token}
+        # wait_index: the leader's store index at dequeue time.  A
+        # redelivered eval may already have had a plan committed for it
+        # (nack after crash-after-commit, lease expiry, failover); a
+        # follower worker scheduling from a snapshot older than this
+        # index would not see those allocs and double-place the job
+        # (reference eval_endpoint.go Dequeue GetWaitIndex).
+        return {"eval": ev, "token": token,
+                "wait_index": self.server.store.latest_index}
 
     def rpc_Eval__Ack(self, args):
         return {"ok": self.server.broker.ack(args["eval_id"], args["token"])}
@@ -437,9 +444,10 @@ class Endpoints:
     # ------------------------------------------------------------- plans
 
     def rpc_Plan__Submit(self, args):
-        """Leader-side plan submission (plan_endpoint.go:23): enqueue and
-        block for the applier's result."""
-        pending = self.server.plan_queue.enqueue(args["plan"])
+        """Leader-side plan submission (plan_endpoint.go:23): enqueue
+        (gated on the submitter's eval lease still being live) and block
+        for the applier's result."""
+        pending = self.server.enqueue_plan(args["plan"])
         return pending.future.result(timeout=30.0)
 
     # ------------------------------------------------------------- deploys

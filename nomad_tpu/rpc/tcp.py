@@ -13,12 +13,15 @@ from __future__ import annotations
 import hashlib
 import hmac
 import pickle
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Dict, Optional
 
+from nomad_tpu import chaos
 from nomad_tpu.rpc.endpoints import Endpoints, RpcError
 
 _HDR = struct.Struct(">I")
@@ -82,6 +85,11 @@ class _Handler(socketserver.BaseRequestHandler):
             except (ConnectionError, EOFError, OSError):
                 return
             try:
+                # deadline propagation: the client ships its remaining
+                # budget (seconds); refuse work that is already stale
+                # rather than burn server time on an abandoned request
+                if req.get("deadline", 1.0) <= 0:
+                    raise RpcError("timeout", "deadline exceeded")
                 result = endpoints.handle(req["method"], req.get("args"))
                 resp = {"result": result}
             except RpcError as e:
@@ -146,11 +154,33 @@ class TcpRpcClient:
             self._socks[addr] = s
         return s
 
-    def _roundtrip(self, addr, method: str, args: dict):
+    def _roundtrip(self, addr, method: str, args: dict,
+                   deadline: Optional[float] = None):
+        if chaos.active is not None:
+            chaos.maybe_delay()
+            if chaos.active.should("rpc.drop"):
+                with self._lock:
+                    s = self._socks.pop(addr, None)
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                raise ConnectionError("chaos: rpc.drop")
         frame = {"method": method, "args": args}
         with self._lock:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RpcError("timeout",
+                                   f"deadline exceeded calling {method}")
+                frame["deadline"] = remaining
+            per_call = self.timeout if remaining is None \
+                else min(self.timeout, remaining)
             try:
                 sock = self._sock(addr)
+                sock.settimeout(per_call)
                 _send_frame(sock, frame, self.secret)
                 return _recv_frame(sock, self.secret)
             except (ConnectionError, OSError):
@@ -160,22 +190,64 @@ class TcpRpcClient:
                 if not _is_idempotent(method):
                     raise
                 sock = self._sock(addr)
+                sock.settimeout(per_call)
                 _send_frame(sock, frame, self.secret)
                 return _recv_frame(sock, self.secret)
 
+    @staticmethod
+    def _backoff(delay: float, deadline: Optional[float]) -> float:
+        """Sleep `delay` with jitter (bounded by the deadline); return the
+        next delay of the exponential schedule."""
+        jittered = delay * (0.5 + random.random() * 0.5)
+        if deadline is not None:
+            jittered = min(jittered, max(0.0, deadline - time.monotonic()))
+        if jittered > 0:
+            time.sleep(jittered)
+        return min(delay * 2.0, 1.0)
+
     def call(self, method: str, args: Optional[dict] = None,
+             retries: int = 2, deadline: Optional[float] = None,
              _redirects: int = 2):
-        resp = self._roundtrip(self.address, method, args or {})
-        if "error" not in resp:
-            return resp["result"]
-        if resp.get("kind") == "not_leader" and _redirects > 0:
-            leader_addr = self.addr_book.get(resp.get("leader"))
-            if leader_addr is not None:
-                resp = self._roundtrip(tuple(leader_addr), method, args or {})
-                if "error" not in resp:
-                    return resp["result"]
-        raise RpcError(resp.get("kind", "internal"), resp.get("error", ""),
-                       resp.get("leader"))
+        """Issue one RPC with exponential-backoff retry.
+
+        `deadline` is a seconds budget for the WHOLE call (all attempts,
+        backoff included); the remaining budget ships in the frame so the
+        server can drop work the client has already given up on.
+        Connection errors are retried only for idempotent methods; a
+        `not_leader` rejection was never executed, so leader-forwarding
+        retries any method."""
+        args = args or {}
+        dl = None if deadline is None else time.monotonic() + deadline
+        addr = self.address
+        delay = 0.05
+        attempts_left = max(0, retries)
+        redirects_left = max(0, _redirects)
+        while True:
+            try:
+                resp = self._roundtrip(addr, method, args, dl)
+            except (ConnectionError, OSError):
+                expired = dl is not None and time.monotonic() >= dl
+                if not _is_idempotent(method) or attempts_left <= 0 \
+                        or expired:
+                    raise
+                attempts_left -= 1
+                delay = self._backoff(delay, dl)
+                continue
+            if "error" not in resp:
+                return resp["result"]
+            if resp.get("kind") == "not_leader" and redirects_left > 0:
+                redirects_left -= 1
+                leader_addr = self.addr_book.get(resp.get("leader"))
+                if leader_addr is not None:
+                    addr = tuple(leader_addr)
+                    continue
+                # no leader hint (election in progress): back off and
+                # re-ask the same server, which will know the new leader
+                if dl is None or time.monotonic() < dl:
+                    delay = self._backoff(delay, dl)
+                    continue
+            raise RpcError(resp.get("kind", "internal"),
+                           resp.get("error", ""), resp.get("leader"))
 
     def close(self) -> None:
         with self._lock:

@@ -285,7 +285,16 @@ class AllocReconciler:
                     reconnecting.append(a)
                     continue
                 if getattr(node, "status", "") == "disconnected":
-                    untainted.append(a)   # still unknown; wait for timeout
+                    expires = getattr(a, "disconnected_at", 0.0) + \
+                        (tg.max_client_disconnect_s or 0.0)
+                    if getattr(a, "disconnected_at", 0.0) and \
+                            self.now >= expires:
+                        # max_client_disconnect elapsed (this pass is the
+                        # MAX_DISCONNECT_TIMEOUT follow-up eval): the
+                        # alloc is lost and a replacement must place
+                        lost.append(a)
+                    else:
+                        untainted.append(a)   # still unknown; wait
                     continue
                 # node is down: unknown -> lost below
             if node is None:
@@ -331,6 +340,7 @@ class AllocReconciler:
             u = a.copy()
             u.client_status = AllocClientStatus.UNKNOWN
             u.desired_description = ALLOC_UNKNOWN
+            u.disconnected_at = self.now
             timeout_eval = Evaluation(
                 id=generate_uuid(), namespace=a.namespace, priority=self.eval_priority,
                 type=self.job.type, triggered_by=EvalTrigger.MAX_DISCONNECT_TIMEOUT,
@@ -348,6 +358,7 @@ class AllocReconciler:
             else:
                 u = a.copy()
                 u.client_status = AllocClientStatus.RUNNING
+                u.disconnected_at = 0.0
                 res.reconnect_updates[a.id] = u
                 untainted.append(a)
 

@@ -173,6 +173,13 @@ class StateStore:
         self._snapshot_cache: Optional[StateSnapshot] = None
         # watchers: fn(table: str, obj) called after commit, outside hot loops
         self._watchers: List[Callable[[str, object], None]] = []
+        # plan-id dedup ring: APPLY_PLAN_RESULTS entries replayed after a
+        # leader failover (raft log re-application onto a restored
+        # snapshot) must commit at most once.  Bounded FIFO; old ids age
+        # out long after any replay window.
+        self._applied_plan_ids: List[str] = []
+        self._applied_plan_ids_set: Set[str] = set()
+        self._applied_plan_ids_cap = 8192
 
     # ------------------------------------------------------------ plumbing
 
@@ -920,6 +927,15 @@ class StateStore:
                                    touched: list) -> None:
         """One plan's writes; caller holds self._lock and notifies for
         `touched` after releasing it."""
+        plan_id = getattr(result, "plan_id", "")  # pre-dedup pickles lack it
+        if plan_id:
+            if plan_id in self._applied_plan_ids_set:
+                return
+            self._applied_plan_ids.append(plan_id)
+            self._applied_plan_ids_set.add(plan_id)
+            if len(self._applied_plan_ids) > self._applied_plan_ids_cap:
+                evicted = self._applied_plan_ids.pop(0)
+                self._applied_plan_ids_set.discard(evicted)
         for a in result.alloc_updates:      # stops/evicts
             existing = self._allocs.get(a.id)
             if existing is not None and a.job is None:
@@ -983,13 +999,14 @@ class AppliedPlanResults:
 
     def __init__(self, alloc_updates=None, allocs_to_place=None,
                  allocs_preempted=None, deployment=None, deployment_updates=None,
-                 eval_id: str = ""):
+                 eval_id: str = "", plan_id: str = ""):
         self.alloc_updates = alloc_updates or []
         self.allocs_to_place = allocs_to_place or []
         self.allocs_preempted = allocs_preempted or []
         self.deployment = deployment
         self.deployment_updates = deployment_updates or []
         self.eval_id = eval_id
+        self.plan_id = plan_id
 
 
 def _shallow_copy_node(node: Node) -> Node:

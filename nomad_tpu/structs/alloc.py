@@ -163,6 +163,9 @@ class Allocation:
     previous_allocation: str = ""
     next_allocation: str = ""
     followup_eval_id: str = ""
+    # when the reconciler marked this alloc unknown (node disconnected);
+    # 0.0 = not disconnected.  Drives max_client_disconnect expiry.
+    disconnected_at: float = 0.0
     preempted_by_allocation: str = ""
     preempted_allocations: List[str] = field(default_factory=list)
     metrics: AllocMetric = field(default_factory=AllocMetric)

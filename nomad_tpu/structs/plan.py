@@ -8,6 +8,7 @@ from typing import Dict, List, Optional
 
 from nomad_tpu.structs.alloc import Allocation, AllocDesiredStatus, AllocClientStatus
 from nomad_tpu.structs.job import Job
+from nomad_tpu.utils import generate_uuid
 
 
 @dataclass
@@ -35,6 +36,9 @@ class Plan:
     plan applier for optimistic-concurrency validation."""
     eval_id: str = ""
     eval_token: str = ""
+    # unique per submission; the applied-results entry carries it so a
+    # raft log replay after leader failover commits each plan at most once
+    plan_id: str = field(default_factory=generate_uuid)
     priority: int = 50
     job: Optional[Job] = None
     all_at_once: bool = False

@@ -1,0 +1,172 @@
+"""Disconnect -> reconnect reconciliation (reference: reconcile_util.go
+filterByTainted + reconcile.go computeGroup disconnect handling).
+
+A group with max_client_disconnect_s keeps allocs on a DISCONNECTED node
+in UNKNOWN instead of losing them outright: the reconciler marks them,
+schedules a MAX_DISCONNECT_TIMEOUT follow-up eval, and places a
+replacement.  If the node reconnects before the deadline the unknown
+alloc resumes RUNNING; if the deadline passes first the alloc is lost
+and replaced for good.
+"""
+from __future__ import annotations
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.reconcile import (
+    ALLOC_LOST,
+    ALLOC_UNKNOWN,
+    AllocReconciler,
+)
+from nomad_tpu.structs import (
+    AllocClientStatus,
+    EvalStatus,
+    EvalTrigger,
+    NodeStatus,
+)
+
+NOW = 1_000_000.0
+DISCONNECT_S = 30.0
+
+
+def _job(count: int = 3):
+    j = mock.job()
+    tg = j.task_groups[0]
+    tg.count = count
+    tg.max_client_disconnect_s = DISCONNECT_S
+    return j
+
+
+def _allocs(j, nodes):
+    return [mock.alloc_for(j, n.id, index=i,
+                           client_status=AllocClientStatus.RUNNING)
+            for i, n in enumerate(nodes)]
+
+
+def _reconcile(j, existing, tainted, now=NOW):
+    r = AllocReconciler(j, j.id, existing, tainted, deployment=None, now=now)
+    return r.compute()
+
+
+def test_disconnect_marks_unknown_and_schedules_timeout_followup():
+    j = _job()
+    nodes = [mock.node() for _ in range(3)]
+    allocs = _allocs(j, nodes)
+    nodes[0].status = NodeStatus.DISCONNECTED
+    res = _reconcile(j, allocs, {nodes[0].id: nodes[0]})
+
+    assert set(res.disconnect_updates) == {allocs[0].id}
+    u = res.disconnect_updates[allocs[0].id]
+    assert u.client_status == AllocClientStatus.UNKNOWN
+    assert u.desired_description == ALLOC_UNKNOWN
+    assert u.disconnected_at == NOW
+
+    evs = res.desired_followup_evals[j.task_groups[0].name]
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev.triggered_by == EvalTrigger.MAX_DISCONNECT_TIMEOUT
+    assert ev.status == EvalStatus.PENDING
+    assert ev.wait_until == NOW + DISCONNECT_S
+    assert u.followup_eval_id == ev.id
+
+    # a replacement places while the original sits in unknown; nothing
+    # stops — the unknown alloc may still come back
+    assert len(res.place) == 1
+    assert not res.stop
+
+
+def test_disconnect_without_group_support_is_lost():
+    j = _job()
+    j.task_groups[0].max_client_disconnect_s = None
+    nodes = [mock.node() for _ in range(3)]
+    allocs = _allocs(j, nodes)
+    nodes[0].status = NodeStatus.DISCONNECTED
+    res = _reconcile(j, allocs, {nodes[0].id: nodes[0]})
+
+    assert not res.disconnect_updates
+    assert [sr.alloc.id for sr in res.stop] == [allocs[0].id]
+    assert res.stop[0].status_description == ALLOC_LOST
+    assert res.stop[0].client_status == AllocClientStatus.LOST
+    assert len(res.place) == 1
+
+
+def test_unknown_alloc_waits_out_the_disconnect_window():
+    # the follow-up eval fires early (or another eval runs): deadline not
+    # reached, node still gone -> no churn, the unknown alloc holds its slot
+    j = _job()
+    nodes = [mock.node() for _ in range(3)]
+    allocs = _allocs(j, nodes)
+    nodes[0].status = NodeStatus.DISCONNECTED
+    allocs[0].client_status = AllocClientStatus.UNKNOWN
+    allocs[0].disconnected_at = NOW
+    res = _reconcile(j, allocs, {nodes[0].id: nodes[0]},
+                     now=NOW + DISCONNECT_S / 2)
+
+    assert not res.stop
+    assert not res.place
+    assert not res.disconnect_updates
+    assert not res.reconnect_updates
+
+
+def test_unknown_alloc_expires_to_lost_with_replacement():
+    j = _job()
+    nodes = [mock.node() for _ in range(3)]
+    allocs = _allocs(j, nodes)
+    nodes[0].status = NodeStatus.DISCONNECTED
+    allocs[0].client_status = AllocClientStatus.UNKNOWN
+    allocs[0].disconnected_at = NOW
+    res = _reconcile(j, allocs, {nodes[0].id: nodes[0]},
+                     now=NOW + DISCONNECT_S + 1.0)
+
+    assert [sr.alloc.id for sr in res.stop] == [allocs[0].id]
+    assert res.stop[0].client_status == AllocClientStatus.LOST
+    assert len(res.place) == 1
+    assert res.place[0].previous_alloc is allocs[0]
+    assert not res.reconnect_updates
+
+
+@pytest.mark.parametrize("tainted_entry", [True, False])
+def test_reconnect_restores_running(tainted_entry):
+    # node came back: either it shows up in tainted as READY (status just
+    # flipped) or it has already dropped out of the tainted set entirely
+    j = _job()
+    nodes = [mock.node() for _ in range(3)]
+    allocs = _allocs(j, nodes)
+    allocs[0].client_status = AllocClientStatus.UNKNOWN
+    allocs[0].disconnected_at = NOW
+    tainted = {}
+    if tainted_entry:
+        nodes[0].status = NodeStatus.READY
+        tainted[nodes[0].id] = nodes[0]
+    res = _reconcile(j, allocs, tainted, now=NOW + 5.0)
+
+    assert set(res.reconnect_updates) == {allocs[0].id}
+    u = res.reconnect_updates[allocs[0].id]
+    assert u.client_status == AllocClientStatus.RUNNING
+    assert u.disconnected_at == 0.0
+    # the reconnected alloc fills its own slot: no replacement, no stop
+    assert not res.place
+    assert not res.stop
+
+
+def test_reconnect_after_replacement_scales_down_surplus():
+    # disconnect placed a replacement; the original then reconnects while
+    # both are live -> group is over count and one of the pair stops
+    j = _job()
+    nodes = [mock.node() for _ in range(4)]
+    allocs = _allocs(j, nodes[:3])
+    allocs[0].client_status = AllocClientStatus.UNKNOWN
+    allocs[0].disconnected_at = NOW
+    replacement = mock.alloc_for(j, nodes[3].id, index=0,
+                                 client_status=AllocClientStatus.RUNNING)
+    res = _reconcile(j, allocs + [replacement], {}, now=NOW + 5.0)
+
+    assert set(res.reconnect_updates) == {allocs[0].id}
+    assert not res.place
+    # surplus scale-down trims exactly one live alloc (the highest index
+    # in the name space) so the group converges back to count
+    stopped = {sr.alloc.id for sr in res.stop}
+    assert len(stopped) == 1
+    live = {a.id for a in allocs} | {replacement.id}
+    assert stopped < live
+    assert allocs[0].id not in stopped or replacement.id not in stopped
