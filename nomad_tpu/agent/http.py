@@ -787,8 +787,8 @@ class HTTPServer:
         namespaces = None
         if getattr(self.agent.server, "acl_enabled", False):
             store = self.agent.server.store
-            namespaces = [ns for ns in store._namespaces
-                          if self._ns_visible(h, ns)]
+            namespaces = [ns["name"] for ns in store.namespaces()
+                          if self._ns_visible(h, ns["name"])]
         resp = self._rpc("Search.PrefixSearch", {
             "prefix": body.get("Prefix", ""),
             "context": body.get("Context", "all"),

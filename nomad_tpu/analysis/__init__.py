@@ -1,0 +1,58 @@
+"""nomad_tpu.analysis — AST-based invariant linters for the scheduler.
+
+Four checkers over the repo tree (stdlib-only; never imports the code it
+analyzes, so this runs without jax/numpy installed):
+
+    fsm-determinism   no wall-clock/entropy/set-iteration in the raft
+                      FSM apply cone
+    lock-discipline   declared lock-protected attrs only touched under
+                      their lock or in @requires_lock methods
+    native-abi        ctypes bindings match the extern "C" prototypes
+                      and the abi version gate
+    jax-purity        no host escapes / tracer branching in jitted
+                      kernels
+    chaos-coverage    chaos registry and injection sites agree
+
+Run: `python -m nomad_tpu.analysis [--json] [--checker NAME] [--root D]`
+Suppress: `# analysis: allow(checker-name)` on the finding's line or the
+enclosing `def` line.  The runtime lock-order recorder lives in
+`nomad_tpu.analysis.lock_order` (it is dynamic, not part of `run_all`).
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from nomad_tpu.analysis import (
+    chaos_coverage, fsm_determinism, jax_purity, lock_discipline,
+    native_abi,
+)
+from nomad_tpu.analysis.common import Corpus, Finding, load_corpus
+from nomad_tpu.analysis.lock_order import LockOrderRecorder
+
+CHECKERS = {
+    fsm_determinism.CHECKER: fsm_determinism.run,
+    lock_discipline.CHECKER: lock_discipline.run,
+    native_abi.CHECKER: native_abi.run,
+    jax_purity.CHECKER: jax_purity.run,
+    chaos_coverage.CHECKER: chaos_coverage.run,
+}
+
+
+def run_all(root: Path, checkers: Optional[Sequence[str]] = None,
+            include_tests: bool = False) -> List[Finding]:
+    names = list(checkers) if checkers else list(CHECKERS)
+    unknown = [n for n in names if n not in CHECKERS]
+    if unknown:
+        raise ValueError(f"unknown checker(s): {', '.join(unknown)} "
+                         f"(known: {', '.join(CHECKERS)})")
+    corpus = load_corpus(root, include_tests=include_tests)
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(CHECKERS[name](corpus))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings
+
+
+__all__ = ["CHECKERS", "Corpus", "Finding", "LockOrderRecorder",
+           "load_corpus", "run_all"]

@@ -1,0 +1,49 @@
+"""CLI: `python -m nomad_tpu.analysis` — exit 1 on any finding."""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from nomad_tpu.analysis import CHECKERS, run_all
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m nomad_tpu.analysis",
+        description="Invariant linters: %s" % ", ".join(CHECKERS))
+    ap.add_argument("--root", type=Path, default=None,
+                    help="tree to analyze (default: the repo containing "
+                         "this package)")
+    ap.add_argument("--checker", action="append", dest="checkers",
+                    metavar="NAME", choices=sorted(CHECKERS),
+                    help="run only this checker (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+
+    root = args.root
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    try:
+        findings = run_all(root, checkers=args.checkers)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({"root": str(root),
+                          "findings": [f.to_dict() for f in findings]},
+                         indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"nomad_tpu.analysis: {n} finding{'s' if n != 1 else ''}"
+              f" in {root}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

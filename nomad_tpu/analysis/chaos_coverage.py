@@ -1,0 +1,109 @@
+"""chaos-coverage: the chaos registry and its injection sites agree.
+
+`nomad_tpu/chaos.py` registers the fault-point universe in
+`FAULT_POINTS`; injection sites call `chaos.should("…")`,
+`chaos.fire("…")`, or `chaos.maybe_delay("…")` (no-arg `maybe_delay()`
+defaults to "rpc.delay").  Two drift directions, both flagged:
+
+- a registered point with NO injection site is dead chaos config — a
+  soak run setting its rate exercises nothing
+- an injection site naming an UNREGISTERED point raises ValueError only
+  when someone first sets a rate for it, i.e. never in CI
+
+The file defining FAULT_POINTS is exempt from site collection (its own
+function defs mention the default point).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, dotted, enclosing_def_line,
+)
+
+CHECKER = "chaos-coverage"
+
+_SITE_FNS = {"should", "fire", "maybe_delay"}
+
+
+def _fault_points(sf) -> Optional[Tuple[Set[str], int]]:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "FAULT_POINTS" and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            points = {el.value for el in node.value.elts
+                      if isinstance(el, ast.Constant) and
+                      isinstance(el.value, str)}
+            return points, node.lineno
+    return None
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    registry_sf = None
+    points: Set[str] = set()
+    decl_line = 1
+    for sf in corpus.py:
+        got = _fault_points(sf)
+        if got and sf.rel.endswith("chaos.py"):
+            registry_sf, (points, decl_line) = sf, got
+            break
+    if registry_sf is None:
+        return []
+
+    findings: List[Finding] = []
+    # point -> first site (rel, line); plus unknown-point findings
+    sites: Dict[str, Tuple[str, int]] = {}
+    for sf in corpus.py:
+        if sf is registry_sf:
+            continue
+        # names bound to a chaos expression (`reg = chaos.active`)
+        aliases: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and \
+                    "chaos" in ((dotted(node.value) or "").lower()):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        aliases.add(tgt.id)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name not in _SITE_FNS:
+                continue
+            # only count chaos-module/registry receivers (incl. aliases)
+            if isinstance(f, ast.Attribute):
+                base = dotted(f.value) or ""
+                if "chaos" not in base.lower() and \
+                        base.split(".")[0] not in aliases:
+                    continue
+            if node.args:
+                a = node.args[0]
+                if not (isinstance(a, ast.Constant) and
+                        isinstance(a.value, str)):
+                    continue          # dynamic point: can't check statically
+                point = a.value
+            elif name == "maybe_delay":
+                point = "rpc.delay"
+            else:
+                continue
+            if point not in points:
+                if not sf.allowed(CHECKER, node.lineno,
+                                  enclosing_def_line(sf, node.lineno)):
+                    findings.append(Finding(
+                        CHECKER, sf.rel, node.lineno,
+                        f"injection site names unregistered chaos point "
+                        f"{point!r} (not in FAULT_POINTS)"))
+            else:
+                sites.setdefault(point, (sf.rel, node.lineno))
+
+    for point in sorted(points - set(sites)):
+        if not registry_sf.allowed(CHECKER, decl_line):
+            findings.append(Finding(
+                CHECKER, registry_sf.rel, decl_line,
+                f"registered chaos point {point!r} has no injection site "
+                f"(dead fault config)"))
+    return findings

@@ -1,0 +1,255 @@
+"""Shared infrastructure for the invariant linter suite.
+
+Everything here is stdlib-only (ast + re): the analyzers parse source
+trees, they never import the code under analysis, so `python -m
+nomad_tpu.analysis` runs in a bare interpreter with no jax/numpy.
+
+Suppression grammar (checked on the finding's line and on the line of
+the enclosing `def`):
+
+    # analysis: allow(checker-name)
+    # analysis: allow(checker-a, checker-b)
+    # analysis: allow(*)
+
+A suppressed call site is also removed from call-graph traversal, so an
+allowed edge does not leak findings from the functions behind it.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+ALLOW_RE = re.compile(r"#\s*analysis:\s*allow\(([^)]*)\)")
+
+# directories never scanned, wherever the root points
+EXCLUDED_PARTS = {"__pycache__", ".git", "build", ".scratch", ".jax_cache"}
+
+
+@dataclass
+class Finding:
+    """One invariant violation."""
+    checker: str
+    path: str               # repo-relative (or root-relative) posix path
+    line: int
+    message: str
+    chain: Tuple[str, ...] = ()   # call chain for transitive findings
+
+    def to_dict(self) -> dict:
+        d = {"checker": self.checker, "path": self.path,
+             "line": self.line, "message": self.message}
+        if self.chain:
+            d["chain"] = list(self.chain)
+        return d
+
+    def render(self) -> str:
+        s = f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+        if self.chain:
+            s += f"  (via {' -> '.join(self.chain)})"
+        return s
+
+
+class SourceFile:
+    """A parsed python source file plus its allow-comment map."""
+
+    def __init__(self, path: Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.tree = ast.parse(text, filename=str(path))
+        # dotted module name from the root-relative path:
+        # nomad_tpu/state/store.py -> nomad_tpu.state.store
+        mod = rel[:-3] if rel.endswith(".py") else rel
+        mod = mod.replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        self.module = mod
+        self._imports: Optional[Set[str]] = None
+        # line -> set of checker names allowed ("*" = all)
+        self.allow: Dict[int, Set[str]] = {}
+        for i, line in enumerate(text.splitlines(), 1):
+            m = ALLOW_RE.search(line)
+            if m:
+                names = {p.strip() for p in m.group(1).split(",") if p.strip()}
+                if names:
+                    self.allow[i] = names
+
+    @property
+    def imports(self) -> Set[str]:
+        """Dotted names this module imports (absolute and resolved
+        relative), including `from pkg import sub` as `pkg.sub`."""
+        if self._imports is None:
+            out: Set[str] = set()
+            pkg = self.module if self.rel.endswith("__init__.py") \
+                else self.module.rpartition(".")[0]
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        out.add(alias.name)
+                elif isinstance(node, ast.ImportFrom):
+                    base = node.module or ""
+                    if node.level:
+                        parts = pkg.split(".") if pkg else []
+                        parts = parts[: len(parts) - (node.level - 1)] \
+                            if node.level > 1 else parts
+                        base = ".".join(parts + ([base] if base else []))
+                    if base:
+                        out.add(base)
+                    for alias in node.names:
+                        if base:
+                            out.add(f"{base}.{alias.name}")
+                        else:
+                            out.add(alias.name)
+            self._imports = out
+        return self._imports
+
+    def allowed(self, checker: str, *lines: Optional[int]) -> bool:
+        for ln in lines:
+            if ln is None:
+                continue
+            names = self.allow.get(ln)
+            if names and ("*" in names or checker in names):
+                return True
+        return False
+
+
+@dataclass
+class Corpus:
+    """The file set one analysis run operates on."""
+    root: Path
+    py: List[SourceFile] = field(default_factory=list)
+    cpp: List[Tuple[Path, str, str]] = field(default_factory=list)  # (path, rel, text)
+
+
+def _is_excluded(rel: Path) -> bool:
+    return any(part in EXCLUDED_PARTS for part in rel.parts)
+
+
+def load_corpus(root: Path, include_tests: bool = False) -> Corpus:
+    """Load every .py/.cpp under `root`.
+
+    When `root` looks like the repo checkout (contains a `nomad_tpu`
+    package), only `nomad_tpu/` and `native/` are scanned so the test
+    fixtures' seeded violations never pollute a repo run.  Any other
+    root (a fixture dir) is scanned wholesale.
+    """
+    root = Path(root).resolve()
+    corpus = Corpus(root=root)
+    if (root / "nomad_tpu").is_dir() and not include_tests:
+        search_roots = [root / "nomad_tpu", root / "native"]
+    else:
+        search_roots = [root]
+    seen: Set[Path] = set()
+    for sr in search_roots:
+        if not sr.exists():
+            continue
+        for p in sorted(sr.rglob("*.py")):
+            rel = p.relative_to(root)
+            if _is_excluded(rel) or p in seen:
+                continue
+            seen.add(p)
+            try:
+                text = p.read_text()
+            except (OSError, UnicodeDecodeError):
+                continue
+            try:
+                corpus.py.append(SourceFile(p, rel.as_posix(), text))
+            except SyntaxError:
+                continue
+        for p in sorted(sr.rglob("*.cpp")):
+            rel = p.relative_to(root)
+            if _is_excluded(rel) or p in seen:
+                continue
+            seen.add(p)
+            try:
+                corpus.cpp.append((p, rel.as_posix(), p.read_text()))
+            except (OSError, UnicodeDecodeError):
+                continue
+    return corpus
+
+
+# ------------------------------------------------------------------ AST utils
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Bare callee name: `f(...)` -> 'f', `a.b.f(...)` -> 'f'."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def dotted(expr: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def decorator_names(fn: ast.AST) -> List[str]:
+    """Dotted names of each decorator (call decorators yield the callee,
+    so `@functools.partial(jax.jit, ...)` yields 'functools.partial')."""
+    out = []
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted(target)
+        if name:
+            out.append(name)
+    return out
+
+
+@dataclass
+class FuncInfo:
+    """A function definition located in the corpus."""
+    sf: SourceFile
+    node: ast.AST                  # FunctionDef / AsyncFunctionDef
+    qualname: str                  # Class.method or module-level name
+
+    @property
+    def key(self) -> str:
+        return f"{self.sf.rel}::{self.qualname}"
+
+
+def index_functions(files: Sequence[SourceFile]) -> Dict[str, List[FuncInfo]]:
+    """name -> every def with that bare name, package-wide.  The static
+    call graph resolves calls by bare name (receiver types are unknown),
+    which over-approximates: good for an invariant cone, where missing an
+    edge is worse than following a spurious one."""
+    index: Dict[str, List[FuncInfo]] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        index.setdefault(item.name, []).append(
+                            FuncInfo(sf, item, f"{node.name}.{item.name}"))
+            elif isinstance(node, ast.Module):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        index.setdefault(item.name, []).append(
+                            FuncInfo(sf, item, item.name))
+    return index
+
+
+def enclosing_def_line(sf: SourceFile, lineno: int) -> Optional[int]:
+    """Line of the innermost def containing `lineno` (for def-level
+    allow comments)."""
+    best: Optional[int] = None
+    best_span = None
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= lineno <= end:
+                span = end - node.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = node.lineno, span
+    return best

@@ -1,0 +1,194 @@
+"""jax-purity: jitted scheduler kernels must stay traceable.
+
+Host-side escapes inside a jitted function force a trace-time
+materialization (`ConcretizationTypeError` at best, silent recompiles or
+stale constants at worst).  For every function that is jitted —
+
+    @jax.jit
+    @functools.partial(jax.jit, static_argnames=("k",))
+    fn = jax.jit(body)            # incl. jax.jit(jax.shard_map(body, …))
+
+— this checker flags:
+
+- `float(x)` / `int(x)` / `bool(x)` coercions of traced values
+- `.item()` calls
+- `np.*` calls (numpy eagerly materializes; use `jnp`)
+- Python `if` branching on a traced parameter (tests that only touch
+  `static_argnames` parameters are fine) — applied to directly-jitted
+  defs where the static set is visible
+
+Same-module helpers called from a jitted body are checked transitively
+for the first three (a helper can't know its caller's static set, so the
+branching check stays local).  `# analysis: allow(jax-purity)` on the
+line or the enclosing `def` line suppresses.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, SourceFile, dotted, enclosing_def_line,
+)
+
+CHECKER = "jax-purity"
+
+_COERCIONS = {"float", "int", "bool"}
+_NP_BASES = {"np", "numpy"}
+# np attrs that are fine at trace time (dtype constructors / constants)
+_NP_BENIGN = {"float32", "float64", "int32", "int64", "uint32", "uint8",
+              "bool_", "dtype", "pi", "inf", "nan", "newaxis", "ndarray",
+              "ctypeslib"}
+
+
+def _static_argnames(dec: ast.expr) -> Optional[Set[str]]:
+    """static_argnames from a functools.partial(jax.jit, ...) decorator;
+    None if this decorator isn't a jit form at all."""
+    if isinstance(dec, ast.Call):
+        target = dotted(dec.func)
+        if target in ("functools.partial", "partial") and dec.args and \
+                dotted(dec.args[0]) in ("jax.jit", "jit"):
+            names: Set[str] = set()
+            for kw in dec.keywords:
+                if kw.arg in ("static_argnames", "static_argnums"):
+                    if isinstance(kw.value, (ast.Tuple, ast.List, ast.Set)):
+                        for el in kw.value.elts:
+                            if isinstance(el, ast.Constant) and \
+                                    isinstance(el.value, str):
+                                names.add(el.value)
+                    elif isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, str):
+                        names.add(kw.value.value)
+            return names
+        if target in ("jax.jit", "jit"):
+            return set()
+    elif dotted(dec) in ("jax.jit", "jit"):
+        return set()
+    return None
+
+
+def _jitted_defs(sf: SourceFile) -> List[Tuple[ast.AST, Optional[Set[str]]]]:
+    """(def node, static names or None-when-unknown) for every function
+    the module jits, by decorator or by `jax.jit(name)` reference."""
+    by_name: Dict[str, ast.AST] = {}
+    out: List[Tuple[ast.AST, Optional[Set[str]]]] = []
+    picked: Set[ast.AST] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                statics = _static_argnames(dec)
+                if statics is not None and node not in picked:
+                    picked.add(node)
+                    out.append((node, statics))
+
+    def _jit_operands(call: ast.Call) -> List[str]:
+        """Names passed (possibly through shard_map) to a jax.jit call."""
+        if dotted(call.func) not in ("jax.jit", "jit"):
+            return []
+        names: List[str] = []
+        for a in call.args:
+            if isinstance(a, ast.Name):
+                names.append(a.id)
+            elif isinstance(a, ast.Call) and \
+                    (dotted(a.func) or "").split(".")[-1] == "shard_map":
+                for inner in a.args:
+                    if isinstance(inner, ast.Name):
+                        names.append(inner.id)
+        return names
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            for name in _jit_operands(node):
+                fn = by_name.get(name)
+                # statics unknown for call-form jits: skip branch check
+                if fn is not None and fn not in picked:
+                    picked.add(fn)
+                    out.append((fn, None))
+    return out
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    names.discard("self")
+    return names
+
+
+def _check_body(sf: SourceFile, fn: ast.AST, statics: Optional[Set[str]],
+                qual: str, findings: List[Finding],
+                reported: Set[Tuple[str, int]]) -> Set[str]:
+    """Flag escapes in one jitted def; return same-module callee names."""
+    callees: Set[str] = set()
+    traced = (_param_names(fn) - statics) if statics is not None else set()
+
+    def emit(line: int, msg: str) -> None:
+        if sf.allowed(CHECKER, line, enclosing_def_line(sf, line)):
+            return
+        key = (sf.rel, line)
+        if key not in reported:
+            reported.add(key)
+            findings.append(Finding(CHECKER, sf.rel, line, msg, (qual,)))
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name):
+                if f.id in _COERCIONS and node.args:
+                    emit(node.lineno,
+                         f"`{f.id}()` coercion inside jitted kernel "
+                         f"forces host materialization of a tracer")
+                else:
+                    callees.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                if f.attr == "item":
+                    emit(node.lineno,
+                         "`.item()` inside jitted kernel pulls the value "
+                         "to host at trace time")
+                else:
+                    base = dotted(f.value)
+                    if base in _NP_BASES and f.attr not in _NP_BENIGN:
+                        emit(node.lineno,
+                             f"`{base}.{f.attr}()` inside jitted kernel: "
+                             f"numpy runs eagerly at trace time (use jnp)")
+        elif isinstance(node, ast.If) and statics is not None:
+            hit = next((n.id for n in ast.walk(node.test)
+                        if isinstance(n, ast.Name) and n.id in traced), None)
+            if hit:
+                emit(node.lineno,
+                     f"Python `if` on traced parameter `{hit}` inside "
+                     f"jitted kernel (mark it static or use jnp.where / "
+                     f"lax.cond)")
+    return callees
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    reported: Set[Tuple[str, int]] = set()
+    for sf in corpus.py:
+        jitted = _jitted_defs(sf)
+        if not jitted:
+            continue
+        module_defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                module_defs.setdefault(node.name, node)
+        seen: Set[str] = set()
+        frontier: List[Tuple[ast.AST, Optional[Set[str]], str]] = [
+            (fn, statics, fn.name) for fn, statics in jitted]
+        while frontier:
+            fn, statics, qual = frontier.pop()
+            if fn.name in seen:
+                continue
+            seen.add(fn.name)
+            callees = _check_body(sf, fn, statics, qual, findings, reported)
+            for name in callees:
+                tgt = module_defs.get(name)
+                if tgt is not None and name not in seen:
+                    # helpers: escapes only; no branch check (statics=None)
+                    frontier.append((tgt, None, f"{qual} -> {name}"))
+    return findings

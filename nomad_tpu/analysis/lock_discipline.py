@@ -1,0 +1,205 @@
+"""lock-discipline: every touch of a declared lock-protected attribute
+happens with the owning lock held.
+
+Classes declare their discipline inline:
+
+    class StateStore:
+        _LOCK_NAME = "_lock"
+        _LOCK_ALIASES = ("_index_cv",)       # Condition over the same lock
+        _LOCK_PROTECTED = frozenset({"_nodes", "_jobs", ...})
+
+The checker then walks EVERY file in the corpus and requires each
+read/write of a protected attribute — `self._nodes`, `store._nodes`,
+`self.store._nodes`, whatever the receiver — to appear either:
+
+- lexically inside a `with <receiver>.<lockname>:` block whose receiver
+  expression matches the access's receiver (`with s._lock:` covers
+  `s._nodes`), or
+- inside a function decorated `@requires_lock("<lockname>")` (the
+  caller-holds-the-lock contract for `_locked` helpers), or
+- inside the owning class's own `__init__` with receiver `self`
+  (construction precedes sharing), or
+- on a line carrying `# analysis: allow(lock-discipline)`.
+
+Receiver matching is textual (`ast.unparse`), which is exactly as strong
+as the aliasing in this codebase: helpers bind `s = self.store` before
+`with s._lock:`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, SourceFile, dotted, enclosing_def_line,
+)
+
+CHECKER = "lock-discipline"
+
+
+def _const_str_set(node: ast.AST) -> Optional[Set[str]]:
+    """Evaluate a literal set/frozenset/tuple/list of strings."""
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("frozenset", "set") and node.args:
+        return _const_str_set(node.args[0])
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        out = set()
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant) and isinstance(el.value, str)):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+def _collect_declarations(files) -> Tuple[Set[str], Set[str], Dict[str, str]]:
+    """-> (protected attr names, acceptable lock attr names,
+           owning class name per protected attr)."""
+    protected: Set[str] = set()
+    locknames: Set[str] = set()
+    owner: Dict[str, str] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            decl: Optional[Set[str]] = None
+            lockname = "_lock"
+            aliases: Set[str] = set()
+            for item in node.body:
+                if isinstance(item, ast.Assign) and len(item.targets) == 1 \
+                        and isinstance(item.targets[0], ast.Name):
+                    tname = item.targets[0].id
+                    if tname == "_LOCK_PROTECTED":
+                        decl = _const_str_set(item.value)
+                    elif tname == "_LOCK_NAME" and \
+                            isinstance(item.value, ast.Constant):
+                        lockname = item.value.value
+                    elif tname == "_LOCK_ALIASES":
+                        aliases = _const_str_set(item.value) or set()
+            if decl:
+                protected |= decl
+                locknames.add(lockname)
+                locknames |= aliases
+                for a in decl:
+                    owner[a] = node.name
+    return protected, locknames, owner
+
+
+def _requires_lock(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted(target)
+        if name and name.split(".")[-1] == "requires_lock":
+            return True
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile, protected: Set[str],
+                 locknames: Set[str], owner: Dict[str, str],
+                 findings: List[Finding]):
+        self.sf = sf
+        self.protected = protected
+        self.locknames = locknames
+        self.owner = owner
+        self.findings = findings
+        self.held: List[str] = []          # receiver exprs with lock held
+        self.fn_stack: List[ast.AST] = []
+        self.class_stack: List[str] = []
+        self.reported: Set[int] = set()
+
+    # ---- scope tracking
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_fn(self, node) -> None:
+        self.fn_stack.append(node)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_With(self, node: ast.With) -> None:
+        added = 0
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Attribute) and ctx.attr in self.locknames:
+                recv = _unparse(ctx.value)
+                if recv is not None:
+                    self.held.append(recv)
+                    added += 1
+            # `with self._lock` may also appear via a local alias:
+            # `lk = store._lock; with lk:` — treat a bare Name context
+            # whose id ends with a lock name as held-for-anything? No:
+            # too loose; aliased lock handles stay on the allow comment.
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(added):
+            self.held.pop()
+
+    # ---- access checks
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr in self.protected:
+            self._check(node)
+        self.generic_visit(node)
+
+    def _check(self, node: ast.Attribute) -> None:
+        line = node.lineno
+        if line in self.reported:
+            return
+        sf = self.sf
+        recv = _unparse(node.value)
+        if recv is None:
+            return
+        # declaration site / class body (no function yet): skip
+        if not self.fn_stack:
+            return
+        fn = self.fn_stack[-1]
+        # any enclosing annotated function accepts the access
+        if any(_requires_lock(f) for f in self.fn_stack):
+            return
+        if recv in self.held:
+            return
+        if recv == "self" and self.class_stack:
+            # `self.X` in a class that is NOT X's declared owner refers to
+            # that class's own attribute which merely shares the name
+            # (e.g. StateSnapshot's immutable copies of store tables)
+            if self.class_stack[-1] != self.owner.get(node.attr):
+                return
+            # construction in the owner's __init__ precedes sharing
+            if fn.name == "__init__":
+                return
+        if sf.allowed(CHECKER, line, enclosing_def_line(sf, line)):
+            return
+        self.reported.add(line)
+        owner = self.owner.get(node.attr, "?")
+        self.findings.append(Finding(
+            CHECKER, sf.rel, line,
+            f"`{recv}.{node.attr}` ({owner} lock-protected) accessed "
+            f"without holding `{recv}._lock` (wrap in `with "
+            f"{recv}._lock:` or annotate the method with "
+            f"@requires_lock)"))
+
+
+def _unparse(node: ast.AST) -> Optional[str]:
+    try:
+        return ast.unparse(node)
+    except Exception:               # noqa: BLE001 — exotic receivers
+        return None
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    protected, locknames, owner = _collect_declarations(corpus.py)
+    if not protected:
+        return []
+    findings: List[Finding] = []
+    for sf in corpus.py:
+        _Visitor(sf, protected, locknames, owner, findings).visit(sf.tree)
+    return findings

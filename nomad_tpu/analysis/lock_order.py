@@ -1,0 +1,203 @@
+"""Runtime lock-order recorder: acquisition-order cycles = deadlock risk.
+
+Static discipline (lock_discipline.py) proves accesses hold *a* lock; it
+cannot see in which ORDER two locks nest across threads.  This recorder
+patches `threading.Lock` / `threading.RLock` so every lock allocated
+while installed is wrapped: each successful acquire records an edge
+`held -> acquired` for every lock the acquiring thread already holds.
+A cycle in that graph means two code paths nest the same locks in
+opposite orders — a latent deadlock even if the interleaving never hit.
+
+Locks are named by their allocation site (`file:line`, threading.py
+frames skipped) so `Condition()`-internal RLocks get the caller's site.
+The wrapper delegates unknown attributes to the inner lock, keeping the
+`hasattr(lock, "_release_save")` probes in `threading.Condition` honest:
+a wrapped RLock still presents the Condition protocol, a wrapped Lock
+still doesn't.  `Condition.wait` bypasses the wrapper for its
+release/reacquire pair — harmless for edge recording, since a waiting
+thread acquires nothing while blocked.
+
+Intended use (pytest):
+
+    rec = LockOrderRecorder()
+    rec.install()
+    try:
+        ... exercise the system ...
+    finally:
+        rec.uninstall()
+    assert rec.cycles() == []
+
+or process-wide via `NOMAD_TPU_LOCK_ORDER=1` (see tests/conftest.py).
+"""
+from __future__ import annotations
+
+import _thread
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+
+def _alloc_site(skip_modules: Tuple[str, ...] = ("threading",)) -> str:
+    import sys
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename
+        short = fname.rsplit("/", 1)[-1]
+        if short.rsplit(".", 1)[0] not in skip_modules and \
+                "analysis/lock_order" not in fname.replace("\\", "/"):
+            return f"{short}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+class _RecordingLock:
+    """Wraps one real Lock/RLock; bookkeeping on acquire/release only."""
+
+    def __init__(self, inner, name: str, recorder: "LockOrderRecorder"):
+        self._inner = inner
+        self._name = name
+        self._recorder = recorder
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._recorder._on_acquire(self._name)
+        return got
+
+    def release(self) -> None:
+        self._recorder._on_release(self._name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __getattr__(self, name: str):
+        # Condition() probes _release_save/_acquire_restore/_is_owned via
+        # hasattr — delegate so wrapped RLocks keep the protocol and
+        # wrapped Locks keep lacking it.
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<RecordingLock {self._name} over {self._inner!r}>"
+
+
+class LockOrderRecorder:
+    def __init__(self):
+        # edge -> one sample (thread name, held-stack snapshot)
+        self.edges: Dict[Tuple[str, str], Tuple[str, Tuple[str, ...]]] = {}
+        self._held = threading.local()
+        self._meta = _thread.allocate_lock()   # raw: never self-recorded
+        self._orig: Optional[Tuple] = None
+
+    # ---- patching
+
+    def install(self) -> "LockOrderRecorder":
+        if self._orig is not None:
+            return self
+        self._orig = (threading.Lock, threading.RLock)
+        real_lock, real_rlock = self._orig
+
+        def lock_factory():
+            return _RecordingLock(real_lock(), _alloc_site(), self)
+
+        def rlock_factory():
+            return _RecordingLock(real_rlock(), _alloc_site(), self)
+
+        threading.Lock = lock_factory
+        threading.RLock = rlock_factory
+        return self
+
+    def uninstall(self) -> None:
+        if self._orig is not None:
+            threading.Lock, threading.RLock = self._orig
+            self._orig = None
+
+    def __enter__(self) -> "LockOrderRecorder":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # ---- bookkeeping (called from the wrapper)
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = self._held.stack = []
+        return st
+
+    def _on_acquire(self, name: str) -> None:
+        stack = self._stack()
+        if stack:
+            snap = tuple(stack)
+            me = threading.current_thread().name
+            with self._meta:
+                for held in stack:
+                    if held != name:
+                        self.edges.setdefault((held, name), (me, snap))
+        stack.append(name)
+
+    def _on_release(self, name: str) -> None:
+        stack = self._stack()
+        # remove the most recent matching entry: releases may interleave
+        # out of LIFO order (condition waits, manual release())
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    # ---- analysis
+
+    def graph(self) -> Dict[str, Set[str]]:
+        g: Dict[str, Set[str]] = {}
+        with self._meta:
+            for (a, b) in self.edges:
+                g.setdefault(a, set()).add(b)
+        return g
+
+    def cycles(self) -> List[List[str]]:
+        """Every distinct cycle found by DFS over the acquisition graph."""
+        g = self.graph()
+        out: List[List[str]] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in g}
+
+        def dfs(node: str, path: List[str]) -> None:
+            color[node] = GREY
+            path.append(node)
+            for nxt in sorted(g.get(node, ())):
+                c = color.get(nxt, WHITE)
+                if c == GREY:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    canon = tuple(sorted(cyc[:-1]))
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        out.append(cyc)
+                elif c == WHITE:
+                    dfs(nxt, path)
+            path.pop()
+            color[node] = BLACK
+
+        for n in sorted(g):
+            if color.get(n, WHITE) == WHITE:
+                dfs(n, [])
+        return out
+
+    def render_cycles(self) -> str:
+        lines = []
+        for cyc in self.cycles():
+            lines.append("lock-order cycle: " + " -> ".join(cyc))
+            for a, b in zip(cyc, cyc[1:]):
+                sample = self.edges.get((a, b))
+                if sample:
+                    thread, snap = sample
+                    lines.append(f"    {a} -> {b}  (thread {thread}, "
+                                 f"held {list(snap)})")
+        return "\n".join(lines)

@@ -1,0 +1,313 @@
+"""native-abi: the ctypes bindings must match the `extern "C"` surface.
+
+Parses every prototype inside `extern "C" { ... }` blocks of the corpus
+.cpp files and cross-checks the .py files that declare `lib.<fn>.argtypes`
+/ `.restype`:
+
+- every exported function with parameters has `argtypes` declared
+- argument count matches the prototype
+- each ctype is compatible with the C parameter type (ndpointer dtypes
+  are resolved from the binding module's own helper assignments)
+- every non-void function declares `restype`; VOID functions must set
+  `restype = None` explicitly — ctypes silently defaults restype to
+  c_int, which reads a garbage register on void returns
+- the binding's `nomad_native_abi_version` gate compares against the
+  version the .cpp actually returns
+
+Bindings for functions absent from the .cpp (stale bindings) are flagged
+too — that's the drift direction ctypes never catches at runtime.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import Corpus, Finding, SourceFile
+
+CHECKER = "native-abi"
+
+_EXTERN_RE = re.compile(r'extern\s+"C"\s*\{(.*)\}', re.DOTALL)
+_PROTO_RE = re.compile(
+    r'^[ \t]*((?:[A-Za-z_][\w]*[ \t*]+)+)'     # return type tokens
+    r'([A-Za-z_]\w*)[ \t]*'                    # function name
+    r'\(([^)]*)\)[ \t]*\{',                    # params up to the body
+    re.MULTILINE | re.DOTALL)
+
+# canonical C scalar/pointer type -> acceptable ctypes names
+_SCALAR_OK = {
+    "int": {"c_int", "c_int32"},
+    "int32_t": {"c_int32", "c_int"},
+    "uint32_t": {"c_uint32", "c_uint"},
+    "int64_t": {"c_int64", "c_longlong"},
+    "size_t": {"c_size_t"},
+    "float": {"c_float"},
+    "double": {"c_double"},
+    "char*": {"c_char_p"},
+}
+_PTR_DTYPE = {
+    "float*": "float32",
+    "double*": "float64",
+    "int32_t*": "int32",
+    "uint32_t*": "uint32",
+    "uint8_t*": "uint8",
+    "int8_t*": "int8",
+    "int64_t*": "int64",
+    "uint64_t*": "uint64",
+}
+
+_NP_DTYPES = {"float32", "float64", "int8", "int32", "int64",
+              "uint8", "uint32", "uint64"}
+
+
+def _canon_ctype(raw: str) -> str:
+    """'const float* capacity' -> 'float*'; 'int n_rows' -> 'int'."""
+    raw = raw.strip()
+    raw = re.sub(r"\bconst\b", "", raw)
+    raw = raw.replace("*", " * ")
+    toks = raw.split()
+    if toks and toks[-1] != "*" and re.match(r"^[A-Za-z_]\w*$", toks[-1]) \
+            and len(toks) > 1:
+        toks = toks[:-1]           # drop the parameter name
+    return "".join(toks)
+
+
+class _CFunc:
+    def __init__(self, name: str, ret: str, params: List[str], line: int):
+        self.name = name
+        self.ret = ret
+        self.params = params
+        self.line = line
+
+
+def _parse_cpp(text: str) -> Dict[str, _CFunc]:
+    out: Dict[str, _CFunc] = {}
+    m = _EXTERN_RE.search(text)
+    body = m.group(1) if m else text
+    offset = text[:m.start(1)].count("\n") if m else 0
+    # strip comments so commented-out prototypes don't register
+    stripped = re.sub(r"//[^\n]*", "", body)
+    for pm in _PROTO_RE.finditer(stripped):
+        if re.search(r"\bstatic\b|\binline\b", pm.group(1)):
+            continue                # internal helper, not part of the ABI
+        ret = _canon_ctype(pm.group(1) + " _")   # reuse param canon; fake name
+        name = pm.group(2)
+        raw_params = pm.group(3).strip()
+        params = []
+        if raw_params and raw_params != "void":
+            params = [_canon_ctype(p) for p in raw_params.split(",")]
+        line = offset + stripped[:pm.start()].count("\n") + 1
+        out[name] = _CFunc(name, ret, params, line)
+    return out
+
+
+def _abi_version_value(cpp_text: str) -> Optional[int]:
+    m = re.search(r"nomad_native_abi_version\s*\([^)]*\)\s*\{\s*return\s+"
+                  r"(\d+)\s*;", cpp_text)
+    return int(m.group(1)) if m else None
+
+
+# ------------------------------------------------------------------ bindings
+
+class _Binding:
+    def __init__(self):
+        self.argtypes: Optional[List[str]] = None   # canonical ctype names
+        self.argtypes_line: int = 0
+        self.restype: Optional[str] = "UNSET"       # canonical or None/"UNSET"
+        self.restype_line: int = 0
+
+
+def _ndpointer_dtypes(sf: SourceFile) -> Dict[str, str]:
+    """Helper-name -> numpy dtype for `X = np.ctypeslib.ndpointer(np.T,…)`
+    (and direct ndpointer calls resolved inline elsewhere)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            dt = _dtype_of_ndpointer(node.value)
+            if dt:
+                out[node.targets[0].id] = dt
+    return out
+
+
+def _dtype_of_ndpointer(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "ndpointer" and node.args:
+        a = node.args[0]
+        if isinstance(a, ast.Attribute) and a.attr in _NP_DTYPES:
+            return a.attr
+        if isinstance(a, ast.Constant) and a.value in _NP_DTYPES:
+            return a.value
+    return None
+
+
+def _ctype_token(node: ast.AST, helpers: Dict[str, str]) -> str:
+    """One element of an argtypes list -> canonical token:
+    'nd:<dtype>' for ndpointers, ctypes member name otherwise."""
+    if isinstance(node, ast.Name):
+        if node.id in helpers:
+            return f"nd:{helpers[node.id]}"
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr                          # ctypes.c_int -> c_int
+    dt = _dtype_of_ndpointer(node)
+    if dt:
+        return f"nd:{dt}"
+    return "?"
+
+
+def _collect_bindings(sf: SourceFile) -> Dict[str, _Binding]:
+    helpers = _ndpointer_dtypes(sf)
+    out: Dict[str, _Binding] = {}
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute) and
+                isinstance(tgt.value, ast.Attribute)):
+            continue
+        fn_name = tgt.value.attr
+        b = out.setdefault(fn_name, _Binding())
+        if tgt.attr == "argtypes" and isinstance(node.value,
+                                                 (ast.List, ast.Tuple)):
+            b.argtypes = [_ctype_token(el, helpers)
+                          for el in node.value.elts]
+            b.argtypes_line = node.lineno
+        elif tgt.attr == "restype":
+            if isinstance(node.value, ast.Constant) and \
+                    node.value.value is None:
+                b.restype = None
+            else:
+                b.restype = _ctype_token(node.value, helpers)
+            b.restype_line = node.lineno
+    return out
+
+
+def _gate_versions(sf: SourceFile) -> List[Tuple[int, int]]:
+    """(compared value, line) for `... nomad_native_abi_version() ==/!= N`
+    — directly or through a variable (`got = lib.…(); if got != N`)."""
+    gate_names: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                _is_abi_call(node.value):
+            gate_names.add(node.targets[0].id)
+    out = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Compare) and len(node.comparators) == 1:
+            left, right = node.left, node.comparators[0]
+            for a, b in ((left, right), (right, left)):
+                direct = _is_abi_call(a)
+                via_var = isinstance(a, ast.Name) and a.id in gate_names
+                if (direct or via_var) and isinstance(b, ast.Constant) \
+                        and isinstance(b.value, int):
+                    out.append((b.value, node.lineno))
+    return out
+
+
+def _is_abi_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        isinstance(node.func, ast.Attribute) and \
+        node.func.attr == "nomad_native_abi_version"
+
+
+def _compatible(ctok: str, cparam: str) -> bool:
+    if cparam in _PTR_DTYPE:
+        return ctok == f"nd:{_PTR_DTYPE[cparam]}" or ctok == "c_void_p"
+    if cparam in _SCALAR_OK:
+        return ctok in _SCALAR_OK[cparam]
+    return True                                   # unknown C type: no claim
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    cfuncs: Dict[str, Tuple[str, _CFunc]] = {}
+    abi_cpp: Optional[int] = None
+    for _, rel, text in corpus.cpp:
+        if 'extern "C"' not in text:
+            continue
+        for name, cf in _parse_cpp(text).items():
+            cfuncs[name] = (rel, cf)
+        v = _abi_version_value(text)
+        if v is not None:
+            abi_cpp = v
+    if not cfuncs:
+        return []
+
+    binding_files = [sf for sf in corpus.py
+                     if any(isinstance(n, ast.Assign) and n.targets and
+                            isinstance(n.targets[0], ast.Attribute) and
+                            n.targets[0].attr in ("argtypes", "restype") and
+                            isinstance(n.targets[0].value, ast.Attribute)
+                            for n in ast.walk(sf.tree))]
+    if not binding_files:
+        return []
+
+    for sf in binding_files:
+        bindings = _collect_bindings(sf)
+        gates = _gate_versions(sf)
+
+        def emit(line: int, msg: str) -> None:
+            from nomad_tpu.analysis.common import enclosing_def_line
+            if not sf.allowed(CHECKER, line, enclosing_def_line(sf, line)):
+                findings.append(Finding(CHECKER, sf.rel, line, msg))
+
+        for name, (crel, cf) in cfuncs.items():
+            b = bindings.get(name)
+            if name == "nomad_native_abi_version":
+                if abi_cpp is not None and gates and \
+                        all(v != abi_cpp for v, _ in gates):
+                    emit(gates[0][1],
+                         f"abi version gate compares against "
+                         f"{gates[0][0]} but {crel} returns {abi_cpp}")
+                if abi_cpp is not None and not gates:
+                    emit(1, f"no abi version gate: binding never checks "
+                            f"nomad_native_abi_version() (== {abi_cpp})")
+            if b is None or b.argtypes is None:
+                if cf.params:
+                    emit(b.restype_line if b else 1,
+                         f"`{name}` exported by {crel}:{cf.line} has no "
+                         f"argtypes declaration (ctypes would not check "
+                         f"{len(cf.params)} args)")
+                if cf.ret != "void" and (b is None or b.restype == "UNSET") \
+                        and name != "nomad_native_abi_version":
+                    emit(1, f"`{name}` returns {cf.ret} but restype is "
+                            f"undeclared (ctypes defaults to c_int)")
+                continue
+            if len(b.argtypes) != len(cf.params):
+                emit(b.argtypes_line,
+                     f"`{name}` argtypes declares {len(b.argtypes)} args "
+                     f"but {crel}:{cf.line} takes {len(cf.params)}")
+            else:
+                for i, (ctok, cparam) in enumerate(zip(b.argtypes,
+                                                       cf.params)):
+                    if not _compatible(ctok, cparam):
+                        emit(b.argtypes_line,
+                             f"`{name}` arg {i}: binding declares {ctok} "
+                             f"but C prototype wants `{cparam}`")
+            if cf.ret == "void":
+                if b.restype == "UNSET":
+                    emit(b.argtypes_line,
+                         f"`{name}` returns void but restype is not set "
+                         f"to None (ctypes defaults to c_int and reads a "
+                         f"garbage register)")
+                elif b.restype is not None:
+                    emit(b.restype_line,
+                         f"`{name}` returns void but restype is "
+                         f"{b.restype}")
+            else:
+                if b.restype == "UNSET":
+                    emit(b.argtypes_line,
+                         f"`{name}` returns {cf.ret} but restype is "
+                         f"undeclared (ctypes defaults to c_int)")
+                elif b.restype is None or not _compatible(b.restype, cf.ret):
+                    emit(b.restype_line or b.argtypes_line,
+                         f"`{name}` returns {cf.ret} but restype is "
+                         f"{b.restype}")
+
+        for name, b in bindings.items():
+            if name not in cfuncs and b.argtypes is not None:
+                emit(b.argtypes_line,
+                     f"stale binding: `{name}` is not exported by any "
+                     f"extern \"C\" block in the corpus")
+    return findings

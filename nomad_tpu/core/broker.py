@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from nomad_tpu import chaos
 from nomad_tpu.structs import Evaluation
+from nomad_tpu.utils import requires_lock
 
 FAILED_QUEUE = "_failed"
 
@@ -38,6 +39,14 @@ class _Lease:
 
 
 class EvalBroker:
+    # Lock discipline (see nomad_tpu.analysis): the queue tables below
+    # are only touched under `self._lock` or in @requires_lock helpers.
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({
+        "_ready", "_unack", "_attempts", "_pending", "_active_jobs",
+        "_delayed", "_requeued",
+    })
+
     def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3,
                  initial_nack_delay: float = 1.0, subsequent_nack_delay: float = 20.0):
         self._lock = threading.Condition()
@@ -71,6 +80,7 @@ class EvalBroker:
             if not enabled:
                 self.flush()
 
+    @requires_lock("_lock")
     def flush(self) -> None:
         self._ready.clear()
         self._unack.clear()
@@ -93,6 +103,7 @@ class EvalBroker:
                 self._enqueue_locked(ev)
             self._lock.notify_all()
 
+    @requires_lock("_lock")
     def _enqueue_locked(self, ev: Evaluation) -> None:
         if not self.enabled:
             return
@@ -113,6 +124,7 @@ class EvalBroker:
 
     # ------------------------------------------------------------- dequeue
 
+    @requires_lock("_lock")
     def _poll_timers_locked(self) -> None:
         now = _time.time()
         while self._delayed and self._delayed[0][0] <= now:
@@ -191,6 +203,7 @@ class EvalBroker:
             self._lock.notify_all()
             return True
 
+    @requires_lock("_lock")
     def _nack_locked(self, ev: Evaluation, requeue_now: bool = False) -> None:
         self._attempts[ev.id] += 1
         attempts = self._attempts[ev.id]
@@ -211,6 +224,7 @@ class EvalBroker:
                        (_time.time() + delay, next(self._counter), ev))
         self.stats["nacked"] += 1
 
+    @requires_lock("_lock")
     def _release_pending_locked(self, key: Tuple[str, str]) -> None:
         pending = self._pending.get(key)
         if pending:
@@ -239,6 +253,10 @@ class EvalBroker:
                 return False
             lease.expires_at = _time.time() + self.nack_timeout
             return True
+
+    def unacked_count(self) -> int:
+        with self._lock:
+            return len(self._unack)
 
     def ready_count(self) -> int:
         with self._lock:

@@ -54,7 +54,7 @@ class CoreScheduler:
         """Terminal evals (and their terminal allocs) past the threshold."""
         store = self.server.store
         gc_evals, gc_allocs = [], []
-        for ev in list(store._evals.values()):
+        for ev in store.evals():
             if not ev.terminal():
                 continue
             if not self._old_enough(ev.modify_time or ev.create_time, now,

@@ -20,6 +20,16 @@ from nomad_tpu.structs import (
 from nomad_tpu.structs.evaluation import EvalTrigger
 
 
+def _stamp(d: Deployment) -> Deployment:
+    """Propose-time timestamps: they ride in the raft log payload so the
+    FSM never reads the clock (replicas/replay must agree byte-for-byte;
+    see nomad_tpu.analysis fsm-determinism)."""
+    d.modify_time = _time.time()
+    if not d.create_time:
+        d.create_time = d.modify_time
+    return d
+
+
 class DeploymentWatcher:
     def __init__(self, server, interval: float = 0.1):
         self.server = server
@@ -126,7 +136,7 @@ class DeploymentWatcher:
         if complete and updated.task_groups:
             updated.status = DeploymentStatus.SUCCESSFUL
             updated.status_description = DeploymentStatus.DESC_SUCCESSFUL
-            server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": updated})
+            server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": _stamp(updated)})
             self._mark_job_stable(d)
             return
 
@@ -145,7 +155,7 @@ class DeploymentWatcher:
         # only write when something actually changed — an unconditional
         # upsert re-triggers this watcher through its own state watch
         if counts(updated) != counts(d) or updated.status != d.status:
-            server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": updated})
+            server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": _stamp(updated)})
         if progressed:
             self._emit_eval(updated)
 
@@ -157,7 +167,7 @@ class DeploymentWatcher:
         d.status = DeploymentStatus.FAILED
         d.status_description = (DeploymentStatus.DESC_PROGRESS_DEADLINE
                                 if deadline else DeploymentStatus.DESC_FAILED_ALLOCATIONS)
-        server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": d})
+        server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": _stamp(d)})
         # auto-revert to the latest stable version
         if any(s.auto_revert for s in d.task_groups.values()):
             job = server.store.job_by_id(d.namespace, d.job_id)
@@ -170,7 +180,7 @@ class DeploymentWatcher:
         self._emit_eval(d)
 
     def _latest_stable(self, namespace: str, job_id: str, before_version: int):
-        versions = self.server.store._job_versions.get((namespace, job_id), [])
+        versions = self.server.store.job_versions(namespace, job_id)
         for j in sorted(versions, key=lambda x: -x.version):
             if j.stable and j.version < before_version:
                 return j
@@ -199,7 +209,7 @@ class DeploymentWatcher:
         for name, state in updated.task_groups.items():
             if groups is None or name in groups:
                 state.promoted = True
-        server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": updated})
+        server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": _stamp(updated)})
         self._emit_eval(updated)
         return True
 
@@ -217,7 +227,7 @@ class DeploymentWatcher:
         updated = d.copy()
         updated.status = (DeploymentStatus.PAUSED if pause
                           else DeploymentStatus.RUNNING)
-        self.server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": updated})
+        self.server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": _stamp(updated)})
         if not pause:
             self._emit_eval(updated)
         return True

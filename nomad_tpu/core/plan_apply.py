@@ -297,7 +297,7 @@ class PlanApplier:
         group_ports: List[List[int]] = []
         group_freed: List[List[int]] = []
         for i, node_id in enumerate(node_ids):
-            node = store._nodes.get(node_id)
+            node = store.node_by_id(node_id)
             row = cm.row_of.get(node_id)
             ports: List[int] = []
             if self._node_ok_for_placement(node) and row is not None:
@@ -425,6 +425,13 @@ class PlanApplier:
                 and not result.node_preemptions and result.deployment is None
                 and not result.deployment_updates):
             return None
+        if result.deployment is not None:
+            # stamp here (propose side) so the FSM applies carried values
+            # instead of reading the clock under fsm.apply
+            d = result.deployment
+            d.modify_time = _time.time()
+            if not d.create_time:
+                d.create_time = d.modify_time
         return AppliedPlanResults(
             alloc_updates=[a for v in result.node_update.values() for a in v],
             allocs_to_place=[a for v in result.node_allocation.values() for a in v],

@@ -397,7 +397,7 @@ class Server:
 
     def _restore_evals(self) -> None:
         """On leadership: re-enqueue non-terminal evals (leader.go:572)."""
-        for ev in list(self.store._evals.values()):
+        for ev in self.store.evals():
             if ev.should_enqueue():
                 self.broker.enqueue(ev.copy())
             elif ev.should_block():
@@ -442,7 +442,7 @@ class Server:
         if table == "allocs":
             a = obj
             if a.terminal_status():
-                node = self.store._nodes.get(a.node_id)
+                node = self.store.node_by_id(a.node_id)
                 if node is not None:
                     self.blocked_evals.unblock(node.computed_class,
                                                self.store.latest_index)
@@ -473,13 +473,25 @@ class Server:
             self.create_evals(evals)
 
     def update_eval(self, ev: Evaluation) -> None:
+        # timestamps ride in the log payload: the FSM must not read the
+        # clock, or replicas/replay diverge (see nomad_tpu.analysis)
+        ev.modify_time = _time.time()
+        if not ev.create_time:
+            ev.create_time = ev.modify_time
         self.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
 
     def create_evals(self, evals: List[Evaluation]) -> None:
         # pending evals are enqueued / blocked by the FSM's leader hook
         # (reference: fsm eval apply with the broker attached)
-        self.apply(MessageType.EVAL_UPDATE,
-                   {"evals": [e.copy() for e in evals]})
+        now = _time.time()
+        copies = []
+        for e in evals:
+            c = e.copy()
+            c.modify_time = now
+            if not c.create_time:
+                c.create_time = now
+            copies.append(c)
+        self.apply(MessageType.EVAL_UPDATE, {"evals": copies})
 
     def register_job(self, job: Job) -> Evaluation:
         """Job.Register (nomad/job_endpoint.go:81): upsert + eval.  A job
@@ -493,6 +505,8 @@ class Server:
                 job_id=job.id, type=job.type,
                 triggered_by=EvalTrigger.JOB_REGISTER,
                 status=EvalStatus.PENDING)
+        if not job.submit_time:
+            job.submit_time = _time.time()   # propose-time, rides the log
         index = self.apply(MessageType.JOB_REGISTER, {"job": job})
         # when the write was forwarded, the leader mutated a pickled copy;
         # pull the committed indexes back onto the caller's object so the
@@ -809,7 +823,7 @@ class Server:
         deadline = _time.time() + timeout
         while _time.time() < deadline:
             if (self.broker.ready_count() == 0
-                    and not self.broker._unack
+                    and self.broker.unacked_count() == 0
                     and self.plan_queue.depth() == 0):
                 return True
             _time.sleep(0.01)

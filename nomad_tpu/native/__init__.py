@@ -47,7 +47,11 @@ _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 def _build() -> Optional[str]:
     """Compile the native library, cached by source *content hash* (an
     mtime check could silently prefer a stale or foreign-toolchain binary
-    after a checkout)."""
+    after a checkout).  NOMAD_TPU_NATIVE_LIB overrides with a prebuilt
+    .so (the sanitizer CI leg points this at an ASan/UBSan build)."""
+    override = os.environ.get("NOMAD_TPU_NATIVE_LIB")
+    if override:
+        return override if os.path.exists(override) else None
     if not os.path.exists(_SRC):
         return None
     os.makedirs(_BUILD_DIR, exist_ok=True)
@@ -87,10 +91,18 @@ def _load() -> Optional[ctypes.CDLL]:
         except OSError:
             return None
         lib.nomad_native_abi_version.restype = ctypes.c_int32
-        if lib.nomad_native_abi_version() != 2:
-            return None
+        got = lib.nomad_native_abi_version()
+        if got != 2:
+            # a wrong-ABI library silently misreading argument layouts is
+            # far worse than no library: fail loudly, never fall back
+            raise RuntimeError(
+                f"nomad_native ABI mismatch: {path} reports version "
+                f"{got}, bindings require 2 — rebuild the library "
+                f"(delete {_BUILD_DIR}) or fix NOMAD_TPU_NATIVE_LIB")
+        lib.allocs_fit_dense.restype = None
         lib.allocs_fit_dense.argtypes = [
             _f32p, _f32p, _f32p, ctypes.c_int, ctypes.c_int, _u8p]
+        lib.score_fit_dense.restype = None
         lib.score_fit_dense.argtypes = [
             _f32p, _f32p, _f32p, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, _f32p]
@@ -98,11 +110,14 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ports_check.argtypes = [
             _u32p, ctypes.c_int, ctypes.c_int, _i32p, ctypes.c_int,
             _i32p, ctypes.c_int]
+        lib.ports_set.restype = None
         lib.ports_set.argtypes = [
             _u32p, ctypes.c_int, ctypes.c_int, _i32p, ctypes.c_int,
             ctypes.c_int]
+        lib.scatter_add.restype = None
         lib.scatter_add.argtypes = [
             _f32p, ctypes.c_int, _i32p, _f32p, ctypes.c_int]
+        lib.validate_plan.restype = None
         lib.validate_plan.argtypes = [
             _f32p, _f32p, _u32p, ctypes.c_int, ctypes.c_int,
             _i32p, _f32p, _f32p, _i32p, _i32p, _i32p, _i32p,
@@ -111,8 +126,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.expand_pairs.argtypes = [
             _i32p, _i32p, _f32p, ctypes.c_int, _i32p, _f32p,
             ctypes.c_int32]
+        lib.format_uuids.restype = None
         lib.format_uuids.argtypes = [
             _u8p, ctypes.c_int, ctypes.c_char_p]
+        lib.scatter_add_rank1.restype = None
         lib.scatter_add_rank1.argtypes = [
             _f32p, ctypes.c_int, _i32p, _i32p, _f32p, ctypes.c_int]
         _lib = lib

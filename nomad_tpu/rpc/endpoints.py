@@ -569,13 +569,13 @@ class Endpoints:
             add("deployment", [d.id for d in store.deployments()
                                if ns_ok(d.namespace)])
         if context in ("all", "plugins"):
-            add("plugins", [p.get("id", pid) if isinstance(p, dict) else pid
-                            for pid, p in store._csi_plugins.items()])
+            add("plugins", [p.get("id", "") if isinstance(p, dict) else p.id
+                            for p in store.csi_plugins()])
         if context in ("all", "volumes"):
-            add("volumes", [vid for (ns, vid) in store._csi_volumes
-                            if ns_ok(ns)])
+            add("volumes", [v.id for v in store.csi_volumes()
+                            if ns_ok(v.namespace)])
         if context in ("all", "namespaces"):
-            add("namespaces", list(store._namespaces))
+            add("namespaces", [ns["name"] for ns in store.namespaces()])
         return {"matches": out, "truncations": trunc}
 
     # ------------------------------------------------------------- scaling

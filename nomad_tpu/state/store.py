@@ -34,6 +34,7 @@ from nomad_tpu.structs import (
 from nomad_tpu.structs.evaluation import EvalTrigger
 from nomad_tpu.structs.node import NodeStatus, compute_node_class
 from nomad_tpu.structs.plan import Plan, PlanResult
+from nomad_tpu.utils import requires_lock
 
 
 class JobSummary:
@@ -64,7 +65,9 @@ class JobSummary:
 class StateSnapshot:
     """A consistent read-only view at one index."""
 
+    @requires_lock("_lock")
     def __init__(self, store: "StateStore"):
+        # caller (StateStore.snapshot) holds store._lock while we copy
         self.index = store.latest_index
         self.nodes: Dict[str, Node] = dict(store._nodes)
         self.jobs: Dict[Tuple[str, str], Job] = dict(store._jobs)
@@ -132,6 +135,21 @@ class StateSnapshot:
 
 
 class StateStore:
+    # Lock discipline, enforced statically by nomad_tpu.analysis
+    # (lock-discipline checker): every read/write of the attrs below must
+    # happen inside `with <store>._lock:` or a @requires_lock method.
+    _LOCK_NAME = "_lock"
+    _LOCK_ALIASES = ("_index_cv",)       # Condition wrapping the same RLock
+    _LOCK_PROTECTED = frozenset({
+        "_nodes", "_jobs", "_job_versions", "_evals", "_allocs",
+        "_deployments", "_job_summaries", "_allocs_by_job",
+        "_allocs_by_node", "_allocs_by_eval", "_evals_by_job",
+        "_namespaces", "_acl_policies", "_acl_tokens", "_acl_by_secret",
+        "_csi_volumes", "_csi_plugins", "_scaling_events", "_services",
+        "_services_by_alloc", "_applied_plan_ids", "_applied_plan_ids_set",
+        "_snapshot_cache",
+    })
+
     def __init__(self):
         self._lock = threading.RLock()
         self._index_cv = threading.Condition(self._lock)
@@ -190,6 +208,7 @@ class StateStore:
         for fn in self._watchers:
             fn(table, obj)
 
+    @requires_lock("_lock")
     def _bump(self, index: int) -> None:
         if index <= self.latest_index:
             index = self.latest_index  # idempotent replay keeps max
@@ -247,6 +266,7 @@ class StateStore:
         if node:
             self._notify("nodes", node)
 
+    @requires_lock("_lock")
     def _update_csi_plugins_for_node(self, index: int, node: Node) -> None:
         """Derive csi_plugins rows from node fingerprints (reference
         state_store.go updateNodeCSIPlugins)."""
@@ -343,8 +363,10 @@ class StateStore:
     def upsert_job(self, index: int, job: Job) -> None:
         with self._lock:
             job.canonicalize()
-            if not job.submit_time:
-                job.submit_time = _time.time()
+            # submit_time is stamped at PROPOSE time (Server.register_job)
+            # and carried in the raft log payload: stamping it here would
+            # run inside fsm.apply, where a wall-clock read makes every
+            # replica/replay produce a different value.
             key = (job.namespace, job.id)
             existing = self._jobs.get(key)
             if existing is not None:
@@ -430,15 +452,15 @@ class StateStore:
     # ------------------------------------------------------------ evals
 
     def upsert_evals(self, index: int, evals: Iterable[Evaluation]) -> None:
+        # create_time/modify_time are stamped at propose time and ride in
+        # the log payload — reading the clock here diverges replicas.
         out = []
-        now = _time.time()
         with self._lock:
             for e in evals:
                 if e.id not in self._evals:
                     e.create_index = index
-                    if not e.create_time:
-                        e.create_time = now
-                e.modify_time = now
+                if not e.modify_time:
+                    e.modify_time = e.create_time
                 e.modify_index = index
                 self._evals[e.id] = e
                 self._evals_by_job[(e.namespace, e.job_id)].add(e.id)
@@ -531,7 +553,9 @@ class StateStore:
             if alloc_id is not None:
                 doomed |= self._services_by_alloc.get(alloc_id, set())
             removed = []
-            for sid in doomed:
+            # sorted: set order varies with hash randomization, and pop
+            # order shapes dict layout -> snapshot bytes must not care
+            for sid in sorted(doomed):
                 sr = self._services.pop(sid, None)
                 if sr is not None:
                     self._services_by_alloc[sr.alloc_id].discard(sid)
@@ -557,6 +581,7 @@ class StateStore:
 
     # ------------------------------------------------------------ allocs
 
+    @requires_lock("_lock")
     def _drop_alloc(self, alloc_id: str) -> None:
         a = self._allocs.pop(alloc_id, None)
         if a is None:
@@ -566,6 +591,7 @@ class StateStore:
         self._allocs_by_eval[a.eval_id].discard(alloc_id)
         self.matrix.remove_alloc(alloc_id)
 
+    @requires_lock("_lock")
     def _insert_alloc(self, index: int, a: Allocation) -> None:
         prev = self._allocs.get(a.id)
         if prev is not None:
@@ -584,6 +610,7 @@ class StateStore:
         self.matrix.upsert_alloc(a)
         self._update_summary(a, prev)
 
+    @requires_lock("_lock")
     def _update_summary(self, a: Allocation, prev: Optional[Allocation]) -> None:
         key = (a.namespace, a.job_id)
         js = self._job_summaries.get(key)
@@ -664,13 +691,13 @@ class StateStore:
     # ------------------------------------------------------------ deployments
 
     def upsert_deployment(self, index: int, d: Deployment) -> None:
-        now = _time.time()
+        # timestamps stamped at propose time (core/deployments.py) and
+        # carried in the log payload; no clock reads under fsm.apply
         with self._lock:
             if d.id not in self._deployments:
                 d.create_index = index
-                if not d.create_time:
-                    d.create_time = now
-            d.modify_time = now
+            if not d.modify_time:
+                d.modify_time = d.create_time
             d.modify_index = index
             self._deployments[d.id] = d
             self._bump(index)
@@ -876,6 +903,7 @@ class StateStore:
                     per[vol.plugin_id] = per.get(vol.plugin_id, 0) + 1
         return counts
 
+    @requires_lock("_lock")
     def _refresh_volume_health(self, vol) -> None:
         """Denormalize plugin health onto the volume (reference
         CSIVolumeDenormalizePlugins): schedulable tracks node-plugin
@@ -896,6 +924,7 @@ class StateStore:
             ok = ok and vol.controllers_healthy > 0
         vol.schedulable = ok
 
+    @requires_lock("_lock")
     def _take_csi_claims_for_alloc(self, index: int, alloc) -> None:
         """Claims for a placed allocation's CSI volume requests (the
         reference claims from the client csi_hook via the
@@ -922,6 +951,7 @@ class StateStore:
                 state=csistructs.CLAIM_STATE_TAKEN))
             vol.modify_index = index
 
+    @requires_lock("_lock")
     def _upsert_plan_result_locked(self, index: int,
                                    result: "AppliedPlanResults",
                                    touched: list) -> None:

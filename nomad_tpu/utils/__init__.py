@@ -71,6 +71,18 @@ def enable_compile_cache(path: str | None = None) -> str | None:
         return None
 
 
+def requires_lock(lockname: str = "_lock"):
+    """Marker decorator: the decorated method must only be called with
+    `lockname` already held by the caller.  Runtime no-op; the static
+    lock-discipline checker (nomad_tpu.analysis) treats the body as
+    lock-covered and every caller remains obligated to hold the lock at
+    the call site."""
+    def mark(fn):
+        fn.__requires_lock__ = lockname
+        return fn
+    return mark
+
+
 def generate_uuid() -> str:
     """RFC-4122-shaped random id, ~10x faster than uuid.uuid4() (which
     dominates profiles at thousands of allocs/evals per second; the

@@ -22,3 +22,21 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_order_guard():
+    """NOMAD_TPU_LOCK_ORDER=1 wraps every lock allocated during the run
+    and fails the session if the acquisition graph has a cycle (latent
+    deadlock).  Off by default: the wrapper adds per-acquire overhead."""
+    if os.environ.get("NOMAD_TPU_LOCK_ORDER", "0") in ("", "0"):
+        yield
+        return
+    from nomad_tpu.analysis.lock_order import LockOrderRecorder
+    rec = LockOrderRecorder().install()
+    yield
+    rec.uninstall()
+    cycles = rec.cycles()
+    assert not cycles, "\n" + rec.render_cycles()

@@ -1,0 +1,11 @@
+"""Registry with a dead point, suppressed at the declaration line."""
+
+FAULT_POINTS = ("rpc.drop", "plan.crash", "dead.point")   # analysis: allow(chaos-coverage)
+
+
+class ChaosRegistry:
+    def should(self, point):
+        return False
+
+
+active = None

@@ -1,0 +1,14 @@
+"""The unregistered-point site, suppressed on its line."""
+import chaos
+
+
+def rpc_send(msg):
+    if chaos.active is not None and chaos.active.should("rpc.drop"):
+        return False
+    chaos.fire("unknown.point")              # analysis: allow(chaos-coverage)
+    return True
+
+
+def commit_plan(plan):
+    chaos.fire("plan.crash")
+    return plan

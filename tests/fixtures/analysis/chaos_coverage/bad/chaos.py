@@ -1,0 +1,11 @@
+"""Fixture chaos registry with one dead point."""
+
+FAULT_POINTS = ("rpc.drop", "plan.crash", "dead.point")
+
+
+class ChaosRegistry:
+    def should(self, point):
+        return False
+
+
+active = None

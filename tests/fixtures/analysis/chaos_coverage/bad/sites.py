@@ -1,0 +1,15 @@
+"""Injection sites: one names an unregistered point; nothing fires
+`dead.point`."""
+import chaos
+
+
+def rpc_send(msg):
+    if chaos.active is not None and chaos.active.should("rpc.drop"):
+        return False
+    chaos.fire("unknown.point")              # not in FAULT_POINTS
+    return True
+
+
+def commit_plan(plan):
+    chaos.fire("plan.crash")
+    return plan

@@ -1,0 +1,11 @@
+"""Registry and sites in agreement."""
+
+FAULT_POINTS = ("rpc.drop", "plan.crash")
+
+
+class ChaosRegistry:
+    def should(self, point):
+        return False
+
+
+active = None

@@ -1,0 +1,13 @@
+"""Every registered point has a site, every site is registered."""
+import chaos
+
+
+def rpc_send(msg):
+    if chaos.active is not None and chaos.active.should("rpc.drop"):
+        return False
+    return True
+
+
+def commit_plan(plan):
+    chaos.fire("plan.crash")
+    return plan

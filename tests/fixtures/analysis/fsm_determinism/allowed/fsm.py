@@ -1,0 +1,25 @@
+"""Same seeded violations as bad/, every one fenced with the allow
+comment — including the call edge into `_stamp`, which must prune the
+transitive finding behind it."""
+import time as _time
+import uuid
+
+
+class MiniFSM:
+    def __init__(self, store):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        if msg_type == "job":
+            self._apply_job(index, payload)
+
+    def _apply_job(self, index, payload):
+        payload["submit_time"] = _time.time()        # analysis: allow(fsm-determinism)
+        payload["id"] = str(uuid.uuid4())            # analysis: allow(fsm-determinism)
+        doomed = set(payload.get("doomed", ()))
+        for d in doomed:                             # analysis: allow(fsm-determinism)
+            self.store.pop(d, None)
+        self._stamp(payload)                         # analysis: allow(fsm-determinism)
+
+    def _stamp(self, payload):
+        payload["nonce"] = uuid.uuid4().hex          # reached only via the allowed edge

@@ -1,0 +1,24 @@
+"""Seeded fsm-determinism violations: wall-clock, entropy (direct and
+transitive), and unordered-set iteration inside the FSM apply cone."""
+import time as _time
+import uuid
+
+
+class MiniFSM:
+    def __init__(self, store):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        if msg_type == "job":
+            self._apply_job(index, payload)
+
+    def _apply_job(self, index, payload):
+        payload["submit_time"] = _time.time()        # wall-clock
+        payload["id"] = str(uuid.uuid4())            # entropy
+        doomed = set(payload.get("doomed", ()))
+        for d in doomed:                             # unordered iteration
+            self.store.pop(d, None)
+        self._stamp(payload)
+
+    def _stamp(self, payload):
+        payload["nonce"] = uuid.uuid4().hex          # transitive entropy

@@ -1,0 +1,17 @@
+"""Deterministic apply cone: timestamps ride in the log payload and
+set-like tables are iterated in sorted order."""
+
+
+class MiniFSM:
+    def __init__(self, store):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        if msg_type == "job":
+            self._apply_job(index, payload)
+
+    def _apply_job(self, index, payload):
+        payload.setdefault("submit_time", 0.0)       # stamped at propose time
+        doomed = set(payload.get("doomed", ()))
+        for d in sorted(doomed):
+            self.store.pop(d, None)

@@ -1,0 +1,31 @@
+"""Same escapes as bad/, each fenced with the allow comment."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_norm(x):
+    total = float(x.sum())                   # analysis: allow(jax-purity)
+    arr = np.asarray(x)                      # analysis: allow(jax-purity)
+    return x / (total + arr.shape[0])
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def bad_gate(scores, k):
+    if scores > 0:                           # analysis: allow(jax-purity)
+        return scores * k
+    return scores
+
+
+def _pull(x):
+    return x.item()                          # analysis: allow(jax-purity)
+
+
+def body(x):
+    return _pull(x) + 1
+
+
+kernel = jax.jit(body)

@@ -1,0 +1,32 @@
+"""Seeded jax-purity violations: host coercion, eager numpy, tracer
+branching, and a transitive .item() through a call-form jit."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_norm(x):
+    total = float(x.sum())                   # host coercion of a tracer
+    arr = np.asarray(x)                      # eager numpy at trace time
+    return x / (total + arr.shape[0])
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def bad_gate(scores, k):
+    if scores > 0:                           # branch on traced param
+        return scores * k
+    return scores
+
+
+def _pull(x):
+    return x.item()                          # transitive host pull
+
+
+def body(x):
+    return _pull(x) + 1
+
+
+kernel = jax.jit(body)

@@ -1,0 +1,17 @@
+"""Traceable kernels: jnp everywhere, branching only on statics."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def norm(x):
+    return x / jnp.sum(x)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def gate(scores, k):
+    if k > 2:                                # static: resolved at trace time
+        scores = scores * 2.0
+    return jnp.where(scores > 0, scores, 0.0)

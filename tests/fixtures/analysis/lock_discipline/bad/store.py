@@ -1,0 +1,15 @@
+"""Seeded lock-discipline violation: a declared protected table written
+outside the lock."""
+import threading
+
+
+class Store:
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({"_jobs"})
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs = {}
+
+    def put(self, job_id, job):
+        self._jobs[job_id] = job                     # no lock held

@@ -1,0 +1,22 @@
+"""Disciplined accesses: under `with self._lock`, in a @requires_lock
+helper, or in the owner's __init__ (construction precedes sharing)."""
+import threading
+
+from nomad_tpu.utils import requires_lock
+
+
+class Store:
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({"_jobs"})
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs = {}
+
+    def put(self, job_id, job):
+        with self._lock:
+            self._jobs[job_id] = job
+
+    @requires_lock("_lock")
+    def _put_locked(self, job_id, job):
+        self._jobs[job_id] = job

@@ -1,0 +1,18 @@
+// Fixture ABI surface for the native-abi checker.
+#include <cstdint>
+
+extern "C" {
+
+int nomad_native_abi_version() { return 2; }
+
+void scale_rows(float* rows, int n, float factor) {
+    for (int i = 0; i < n; ++i) rows[i] *= factor;
+}
+
+int sum_ids(const int32_t* ids, int n) {
+    int s = 0;
+    for (int i = 0; i < n; ++i) s += ids[i];
+    return s;
+}
+
+}

@@ -1,0 +1,16 @@
+"""Seeded native-abi violations: wrong gate version, argument count
+drift, dtype mismatch, missing void restype, and a stale binding."""
+import ctypes
+
+import numpy as np
+
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+lib = ctypes.CDLL("libfixture.so")
+if lib.nomad_native_abi_version() != 1:                # gate vs .cpp's 2
+    raise RuntimeError("abi mismatch")
+
+lib.scale_rows.argtypes = [_f32p, ctypes.c_int]        # 2 args vs 3; void restype unset
+lib.sum_ids.argtypes = [_f32p, ctypes.c_int]           # arg 0 wants int32*
+lib.sum_ids.restype = ctypes.c_int
+lib.old_fn.argtypes = [ctypes.c_int]                   # not exported anymore

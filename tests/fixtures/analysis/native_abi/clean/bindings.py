@@ -1,0 +1,16 @@
+"""Bindings in lockstep with the fixture's extern "C" surface."""
+import ctypes
+
+import numpy as np
+
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+lib = ctypes.CDLL("libfixture.so")
+if lib.nomad_native_abi_version() != 2:
+    raise RuntimeError("abi mismatch")
+
+lib.scale_rows.argtypes = [_f32p, ctypes.c_int, ctypes.c_float]
+lib.scale_rows.restype = None
+lib.sum_ids.argtypes = [_i32p, ctypes.c_int]
+lib.sum_ids.restype = ctypes.c_int
