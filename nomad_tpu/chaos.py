@@ -29,6 +29,19 @@ Fault points and their injection sites:
                               immediately (worker's ack/plan goes stale)
     native.fail               native/__init__.py — a native kernel call
                               raises (drives the circuit breaker)
+    disk.torn_write           raft/log.py — a power-loss crash leaves a
+                              partial record at the WAL tail (load must
+                              truncate it and warn)
+    disk.fsync_fail           raft/log.py, raft/meta.py — an fsync fails;
+                              the WAL retries, the vote/term meta store
+                              refuses to acknowledge (a vote must never
+                              be granted on non-durable state)
+    disk.corrupt_read         raft/log.py, raft/snapshot.py — a read
+                              returns flipped bits; the CRC catches it
+                              and the reader retries from disk
+    snapshot.partial_write    raft/snapshot.py — crash mid-snapshot: a
+                              truncated record lands under the final
+                              name (latest() must skip it and fall back)
 
 Zero-overhead-when-disabled contract: `active` is None unless a registry
 is installed; every injection site guards with `if chaos.active is not
@@ -53,6 +66,10 @@ FAULT_POINTS = (
     "plan.crash_after_commit",
     "broker.lease_expire",
     "native.fail",
+    "disk.torn_write",
+    "disk.fsync_fail",
+    "disk.corrupt_read",
+    "snapshot.partial_write",
 )
 
 
@@ -130,6 +147,13 @@ class ChaosRegistry:
             if hit:
                 self.stats[point] += 1
         return hit
+
+    def uniform(self) -> float:
+        """Seeded parameter draw for a fault that already fired (e.g. how
+        much of a torn record survives); shares the registry RNG so the
+        whole fault schedule stays a function of the seed."""
+        with self._lock:
+            return self._rng.random()
 
 
 # The installed registry; None = chaos disabled (the common case).

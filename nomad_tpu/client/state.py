@@ -4,10 +4,19 @@ a restarted client can recover running tasks via RecoverTask).
 
 sqlite3 (stdlib, a real embedded native DB) replaces BoltDB.  Schema
 versioned for upgrade handling (client/state/upgrade.go).
+
+Corruption recovery: a client whose state DB is damaged (torn page,
+truncated file) must still boot — the servers hold desired state, and
+running tasks re-register or restart.  On `sqlite3.DatabaseError` at
+open, the damaged files move aside to ``<path>.corrupt`` (plus the WAL/
+SHM sidecars) and a fresh DB is created; `close()` checkpoints the
+sqlite WAL back into the main file so a clean shutdown leaves one
+self-contained db file behind.
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sqlite3
 import threading
@@ -15,6 +24,8 @@ from dataclasses import asdict
 from typing import Dict, List, Optional, Tuple
 
 from nomad_tpu.client.drivers import TaskHandle
+
+log = logging.getLogger(__name__)
 
 SCHEMA_VERSION = 1
 
@@ -26,25 +37,52 @@ class ClientStateDB:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._lock = threading.Lock()
         self._closed = False
-        self._db = sqlite3.connect(path, check_same_thread=False)
-        self._db.execute("PRAGMA journal_mode=WAL")
-        self._init_schema()
+        self.path = path
+        try:
+            self._db = self._open(path)
+        except sqlite3.DatabaseError:
+            # corrupt DB: losing local runner state is recoverable (the
+            # control plane re-sends desired allocs); crashing the client
+            # on boot is not.  Keep the evidence for forensics.
+            log.warning("client state db %s is corrupt; moving it aside "
+                        "to %s.corrupt and starting fresh", path, path)
+            self._move_aside(path)
+            self._db = self._open(path)
 
-    def _init_schema(self) -> None:
-        with self._lock, self._db:
-            self._db.execute("""CREATE TABLE IF NOT EXISTS meta
+    @staticmethod
+    def _open(path: str) -> sqlite3.Connection:
+        db = sqlite3.connect(path, check_same_thread=False)
+        try:
+            db.execute("PRAGMA journal_mode=WAL")
+            ClientStateDB._init_schema(db)
+        except sqlite3.DatabaseError:
+            db.close()
+            raise
+        return db
+
+    @staticmethod
+    def _move_aside(path: str) -> None:
+        for suffix in ("", "-wal", "-shm"):
+            src = path + suffix
+            if os.path.exists(src):
+                os.replace(src, path + ".corrupt" + suffix)
+
+    @staticmethod
+    def _init_schema(db: sqlite3.Connection) -> None:
+        with db:
+            db.execute("""CREATE TABLE IF NOT EXISTS meta
                 (key TEXT PRIMARY KEY, value TEXT)""")
-            self._db.execute("""CREATE TABLE IF NOT EXISTS allocs
+            db.execute("""CREATE TABLE IF NOT EXISTS allocs
                 (alloc_id TEXT PRIMARY KEY, blob TEXT NOT NULL)""")
-            self._db.execute("""CREATE TABLE IF NOT EXISTS task_state
+            db.execute("""CREATE TABLE IF NOT EXISTS task_state
                 (alloc_id TEXT, task TEXT, state TEXT, failed INTEGER,
                  restarts INTEGER, handle TEXT,
                  PRIMARY KEY (alloc_id, task))""")
-            cur = self._db.execute(
+            cur = db.execute(
                 "SELECT value FROM meta WHERE key='schema_version'")
             row = cur.fetchone()
             if row is None:
-                self._db.execute(
+                db.execute(
                     "INSERT INTO meta VALUES ('schema_version', ?)",
                     (str(SCHEMA_VERSION),))
             elif int(row[0]) > SCHEMA_VERSION:
@@ -117,4 +155,10 @@ class ClientStateDB:
         with self._lock:
             if not self._closed:
                 self._closed = True
+                try:
+                    # fold the sqlite WAL back into the main file so a
+                    # clean shutdown leaves one self-contained db behind
+                    self._db.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+                except sqlite3.DatabaseError:
+                    pass
                 self._db.close()

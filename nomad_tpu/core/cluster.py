@@ -9,6 +9,7 @@ tests.
 """
 from __future__ import annotations
 
+import copy
 import time
 from typing import List, Optional
 
@@ -21,24 +22,24 @@ class Cluster:
                  raft_config: Optional[RaftConfig] = None,
                  data_dir: Optional[str] = None):
         self.transport = InMemTransport()
-        names = [f"server-{i}" for i in range(n)]
+        self._names = [f"server-{i}" for i in range(n)]
+        self._config = config
+        self._data_dir = data_dir
         # timeouts tolerate multi-hundred-ms GIL pauses (jit compiles in
         # neighboring tests share the process) without leader flapping
         self.raft_config = raft_config or RaftConfig(
             heartbeat_interval=0.05, election_timeout=0.3)
-        self.servers: List[Server] = []
-        for nm in names:
-            cfg = config or ServerConfig(num_schedulers=2)
-            if data_dir is not None:
-                cfg = ServerConfig(
-                    num_schedulers=cfg.num_schedulers,
-                    enabled_schedulers=cfg.enabled_schedulers,
-                    heartbeat_ttl=cfg.heartbeat_ttl,
-                    gc_interval=cfg.gc_interval,
-                    data_dir=data_dir)
-            self.servers.append(Server(
-                cfg, name=nm, peers=names, raft_transport=self.transport,
-                raft_config=self.raft_config))
+        self.servers: List[Server] = [self._make_server(nm)
+                                      for nm in self._names]
+
+    def _make_server(self, name: str) -> Server:
+        cfg = self._config or ServerConfig(num_schedulers=2)
+        if self._data_dir is not None:
+            cfg = copy.copy(cfg)
+            cfg.data_dir = self._data_dir
+        return Server(cfg, name=name, peers=self._names,
+                      raft_transport=self.transport,
+                      raft_config=self.raft_config)
 
     def start(self) -> None:
         for s in self.servers:
@@ -68,6 +69,28 @@ class Cluster:
         """Hard-stop a member (network drop + component shutdown)."""
         self.transport.set_down(server.name)
         server.stop()
+
+    def hard_kill(self, server: Server) -> None:
+        """Power-loss kill: the network drops and the server's WAL loses
+        everything past its last fsync (Server.crash) — nothing is
+        flushed or closed cleanly.  restart() brings the member back from
+        its data_dir."""
+        self.transport.set_down(server.name)
+        server.crash()
+
+    def restart(self, server: Server) -> Server:
+        """Boot a fresh Server over the killed member's name + data_dir
+        (the crashed process restarting on the same host).  Requires the
+        cluster to have been built with a data_dir; returns the
+        replacement, which also takes the old member's slot in
+        `self.servers`."""
+        if self._data_dir is None:
+            raise RuntimeError("restart() needs a data_dir-backed cluster")
+        replacement = self._make_server(server.name)
+        self.servers[self.servers.index(server)] = replacement
+        self.transport.set_down(server.name, down=False)
+        replacement.start()
+        return replacement
 
     def isolate(self, server: Server) -> None:
         """Cut a live member off the network (it keeps running — the

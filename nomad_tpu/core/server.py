@@ -34,6 +34,7 @@ from nomad_tpu.core.plan_queue import PlanQueue
 from nomad_tpu.core.secrets import SecretsProvider
 from nomad_tpu.core.worker import Worker
 from nomad_tpu.raft import (
+    DurableMeta,
     FileSnapshotStore,
     LogStore,
     MessageType,
@@ -128,15 +129,19 @@ class Server:
         if raft_transport is not None:
             raft_transport.register(f"rpc:{name}", self.endpoints.handle)
             data_dir = self.config.data_dir
-            log_store = snapshots = None
+            log_store = snapshots = meta = None
             if data_dir:
                 sdir = os.path.join(data_dir, name)
                 os.makedirs(sdir, exist_ok=True)
                 log_store = LogStore(os.path.join(sdir, "raft.log"))
                 snapshots = FileSnapshotStore(os.path.join(sdir, "snapshots"))
+                # term + vote on stable storage: without this a restarted
+                # server can grant a second vote in the same term
+                meta = DurableMeta(os.path.join(sdir, "raft_meta.json"))
             self.raft = RaftNode(
                 name, peers or [name], raft_transport, self.fsm,
                 config=raft_config, log_store=log_store, snapshots=snapshots,
+                meta=meta,
                 on_leader=self._establish_leadership,
                 on_follower=self._revoke_leadership)
 
@@ -370,6 +375,24 @@ class Server:
         self.remote_workers = []
         if self.raft is not None:
             self.raft.stop()
+
+    def crash(self) -> None:
+        """Hard-kill (power loss) simulation: threads stop, but nothing
+        flushes — the raft WAL loses its unsynced tail (and may keep a
+        torn record under chaos `disk.torn_write`).  The durability soak
+        restarts a crashed server from the same data_dir and asserts no
+        committed state was lost."""
+        self._stop.set()
+        for w in self.remote_workers:
+            w.stop()
+        self._revoke_leadership()
+        for w in self.remote_workers:
+            w.join(1.0)
+        self.remote_workers = []
+        if self.raft is not None:
+            self.raft.crash()
+        if self._transport is not None:
+            self._transport.deregister(f"rpc:{self.name}")
 
     # ------------------------------------------------------------- snapshots
 

@@ -9,6 +9,7 @@ plans go to the plan queue and the worker blocks on the applier's result.
 """
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import random
 import threading
@@ -26,9 +27,14 @@ from nomad_tpu.telemetry import global_metrics
 
 log = logging.getLogger(__name__)
 
-# transient cluster errors: the eval should be redelivered, not failed
+# transient cluster errors: the eval should be redelivered, not failed.
+# A raft-apply commit timeout (futures.TimeoutError) belongs here: the
+# write may or may not have landed, which is the same ambiguity as a
+# leadership loss, and redelivery resolves both the same way (the worker
+# re-snapshots past the eval's index before scheduling again).
 TRANSIENT_ERRORS = (NotLeaderError, LeadershipLostError, RpcError,
-                    Unreachable)
+                    Unreachable, concurrent.futures.TimeoutError,
+                    TimeoutError)
 
 
 class Worker:
@@ -87,8 +93,14 @@ class Worker:
                     pass
             except Exception:                       # noqa: BLE001
                 # never let the worker thread die (reference workers live
-                # for the life of the server, worker.go:386)
+                # for the life of the server, worker.go:386) — and hand
+                # the lease back so the eval redelivers now, not at the
+                # nack timeout
                 log.exception("worker %s: unhandled error", self.id)
+                try:
+                    self._nack(ev.id, token)
+                except TRANSIENT_ERRORS:
+                    pass
 
     # -- broker ops, overridable for the RPC path (RemoteWorker)
 

@@ -1,17 +1,140 @@
-"""Raft log store (reference: raft-boltdb log store + raftInmem,
+"""Raft write-ahead log (reference: raft-boltdb log store + raftInmem,
 nomad/server.go:107-111).
 
-In-memory list of entries with an optional append-only file behind it so a
-restarted server replays its log from disk (the BoltDB store's job in the
-reference).  Entries before `first_index` have been compacted into a
-snapshot.
+In-memory list of entries with a crash-safe append-only file behind it so
+a restarted server replays its log from disk (the BoltDB store's job in
+the reference — BoltDB gives the reference checksummed pages and fsynced
+commits for free; this store provides the same guarantees explicitly).
+
+On-disk format (version 1): the file opens with an 8-byte magic
+(``NTPUWAL1``, last byte = format version) followed by length-prefixed
+records::
+
+    [u32 payload_len][u32 crc32(payload)][payload]
+
+where payload is the pickled op tuple ``("entry", index, term, type,
+body)`` or ``("compact", index)``.  The length + CRC catch exactly the
+crash-consistency failures Pillai et al. (OSDI 2014) show dominate real
+storage bugs:
+
+- a *torn tail* — the record extends past EOF or its checksum fails with
+  nothing valid after it, i.e. what a crash mid-append leaves behind —
+  is truncated with a warning and the store opens normally;
+- *mid-stream corruption* — a bad record followed by valid ones — means
+  committed history is damaged, and the store refuses to open
+  (`WALCorruptionError`) rather than silently dropping entries; restore
+  from a snapshot/peer instead.
+
+Durability policy (``NOMAD_TPU_FSYNC``):
+
+    always   fsync before ``append()`` returns
+    batch    group commit (default): the appender blocks until a
+             background syncer's fsync covers its record, so concurrent
+             appends amortize one fsync (BoltDB-style group commit)
+    off      never fsync — page cache only (dev/test)
+
+Regardless of policy, ``append()`` only returns once the record is at
+least in the OS page cache, and the Raft metadata store (term/vote,
+``raft/meta.py``) always fsyncs — the policies here trade off *log*
+durability, never election safety.
+
+Legacy migration: a seed-era WAL (bare pickle stream) is detected by its
+first byte (pickle's 0x80 opcode vs. the magic), parsed tolerating a
+truncated/corrupt tail, and rewritten atomically in the new format on
+first open; the original is kept at ``<path>.legacy``.
+
+Chaos points (see nomad_tpu/chaos.py): ``disk.fsync_fail`` at every
+fsync, ``disk.corrupt_read`` at record reads (CRC catches, reader
+retries), ``disk.torn_write`` at `simulate_crash` (the power-loss hook
+the durability soak drives).
 """
 from __future__ import annotations
 
+import io
+import logging
 import os
 import pickle
+import struct
+import tempfile
 import threading
-from typing import List, Optional
+import time
+import zlib
+from typing import List, Optional, Tuple
+
+from nomad_tpu import chaos
+
+log = logging.getLogger(__name__)
+
+WAL_MAGIC = b"NTPUWAL1"
+_HDR = struct.Struct("<II")
+# a record length beyond this is treated as corruption, not data (the
+# biggest real payloads — FSM snapshots — live in the snapshot store)
+_MAX_RECORD = 1 << 30
+# how far past a bad record _parse scans for a valid successor before
+# declaring the damage a torn tail (bounds the O(n·m) resync probe)
+_RESYNC_WINDOW = 1 << 20
+
+FSYNC_POLICIES = ("always", "batch", "off")
+
+
+class WALCorruptionError(RuntimeError):
+    """Mid-stream WAL corruption: valid records exist past a damaged one,
+    so truncating would drop committed history.  Restore from snapshot or
+    re-join from peers instead of starting on a silently shortened log."""
+
+
+def fsync_policy_from_env() -> str:
+    pol = os.environ.get("NOMAD_TPU_FSYNC", "batch").strip().lower()
+    if pol not in FSYNC_POLICIES:
+        raise ValueError(
+            f"NOMAD_TPU_FSYNC={pol!r}: want one of {', '.join(FSYNC_POLICIES)}")
+    return pol
+
+
+def encode_record(payload: bytes) -> bytes:
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory containing `path` so a rename/create survives
+    power loss (the step Pillai et al. found most often missing)."""
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:           # some filesystems can't fsync directories
+        pass
+    finally:
+        os.close(fd)
+
+
+def _valid_record_at(data: bytes, off: int) -> bool:
+    if off + _HDR.size > len(data):
+        return False
+    ln, crc = _HDR.unpack_from(data, off)
+    end = off + _HDR.size + ln
+    if ln > _MAX_RECORD or end > len(data):
+        return False
+    return zlib.crc32(data[off + _HDR.size:end]) == crc
+
+
+def _read_payload(data: bytes, off: int, ln: int, crc: int) -> Optional[bytes]:
+    """One record read with CRC verification.  A transient corrupt read
+    (chaos `disk.corrupt_read`, or real bit rot between media and memory)
+    fails the CRC and is retried once from the source."""
+    for attempt in (0, 1):
+        payload = data[off:off + ln]
+        if attempt == 0 and chaos.active is not None \
+                and payload and chaos.should("disk.corrupt_read"):
+            payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
+        if zlib.crc32(payload) == crc:
+            return payload
+        log.warning("wal: CRC mismatch reading record at offset %d "
+                    "(attempt %d); retrying read", off, attempt + 1)
+    return None
 
 
 class LogEntry:
@@ -28,39 +151,282 @@ class LogEntry:
 
 
 class LogStore:
-    def __init__(self, path: Optional[str] = None):
+    def __init__(self, path: Optional[str] = None,
+                 fsync: Optional[str] = None):
         self._lock = threading.Lock()
         self._entries: List[LogEntry] = []
         self.first_index = 1           # index of _entries[0] if any
         self.path = path
+        self.fsync_policy = fsync or fsync_policy_from_env()
+        if self.fsync_policy not in FSYNC_POLICIES:
+            raise ValueError(f"bad fsync policy {self.fsync_policy!r}")
         self._fh = None
+        self._size = 0                 # bytes written (file offset)
+        self._synced_size = 0          # bytes known durable (fsynced)
+        self._sync_cv = threading.Condition()
+        self._sync_stop = threading.Event()
+        self._syncer: Optional[threading.Thread] = None
         if path:
-            self._load(path)
-            self._fh = open(path, "ab")
+            for op in self._load(path):
+                self._replay(op)
+            # buffering=0: writes reach the OS immediately, so the only
+            # volatile window left is page cache → disk, which is exactly
+            # what _synced_size / simulate_crash model
+            self._fh = open(path, "ab", buffering=0)
+            self._size = self._synced_size = os.path.getsize(path)
+            if self.fsync_policy == "batch":
+                self._syncer = threading.Thread(
+                    target=self._sync_loop, name="wal-sync", daemon=True)
+                self._syncer.start()
 
     # ------------------------------------------------------------- disk
 
-    def _load(self, path: str) -> None:
+    def _load(self, path: str) -> List[tuple]:
+        """Read (and, where needed, repair or migrate) the WAL; returns
+        the ops to replay.  Leaves the on-disk file valid new-format."""
         if not os.path.exists(path):
-            return
+            self._create(path)
+            return []
         with open(path, "rb") as fh:
-            while True:
-                try:
-                    rec = pickle.load(fh)
-                except EOFError:
-                    break
-                if rec[0] == "entry":
-                    _, index, term, msg_type, payload = rec
-                    self._truncate_from(index)
-                    self._entries.append(LogEntry(index, term, msg_type, payload))
-                elif rec[0] == "compact":
-                    self._compact_to(rec[1])
+            data = fh.read()
+        if not data:
+            self._create(path)
+            return []
+        if not data.startswith(WAL_MAGIC):
+            return self._migrate_legacy(path, data)
+        ops, valid_size = self._parse(data, path)
+        if valid_size < len(data):
+            log.warning(
+                "wal: %s has a torn tail (%d trailing bytes after a crash "
+                "mid-append); truncating to last valid record at %d",
+                path, len(data) - valid_size, valid_size)
+            with open(path, "r+b") as fh:
+                fh.truncate(valid_size)
+                fh.flush()
+                os.fsync(fh.fileno())
+        return ops
 
-    def _persist(self, e: LogEntry) -> None:
-        if self._fh is not None:
-            pickle.dump(("entry", e.index, e.term, e.msg_type, e.payload),
-                        self._fh)
-            self._fh.flush()
+    def _create(self, path: str) -> None:
+        """New WAL: the magic header is fsynced (file and directory) at
+        creation regardless of policy, so the file itself — the restart
+        anchor — always survives power loss."""
+        with open(path, "wb") as fh:
+            fh.write(WAL_MAGIC)
+            fh.flush()
+            os.fsync(fh.fileno())
+        fsync_dir(path)
+
+    def _parse(self, data: bytes, path: str) -> Tuple[List[tuple], int]:
+        """Walk new-format records; returns (ops, valid_prefix_size).
+        Raises WALCorruptionError on mid-stream damage."""
+        ops: List[tuple] = []
+        off = len(WAL_MAGIC)
+        while off < len(data):
+            if off + _HDR.size > len(data):
+                return ops, off                      # torn header
+            ln, crc = _HDR.unpack_from(data, off)
+            body_off = off + _HDR.size
+            end = body_off + ln
+            if ln > _MAX_RECORD or end > len(data):
+                # implausible/overrunning length: unreadable past here —
+                # torn tail unless a valid record resyncs further on
+                self._refuse_if_midstream(data, body_off, path, off)
+                return ops, off
+            payload = _read_payload(data, body_off, ln, crc)
+            if payload is None:
+                self._refuse_if_midstream(data, end, path, off)
+                return ops, off
+            try:
+                op = pickle.loads(payload)
+            except Exception:                        # noqa: BLE001
+                self._refuse_if_midstream(data, end, path, off)
+                return ops, off
+            ops.append(op)
+            off = end
+        return ops, off
+
+    @staticmethod
+    def _refuse_if_midstream(data: bytes, scan_from: int, path: str,
+                             bad_off: int) -> None:
+        """A bad record followed by a parseable one is not a torn tail —
+        committed history is damaged and truncation would lose it."""
+        limit = min(len(data), scan_from + _RESYNC_WINDOW)
+        for cand in range(max(scan_from, len(WAL_MAGIC)), limit):
+            if _valid_record_at(data, cand):
+                raise WALCorruptionError(
+                    f"{path}: corrupt record at offset {bad_off} is "
+                    f"followed by valid records (next at {cand}); "
+                    f"refusing to truncate committed history — restore "
+                    f"this member from a snapshot or a peer")
+
+    def _migrate_legacy(self, path: str, data: bytes) -> List[tuple]:
+        """Seed-format WAL (bare pickle stream): parse tolerating a
+        truncated/corrupt tail, rewrite atomically in the new format."""
+        ops: List[tuple] = []
+        fh = io.BytesIO(data)
+        while True:
+            try:
+                rec = pickle.load(fh)
+            except EOFError:
+                break
+            except (pickle.UnpicklingError, AttributeError, ValueError,
+                    IndexError, TypeError) as exc:
+                log.warning(
+                    "wal: dropping corrupt/truncated legacy tail of %s "
+                    "at offset %d (%s)", path, fh.tell(), exc)
+                break
+            ops.append(tuple(rec))
+        log.warning("wal: migrating legacy pickle WAL %s (%d records) to "
+                    "checksummed format; original kept at %s.legacy",
+                    path, len(ops), path)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                                   prefix=".wal-migrate-")
+        with os.fdopen(fd, "wb") as out:
+            out.write(WAL_MAGIC)
+            for op in ops:
+                out.write(encode_record(
+                    pickle.dumps(op, protocol=pickle.HIGHEST_PROTOCOL)))
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(path, path + ".legacy")
+        os.replace(tmp, path)
+        fsync_dir(path)
+        return ops
+
+    def _replay(self, op: tuple) -> None:
+        if op and op[0] == "entry":
+            _, index, term, msg_type, payload = op
+            self._truncate_from(index)
+            if self._entries and index != self._entries[-1].index + 1:
+                # a hole in the sequence is NOT a torn tail — every record
+                # here passed its CRC.  The entries after the hole are
+                # unreachable by index, so starting up would silently
+                # misattribute state; refuse like any mid-stream damage.
+                raise WALCorruptionError(
+                    f"{self.path}: log gap — entry {index} follows "
+                    f"{self._entries[-1].index}")
+            if not self._entries:
+                self.first_index = index
+            self._entries.append(LogEntry(index, term, msg_type, payload))
+        elif op and op[0] == "compact":
+            self._compact_to(op[1])
+        else:
+            raise WALCorruptionError(
+                f"{self.path}: unknown WAL record kind {op[:1]!r}")
+
+    def _persist(self, op: tuple) -> Optional[int]:
+        """Write one record (caller holds self._lock); returns the file
+        offset the record ends at, for _wait_durable."""
+        if self._fh is None:
+            return None
+        rec = encode_record(
+            pickle.dumps(op, protocol=pickle.HIGHEST_PROTOCOL))
+        self._fh.write(rec)
+        self._size += len(rec)
+        return self._size
+
+    # --------------------------------------------------------- durability
+
+    def _fsync_once(self) -> bool:
+        try:
+            if chaos.active is not None and chaos.should("disk.fsync_fail"):
+                raise OSError("chaos: injected fsync failure")
+            os.fsync(self._fh.fileno())
+            return True
+        except (OSError, ValueError, AttributeError):
+            log.warning("wal: fsync failed; will retry", exc_info=True)
+            return False
+
+    def _sync_loop(self) -> None:
+        """Group-commit syncer: one fsync covers every record written
+        before it started; appenders blocked in _wait_durable wake when
+        _synced_size passes their offset."""
+        while not self._sync_stop.is_set():
+            with self._sync_cv:
+                while self._synced_size >= self._size \
+                        and not self._sync_stop.is_set():
+                    self._sync_cv.wait(0.05)
+                if self._sync_stop.is_set():
+                    return
+            target = self._size
+            ok = self._fsync_once()
+            with self._sync_cv:
+                if ok:
+                    self._synced_size = max(self._synced_size, target)
+                self._sync_cv.notify_all()
+            if not ok:
+                time.sleep(0.001)
+
+    def _wait_durable(self, want: Optional[int]) -> None:
+        """Block until the WAL is durable through offset `want` under the
+        configured policy.  Must be called WITHOUT self._lock held."""
+        if want is None or self._fh is None or self.fsync_policy == "off":
+            return
+        if self.fsync_policy == "always":
+            for _ in range(3):
+                if self._fsync_once():
+                    with self._sync_cv:
+                        self._synced_size = max(self._synced_size, want)
+                    return
+            log.warning("wal: giving up fsync after retries; record at "
+                        "offset %d is page-cache only", want)
+            return
+        deadline = time.monotonic() + 5.0
+        with self._sync_cv:
+            self._sync_cv.notify_all()       # wake the syncer
+            while self._synced_size < want \
+                    and not self._sync_stop.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    log.warning("wal: group-commit fsync stalled; record "
+                                "at offset %d is page-cache only", want)
+                    return
+                self._sync_cv.wait(min(remaining, 0.05))
+
+    def sync(self) -> None:
+        """Force the whole WAL durable now (used by close)."""
+        with self._lock:
+            want = self._size if self._fh is not None else None
+        if want is not None and self._fsync_once():
+            with self._sync_cv:
+                self._synced_size = max(self._synced_size, want)
+
+    def _stop_syncer(self) -> None:
+        self._sync_stop.set()
+        with self._sync_cv:
+            self._sync_cv.notify_all()
+        if self._syncer is not None:
+            self._syncer.join(2.0)
+            self._syncer = None
+
+    def simulate_crash(self) -> None:
+        """Power-loss simulation (the durability soak's kill switch):
+        everything past the last fsync is lost, and an in-flight append
+        may leave a partial record behind (chaos `disk.torn_write`).
+        The store is unusable afterwards — reopen from `path`."""
+        with self._lock:
+            if self._fh is None:
+                return
+            self._stop_syncer()
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+            keep = self._synced_size
+            size = os.path.getsize(self.path)
+            if size > keep and chaos.should("disk.torn_write"):
+                reg = chaos.active
+                frac = reg.uniform() if reg is not None else 0.5
+                torn = keep + max(1, int((size - keep) * frac))
+                keep = min(torn, size - 1)
+                log.warning("wal: simulated torn write — %s keeps %d of "
+                            "%d bytes (partial tail record)",
+                            self.path, keep, size)
+            with open(self.path, "r+b") as fh:
+                fh.truncate(max(keep, len(WAL_MAGIC)))
+                fh.flush()
+                os.fsync(fh.fileno())
 
     # ------------------------------------------------------------- core
 
@@ -76,13 +442,37 @@ class LogStore:
             del self._entries[:drop]
             self.first_index = index + 1
 
+    def _append_locked(self, e: LogEntry) -> Optional[int]:
+        self._truncate_from(e.index)
+        if self._entries and e.index != self._entries[-1].index + 1:
+            # refuse to create a hole: entries list is positional, so a
+            # gapped append would misindex every later lookup and write a
+            # WAL that cannot be replayed (see _replay's gap check)
+            raise ValueError(
+                f"non-contiguous append: entry {e.index} after "
+                f"{self._entries[-1].index}")
+        if not self._entries:
+            self.first_index = e.index
+        self._entries.append(e)
+        return self._persist(("entry", e.index, e.term, e.msg_type,
+                              e.payload))
+
     def append(self, e: LogEntry) -> None:
         with self._lock:
-            self._truncate_from(e.index)
-            if not self._entries:
-                self.first_index = e.index
-            self._entries.append(e)
-            self._persist(e)
+            want = self._append_locked(e)
+        self._wait_durable(want)
+
+    def append_batch(self, entries: List[LogEntry]) -> None:
+        """Append several entries with ONE durability wait — the follower
+        AppendEntries path, where per-entry fsync waits would serialize
+        catch-up replication."""
+        if not entries:
+            return
+        want = None
+        with self._lock:
+            for e in entries:
+                want = self._append_locked(e)
+        self._wait_durable(want)
 
     def get(self, index: int) -> Optional[LogEntry]:
         with self._lock:
@@ -118,11 +508,16 @@ class LogStore:
         """Discard entries ≤ through_index (they live in a snapshot now)."""
         with self._lock:
             self._compact_to(through_index)
-            if self._fh is not None:
-                pickle.dump(("compact", through_index), self._fh)
-                self._fh.flush()
+            want = self._persist(("compact", through_index))
+        self._wait_durable(want)
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        self._stop_syncer()
+        with self._lock:
+            if self._fh is not None:
+                if self.fsync_policy != "off":
+                    self._fsync_once()
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None

@@ -1,0 +1,117 @@
+"""Durable Raft metadata: currentTerm + votedFor.
+
+Raft requires both on stable storage BEFORE a node acts on them (Ongaro &
+Ousterhout 2014, Figure 2: "updated on stable storage before responding
+to RPCs").  A node that grants a vote, crashes, and forgets it can grant
+a second vote in the same term — two leaders.  The reference gets this
+from raft-boltdb's StableStore; this is the explicit equivalent.
+
+The file is one small JSON object written write-temp → fsync → atomic
+rename → directory fsync, so a crash at any instant leaves either the old
+or the new metadata, never a torn mix.  It always fsyncs regardless of
+``NOMAD_TPU_FSYNC`` — the file is tiny and written only on term/vote
+changes, and surviving power loss is its entire purpose.  A failed fsync
+raises `MetaPersistError`, and callers must then refuse the action that
+needed durability (RaftNode refuses to grant the vote / abort the
+candidacy).
+
+A CRC over the body is stored alongside as belt-and-braces; rename
+atomicity should make load-time corruption impossible, so a bad CRC or
+unparseable file is treated as an operator problem (raise), not silently
+reset — resetting would forget a vote, the exact bug this file prevents.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import zlib
+from typing import Optional, Tuple
+
+from nomad_tpu import chaos
+from nomad_tpu.raft.log import fsync_dir
+
+log = logging.getLogger(__name__)
+
+META_VERSION = 1
+
+
+class MetaPersistError(RuntimeError):
+    """Term/vote could not be made durable (or loaded); the caller must
+    not act as if it had been."""
+
+
+def _encode_body(term: int, voted_for: Optional[str]) -> bytes:
+    return json.dumps({"v": META_VERSION, "term": term,
+                       "voted_for": voted_for}, sort_keys=True).encode()
+
+
+class DurableMeta:
+    """Load-once, persist-on-change store for (term, voted_for)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self._lock = threading.Lock()
+        self._load()
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        try:
+            with open(self.path, "rb") as fh:
+                rec = json.loads(fh.read())
+            body = _encode_body(int(rec["term"]), rec["voted_for"])
+            if int(rec["crc"]) != zlib.crc32(body):
+                raise ValueError("crc mismatch")
+            if int(rec["v"]) > META_VERSION:
+                raise ValueError(f"meta version {rec['v']} newer than "
+                                 f"supported {META_VERSION}")
+            self.term = int(rec["term"])
+            self.voted_for = rec["voted_for"]
+        except (OSError, ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as exc:
+            # forgetting a persisted vote re-opens the double-vote window;
+            # surface the damage instead of starting amnesiac
+            raise MetaPersistError(
+                f"raft metadata {self.path} unreadable ({exc}); refusing "
+                f"to start with a possibly forgotten vote — restore or "
+                f"remove the file deliberately") from exc
+
+    def persist(self, term: int, voted_for: Optional[str]) -> None:
+        """Durably record (term, voted_for); no-op when unchanged.
+        Raises MetaPersistError if durability cannot be guaranteed."""
+        with self._lock:
+            if term == self.term and voted_for == self.voted_for:
+                return
+            rec = {"v": META_VERSION, "term": term, "voted_for": voted_for,
+                   "crc": zlib.crc32(_encode_body(term, voted_for))}
+            d = os.path.dirname(self.path) or "."
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".raft-meta-")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(json.dumps(rec, sort_keys=True).encode())
+                    fh.flush()
+                    if chaos.active is not None \
+                            and chaos.should("disk.fsync_fail"):
+                        raise OSError("chaos: injected fsync failure")
+                    os.fsync(fh.fileno())
+                os.replace(tmp, self.path)
+            except OSError as exc:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise MetaPersistError(
+                    f"could not persist term/vote to {self.path}: {exc}"
+                ) from exc
+            fsync_dir(self.path)
+            self.term = term
+            self.voted_for = voted_for
+
+    def state(self) -> Tuple[int, Optional[str]]:
+        with self._lock:
+            return self.term, self.voted_for

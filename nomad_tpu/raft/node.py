@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import pickle
 import queue
 import random
 import threading
@@ -20,6 +21,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from nomad_tpu.raft.log import LogEntry, LogStore
+from nomad_tpu.raft.meta import DurableMeta, MetaPersistError
 from nomad_tpu.raft.snapshot import FileSnapshotStore
 from nomad_tpu.raft.transport import InMemTransport, Unreachable
 
@@ -52,6 +54,7 @@ class RaftNode:
                  config: Optional[RaftConfig] = None,
                  log_store: Optional[LogStore] = None,
                  snapshots: Optional[FileSnapshotStore] = None,
+                 meta: Optional[DurableMeta] = None,
                  on_leader: Optional[Callable[[], None]] = None,
                  on_follower: Optional[Callable[[], None]] = None):
         self.name = name
@@ -61,13 +64,17 @@ class RaftNode:
         self.config = config or RaftConfig()
         self.log = log_store or LogStore()
         self.snapshots = snapshots
+        self.meta = meta
         self.on_leader = on_leader
         self.on_follower = on_follower
 
         self._lock = threading.RLock()
         self.state = FOLLOWER
-        self.term = 0
-        self.voted_for: Optional[str] = None
+        # term + vote come back from stable storage (Raft Figure 2): a
+        # restarted node that voted this term must still remember it
+        self.term = meta.term if meta is not None else 0
+        self.voted_for: Optional[str] = \
+            meta.voted_for if meta is not None else None
         self.leader_id: Optional[str] = None
         self.commit_index = 0
         self.last_applied = 0
@@ -122,6 +129,37 @@ class RaftNode:
             t.join(1.0)
         self.log.close()
 
+    def crash(self) -> None:
+        """Hard-kill (power loss) simulation for durability soaks: threads
+        stop and the WAL loses its unsynced tail — possibly tearing the
+        record being appended (chaos `disk.torn_write`).  The meta and
+        snapshot files are left exactly as last durably written; restart
+        by constructing a fresh node over the same paths."""
+        self._stop.set()
+        with self._apply_cv:
+            self._apply_cv.notify_all()
+        self.transport.deregister(self.name)
+        for t in self._threads:
+            t.join(1.0)
+        self.log.simulate_crash()
+
+    # --------------------------------------------------------- stable meta
+
+    def _persist_meta(self) -> bool:
+        """Write (term, voted_for) to stable storage; True on success.
+        Callers gate durability-critical actions (granting a vote,
+        launching a candidacy) on the result."""
+        if self.meta is None:
+            return True
+        try:
+            self.meta.persist(self.term, self.voted_for)
+            return True
+        except MetaPersistError:
+            log.warning("raft: %s could not persist term/vote; refusing "
+                        "the action that required it", self.name,
+                        exc_info=True)
+            return False
+
     # ------------------------------------------------------------- public
 
     @property
@@ -137,7 +175,12 @@ class RaftNode:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
             index = self.log.last_index + 1
-            entry = LogEntry(index, self.term, msg_type, payload)
+            # The local propose path must have the same wire-faithful copy
+            # semantics as a forwarded RPC (InMemTransport pickles args and
+            # results): the leader's log entry is a private copy, so later
+            # caller-side mutation of the proposal can never alias FSM state.
+            entry = LogEntry(index, self.term, msg_type,
+                             pickle.loads(pickle.dumps(payload)))
             self.log.append(entry)
             self._match_index[self.name] = index
             fut: concurrent.futures.Future = concurrent.futures.Future()
@@ -180,26 +223,74 @@ class RaftNode:
 
     def _run_ticker(self) -> None:
         while not self._stop.is_set():
-            with self._lock:
-                state = self.state
-            if state == LEADER:
-                self._replicate_all(heartbeat=True)
-                self._maybe_compact()
-                self._stop.wait(self.config.heartbeat_interval)
-            else:
-                if time.monotonic() >= self._election_deadline():
-                    self._run_election()
+            # backstop: the ticker is the only thread that heartbeats and
+            # starts elections — if it dies, this node can never lead or
+            # vote itself out of a wedge, so no exception may escape
+            try:
+                with self._lock:
+                    state = self.state
+                if state == LEADER:
+                    self._replicate_all(heartbeat=True)
+                    self._maybe_compact()
+                    self._stop.wait(self.config.heartbeat_interval)
                 else:
-                    self._stop.wait(self.config.heartbeat_interval / 2)
+                    if time.monotonic() >= self._election_deadline():
+                        self._run_election()
+                    else:
+                        self._stop.wait(self.config.heartbeat_interval / 2)
+            except Exception:                       # noqa: BLE001
+                log.exception("raft: %s ticker iteration failed", self.name)
+                self._stop.wait(self.config.heartbeat_interval)
 
     # ------------------------------------------------------------- election
 
     def _run_election(self) -> None:
+        # Pre-vote round (the reference's preElectSelf): probe whether a
+        # quorum WOULD vote for us before touching our real term.  A node
+        # that is merely behind — restarting from its data_dir while the
+        # leader streams it a snapshot — must not depose a healthy leader
+        # just by timing out: without this, its inflated term leaks back
+        # through append responses and forces an election it cannot win,
+        # over and over, for as long as catch-up takes.  Pre-votes also
+        # hit no disk, so an unwinnable election costs zero fsyncs.
         with self._lock:
+            term = self.term + 1
+            last_index = self.log.last_index
+            last_term = self.log.last_term or self._snapshot_term()
+        votes = 1
+        for peer in self.peers:
+            try:
+                resp = self.transport.call(self.name, peer, "request_vote", {
+                    "term": term, "candidate": self.name, "prevote": True,
+                    "last_log_index": last_index, "last_log_term": last_term})
+            except Unreachable:
+                continue
+            except Exception:                       # noqa: BLE001
+                log.warning("raft: %s pre-vote call to %s failed",
+                            self.name, peer, exc_info=True)
+                continue
+            if resp.get("granted"):
+                votes += 1
+        if votes * 2 <= len(self.peers) + 1:
+            with self._lock:
+                # a quorum sees a live leader (or a better log); wait a
+                # full randomized timeout before probing again
+                self._last_contact = time.monotonic()
+            return
+        with self._lock:
+            prev_term, prev_vote = self.term, self.voted_for
+            if self.term + 1 != term or self.state == LEADER:
+                return   # the world moved while we were pre-voting
             self.state = CANDIDATE
-            self.term += 1
-            term = self.term
+            self.term = term
             self.voted_for = self.name
+            # the self-vote must hit stable storage before any peer can
+            # count it — otherwise a crash-restart mid-election forgets
+            # it and this node may vote for someone else in the same term
+            if not self._persist_meta():
+                self.state = FOLLOWER
+                self.term, self.voted_for = prev_term, prev_vote
+                return
             self.leader_id = None
             self._last_contact = time.monotonic()
             last_index = self.log.last_index
@@ -211,6 +302,10 @@ class RaftNode:
                     "term": term, "candidate": self.name,
                     "last_log_index": last_index, "last_log_term": last_term})
             except Unreachable:
+                continue
+            except Exception:                       # noqa: BLE001
+                log.warning("raft: %s vote call to %s failed",
+                            self.name, peer, exc_info=True)
                 continue
             with self._lock:
                 if resp["term"] > self.term:
@@ -243,8 +338,16 @@ class RaftNode:
     def _step_down(self, term: int) -> None:
         was_leader = self.state == LEADER
         self.state = FOLLOWER
-        self.term = term
-        self.voted_for = None
+        if term > self.term:
+            # adopting a NEW term resets the vote; an equal-term step-down
+            # (e.g. a candidate seeing the elected leader's heartbeat)
+            # must keep voted_for — clearing it would let this node vote
+            # twice in one term.  Persist is best-effort here: a vote
+            # granted later in this term re-persists term+vote atomically
+            # before it is released.
+            self.term = term
+            self.voted_for = None
+            self._persist_meta()
         if was_leader:
             # don't advertise ourselves as leader after deposition — a
             # stale self-pointing leader_id would make rpc_leader forward
@@ -284,6 +387,13 @@ class RaftNode:
             try:
                 self._replicate_one(peer)
             except Unreachable:
+                continue
+            except Exception:                       # noqa: BLE001
+                # a peer mid-crash raises out of its own handler (closed
+                # WAL, dying transport) straight into this thread over the
+                # in-process transport; replication just retries next tick
+                log.warning("raft: %s replicate to %s failed",
+                            self.name, peer, exc_info=True)
                 continue
 
     def _replicate_one(self, peer: str) -> None:
@@ -340,6 +450,8 @@ class RaftNode:
             if resp["term"] > self.term:
                 self._step_down(resp["term"])
                 return
+            if not resp.get("success"):
+                return   # follower could not persist it; retry next round
             self._next_index[peer] = s_idx + 1
             self._match_index[peer] = s_idx
 
@@ -420,7 +532,16 @@ class RaftNode:
                     or self.term
             blob = self.fsm.snapshot()
         with self._lock:
-            self.snapshots.save(applied, term, blob)
+            try:
+                self.snapshots.save(applied, term, blob)
+            except Exception:                       # noqa: BLE001
+                # incl. injected snapshot.partial_write: the save did NOT
+                # land durably, so compacting the log here would orphan
+                # the only copy of those entries; keep the log and retry
+                # at the next snapshot threshold
+                log.warning("raft: %s snapshot save failed; keeping log",
+                            self.name, exc_info=True)
+                return
             self._last_snapshot_index = applied
             self._last_snap_term = term
             self.log.compact(applied)
@@ -438,6 +559,25 @@ class RaftNode:
 
     def _on_request_vote(self, a: dict) -> dict:
         with self._lock:
+            # leader stickiness (reference requestVote/requestPreVote):
+            # while we are hearing from a live leader, refuse — and do NOT
+            # adopt the candidate's term.  A partitioned or catching-up
+            # node cannot depose a leader the quorum still follows.
+            if self.leader_id is not None \
+                    and self.leader_id != a["candidate"] \
+                    and (time.monotonic() - self._last_contact
+                         < self.config.election_timeout):
+                return {"term": self.term, "granted": False}
+            if a.get("prevote"):
+                # would we vote for this candidate in that term?  No state
+                # change, no disk: just an electability probe.
+                my_last_term = self.log.last_term or self._last_snap_term
+                granted = (a["term"] > self.term
+                           and (a["last_log_term"] > my_last_term
+                                or (a["last_log_term"] == my_last_term
+                                    and a["last_log_index"]
+                                    >= self.log.last_index)))
+                return {"term": self.term, "granted": granted}
             if a["term"] > self.term:
                 self._step_down(a["term"])
             granted = False
@@ -449,9 +589,14 @@ class RaftNode:
                     or (a["last_log_term"] == my_last_term
                         and a["last_log_index"] >= self.log.last_index))
                 if up_to_date:
-                    granted = True
+                    # grant only once the vote is on stable storage: a
+                    # granted-then-forgotten vote is the two-leaders bug
                     self.voted_for = a["candidate"]
-                    self._last_contact = time.monotonic()
+                    if self._persist_meta():
+                        granted = True
+                        self._last_contact = time.monotonic()
+                    else:
+                        self.voted_for = None
             return {"term": self.term, "granted": granted}
 
     def _on_append_entries(self, a: dict) -> dict:
@@ -460,8 +605,7 @@ class RaftNode:
                 return {"term": self.term, "success": False,
                         "last_index": self.log.last_index}
             if a["term"] > self.term or self.state != FOLLOWER:
-                self._step_down(a["term"])
-            self.term = a["term"]
+                self._step_down(a["term"])   # single term-adoption path
             self.leader_id = a["leader"]
             self._last_contact = time.monotonic()
             prev_index = a["prev_log_index"]
@@ -474,11 +618,17 @@ class RaftNode:
                     return {"term": self.term, "success": False,
                             "last_index": min(self.log.last_index,
                                               prev_index - 1)}
+            # collect the fresh suffix, then append with ONE group-commit
+            # durability wait (raft requires entries durable before this
+            # response ACKs them — the leader counts us toward commit)
+            fresh: List[LogEntry] = []
             for (idx, term, msg_type, payload) in a["entries"]:
-                existing = self.log.get(idx)
-                if existing is not None and existing.term == term:
-                    continue
-                self.log.append(LogEntry(idx, term, msg_type, payload))
+                if not fresh:
+                    existing = self.log.get(idx)
+                    if existing is not None and existing.term == term:
+                        continue
+                fresh.append(LogEntry(idx, term, msg_type, payload))
+            self.log.append_batch(fresh)
             if a["leader_commit"] > self.commit_index:
                 self.commit_index = min(a["leader_commit"],
                                         self.log.last_index)
@@ -489,19 +639,40 @@ class RaftNode:
     def _on_install_snapshot(self, a: dict) -> dict:
         with self._lock:
             if a["term"] < self.term:
-                return {"term": self.term}
-            self.term = a["term"]
+                return {"term": self.term, "success": False}
+            if a["term"] > self.term or self.state != FOLLOWER:
+                self._step_down(a["term"])   # single term-adoption path
             self.leader_id = a["leader"]
             self._last_contact = time.monotonic()
-        with self._fsm_lock:
-            self.fsm.restore(a["data"])
-        with self._lock:
-            self._last_snapshot_index = a["last_index"]
-            self._last_snap_term = a["last_term"]
-            self.log.compact(a["last_index"])
-            self.last_applied = max(self.last_applied, a["last_index"])
-            self.commit_index = max(self.commit_index, a["last_index"])
+            # Persist BEFORE accepting.  The snapshot stands in for log
+            # entries the leader has already compacted away: if we restore
+            # it in memory without a durable copy, later appends land past
+            # a hole that exists only on disk, and the next restart replays
+            # around the hole — committed state silently vanishes.  Reject
+            # instead; the leader keeps us behind and retries the install.
             if self.snapshots is not None:
-                self.snapshots.save(a["last_index"], a["last_term"],
-                                    a["data"])
-            return {"term": self.term}
+                try:
+                    self.snapshots.save(a["last_index"], a["last_term"],
+                                        a["data"])
+                except Exception:                   # noqa: BLE001
+                    log.warning("raft: %s could not persist installed "
+                                "snapshot; rejecting (leader retries)",
+                                self.name, exc_info=True)
+                    return {"term": self.term, "success": False}
+        # fsm_lock outer, _lock inner (same nesting as force_snapshot):
+        # last_applied must move in the same critical section as the
+        # restore or the apply loop could re-apply a pre-snapshot entry
+        # onto the restored state
+        with self._fsm_lock:
+            with self._lock:
+                if a["last_index"] <= self._last_snapshot_index:
+                    # duplicate/stale install: never regress the FSM
+                    return {"term": self.term, "success": True}
+            self.fsm.restore(a["data"])
+            with self._lock:
+                self._last_snapshot_index = a["last_index"]
+                self._last_snap_term = a["last_term"]
+                self.log.compact(a["last_index"])
+                self.last_applied = max(self.last_applied, a["last_index"])
+                self.commit_index = max(self.commit_index, a["last_index"])
+                return {"term": self.term, "success": True}

@@ -2,16 +2,39 @@
 save/restore`, helper/snapshot/ and command/raft_tools/).
 
 Snapshots are (term, index, fsm blob) files in a directory; `latest()`
-returns the newest for restart/restore, old snapshots are reaped keeping
-`retain`.
+returns the newest *valid* one for restart/restore, old snapshots are
+reaped keeping `retain`.
+
+Crash safety: each file is a checksummed record (8-byte magic
+``NTPUSNP1`` + ``[u32 len][u32 crc32][payload]``) written
+write-temp → fsync → atomic rename → directory fsync, so a crash
+mid-save leaves the previous snapshot untouched.  `latest()` verifies
+the checksum and falls back to an older retained snapshot when the
+newest is torn/corrupt (the window chaos point `snapshot.partial_write`
+injects), and `_reap` never deletes the newest valid snapshot — even
+when retention is misconfigured to 0, the restart anchor survives.
+
+Seed-era bare-pickle snapshots remain readable (no checksum to verify,
+best-effort parse) so existing data dirs upgrade in place.
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
+import struct
 import tempfile
 import threading
+import zlib
 from typing import Optional, Tuple
+
+from nomad_tpu import chaos
+from nomad_tpu.raft.log import fsync_dir
+
+log = logging.getLogger(__name__)
+
+SNAP_MAGIC = b"NTPUSNP1"
+_HDR = struct.Struct("<II")
 
 
 class FileSnapshotStore:
@@ -25,24 +48,108 @@ class FileSnapshotStore:
         with self._lock:
             name = f"snapshot-{term:010d}-{index:012d}.snap"
             path = os.path.join(self.dir, name)
-            fd, tmp = tempfile.mkstemp(dir=self.dir)
-            with os.fdopen(fd, "wb") as fh:
-                pickle.dump({"index": index, "term": term, "data": blob}, fh)
-            os.replace(tmp, path)
+            payload = pickle.dumps(
+                {"index": index, "term": term, "data": blob},
+                protocol=pickle.HIGHEST_PROTOCOL)
+            rec = SNAP_MAGIC + _HDR.pack(len(payload),
+                                         zlib.crc32(payload)) + payload
+            if chaos.active is not None \
+                    and chaos.should("snapshot.partial_write"):
+                # crash mid-save: a truncated record lands under the
+                # final name (rename committed, data blocks lost — the
+                # no-fsync window this store's fsyncs close).  latest()
+                # must skip it; the caller must treat the save as failed.
+                reg = chaos.active
+                frac = reg.uniform() if reg is not None else 0.5
+                cut = min(len(rec) - 1,
+                          max(len(SNAP_MAGIC) + 1, int(len(rec) * frac)))
+                fd, tmp = tempfile.mkstemp(dir=self.dir,
+                                           prefix=".snap-tmp-")
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(rec[:cut])
+                os.replace(tmp, path)
+                raise chaos.ChaosError("snapshot.partial_write")
+            fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".snap-tmp-")
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    fh.write(rec)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, path)
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            fsync_dir(path)
             self._reap()
             return path
 
+    def _read(self, path: str) -> Optional[dict]:
+        """Parse + verify one snapshot file; None if torn/corrupt."""
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except OSError:
+            return None
+        if not data.startswith(SNAP_MAGIC):
+            # legacy bare-pickle snapshot (seed format): best-effort
+            try:
+                rec = pickle.loads(data)
+            except Exception:                       # noqa: BLE001
+                return None
+            if isinstance(rec, dict) and {"index", "term",
+                                          "data"} <= rec.keys():
+                return rec
+            return None
+        if len(data) < len(SNAP_MAGIC) + _HDR.size:
+            return None
+        ln, crc = _HDR.unpack_from(data, len(SNAP_MAGIC))
+        body = data[len(SNAP_MAGIC) + _HDR.size:]
+        if len(body) != ln:
+            return None
+        for attempt in (0, 1):
+            payload = body
+            if attempt == 0 and chaos.active is not None \
+                    and payload and chaos.should("disk.corrupt_read"):
+                payload = bytes([payload[0] ^ 0xFF]) + payload[1:]
+            if zlib.crc32(payload) == crc:
+                try:
+                    return pickle.loads(payload)
+                except Exception:                   # noqa: BLE001
+                    return None
+            log.warning("snapshot: CRC mismatch reading %s (attempt %d); "
+                        "retrying read", path, attempt + 1)
+        return None
+
+    def _snap_names(self):
+        return sorted(f for f in os.listdir(self.dir)
+                      if f.endswith(".snap"))
+
     def _reap(self) -> None:
-        snaps = sorted(f for f in os.listdir(self.dir) if f.endswith(".snap"))
-        for old in snaps[:-self.retain] if self.retain else []:
+        snaps = self._snap_names()
+        # the newest VALID snapshot is the restart anchor: never reap it,
+        # even when retention is misconfigured to 0 or the newest files
+        # are corrupt
+        newest_valid = None
+        for name in reversed(snaps):
+            if self._read(os.path.join(self.dir, name)) is not None:
+                newest_valid = name
+                break
+        keep = max(self.retain, 1)
+        for old in snaps[:-keep]:
+            if old == newest_valid:
+                continue
             os.unlink(os.path.join(self.dir, old))
 
     def latest(self) -> Optional[Tuple[int, int, bytes]]:
         with self._lock:
-            snaps = sorted(f for f in os.listdir(self.dir)
-                           if f.endswith(".snap"))
-            if not snaps:
-                return None
-            with open(os.path.join(self.dir, snaps[-1]), "rb") as fh:
-                rec = pickle.load(fh)
-            return rec["index"], rec["term"], rec["data"]
+            for name in reversed(self._snap_names()):
+                rec = self._read(os.path.join(self.dir, name))
+                if rec is None:
+                    log.warning("snapshot: skipping corrupt/torn %s; "
+                                "falling back to an older snapshot", name)
+                    continue
+                return rec["index"], rec["term"], rec["data"]
+            return None

@@ -10,6 +10,7 @@ from nomad_tpu import mock
 from nomad_tpu.core.cluster import Cluster
 from nomad_tpu.core.server import Server, ServerConfig
 from nomad_tpu.raft import (
+    DurableMeta,
     FileSnapshotStore,
     InMemTransport,
     LogStore,
@@ -181,6 +182,31 @@ def test_log_persistence_restart(tmp_path):
         assert {x.id for x in n2.fsm.store.nodes()} == set(node_ids)
     finally:
         n2.stop()
+
+
+def test_restart_preserves_vote_no_double_grant(tmp_path):
+    """Raft Figure 2: votedFor lives on stable storage.  A node that
+    granted a vote, crashed, and restarted in the same term must refuse a
+    different candidate — a forgotten vote can elect two leaders in one
+    term."""
+    meta_path = str(tmp_path / "raft_meta.json")
+    tr = InMemTransport()
+    n = _mk_node("a", ["a", "b", "c"], tr, meta=DurableMeta(meta_path))
+    req_b = {"term": 5, "candidate": "b",
+             "last_log_index": 0, "last_log_term": 0}
+    resp = n._on_request_vote(dict(req_b))
+    assert resp["granted"] and resp["term"] == 5
+    tr.deregister("a")   # never started: no threads to stop
+
+    # crash-restart: term + vote come back from disk
+    n2 = _mk_node("a", ["a", "b", "c"], InMemTransport(),
+                  meta=DurableMeta(meta_path))
+    assert (n2.term, n2.voted_for) == (5, "b")
+    resp = n2._on_request_vote({"term": 5, "candidate": "c",
+                                "last_log_index": 10, "last_log_term": 5})
+    assert not resp["granted"]
+    # the original candidate retransmitting its request is still granted
+    assert n2._on_request_vote(dict(req_b))["granted"]
 
 
 def test_snapshot_compaction_and_restart(tmp_path):
