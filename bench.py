@@ -31,17 +31,29 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+# per-scenario plan.submit/plan.evaluate latency summaries, folded into
+# the stdout BENCH JSON so the latency trajectory (ROADMAP item 3) is
+# regression-gatable, not just logged
+_PLAN_STATS: dict = {}
+
+
 def _log_plan_submit(scenario: str) -> dict:
-    """Per-scenario p99 plan-submit latency (the BASELINE.json metric is
-    evals/sec + p99 plan-submit; reference metric nomad.nomad.plan.submit).
+    """Per-scenario p50/p99 plan-submit latency (the BASELINE.json metric
+    is evals/sec + p99 plan-submit; reference metric nomad.nomad.plan.submit).
     Resets the series so scenarios don't pollute each other."""
     from nomad_tpu.telemetry import global_metrics
     s = global_metrics.take_sample("nomad.plan.submit")
     ev = global_metrics.take_sample("nomad.plan.evaluate")
-    log(f"{scenario}: plan.submit p99 {s['p99']:.1f} ms "
+
+    def _ms(m):
+        return {"p50": round(m["p50"], 2), "p99": round(m["p99"], 2),
+                "mean": round(m["mean"], 2), "max": round(m["max"], 2),
+                "count": m["count"]}
+    _PLAN_STATS[scenario] = {"submit_ms": _ms(s), "evaluate_ms": _ms(ev)}
+    log(f"{scenario}: plan.submit p50 {s['p50']:.1f} / p99 {s['p99']:.1f} ms "
         f"(mean {s['mean']:.1f} ms, n={s['count']}); "
-        f"plan.evaluate p99 {ev['p99']:.1f} ms")
-    return s
+        f"plan.evaluate p50 {ev['p50']:.1f} / p99 {ev['p99']:.1f} ms")
+    return _PLAN_STATS[scenario]
 
 
 def _wait_allocs(store, jobs, want, timeout=300.0):
@@ -304,6 +316,10 @@ def bench_c2m_1m(n_nodes=10000, n_jobs=10000, groups_per_job=10,
             f"{n_jobs * groups_per_job} task groups)")
         if s.applier.stats.get("coalesced"):
             log(f"{scenario} applier stats: {s.applier.stats}")
+        from nomad_tpu.parallel.engine import get_engine
+        eng = get_engine()
+        if eng:
+            log(f"{scenario} engine stats: {eng.stats}")
         _log_plan_submit(scenario)
         return placed / dt, placed, want
     finally:
@@ -468,6 +484,102 @@ def bench_kernel_c2m_scale():
     return placed / dt
 
 
+def bench_kernel_100k_nodes(n_nodes=100_000, waves=12, per_wave=8,
+                            count=512,
+                            out_path="BENCH_kernel_100k_nodes.json"):
+    """100K-node world on the serving mesh: the shape a single-host
+    round-trip budget cannot reach (re-uploading f32[131072, R] every
+    wave).  The world uploads ONCE into the device-resident DeviceWorld,
+    then `waves` dispatches of `per_wave` concurrent bulk evals (batched
+    into one chained device call each) place allocs whose commits flow
+    back as rank-1 scatters — steady state ships zero world bytes.
+    Emits its own trajectory JSON (p50/p99 per-wave dispatch latency,
+    engine stats) to `out_path` and returns the parsed dict."""
+    import numpy as np
+
+    from nomad_tpu import mock
+    from nomad_tpu.encode import ClusterMatrix
+    from nomad_tpu.parallel.engine import PlacementEngine
+    from nomad_tpu.scheduler.stack import DenseStack
+
+    cm = ClusterMatrix(initial_rows=131072)
+    t0 = time.time()
+    for i in range(n_nodes):
+        n = mock.node()
+        n.attributes["rack"] = f"r{i % 200}"
+        cm.upsert_node(n)
+    log(f"kernel_100k world build ({n_nodes} nodes, {cm.n_rows} padded "
+        f"rows): {time.time()-t0:.1f}s")
+
+    job = mock.batch_job()
+    job.task_groups[0].count = count
+    st = DenseStack(cm)
+    g = st.compile_group(job, job.task_groups[0])
+    N = cm.n_rows
+    demand = np.zeros(cm.used.shape[1], np.float32)
+    dm = np.asarray(g.demand, np.float32)
+    demand[:min(len(dm), len(demand))] = dm[:len(demand)]
+    bulk = dict(feasible=g.feasible,
+                affinity=g.affinity.astype(np.float32),
+                has_affinity=bool(g.has_affinity), desired=count,
+                penalty=np.zeros(N, bool), coll0=np.zeros(N, np.int32),
+                demand=g.demand.astype(np.float32), count=count)
+
+    # max_batch bounds which E-bucket variants warm at this row count
+    # (each compile stages f32[E, 4N]; per_wave is all we dispatch)
+    eng = PlacementEngine(max_batch=per_wave)
+    try:
+        t0 = time.time()
+        eng.warmup(cm, bulk=bulk)
+        log(f"kernel_100k warm: {time.time()-t0:.1f}s")
+
+        lat_s = []
+        placed_total = 0
+        t_run = time.time()
+        for _ in range(waves):
+            t0 = time.time()
+            futs = [eng.place_bulk_begin(cm, **bulk)
+                    for _ in range(per_wave)]
+            results = [f.result() for f in futs]
+            lat_s.append(time.time() - t0)
+            for assign, placed, _ev, _ex, _scores, ticket in results:
+                placed_total += int(placed)
+                rows = np.flatnonzero(assign)
+                for r_ in rows:
+                    cm.used[r_] += assign[r_] * demand
+                if ticket is not None:
+                    eng.complete(ticket)
+        dt = time.time() - t_run
+
+        import jax
+        lat_ms = sorted(v * 1000.0 for v in lat_s)
+        p50 = lat_ms[len(lat_ms) // 2]
+        p99 = lat_ms[min(len(lat_ms) - 1, int(len(lat_ms) * 0.99))]
+        stats = {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in eng.stats.items()}
+        traj = {
+            "metric": "kernel_100k_nodes_allocs_per_sec",
+            "value": round(placed_total / dt, 1),
+            "unit": "allocs/s",
+            "n_nodes": n_nodes, "padded_rows": int(N),
+            "devices": jax.device_count(),
+            "waves": waves, "evals_per_wave": per_wave, "count": count,
+            "placed": placed_total,
+            "p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+            "engine_stats": stats,
+        }
+        with open(out_path, "w") as f:
+            json.dump(traj, f, indent=2)
+            f.write("\n")
+        log(f"kernel_100k_nodes: {placed_total} allocs in {dt:.1f}s "
+            f"({placed_total/dt:.0f} allocs/s; wave p50 {p50:.0f} ms / "
+            f"p99 {p99:.0f} ms on {traj['devices']} devices)")
+        log(f"kernel_100k engine stats: {eng.stats}")
+        return traj
+    finally:
+        eng.stop()
+
+
 def main():
     target = 1_000_000 / 30.0       # north-star C2M rate (v5e-8)
 
@@ -481,7 +593,15 @@ def main():
             "vs_baseline": round(rate / target, 4),
             "placed": placed,
             "want": want,
+            "plan_latency_ms": _PLAN_STATS,
         }), flush=True)
+        return
+
+    if "--100k" in sys.argv:
+        # the 100K-node device-resident scenario, alone (own trajectory
+        # JSON; the stdout line mirrors it for the driver)
+        traj = bench_kernel_100k_nodes()
+        print(json.dumps(traj), flush=True)
         return
 
     # headline: the REAL north-star number — C2M-1M at full size
@@ -497,6 +617,11 @@ def main():
     except Exception as e:          # noqa: BLE001
         log("kernel bench failed:", e)
         kernel_rate = 0.0
+
+    try:
+        bench_kernel_100k_nodes()
+    except Exception as e:          # noqa: BLE001
+        log("kernel_100k bench failed:", e)
 
     if os.environ.get("BENCH_ALL") == "1":
         # the full BASELINE.json scenario suite (tens of minutes)
@@ -516,6 +641,7 @@ def main():
         "value": round(rate, 1),
         "unit": "allocs/s",
         "vs_baseline": round(rate / target, 4),
+        "plan_latency_ms": _PLAN_STATS,
     }), flush=True)
 
 

@@ -453,8 +453,7 @@ class PlanApplier:
             from nomad_tpu.parallel.engine import get_engine
             eng = get_engine()
             if eng is not None:
-                for t in plan.engine_tickets:
-                    eng.complete(t)
+                eng.complete_many(plan.engine_tickets)
         if applied is None:
             return
         result.alloc_index = index

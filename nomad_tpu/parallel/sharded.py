@@ -24,6 +24,20 @@ from nomad_tpu.ops.place import PlaceInputs, PlaceResult, TOP_K
 BIG = jnp.int32(2**31 - 1)
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """Version-portable shard_map: `jax.shard_map` (jax >= 0.6, kwarg
+    `check_vma`) falls back to `jax.experimental.shard_map` (jax 0.4.x,
+    kwarg `check_rep`).  Every shard_map in this package routes through
+    here — calling `jax.shard_map` directly breaks on the pinned 0.4.x
+    toolchain (the symbol simply doesn't exist there)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def make_mesh(n_eval_shards: int = 1, n_node_shards: Optional[int] = None,
               devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
@@ -201,7 +215,7 @@ def place_eval_batch_sharded(mesh: Mesh, stacked: PlaceInputs,
         P("evals", None), P("evals", None), P("evals", None, None),
         P("evals", None, None), P("evals", "nodes", None),
     )
-    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=(in_specs,),
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(in_specs,),
                                out_specs=out_specs, check_vma=False))
     return fn(stacked)
 
@@ -233,6 +247,49 @@ def make_serving_mesh(devices=None) -> Mesh:
     """1-D ('nodes',) mesh over all devices — the engine's serving mesh."""
     devices = devices if devices is not None else jax.devices()
     return Mesh(np.array(devices), ("nodes",))
+
+
+def _set_rows_local(dev, rows, vals):
+    """Shard-local row SET: global `rows` translate to this shard's
+    local indices; rows outside the shard (and the row==N pad slots)
+    drop, so each device writes only rows it owns."""
+    n_local = dev.shape[0]
+    lrows = rows - jax.lax.axis_index("nodes") * n_local
+    ok = (lrows >= 0) & (lrows < n_local)
+    lrows = jnp.where(ok, lrows, n_local)
+    return dev.at[lrows].set(vals, mode="drop")
+
+
+def _add_rank1_local(dev, rows, counts, demand):
+    """Shard-local twin of the native scatter_add_rank1 export:
+    dev[rows[k]] += counts[k] * demand, rows translated per shard."""
+    n_local = dev.shape[0]
+    lrows = rows - jax.lax.axis_index("nodes") * n_local
+    ok = (lrows >= 0) & (lrows < n_local)
+    lrows = jnp.where(ok, lrows, n_local)
+    vals = counts[:, None].astype(jnp.float32) * demand
+    return dev.at[lrows].add(vals, mode="drop")
+
+
+def serving_update_fns(mesh: Mesh):
+    """Jitted (set_rows, add_rank1) scatter pair for a ('nodes',)-sharded
+    [N, R] resident matrix (parallel.world.DeviceWorld).  Rows/values are
+    replicated operands (KBs); the sharded matrix never moves — each
+    shard scatters its own rows, no cross-device gather of the operand."""
+    key = ("update", mesh)
+    fns = _SERVING_FN_CACHE.get(key)
+    if fns is None:
+        set_fn = jax.jit(shard_map(
+            _set_rows_local, mesh=mesh,
+            in_specs=(P("nodes", None), P(None), P(None, None)),
+            out_specs=P("nodes", None), check_vma=False))
+        add_fn = jax.jit(shard_map(
+            _add_rank1_local, mesh=mesh,
+            in_specs=(P("nodes", None), P(None), P(None), P(None)),
+            out_specs=P("nodes", None), check_vma=False))
+        fns = (set_fn, add_fn)
+        _SERVING_FN_CACHE[key] = fns
+    return fns
 
 
 def _field_specs_batched() -> dict:
@@ -300,7 +357,7 @@ def place_batch_sharded(mesh: Mesh, capacity, used0, fields: dict,
                     _field_specs_batched(), P(None, None),
                     P(None, None, None))
         out_specs = (P(None, None, None), P("nodes", None))
-        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+        fn = jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
                                    out_specs=out_specs, check_vma=False))
         _SERVING_FN_CACHE[key] = fn
     return fn(capacity, used0, fields, delta_rows, delta_vals)
@@ -433,7 +490,7 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
                     P(None), P(None, None), P(None, None, None))
         out_specs = (P(None, "nodes"), P(None, "nodes"), P(None), P(None),
                      P(None), P(None), P("nodes", None))
-        fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+        fn = jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
                                    out_specs=out_specs, check_vma=False))
         _SERVING_FN_CACHE[key] = fn
     return fn(capacity, used0, feasible, affinity, has_affinity, desired,

@@ -1,0 +1,227 @@
+"""Device-resident world state: the node x resource matrices live on
+device across dispatches, and changes scatter in as row deltas.
+
+The capacity / usage-basis matrices are the only per-dispatch inputs
+whose CONTENT survives from wave to wave: a plan cycle touches a few
+hundred rows of a 10K-100K row world.  Re-shipping the full [N, R]
+matrices host->device every dispatch (and, on the sharded path,
+re-sharding them across the mesh) was the dominant transfer cost at
+C2M-1M rates (BENCH_r05: put_basis_s/put_heavy_s ~0.35 s,
+put_kernel_s ~14.7 s per run).
+
+`DeviceWorld` keeps one (capacity, basis) pair resident per cluster
+epoch — an epoch is a (matrix identity, padded row count) pair, so the
+matrix growing (ClusterMatrix._grow re-buckets the node axis) starts a
+new epoch with one full upload, while routine node churn (join/drain
+mutates PADDED rows in place) and plan commits flow in as bucketed
+dirty-row scatters:
+
+- `update(capacity, basis)` diffs both matrices against the host
+  snapshot shipped last time and scatters only the changed rows
+  (bucketed pad so the row count never forks an XLA compile variant;
+  >25% churn or a shape change falls back to one full device_put).
+- `apply_rank1(rows, counts, demand)` is the commit/overlay hand-off
+  twin of the native `scatter_add_rank1` export: the same rank-1
+  update lands in the host snapshot (native scatter) and in the device
+  basis (jitted scatter) in one call, so a resolved bulk eval's
+  placements are already device-resident before the next dispatch
+  diffs — the steady-state diff is empty and ships zero rows.
+
+On a multi-device mesh the buffers live sharded over the ('nodes',)
+serving mesh (`NamedSharding(mesh, P('nodes', None))`) and the scatters
+run through `sharded.serving_update_fns` — a shard_map twin that
+translates global rows to shard-local ones so each device only writes
+rows it owns (no cross-device gather of the operand).
+
+Updates are functional (`at[...].set` under jit): in-flight consumers
+(a dispatched kernel, a concurrent warmup thread) keep the old buffer
+alive until they finish, then it frees — replacing the buffer under
+the lock while readers hold references is safe, which explicit buffer
+donation is not.  The transient second [N, R] buffer is ~2 MB at 100K
+nodes, noise next to the per-eval stacks.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from nomad_tpu import native as _native
+
+# dirty-row buckets: each size is one small compile of the row scatter
+ROW_BUCKETS = (64, 512, 4096)
+
+
+def mesh_key(mesh) -> Optional[tuple]:
+    """Stable identity of a device mesh: axis layout + device ids.
+
+    `id(mesh)` is NOT a mesh identity — a re-created Mesh object can
+    reuse the id of a dead one and resurrect its cache entries with
+    stale shardings.  Two meshes with the same axes over the same
+    devices are interchangeable for sharding purposes."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat))
+
+
+_set_rows_fn = None
+_add_rank1_fn = None
+
+
+def _single_device_fns():
+    """Jitted (set_rows, add_rank1) scatter pair for the unsharded world
+    (rows == N pad slots drop)."""
+    global _set_rows_fn, _add_rank1_fn
+    if _set_rows_fn is None:
+        import jax
+        import jax.numpy as jnp
+        _set_rows_fn = jax.jit(
+            lambda d, r, v: d.at[r].set(v, mode="drop"))
+        _add_rank1_fn = jax.jit(
+            lambda d, r, c, dem: d.at[r].add(
+                c[:, None].astype(jnp.float32) * dem, mode="drop"))
+    return _set_rows_fn, _add_rank1_fn
+
+
+class DeviceWorld:
+    """One epoch's device-resident (capacity, basis) pair.
+
+    Thread-safe: every read-modify-write of the resident pair happens
+    under `self.lock` (warmup dispatches run concurrently with the
+    engine thread)."""
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+        self.lock = threading.Lock()
+        self.shape: Optional[tuple] = None       # (N, R) of current epoch
+        self._cap_last: Optional[np.ndarray] = None
+        self._cap_dev = None
+        self._basis_last: Optional[np.ndarray] = None
+        self._basis_dev = None
+        self.stats = {"full_uploads": 0, "rows_scattered": 0,
+                      "clean_hits": 0, "rank1_applies": 0}
+
+    # ------------------------------------------------------------ helpers
+
+    def _sharding(self):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P("nodes", None))
+
+    def _put_full(self, host: np.ndarray):
+        import jax
+        sh = self._sharding()
+        # ALWAYS ship a private copy: on the CPU backend device_put
+        # zero-copy aliases the numpy buffer, so uploading
+        # _basis_last/_cap_last directly would let apply_rank1's native
+        # host scatter mutate the "device" array in place behind jit
+        arr = np.array(host, dtype=np.float32)
+        return jax.device_put(arr) if sh is None \
+            else jax.device_put(arr, sh)
+
+    def _set_rows(self, dev, rows: np.ndarray, vals: np.ndarray):
+        if self.mesh is None:
+            fn, _ = _single_device_fns()
+            return fn(dev, rows, vals)
+        from nomad_tpu.parallel.sharded import serving_update_fns
+        fn, _ = serving_update_fns(self.mesh)
+        return fn(dev, rows, vals)
+
+    def _update_one(self, host: np.ndarray, last: Optional[np.ndarray],
+                    dev) -> Tuple[np.ndarray, object, bool]:
+        """Sync one matrix; returns (new snapshot, new device array,
+        full-upload?).  Caller holds self.lock."""
+        N = host.shape[0]
+        B = None
+        changed = None
+        if last is not None and last.shape == host.shape:
+            changed = np.nonzero(np.any(last != host, axis=1))[0]
+            if changed.size == 0:
+                self.stats["clean_hits"] += 1
+                return last, dev, False
+            if changed.size <= N // 4:
+                B = next((b for b in ROW_BUCKETS if b >= changed.size),
+                         None)
+        if B is None:
+            snap = np.array(host, dtype=np.float32)
+            return snap, self._put_full(snap), True
+        # read the dirty rows ONCE: `host` may be live (node churn mutates
+        # it concurrently) and the snapshot must equal what shipped, not
+        # what the row holds a moment later
+        changed_vals = np.array(host[changed], dtype=np.float32)
+        rows = np.full(B, N, np.int32)           # pad slots drop
+        rows[:changed.size] = changed
+        vals = np.zeros((B, host.shape[1]), np.float32)
+        vals[:changed.size] = changed_vals
+        snap = last.copy()
+        snap[changed] = changed_vals
+        self.stats["rows_scattered"] += int(changed.size)
+        return snap, self._set_rows(dev, rows, vals), False
+
+    # ------------------------------------------------------------- public
+
+    def update(self, capacity: np.ndarray, basis: np.ndarray):
+        """Bring the resident pair up to date with the host truth;
+        returns (capacity_dev, basis_dev).  `capacity` may be the LIVE
+        cm.capacity (it is snapshot-copied before any caching decision);
+        `basis` must already be a private copy (engine._basis_for)."""
+        with self.lock:
+            shape = (capacity.shape, basis.shape)
+            if shape != self.shape:              # new cluster epoch
+                self.shape = shape
+                self._cap_last = np.array(capacity, dtype=np.float32)
+                self._cap_dev = self._put_full(self._cap_last)
+                self._basis_last = np.array(basis, dtype=np.float32)
+                self._basis_dev = self._put_full(self._basis_last)
+                self.stats["full_uploads"] += 1
+                return self._cap_dev, self._basis_dev
+            self._cap_last, self._cap_dev, full_c = self._update_one(
+                capacity, self._cap_last, self._cap_dev)
+            self._basis_last, self._basis_dev, full_b = self._update_one(
+                basis, self._basis_last, self._basis_dev)
+            if full_c or full_b:
+                self.stats["full_uploads"] += 1
+            return self._cap_dev, self._basis_dev
+
+    def apply_rank1(self, rows: np.ndarray, counts: np.ndarray,
+                    demand: np.ndarray) -> None:
+        """Scatter `counts[k] * demand` into basis row `rows[k]` on BOTH
+        copies (host snapshot via the native export, device via the
+        jitted twin), keeping them in lockstep so the next update()'s
+        diff sees those rows clean."""
+        with self.lock:
+            if self._basis_last is None:
+                return                           # next update ships full
+            n, r = self._basis_last.shape
+            rows = np.ascontiguousarray(rows, np.int32)
+            counts = np.ascontiguousarray(counts, np.int32)
+            keep = rows < n
+            if not keep.all():
+                rows, counts = rows[keep], counts[keep]
+            if rows.size == 0:
+                return
+            d = np.zeros(r, np.float32)
+            d[:min(len(demand), r)] = np.asarray(
+                demand, np.float32)[:r]
+            _native.scatter_add_rank1(self._basis_last, rows, counts, d)
+            if self.mesh is None:
+                _, fn = _single_device_fns()
+            else:
+                from nomad_tpu.parallel.sharded import serving_update_fns
+                _, fn = serving_update_fns(self.mesh)
+            self._basis_dev = fn(self._basis_dev, rows, counts, d)
+            self.stats["rank1_applies"] += 1
+
+    def host_basis(self) -> Optional[np.ndarray]:
+        """Copy of the host-side basis snapshot (tests / debugging)."""
+        with self.lock:
+            return None if self._basis_last is None \
+                else self._basis_last.copy()
+
+    def device_arrays(self):
+        """(capacity_dev, basis_dev) as currently resident (no sync)."""
+        with self.lock:
+            return self._cap_dev, self._basis_dev

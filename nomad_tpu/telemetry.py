@@ -38,10 +38,11 @@ class _Sample:
 
     def summary(self) -> dict:
         vals = sorted(self.values)
+        p50 = vals[min(len(vals) - 1, int(len(vals) * 0.50))] if vals else 0.0
         p99 = vals[min(len(vals) - 1, int(len(vals) * 0.99))] if vals else 0.0
         return {"count": self.count,
                 "mean": self.total / self.count if self.count else 0.0,
-                "max": self.max, "p99": p99}
+                "max": self.max, "p50": p50, "p99": p99}
 
 
 class MetricsRegistry:
@@ -118,6 +119,7 @@ class MetricsRegistry:
                 m = s.summary()
                 base = san(k)
                 lines.append(f"# TYPE {base} summary")
+                lines.append(f'{base}{{quantile="0.5"}} {m["p50"]}')
                 lines.append(f'{base}{{quantile="0.99"}} {m["p99"]}')
                 lines.append(f"{base}_sum {s.total}")
                 lines.append(f"{base}_count {m['count']}")

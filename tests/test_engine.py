@@ -185,3 +185,85 @@ def test_packed_cache_hits_and_single_path():
         assert engine._cache.hits >= 4
     finally:
         engine.stop()
+
+
+def test_device_world_upload_never_aliases_host_snapshot():
+    """Regression: on the CPU backend `jax.device_put` zero-copy aliases
+    the numpy buffer, so uploading `_basis_last` itself let apply_rank1's
+    NATIVE host scatter mutate the "device" array in place — the jitted
+    scatter then added the delta again and the device basis drifted to
+    snapshot + demand on every commit.  The upload must own its bytes."""
+    import jax
+
+    from nomad_tpu.parallel.world import DeviceWorld
+
+    N, R = 16, 4
+    world = DeviceWorld(mesh=None)
+    capacity = np.full((N, R), 100.0, np.float32)
+    world.update(capacity, np.zeros((N, R), np.float32))
+
+    rows = np.array([0, 3], np.int32)
+    demand = np.array([5.0, 2.0, 0.0, 0.0], np.float32)
+    world.apply_rank1(rows, np.ones(2, np.int32), demand)
+
+    _, basis_dev = world.device_arrays()
+    got = np.asarray(jax.device_get(basis_dev)).copy()
+    expect = np.zeros((N, R), np.float32)
+    expect[rows] = demand
+    np.testing.assert_array_equal(got, expect)
+    np.testing.assert_array_equal(world.host_basis(), expect)
+
+
+def test_engine_single_device_world_resident_across_evals():
+    """The unsharded engine path keeps the world device-resident: the
+    second eval's dispatch diffs clean against the post-commit snapshot
+    (zero rows scattered, no second full upload) and placements match a
+    from-scratch engine seeing the same committed state."""
+    cm = ClusterMatrix()
+    for _ in range(32):
+        cm.upsert_node(mock.node())
+    j = mock.batch_job()
+    j.task_groups[0].count = 8
+    st = DenseStack(cm)
+    g = st.compile_group(j, j.task_groups[0])
+    N = cm.n_rows
+    demand = np.zeros(cm.used.shape[1], np.float32)
+    dm = np.asarray(g.demand, np.float32)
+    demand[:min(len(dm), len(demand))] = dm[:len(demand)]
+    bulk = dict(feasible=g.feasible, affinity=g.affinity.astype(np.float32),
+                has_affinity=bool(g.has_affinity), desired=8,
+                penalty=np.zeros(N, bool), coll0=np.zeros(N, np.int32),
+                demand=g.demand.astype(np.float32), count=8)
+
+    def one_eval(eng):
+        assign, placed, _e, _x, _s, ticket = eng.place_bulk(cm, **bulk)
+        rows = np.flatnonzero(assign)
+        for r in rows:
+            cm.used[r] += assign[r] * demand
+        if ticket is not None:
+            eng.complete(ticket)
+        return np.asarray(assign).copy()
+
+    used0 = cm.used.copy()
+    eng = PlacementEngine(shard_min_nodes=1 << 30)   # force single-device
+    try:
+        a1 = one_eval(eng)
+        a2 = one_eval(eng)
+        world = next(iter(eng._worlds.values()))
+        assert world.stats["full_uploads"] == 1
+        assert world.stats["rows_scattered"] == 0    # commits kept it clean
+        assert world.stats["rank1_applies"] >= 1
+    finally:
+        eng.stop()
+
+    committed = cm.used.copy()
+    cm.used[:] = used0
+    for r in np.flatnonzero(a1):
+        cm.used[r] += a1[r] * demand
+    fresh = PlacementEngine(shard_min_nodes=1 << 30)
+    try:
+        a2_fresh = one_eval(fresh)
+    finally:
+        fresh.stop()
+    np.testing.assert_array_equal(a2, a2_fresh)
+    np.testing.assert_array_equal(cm.used, committed)

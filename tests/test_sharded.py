@@ -336,3 +336,110 @@ def test_engine_sharded_c2m_scale_mixed_batch():
                                   single["scan_nodes"])
     np.testing.assert_allclose(sharded["scan_scores"],
                                single["scan_scores"], rtol=1e-5)
+
+
+@pytest.mark.parametrize("use_mesh", [False, True])
+def test_device_world_parity_randomized(use_mesh):
+    """Device-resident incremental state == from-scratch rebuild, bitwise,
+    after a randomized interleaving of plan commits (rank-1 scatters),
+    node joins/drains (row mutations), preemptions (negative counts), and
+    a cluster epoch change (row-count growth -> full re-upload)."""
+    import jax
+
+    from nomad_tpu.parallel.sharded import make_serving_mesh
+    from nomad_tpu.parallel.world import DeviceWorld
+
+    rng = np.random.default_rng(7)
+    N, R = 64, 4
+    mesh = make_serving_mesh() if use_mesh else None
+    world = DeviceWorld(mesh=mesh)
+
+    capacity = rng.uniform(100, 1000, (N, R)).astype(np.float32)
+    truth = np.zeros((N, R), np.float32)        # from-scratch reference
+    world.update(capacity, truth.copy())
+
+    def check():
+        cap_dev, basis_dev = world.device_arrays()
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(basis_dev)), truth)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(cap_dev)), capacity)
+        np.testing.assert_array_equal(world.host_basis(), truth)
+
+    for step in range(60):
+        op = rng.integers(0, 4)
+        if op == 0:                              # plan commit
+            k = int(rng.integers(1, 9))
+            rows = rng.choice(N, k, replace=False).astype(np.int32)
+            counts = rng.integers(1, 4, k).astype(np.int32)
+            demand = rng.uniform(0, 50, R).astype(np.float32)
+            world.apply_rank1(rows, counts, demand)
+            truth[rows] += counts[:, None].astype(np.float32) * demand
+        elif op == 1:                            # preemption: reverse
+            k = int(rng.integers(1, 5))
+            rows = rng.choice(N, k, replace=False).astype(np.int32)
+            demand = rng.uniform(0, 20, R).astype(np.float32)
+            world.apply_rank1(rows, np.full(k, -1, np.int32), demand)
+            truth[rows] -= demand
+        elif op == 2:                            # node join/drain churn
+            k = int(rng.integers(1, 6))
+            rows = rng.choice(N, k, replace=False)
+            capacity[rows] = rng.uniform(100, 1000, (k, R))
+            truth[rows] = 0.0                    # drained node resets
+            world.update(capacity, truth.copy())
+        else:                                    # clean dispatch
+            world.update(capacity, truth.copy())
+        check()
+
+    # epoch change: the padded row axis grows -> one full re-upload
+    N2 = N * 2
+    cap2 = rng.uniform(100, 1000, (N2, R)).astype(np.float32)
+    cap2[:N] = capacity
+    truth2 = np.zeros((N2, R), np.float32)
+    truth2[:N] = truth
+    if use_mesh:
+        capacity, truth = cap2, truth2
+        N = N2
+    else:                                        # odd N fine unsharded
+        capacity = cap2[: N2 - 3].copy()
+        truth = truth2[: N2 - 3].copy()
+        N = N2 - 3
+    world.update(capacity, truth.copy())
+    rows = rng.choice(N, 5, replace=False).astype(np.int32)
+    demand = rng.uniform(0, 50, R).astype(np.float32)
+    world.apply_rank1(rows, np.ones(5, np.int32), demand)
+    truth[rows] += demand
+    check()
+    assert world.stats["full_uploads"] >= 2
+    assert world.stats["rank1_applies"] >= 1
+
+
+def test_mesh_key_survives_mesh_recreation():
+    """`mesh_key` identifies re-created meshes as the same serving mesh
+    (the `id(mesh)` keying bug: a new Mesh object could reuse a dead
+    mesh's id and resurrect stale shardings)."""
+    from nomad_tpu.parallel.engine import PlacementEngine
+    from nomad_tpu.parallel.sharded import make_serving_mesh
+    from nomad_tpu.parallel.world import mesh_key
+
+    import jax
+
+    m1 = make_serving_mesh()
+    m2 = make_serving_mesh()
+    assert mesh_key(m1) == mesh_key(m2)
+    assert mesh_key(None) is None
+    # the key DISCRIMINATES meshes over different device sets
+    half = make_serving_mesh(jax.devices()[: len(jax.devices()) // 2])
+    assert mesh_key(half) != mesh_key(m1)
+
+    eng = PlacementEngine()
+    try:
+        arr = np.arange(16, dtype=np.float32).reshape(8, 2)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        a1 = eng._cache.sharded("t", m1, arr,
+                                NamedSharding(m1, P("nodes", None)))
+        a2 = eng._cache.sharded("t", m2, arr,
+                                NamedSharding(m2, P("nodes", None)))
+        assert a1 is a2                          # same content-address
+    finally:
+        eng.stop()
