@@ -36,6 +36,70 @@ def log(*a):
 # regression-gatable, not just logged
 _PLAN_STATS: dict = {}
 
+# per-scenario steady-state purity report (transfer guard + recompile
+# budget + world re-upload watch), folded into the BENCH JSON; any
+# violation fails the --smoke leg.  NOMAD_TPU_BENCH_GUARD=0 opts out.
+_STEADY_STATE: dict = {}
+
+
+class _SteadyGate:
+    """Arms the steady-state dispatch discipline around a measured
+    window, AFTER warmup: jax's transfer guard flips to "disallow" (any
+    implicit host<->device transfer raises inside the dispatch loop),
+    the recompile budget snapshots every registered kernel's jit cache
+    (post-warmup growth is a shape-bucketing regression), and
+    DeviceWorld stats are diffed (a full [N, R] re-upload after the
+    epoch's first means the scatter path leaked).  Results land in
+    `_STEADY_STATE[scenario]`."""
+
+    def __init__(self, scenario: str):
+        self.scenario = scenario
+        self.enabled = \
+            os.environ.get("NOMAD_TPU_BENCH_GUARD", "1") != "0"
+        self._guard = None
+        self._eng = None
+
+    def __enter__(self):
+        if not self.enabled:
+            return self
+        from nomad_tpu.analysis import recompile, transfer_purity
+        from nomad_tpu.parallel.engine import get_engine
+        self._eng = get_engine()
+        self.budget = recompile.Budget()
+        self._world0 = self._eng.world_stats() if self._eng else {}
+        self._guard = transfer_purity.steady_state_guard()
+        self._guard.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._guard is not None:
+            self._guard.__exit__(exc_type, exc, tb)
+        if not self.enabled or exc_type is not None:
+            return False
+        from nomad_tpu.telemetry import global_metrics
+        rep = self.budget.report()
+        wstats = self._eng.world_stats() if self._eng else {}
+        reuploads = wstats.get("steady_reuploads", 0) - \
+            self._world0.get("steady_reuploads", 0)
+        violations = self.budget.violations()
+        if reuploads > 0:
+            violations.append(
+                f"{reuploads} full world re-upload(s) during the "
+                f"measured window (steady state must scatter rows only)")
+        self.budget.publish(global_metrics)
+        _STEADY_STATE[self.scenario] = {
+            "transfer_guard": "disallow",
+            "recompiled": rep["recompiled"],
+            "compile_events": rep["compile_events"],
+            "steady_reuploads": reuploads,
+            "world": wstats,
+            "violations": violations,
+        }
+        log(f"{self.scenario} steady-state: "
+            f"compiles={rep['compile_events']} reuploads={reuploads} "
+            f"violations={violations or 'none'}")
+        return False
+
 
 def _log_plan_submit(scenario: str) -> dict:
     """Per-scenario p50/p99 plan-submit latency (the BASELINE.json metric
@@ -289,7 +353,7 @@ def bench_c2m_1m(n_nodes=10000, n_jobs=10000, groups_per_job=10,
             return j
 
         t0 = time.time()
-        _warm_engine(s, scan_job=make_job())
+        _warm_engine(s, scan_job=make_job(), bulk_job=make_job())
         wj = make_job()
         s.register_job(wj)
         _wait_allocs(s.store, [wj], groups_per_job * group_count,
@@ -299,17 +363,21 @@ def bench_c2m_1m(n_nodes=10000, n_jobs=10000, groups_per_job=10,
         want = n_jobs * groups_per_job * group_count
         base_allocs = len(s.store._allocs)
         t0 = time.time()
-        for _ in range(n_jobs):
-            s.register_job(make_job())
-        reg_dt = time.time() - t0
-        log(f"{scenario} registered {n_jobs} jobs in {reg_dt:.1f}s")
-        deadline = time.time() + deadline_s
-        placed = 0
-        while time.time() < deadline:
-            placed = len(s.store._allocs) - base_allocs
-            if placed >= want:
-                break
-            time.sleep(0.2 if deadline_s < 600 else 1.0)
+        # measured window runs under the steady-state purity gate: the
+        # warm epoch's world is resident, so from here on the dispatch
+        # loop must scatter rows, never re-ship or recompile
+        with _SteadyGate(scenario):
+            for _ in range(n_jobs):
+                s.register_job(make_job())
+            reg_dt = time.time() - t0
+            log(f"{scenario} registered {n_jobs} jobs in {reg_dt:.1f}s")
+            deadline = time.time() + deadline_s
+            placed = 0
+            while time.time() < deadline:
+                placed = len(s.store._allocs) - base_allocs
+                if placed >= want:
+                    break
+                time.sleep(0.2 if deadline_s < 600 else 1.0)
         dt = time.time() - t0
         log(f"{scenario} spine: {placed}/{want} allocs in {dt:.1f}s "
             f"({placed/dt:.0f} allocs/s on one chip; "
@@ -586,6 +654,7 @@ def main():
     if "--smoke" in sys.argv:
         # CI leg: the same shape in seconds (tier-1 invokes this)
         rate, placed, want = bench_smoke()
+        steady = _STEADY_STATE.get("smoke", {})
         print(json.dumps({
             "metric": "c2m_smoke_allocs_per_sec",
             "value": round(rate, 1),
@@ -594,7 +663,11 @@ def main():
             "placed": placed,
             "want": want,
             "plan_latency_ms": _PLAN_STATS,
+            "steady_state": steady,
         }), flush=True)
+        if steady.get("violations"):
+            log("steady-state violations:", steady["violations"])
+            sys.exit(1)
         return
 
     if "--100k" in sys.argv:
@@ -642,6 +715,7 @@ def main():
         "unit": "allocs/s",
         "vs_baseline": round(rate / target, 4),
         "plan_latency_ms": _PLAN_STATS,
+        "steady_state": _STEADY_STATE,
     }), flush=True)
 
 

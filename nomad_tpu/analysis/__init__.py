@@ -1,7 +1,7 @@
-"""nomad_tpu.analysis — AST-based invariant linters for the scheduler.
+"""nomad_tpu.analysis — static + runtime invariant analysis plane.
 
-Four checkers over the repo tree (stdlib-only; never imports the code it
-analyzes, so this runs without jax/numpy installed):
+Eight checkers over the repo tree (stdlib-only; never imports the code
+it analyzes, so this runs without jax/numpy installed):
 
     fsm-determinism   no wall-clock/entropy/set-iteration in the raft
                       FSM apply cone
@@ -11,12 +11,23 @@ analyzes, so this runs without jax/numpy installed):
                       and the abi version gate
     jax-purity        no host escapes / tracer branching in jitted
                       kernels
-    chaos-coverage    chaos registry and injection sites agree
+    chaos-coverage    chaos registry and injection sites agree (incl.
+                      chaos.REQUIRED_SITES pinning points to functions)
+    transfer-purity   no implicit host<->device transfers in declared
+                      hot-path modules (_TRANSFER_HOT_PATH)
+    recompile-budget  every jit site in _RECOMPILE_TRACKED modules is
+                      registered with the recompile registry
+    happens-before    _RACE_TRACED declarations and race.read/write
+                      hooks agree (the vector-clock detector is the
+                      runtime half)
 
 Run: `python -m nomad_tpu.analysis [--json] [--checker NAME] [--root D]`
 Suppress: `# analysis: allow(checker-name)` on the finding's line or the
-enclosing `def` line.  The runtime lock-order recorder lives in
-`nomad_tpu.analysis.lock_order` (it is dynamic, not part of `run_all`).
+enclosing `def` line.  The runtime halves — lock-order recorder
+(`lock_order`), vector-clock race detector (`race.RaceDetector`,
+`NOMAD_TPU_RACE=1`), transfer guard (`transfer_purity.
+steady_state_guard`), and recompile budget (`recompile.Budget`) — are
+dynamic and not part of `run_all`.
 """
 from __future__ import annotations
 
@@ -25,7 +36,7 @@ from typing import Dict, List, Optional, Sequence
 
 from nomad_tpu.analysis import (
     chaos_coverage, fsm_determinism, jax_purity, lock_discipline,
-    native_abi,
+    native_abi, race, recompile, transfer_purity,
 )
 from nomad_tpu.analysis.common import Corpus, Finding, load_corpus
 from nomad_tpu.analysis.lock_order import LockOrderRecorder
@@ -36,6 +47,9 @@ CHECKERS = {
     native_abi.CHECKER: native_abi.run,
     jax_purity.CHECKER: jax_purity.run,
     chaos_coverage.CHECKER: chaos_coverage.run,
+    transfer_purity.CHECKER: transfer_purity.run,
+    recompile.CHECKER: recompile.run,
+    race.CHECKER: race.run,
 }
 
 
@@ -55,4 +69,5 @@ def run_all(root: Path, checkers: Optional[Sequence[str]] = None,
 
 
 __all__ = ["CHECKERS", "Corpus", "Finding", "LockOrderRecorder",
-           "load_corpus", "run_all"]
+           "load_corpus", "race", "recompile", "run_all",
+           "transfer_purity"]

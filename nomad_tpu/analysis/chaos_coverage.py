@@ -10,6 +10,20 @@ defaults to "rpc.delay").  Two drift directions, both flagged:
 - an injection site naming an UNREGISTERED point raises ValueError only
   when someone first sets a rate for it, i.e. never in CI
 
+The registry may additionally pin points to the functions that must
+carry them:
+
+    REQUIRED_SITES = {
+        "world.scatter_fail": ("DeviceWorld.apply_rank1",
+                               "DeviceWorld._update_one"),
+    }
+
+Each listed `Class.method` (or bare function) qualname must contain an
+injection site for that point — so a refactor that drops the fault hook
+from a critical path (scatter commit, dirty-row diff, batched ticket
+release) fails the lint even though the point still has *a* site
+somewhere.  Required points must themselves be in FAULT_POINTS.
+
 The file defining FAULT_POINTS is exempt from site collection (its own
 function defs mention the default point).
 """
@@ -40,6 +54,56 @@ def _fault_points(sf) -> Optional[Tuple[Set[str], int]]:
     return None
 
 
+def _required_sites(sf) -> Optional[Tuple[Dict[str, Tuple[str, ...]], int]]:
+    """Parse a literal `REQUIRED_SITES = {"point": ("Qual", ...)}`."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "REQUIRED_SITES" and \
+                isinstance(node.value, ast.Dict):
+            out: Dict[str, Tuple[str, ...]] = {}
+            for kn, vn in zip(node.value.keys, node.value.values):
+                if not (isinstance(kn, ast.Constant) and
+                        isinstance(kn.value, str)):
+                    continue
+                quals = []
+                if isinstance(vn, (ast.Tuple, ast.List)):
+                    quals = [el.value for el in vn.elts
+                             if isinstance(el, ast.Constant) and
+                             isinstance(el.value, str)]
+                elif isinstance(vn, ast.Constant) and \
+                        isinstance(vn.value, str):
+                    quals = [vn.value]
+                out[kn.value] = tuple(quals)
+            return out, node.lineno
+    return None
+
+
+def _enclosing_qualname(sf, lineno: int) -> Optional[str]:
+    """Innermost def containing `lineno` as Class.method / bare name."""
+    best: Optional[str] = None
+    best_span = None
+
+    def visit(node: ast.AST, cls: Optional[str]) -> None:
+        nonlocal best, best_span
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                end = getattr(child, "end_lineno", child.lineno)
+                if child.lineno <= lineno <= end:
+                    span = end - child.lineno
+                    if best_span is None or span < best_span:
+                        best = f"{cls}.{child.name}" if cls else child.name
+                        best_span = span
+                visit(child, None)
+            else:
+                visit(child, cls)
+
+    visit(sf.tree, None)
+    return best
+
+
 def run(corpus: Corpus) -> List[Finding]:
     registry_sf = None
     points: Set[str] = set()
@@ -55,6 +119,8 @@ def run(corpus: Corpus) -> List[Finding]:
     findings: List[Finding] = []
     # point -> first site (rel, line); plus unknown-point findings
     sites: Dict[str, Tuple[str, int]] = {}
+    # (point, enclosing qualname) of every site, for REQUIRED_SITES
+    site_quals: Set[Tuple[str, str]] = set()
     for sf in corpus.py:
         if sf is registry_sf:
             continue
@@ -99,6 +165,9 @@ def run(corpus: Corpus) -> List[Finding]:
                         f"{point!r} (not in FAULT_POINTS)"))
             else:
                 sites.setdefault(point, (sf.rel, node.lineno))
+                qual = _enclosing_qualname(sf, node.lineno)
+                if qual:
+                    site_quals.add((point, qual))
 
     for point in sorted(points - set(sites)):
         if not registry_sf.allowed(CHECKER, decl_line):
@@ -106,4 +175,23 @@ def run(corpus: Corpus) -> List[Finding]:
                 CHECKER, registry_sf.rel, decl_line,
                 f"registered chaos point {point!r} has no injection site "
                 f"(dead fault config)"))
+
+    required = _required_sites(registry_sf)
+    if required is not None:
+        req_map, req_line = required
+        for point, quals in sorted(req_map.items()):
+            if registry_sf.allowed(CHECKER, req_line):
+                continue
+            if point not in points:
+                findings.append(Finding(
+                    CHECKER, registry_sf.rel, req_line,
+                    f"REQUIRED_SITES names {point!r} which is not in "
+                    f"FAULT_POINTS"))
+                continue
+            for qual in quals:
+                if (point, qual) not in site_quals:
+                    findings.append(Finding(
+                        CHECKER, registry_sf.rel, req_line,
+                        f"required injection site missing: {qual} must "
+                        f"carry chaos point {point!r}"))
     return findings

@@ -1,0 +1,406 @@
+"""happens-before: vector-clock data-race detection over declared tables.
+
+Two halves, one checker name.
+
+**Static** (part of `run_all`): classes that opt in declare
+
+    _RACE_TRACED = {"_overlay": "_overlay_lock"}
+
+mapping each traced attribute to the lock attribute that guards it.  The
+checker cross-checks the declaration against the runtime hooks the same
+way chaos-coverage ties registry to injection sites:
+
+- `_RACE_TRACED` must be a literal ``{str: str}`` dict;
+- the named lock attribute must actually be assigned somewhere in the
+  class (``self._overlay_lock = ...``);
+- every declared ``Class.attr`` key must be traced by at least one
+  `race.read("Class.attr", ...)` / `race.write("Class.attr", ...)` hook
+  in the corpus (a declaration nothing traces is drift);
+- every hook key must be declared by some class (a hook nothing declares
+  is drift the other way).
+
+**Runtime** (`RaceDetector`, not part of `run_all`): extends the
+lock-order recorder with vector clocks, FastTrack-style.  Wrapped locks
+carry a clock that the releasing thread publishes and the acquiring
+thread joins; `threading.Thread.start`/`join` are patched for fork/join
+edges; `Condition` built over a wrapped RLock goes through an explicit
+`_release_save`/`_acquire_restore` pair so waits keep the clocks honest
+(attribute delegation alone would let Condition bypass the wrapper).
+Production code marks accesses with the module-level hooks
+
+    race.read("PlanApplier._overlay", self)
+    race.write("PlanApplier._overlay", self)
+
+which are a single global-load test when no detector is installed
+(chaos-style zero overhead).  Two accesses to the same (key, instance)
+with no happens-before path between them, at least one a write, produce
+a `RaceReport`.  Lock-order cycles (deadlocks) are inherited from the
+base recorder.  Enable suite-wide with ``NOMAD_TPU_RACE=1`` (see
+tests/conftest.py).
+"""
+from __future__ import annotations
+
+import _thread
+import ast
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, SourceFile, dotted, enclosing_def_line,
+)
+from nomad_tpu.analysis.lock_order import (
+    LockOrderRecorder, _RecordingLock, _alloc_site,
+)
+
+CHECKER = "happens-before"
+
+
+# ===================================================================== runtime
+
+# the installed detector, or None.  Hooks test this one global: the
+# uninstrumented fast path is a load + is-check, nothing else.
+active: Optional["RaceDetector"] = None
+
+
+def read(key: str, obj: object = None) -> None:
+    det = active
+    if det is not None:
+        det.on_access(key, obj, False)
+
+
+def write(key: str, obj: object = None) -> None:
+    det = active
+    if det is not None:
+        det.on_access(key, obj, True)
+
+
+def _call_site() -> str:
+    f = sys._getframe(2)
+    while f is not None:
+        fname = f.f_code.co_filename.replace("\\", "/")
+        if "analysis/race" not in fname:
+            return f"{fname.rsplit('/', 1)[-1]}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _join_into(clk: Dict[int, int], other: Dict[int, int]) -> None:
+    for t, c in other.items():
+        if c > clk.get(t, 0):
+            clk[t] = c
+
+
+class _VCLock(_RecordingLock):
+    """A recording lock that also carries a vector clock."""
+
+    def __init__(self, inner, name: str, recorder: "RaceDetector"):
+        super().__init__(inner, name, recorder)
+        self._vc: Dict[int, int] = {}   # guarded by the lock itself
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._recorder._on_acquire(self._name)
+            self._recorder._vc_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._recorder._vc_release(self)
+        self._recorder._on_release(self._name)
+        self._inner.release()
+
+
+class _VCRLock(_VCLock):
+    """RLock flavor: implements the Condition protocol explicitly so
+    `Condition.wait`'s release/reacquire pair updates the clocks (the
+    base class only delegates via __getattr__, which hands Condition the
+    inner lock's bound methods and silently skips the bookkeeping)."""
+
+    def _release_save(self):
+        self._recorder._vc_release(self)
+        self._recorder._on_release(self._name)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        self._recorder._on_acquire(self._name)
+        self._recorder._vc_acquire(self)
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+@dataclass
+class RaceReport:
+    key: str
+    kind: str                       # write->write / write->read / read->write
+    first: Tuple[str, str]          # (site, thread name)
+    second: Tuple[str, str]
+
+    def render(self) -> str:
+        return (f"race on {self.key} [{self.kind}]: "
+                f"{self.first[0]} (thread {self.first[1]}) unordered with "
+                f"{self.second[0]} (thread {self.second[1]})")
+
+
+class _VarState:
+    __slots__ = ("write", "reads")
+
+    def __init__(self):
+        # (tid, clock component, site, thread name) of the last write
+        self.write: Optional[Tuple[int, int, str, str]] = None
+        # tid -> (clock component, site, thread name) of unordered reads
+        self.reads: Dict[int, Tuple[int, str, str]] = {}
+
+
+class RaceDetector(LockOrderRecorder):
+    """Lock-order recorder + vector-clock happens-before detection."""
+
+    MAX_REPORTS = 64
+
+    def __init__(self):
+        super().__init__()
+        self.races: List[RaceReport] = []
+        self._race_keys: Set[Tuple[str, str, str, str]] = set()
+        self._vars: Dict[Tuple[str, int], _VarState] = {}
+        self._tl = threading.local()
+        self._final: Dict[int, Dict[int, int]] = {}     # id(Thread) -> clock
+        self._torig: Optional[Tuple] = None
+
+    # ---- patching (locks + thread fork/join edges)
+
+    def install(self) -> "RaceDetector":
+        if self._orig is not None:
+            return self
+        self._orig = (threading.Lock, threading.RLock)
+        real_lock, real_rlock = self._orig
+        det = self
+
+        def lock_factory():
+            return _VCLock(real_lock(), _alloc_site(), det)
+
+        def rlock_factory():
+            return _VCRLock(real_rlock(), _alloc_site(), det)
+
+        threading.Lock = lock_factory
+        threading.RLock = rlock_factory
+
+        self._torig = (threading.Thread.start, threading.Thread.join)
+        orig_start, orig_join = self._torig
+
+        def start(t):
+            clk = det._clock()
+            snap = dict(clk)
+            # the fork point splits the parent's timeline: bump so the
+            # parent's *later* events are not covered by the child's
+            # inherited clock
+            clk[_thread.get_ident()] += 1
+            orig_run = t.run
+
+            # the inherited clock rides in the run() closure, NOT an
+            # id(Thread)-keyed map popped via current_thread(): bootstrap
+            # acquires the new thread's Event lock before the thread
+            # registers in threading._active, where current_thread()
+            # would fabricate a _DummyThread whose own Event acquisition
+            # re-enters this path unboundedly
+            def run():
+                _join_into(det._clock(), snap)
+                try:
+                    orig_run()
+                finally:
+                    with det._meta:
+                        det._final[id(t)] = dict(det._clock())
+
+            t.run = run
+            orig_start(t)
+
+        def join(t, timeout=None):
+            orig_join(t, timeout)
+            if not t.is_alive():
+                with det._meta:
+                    fin = det._final.get(id(t))
+                if fin:
+                    _join_into(det._clock(), fin)
+
+        threading.Thread.start = start
+        threading.Thread.join = join
+        return self
+
+    def uninstall(self) -> None:
+        if self._orig is not None:
+            threading.Lock, threading.RLock = self._orig
+            self._orig = None
+        if self._torig is not None:
+            threading.Thread.start, threading.Thread.join = self._torig
+            self._torig = None
+
+    # ---- vector clocks
+
+    def _clock(self) -> Dict[int, int]:
+        # must not touch threading.current_thread(): this runs inside
+        # every wrapped-lock acquire, including bootstrap-time acquires
+        # from threads not yet in threading._active
+        clk = getattr(self._tl, "clock", None)
+        if clk is None:
+            clk = self._tl.clock = {_thread.get_ident(): 1}
+        return clk
+
+    def _vc_acquire(self, lock: _VCLock) -> None:
+        # caller holds `lock`, so lock._vc is stable
+        _join_into(self._clock(), lock._vc)
+
+    def _vc_release(self, lock: _VCLock) -> None:
+        clk = self._clock()
+        lock._vc = dict(clk)
+        clk[_thread.get_ident()] += 1
+
+    # ---- accesses
+
+    def on_access(self, key: str, obj: object, is_write: bool) -> None:
+        clk = self._clock()
+        tid = _thread.get_ident()
+        own = clk[tid]
+        site = _call_site()
+        me = threading.current_thread().name
+        k = (key, id(obj) if obj is not None else 0)
+        with self._meta:
+            st = self._vars.get(k)
+            if st is None:
+                st = self._vars[k] = _VarState()
+            if is_write:
+                for rt, (rc, rsite, rname) in st.reads.items():
+                    if rt != tid and clk.get(rt, 0) < rc:
+                        self._report(key, "read->write",
+                                     (rsite, rname), (site, me))
+                if st.write is not None:
+                    wt, wc, wsite, wname = st.write
+                    if wt != tid and clk.get(wt, 0) < wc:
+                        self._report(key, "write->write",
+                                     (wsite, wname), (site, me))
+                st.write = (tid, own, site, me)
+                st.reads = {}
+            else:
+                if st.write is not None:
+                    wt, wc, wsite, wname = st.write
+                    if wt != tid and clk.get(wt, 0) < wc:
+                        self._report(key, "write->read",
+                                     (wsite, wname), (site, me))
+                st.reads[tid] = (own, site, me)
+
+    def _report(self, key: str, kind: str, first: Tuple[str, str],
+                second: Tuple[str, str]) -> None:
+        dedupe = (key, kind, first[0], second[0])
+        if dedupe in self._race_keys or len(self.races) >= self.MAX_REPORTS:
+            return
+        self._race_keys.add(dedupe)
+        self.races.append(RaceReport(key, kind, first, second))
+
+    def render_races(self) -> str:
+        return "\n".join(r.render() for r in self.races)
+
+
+# ====================================================================== static
+
+def _class_self_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Every `self.X = ...` target in the class body."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and t.value.id == "self":
+                    out.add(t.attr)
+    return out
+
+
+def _traced_decl(cls: ast.ClassDef):
+    """(decl dict attr->lock, lineno) from a `_RACE_TRACED = {...}`
+    class-level assignment, or (None, badness lineno) when malformed."""
+    for item in cls.body:
+        if isinstance(item, ast.Assign) and len(item.targets) == 1 and \
+                isinstance(item.targets[0], ast.Name) and \
+                item.targets[0].id == "_RACE_TRACED":
+            if not isinstance(item.value, ast.Dict):
+                return None, item.lineno
+            decl: Dict[str, str] = {}
+            for kn, vn in zip(item.value.keys, item.value.values):
+                if not (isinstance(kn, ast.Constant) and
+                        isinstance(kn.value, str) and
+                        isinstance(vn, ast.Constant) and
+                        isinstance(vn.value, str)):
+                    return None, item.lineno
+                decl[kn.value] = vn.value
+            return decl, item.lineno
+    return {}, None
+
+
+def _hook_calls(sf: SourceFile) -> List[Tuple[str, int]]:
+    """(key, lineno) for every race.read("K", ...) / race.write("K", ...)
+    in the file."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("read", "write") and \
+                dotted(node.func.value) == "race" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    # declared "Class.attr" -> (sf, decl lineno)
+    declared: Dict[str, Tuple[SourceFile, int]] = {}
+    for sf in corpus.py:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            decl, lineno = _traced_decl(node)
+            if decl is None:
+                if not sf.allowed(CHECKER, lineno):
+                    findings.append(Finding(
+                        CHECKER, sf.rel, lineno,
+                        f"{node.name}._RACE_TRACED must be a literal "
+                        f"{{'attr': 'lock_attr'}} dict of string constants"))
+                continue
+            if not decl:
+                continue
+            attrs = _class_self_attrs(node)
+            for attr, lockname in decl.items():
+                key = f"{node.name}.{attr}"
+                declared[key] = (sf, lineno)
+                if attr not in attrs and not sf.allowed(CHECKER, lineno):
+                    findings.append(Finding(
+                        CHECKER, sf.rel, lineno,
+                        f"_RACE_TRACED declares `{key}` but the class "
+                        f"never assigns self.{attr}"))
+                if lockname not in attrs and not sf.allowed(CHECKER, lineno):
+                    findings.append(Finding(
+                        CHECKER, sf.rel, lineno,
+                        f"_RACE_TRACED maps `{key}` to lock "
+                        f"`{lockname}` but the class never assigns "
+                        f"self.{lockname}"))
+    hooked: Set[str] = set()
+    for sf in corpus.py:
+        for key, lineno in _hook_calls(sf):
+            hooked.add(key)
+            if key not in declared and \
+                    not sf.allowed(CHECKER, lineno,
+                                   enclosing_def_line(sf, lineno)):
+                findings.append(Finding(
+                    CHECKER, sf.rel, lineno,
+                    f"race hook traces `{key}` but no class declares it "
+                    f"in _RACE_TRACED"))
+    for key, (sf, lineno) in sorted(declared.items()):
+        if key not in hooked and not sf.allowed(CHECKER, lineno):
+            findings.append(Finding(
+                CHECKER, sf.rel, lineno,
+                f"_RACE_TRACED declares `{key}` but no race.read/"
+                f"race.write hook traces it (dead declaration)"))
+    return findings

@@ -1,0 +1,202 @@
+"""recompile-budget: every hot-path jit kernel is named, counted, gated.
+
+A shape-bucketing bug does not crash — it recompiles, silently turning a
+microsecond dispatch into a multi-second XLA build.  This module makes
+recompiles loud.
+
+**Static** (part of `run_all`): modules that opt in with
+
+    _RECOMPILE_TRACKED = True
+
+must hand every jitted callable to the runtime registry:
+
+    fn = recompile.register("scan:mesh", jax.jit(shard_map(body, ...)))
+
+The checker collects jit sites (decorated defs and `x = jax.jit(...)`
+assignments) and flags any whose name is never passed to a
+`recompile.register(...)` call in the same module.  Unregistered kernels
+are invisible to the budget, so the drift is the finding.
+
+**Runtime**: `register()` keeps the jitted callables by name;
+`cache_sizes()` polls their `_cache_size()` (one entry per traced
+specialization); `install_listener()` hooks jax.monitoring's
+`/jax/core/compile/backend_compile_duration` event, which fires once per
+backend compile and never on a cache hit.  `Budget` snapshots both after
+warmup; `violations()` names every kernel whose cache grew — plus the
+raw compile-event delta — during the measured run.  bench.py folds
+`report()` into the BENCH JSON and fails the run on violations;
+per-kernel counts land in telemetry as `recompile.<name>` gauges.
+
+Stdlib-only at import (the CI analysis leg lints before pip install);
+jax is imported lazily inside the runtime helpers.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, SourceFile, dotted, enclosing_def_line,
+)
+
+CHECKER = "recompile-budget"
+
+_JIT = {"jax.jit", "jit"}
+
+
+# ===================================================================== runtime
+
+_registry: Dict[str, Any] = {}
+_compile_events = 0
+_listener_installed = False
+
+
+def register(key: str, fn: Any) -> Any:
+    """Track `fn` (a jitted callable) under `key`; returns `fn` so call
+    sites can register inline.  Re-registering a key replaces the entry
+    (caches rebuilt per mesh re-register their current incarnation)."""
+    _registry[key] = fn
+    return fn
+
+
+def cache_sizes() -> Dict[str, int]:
+    """key -> number of traced specializations currently cached."""
+    out: Dict[str, int] = {}
+    for key, fn in _registry.items():
+        try:
+            out[key] = fn._cache_size()
+        except Exception:   # analysis: allow(*) — probe must never raise
+            out[key] = -1
+    return out
+
+
+def install_listener() -> None:
+    """Count backend compiles process-wide (idempotent)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    from jax import monitoring   # runtime-only import
+
+    def _on_event(event: str, *args, **kwargs) -> None:
+        if event.endswith("backend_compile_duration"):
+            global _compile_events
+            _compile_events += 1
+
+    monitoring.register_event_duration_secs_listener(_on_event)
+    _listener_installed = True
+
+
+def compile_events() -> int:
+    return _compile_events
+
+
+class Budget:
+    """Snapshot compile state now; report growth later."""
+
+    def __init__(self):
+        install_listener()
+        self.start_sizes = cache_sizes()
+        self.start_events = compile_events()
+
+    def report(self) -> Dict[str, Any]:
+        sizes = cache_sizes()
+        grew = {k: v - self.start_sizes.get(k, 0)
+                for k, v in sizes.items()
+                if v > self.start_sizes.get(k, 0)}
+        return {
+            "per_kernel": sizes,
+            "recompiled": grew,
+            "compile_events": compile_events() - self.start_events,
+        }
+
+    def violations(self) -> List[str]:
+        rep = self.report()
+        out = [f"kernel `{k}` recompiled {n}x after warmup"
+               for k, n in sorted(rep["recompiled"].items())]
+        if not out and rep["compile_events"] > 0:
+            out.append(f"{rep['compile_events']} backend compile(s) after "
+                       f"warmup outside the registered kernels")
+        return out
+
+    def publish(self, metrics) -> None:
+        """Fold per-kernel counts into a MetricsRegistry as gauges (one
+        atomic batch via set_gauges so readers never see a torn set)."""
+        gauges = dict(cache_sizes())
+        gauges["compile_events"] = compile_events()
+        metrics.set_gauges(gauges, prefix="recompile.")
+
+
+# ====================================================================== static
+
+def _jit_sites(sf: SourceFile) -> List[Tuple[str, int]]:
+    """(name, lineno) of every jitted def / `x = jax.jit(...)` assign."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = dotted(target)
+                jitted = name in _JIT or (
+                    name in ("functools.partial", "partial") and
+                    isinstance(dec, ast.Call) and dec.args and
+                    dotted(dec.args[0]) in _JIT)
+                if jitted:
+                    out.append((node.name, node.lineno))
+                    break
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                dotted(node.value.func) in _JIT:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.append((t.id, node.lineno))
+    return out
+
+
+def _registered_names(sf: SourceFile) -> Set[str]:
+    """Names appearing as arguments to recompile.register(...) — either
+    `register(key, fn)` or the inline `x = register(key, jax.jit(...))`
+    form, whose assign targets count as registered too."""
+    out: Set[str] = set()
+
+    def _is_register(call: ast.Call) -> bool:
+        f = call.func
+        return (isinstance(f, ast.Attribute) and f.attr == "register" and
+                (dotted(f.value) or "").split(".")[-1] == "recompile") or \
+            (isinstance(f, ast.Name) and f.id == "register")
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) and _is_register(node):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _is_register(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in corpus.py:
+        tracked = any(
+            isinstance(node, ast.Assign) and len(node.targets) == 1 and
+            isinstance(node.targets[0], ast.Name) and
+            node.targets[0].id == "_RECOMPILE_TRACKED" and
+            isinstance(node.value, ast.Constant) and node.value.value is True
+            for node in sf.tree.body)
+        if not tracked:
+            continue
+        registered = _registered_names(sf)
+        for name, lineno in _jit_sites(sf):
+            if name in registered:
+                continue
+            if sf.allowed(CHECKER, lineno, enclosing_def_line(sf, lineno)):
+                continue
+            findings.append(Finding(
+                CHECKER, sf.rel, lineno,
+                f"jitted kernel `{name}` is not registered with the "
+                f"recompile budget (recompile.register(key, {name}))"))
+    return findings

@@ -1,0 +1,243 @@
+"""transfer-purity: hot-path code must not move world bytes implicitly.
+
+The device-resident world (PR 6) only pays off if steady-state dispatch
+ships zero host<->device bytes.  Modules on that path opt in with
+
+    _TRANSFER_HOT_PATH = True          # checked
+    _TRANSFER_UPLOAD_SITE = True       # also sanctioned to device_put
+
+and the checker flags, inside every function of a hot-path module:
+
+- `jax.device_put(...)` anywhere in a module that is not a declared
+  upload site (uploads belong in world.py; a cache-fill device_put
+  elsewhere carries an `# analysis: allow(transfer-purity)` with its
+  reason);
+- `np.asarray()` / `np.array()` / `np.copy()` / `float()` / `int()` /
+  `bool()` / `.item()` applied to a device-valued name — an implicit
+  device->host sync (use `jax.device_get` and say so);
+- `if x:` / `while x:` on a bare device-valued name — `__bool__` syncs;
+- a numpy-valued name passed positionally to a same-module jitted
+  kernel — an implicit host->device transfer (device_put it explicitly,
+  which the runtime guard permits).
+
+"Device-valued" is a per-function heuristic: parameters/locals ending in
+`_dev`, names assigned from `jax.device_put(...)`, and comprehension /
+for-loop variables iterating over such a name.  "Numpy-valued" means
+assigned from an `np.*`/`numpy.*` call in the same function.
+
+The runtime half is `steady_state_guard()`: flips the process-wide
+`jax_transfer_guard` to "disallow" (the context-manager form is
+thread-scoped and would miss the engine thread) so any implicit
+host->device or device->device transfer raises inside the dispatch loop.
+bench.py arms it after warmup; on the CPU backend implicit
+device->host is zero-copy and invisible to the guard, so steady-state
+re-uploads are asserted separately from `DeviceWorld.stats`.
+"""
+from __future__ import annotations
+
+import ast
+import contextlib
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, SourceFile, dotted, enclosing_def_line,
+)
+
+CHECKER = "transfer-purity"
+
+_COERCIONS = {"float", "int", "bool"}
+_NP_BASES = {"np", "numpy"}
+_NP_SYNCS = {"asarray", "array", "copy"}
+_DEVICE_PUT = {"jax.device_put", "device_put"}
+_JIT = {"jax.jit", "jit"}
+
+
+def _module_flag(sf: SourceFile, name: str) -> bool:
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name and \
+                isinstance(node.value, ast.Constant) and \
+                node.value.value is True:
+            return True
+    return False
+
+
+def _jitted_names(sf: SourceFile) -> Set[str]:
+    """Defs jitted by decorator plus names assigned from jax.jit(...)."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = dotted(target)
+                if name in _JIT:
+                    out.add(node.name)
+                elif name in ("functools.partial", "partial") and \
+                        isinstance(dec, ast.Call) and dec.args and \
+                        dotted(dec.args[0]) in _JIT:
+                    out.add(node.name)
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                dotted(node.value.func) in _JIT:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _walk_local(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk `fn` without descending into nested defs/classes (they are
+    visited as functions of their own, so descending double-reports)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _device_names(fn: ast.AST) -> Set[str]:
+    """Names the heuristic treats as device arrays inside `fn`."""
+    out: Set[str] = set()
+    a = fn.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        if p.arg.endswith("_dev"):
+            out.add(p.arg)
+
+    def _targets(t: ast.AST) -> Iterator[str]:
+        if isinstance(t, ast.Name):
+            yield t.id
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                yield from _targets(el)
+
+    for node in _walk_local(fn):
+        if isinstance(node, ast.Assign):
+            is_put = isinstance(node.value, ast.Call) and \
+                dotted(node.value.func) in _DEVICE_PUT
+            for t in node.targets:
+                for name in _targets(t):
+                    if is_put or name.endswith("_dev"):
+                        out.add(name)
+    # propagate through one level of iteration: `for x in packed_dev:`
+    # and `[f(x) for x in packed_dev]` make x device-valued
+    changed = True
+    while changed:
+        changed = False
+        for node in _walk_local(fn):
+            it, tgt = None, None
+            if isinstance(node, ast.For):
+                it, tgt = node.iter, node.target
+            elif isinstance(node, ast.comprehension):
+                it, tgt = node.iter, node.target
+            if isinstance(it, ast.Name) and it.id in out:
+                for name in _targets(tgt):
+                    if name not in out:
+                        out.add(name)
+                        changed = True
+    return out
+
+
+def _numpy_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in _walk_local(fn):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                isinstance(node.value.func, ast.Attribute) and \
+                dotted(node.value.func.value) in _NP_BASES:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _check_fn(sf: SourceFile, fn: ast.AST, upload_site: bool,
+              jitted: Set[str], findings: List[Finding]) -> None:
+    dev = _device_names(fn)
+    npv = _numpy_names(fn)
+
+    def emit(line: int, msg: str) -> None:
+        if not sf.allowed(CHECKER, line, enclosing_def_line(sf, line)):
+            findings.append(Finding(CHECKER, sf.rel, line, msg, (fn.name,)))
+
+    for node in _walk_local(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            callee = dotted(f)
+            if callee in _DEVICE_PUT and not upload_site:
+                emit(node.lineno,
+                     "`jax.device_put` outside the sanctioned upload "
+                     "site (world.py owns uploads; annotate cache fills "
+                     "with a reason)")
+            elif isinstance(f, ast.Name) and f.id in _COERCIONS and \
+                    len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in dev:
+                emit(node.lineno,
+                     f"`{f.id}({node.args[0].id})` syncs a device array "
+                     f"to host on the hot path")
+            elif isinstance(f, ast.Attribute):
+                if f.attr == "item" and isinstance(f.value, ast.Name) and \
+                        f.value.id in dev:
+                    emit(node.lineno,
+                         f"`{f.value.id}.item()` syncs a device array "
+                         f"to host on the hot path")
+                elif f.attr in _NP_SYNCS and \
+                        dotted(f.value) in _NP_BASES and node.args and \
+                        isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id in dev:
+                    emit(node.lineno,
+                         f"`np.{f.attr}({node.args[0].id})` implicitly "
+                         f"syncs a device array to host (use "
+                         f"`jax.device_get`)")
+            if isinstance(f, ast.Name) and f.id in jitted:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name) and arg.id in npv:
+                        emit(node.lineno,
+                             f"numpy value `{arg.id}` passed to jitted "
+                             f"kernel `{f.id}`: implicit host->device "
+                             f"transfer (device_put it explicitly)")
+        elif isinstance(node, (ast.If, ast.While)):
+            t = node.test
+            if isinstance(t, ast.Name) and t.id in dev:
+                emit(node.lineno,
+                     f"truth-test on device array `{t.id}` forces a "
+                     f"host sync (`__bool__`)")
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in corpus.py:
+        if not _module_flag(sf, "_TRANSFER_HOT_PATH"):
+            continue
+        upload_site = _module_flag(sf, "_TRANSFER_UPLOAD_SITE")
+        jitted = _jitted_names(sf)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _check_fn(sf, node, upload_site, jitted, findings)
+    return findings
+
+
+# ===================================================================== runtime
+
+@contextlib.contextmanager
+def steady_state_guard(enabled: bool = True) -> Iterator[None]:
+    """Process-wide `jax_transfer_guard = "disallow"` for the duration.
+
+    Covers every thread (the dispatch loop runs on the engine thread,
+    which `with jax.transfer_guard(...)` — thread-local — would miss).
+    Explicit `jax.device_put` / `jax.device_get` stay permitted; any
+    implicit host->device or device->device transfer raises.
+    """
+    if not enabled:
+        yield
+        return
+    import jax  # runtime-only: the static half must import without jax
+    prev = getattr(jax.config, "jax_transfer_guard", None)
+    jax.config.update("jax_transfer_guard", "disallow")
+    try:
+        yield
+    finally:
+        jax.config.update("jax_transfer_guard", prev or "allow")

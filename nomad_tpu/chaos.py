@@ -42,6 +42,20 @@ Fault points and their injection sites:
     snapshot.partial_write    raft/snapshot.py — crash mid-snapshot: a
                               truncated record lands under the final
                               name (latest() must skip it and fall back)
+    world.scatter_fail        parallel/world.py — the device half of a
+                              rank-1 scatter (or a dirty-row diff) is
+                              lost, as if the device dropped the update:
+                              resident state is invalidated and the next
+                              update() re-uploads from the host snapshot
+                              (which always applies), so recovery is
+                              deterministic and nothing raises mid-commit
+    engine.complete_delay     parallel/engine.py — batched ticket release
+                              (complete_many) stalls `delay_ms` before
+                              taking the overlay lock, widening the
+                              window where commits race dispatch
+
+`REQUIRED_SITES` pins points to the hot-path functions that must carry
+them; the chaos-coverage linter fails if a refactor drops one.
 
 Zero-overhead-when-disabled contract: `active` is None unless a registry
 is installed; every injection site guards with `if chaos.active is not
@@ -70,7 +84,17 @@ FAULT_POINTS = (
     "disk.fsync_fail",
     "disk.corrupt_read",
     "snapshot.partial_write",
+    "world.scatter_fail",
+    "engine.complete_delay",
 )
+
+# Points that must be injected in these specific functions (enforced by
+# the chaos-coverage linter): the PR 6 scatter/commit hot paths.
+REQUIRED_SITES = {
+    "world.scatter_fail": ("DeviceWorld.apply_rank1",
+                           "DeviceWorld._update_one"),
+    "engine.complete_delay": ("PlacementEngine.complete_many",),
+}
 
 
 class ChaosError(RuntimeError):

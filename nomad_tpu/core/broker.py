@@ -23,6 +23,7 @@ from collections import defaultdict, deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from nomad_tpu import chaos
+from nomad_tpu.analysis import race
 from nomad_tpu.structs import Evaluation
 from nomad_tpu.utils import requires_lock
 
@@ -46,6 +47,10 @@ class EvalBroker:
         "_ready", "_unack", "_attempts", "_pending", "_active_jobs",
         "_delayed", "_requeued",
     })
+    # happens-before (nomad_tpu.analysis): the lease table is touched by
+    # every scheduler worker (dequeue/ack/nack), the timer poll, and the
+    # plan-submit gate (outstanding); the race detector traces it.
+    _RACE_TRACED = {"_unack": "_lock"}
 
     def __init__(self, nack_timeout: float = 60.0, delivery_limit: int = 3,
                  initial_nack_delay: float = 1.0, subsequent_nack_delay: float = 20.0):
@@ -82,6 +87,7 @@ class EvalBroker:
 
     @requires_lock("_lock")
     def flush(self) -> None:
+        race.write("EvalBroker._unack", self)
         self._ready.clear()
         self._unack.clear()
         self._attempts.clear()
@@ -135,6 +141,7 @@ class EvalBroker:
             _, _, ev = heapq.heappop(self._requeued)
             heapq.heappush(self._ready[ev.type], (-ev.priority, next(self._counter), ev))
         # expire stale leases -> auto-nack
+        race.write("EvalBroker._unack", self)
         expired = [t for t, l in self._unack.items() if l.expires_at <= now]
         for token in expired:
             lease = self._unack.pop(token)
@@ -163,6 +170,7 @@ class EvalBroker:
                         # poll auto-nacks it, so the worker's eventual ack
                         # or plan submit sees a stale token
                         expires = _time.time()
+                    race.write("EvalBroker._unack", self)
                     self._unack[token] = _Lease(ev, token, expires)
                     self.stats["dequeued"] += 1
                     return ev, token
@@ -177,6 +185,7 @@ class EvalBroker:
 
     def ack(self, eval_id: str, token: str) -> bool:
         with self._lock:
+            race.write("EvalBroker._unack", self)
             lease = self._unack.get(token)
             if lease is None or lease.eval.id != eval_id:
                 return False
@@ -192,6 +201,7 @@ class EvalBroker:
 
     def nack(self, eval_id: str, token: str) -> bool:
         with self._lock:
+            race.write("EvalBroker._unack", self)
             lease = self._unack.get(token)
             if lease is None or lease.eval.id != eval_id:
                 return False
@@ -240,6 +250,7 @@ class EvalBroker:
             # settle expired leases first so a stale token is never
             # reported as live (the plan-submit gate relies on this)
             self._poll_timers_locked()
+            race.read("EvalBroker._unack", self)
             for token, lease in self._unack.items():
                 if lease.eval.id == eval_id:
                     return token
@@ -248,6 +259,7 @@ class EvalBroker:
     def outstanding_reset(self, eval_id: str, token: str) -> bool:
         """Extend the lease (reference OutstandingReset for long scheds)."""
         with self._lock:
+            race.write("EvalBroker._unack", self)
             lease = self._unack.get(token)
             if lease is None or lease.eval.id != eval_id:
                 return False
@@ -256,6 +268,7 @@ class EvalBroker:
 
     def unacked_count(self) -> int:
         with self._lock:
+            race.read("EvalBroker._unack", self)
             return len(self._unack)
 
     def ready_count(self) -> int:

@@ -35,6 +35,7 @@ import numpy as np
 from nomad_tpu.encode.matrixizer import comparable_vec, NUM_RESOURCE_DIMS
 
 from nomad_tpu import chaos
+from nomad_tpu.analysis import race
 from nomad_tpu.state.store import AppliedPlanResults, StateStore
 from nomad_tpu.structs import Allocation, Node
 from nomad_tpu.structs.node import NodeStatus
@@ -45,6 +46,11 @@ from nomad_tpu.telemetry import global_metrics
 class PlanApplier:
     """Serialized: one plan at a time, guarded by a lock (the reference
     serializes via the single planApply goroutine)."""
+
+    # happens-before (nomad_tpu.analysis): the pipelining overlay is
+    # written by the evaluation path (_overlay_add) and popped by the
+    # background commit thread; every access must hold _overlay_lock.
+    _RACE_TRACED = {"_overlay": "_overlay_lock"}
 
     def __init__(self, store: StateStore, commit_fn=None):
         self.store = store
@@ -85,6 +91,7 @@ class PlanApplier:
             self._commit(plan, result)
         finally:
             with self._overlay_lock:
+                race.write("PlanApplier._overlay", self)
                 self._overlay.pop(token, None)
         return result
 
@@ -189,6 +196,7 @@ class PlanApplier:
                     pending.future.set_exception(e)
         finally:
             with self._overlay_lock:
+                race.write("PlanApplier._overlay", self)
                 for _pending, _result, token in staged:
                     self._overlay.pop(token, None)
 
@@ -219,6 +227,7 @@ class PlanApplier:
         # overcommitting plans.  Untracked in-flight frees merely delay
         # reuse of the space by one commit.
         with self._overlay_lock:
+            race.write("PlanApplier._overlay", self)
             self._overlay_seq += 1
             token = self._overlay_seq
             self._overlay[token] = (used_delta, port_claim, port_free)
@@ -229,6 +238,7 @@ class PlanApplier:
         are taken under the store lock so a concurrent commit thread
         cannot tear the matrices mid-read."""
         with self._overlay_lock:
+            race.read("PlanApplier._overlay", self)
             if not self._overlay:
                 return cm.used, cm.port_words
             with self.store._lock:

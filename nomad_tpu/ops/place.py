@@ -31,7 +31,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nomad_tpu.analysis import recompile
 from nomad_tpu.ops.fit import score_fit
+
+# recompile-budget (nomad_tpu.analysis): every jitted kernel defined here
+# is registered with the recompile registry (see module tail) so the
+# bench can fail a run whose jit caches grow after warmup
+_RECOMPILE_TRACKED = True
 
 TOP_K = 5  # score_meta entries kept per placement (structs.go:10341 kheap)
 # m-grid bound for the bulk kernel's per-node fill-run length: a run
@@ -844,3 +850,12 @@ def place_eval(inp: PlaceInputs, spread_algorithm: bool = False) -> PlaceResult:
     return PlaceResult(node=node, score=score, fit_score=fit_s,
                        nodes_evaluated=n_eval, nodes_exhausted=n_exh,
                        top_nodes=top_n, top_scores=top_s, used=used)
+
+
+# every jit cache in this module, named for the recompile budget: a
+# post-warmup growth of any of these is a shape-bucketing regression
+recompile.register("place.eval_packed", place_eval_packed_jit)
+recompile.register("place.eval", place_eval_jit)
+recompile.register("place.batch_packed", place_batch_packed_jit)
+recompile.register("place.bulk", place_bulk_jit)
+recompile.register("place.bulk_batch", place_bulk_batch_jit)

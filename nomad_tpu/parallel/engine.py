@@ -36,7 +36,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from nomad_tpu import chaos
 from nomad_tpu import native as _native
+from nomad_tpu.analysis import race
 from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS, pad_to_bucket
 from nomad_tpu.ops.place import (
     SPARSE_CAP,
@@ -56,6 +58,12 @@ from nomad_tpu.ops.place import (
 )
 
 from nomad_tpu.parallel.world import DeviceWorld, mesh_key
+
+# transfer-purity (nomad_tpu.analysis): the dispatch loop is hot-path —
+# implicit host<->device movement is a finding; the few sanctioned
+# device_put sites (cache fills, per-dispatch dynamic leaf) carry
+# `# analysis: allow(transfer-purity)` annotations with their reason
+_TRANSFER_HOT_PATH = True
 
 # fixed sparse-delta slot count per eval: a CONSTANT so the delta axis
 # never forks another XLA compile variant (every distinct D was a full
@@ -105,7 +113,7 @@ class _DeviceCache:
         self.hits = 0
         self.misses = 0
 
-    def _get_or_put(self, key, build):
+    def _get_or_put(self, key, build):  # analysis: allow(transfer-purity) — cache-fill upload: a miss ships once so every later hit ships zero bytes
         import jax
         return self._get_or_put_device(key, lambda: jax.device_put(build()))
 
@@ -156,7 +164,8 @@ class _DeviceCache:
             build = lambda: tree                 # noqa: E731
         full_key = ("sh", tag, mesh_key(mesh), key)
         return self._get_or_put_device(
-            full_key, lambda: jax.device_put(build(), shardings))
+            full_key,
+            lambda: jax.device_put(build(), shardings))  # analysis: allow(transfer-purity) — sharded cache fill: one sanctioned upload per content key
 
     def heavy(self, inputs: PlaceInputs):
         """Device-resident packed heavy block for one eval's inputs."""
@@ -247,6 +256,13 @@ class PlacementEngine:
     plan_apply.go:400).  Callers release their contribution via
     `complete(ticket)` once their plan has been applied (or abandoned) —
     the scheduler does this right after Planner.SubmitPlan returns."""
+
+    # happens-before (nomad_tpu.analysis): the in-flight overlay table is
+    # written by scheduler workers (register_external*), the plan applier
+    # (complete_many) and the engine thread (_register/_basis_for)
+    # concurrently; every access must hold _overlay_lock.  The runtime
+    # race detector (NOMAD_TPU_RACE=1) traces it through these hooks.
+    _RACE_TRACED = {"_overlays": "_overlay_lock"}
 
     # eval-axis compile buckets: lax.scan compile cost is E-independent
     # (one While body), so buckets only bound padding waste — scan-path
@@ -483,6 +499,7 @@ class PlacementEngine:
         the plan commits.  `contributions`: [(row, f32[R])].  Returns a
         ticket for complete()."""
         with self._overlay_lock:
+            race.write("PlacementEngine._overlays", self)
             key = id(cm)
             overlay = self._overlays.get(key)
             n = cm.used.shape[0]
@@ -513,6 +530,7 @@ class PlacementEngine:
         rows = np.ascontiguousarray(rows, np.int32)
         counts = np.ascontiguousarray(counts, np.int32)
         with self._overlay_lock:
+            race.write("PlacementEngine._overlays", self)
             key = id(cm)
             overlay = self._overlays.get(key)
             n = cm.used.shape[0]
@@ -582,8 +600,10 @@ class PlacementEngine:
         acquisition — the plan applier's commit->overlay hand-off
         releases every ticket of a coalesced plan batch at once, instead
         of bouncing the lock against concurrent dispatches per ticket."""
+        chaos.maybe_delay("engine.complete_delay")
         drained = False
         with self._overlay_lock:
+            race.write("PlacementEngine._overlays", self)
             for ticket in tickets:
                 if ticket is None:
                     continue
@@ -654,6 +674,20 @@ class PlacementEngine:
                 self._worlds.popitem(last=False)
             return w
 
+    def world_stats(self) -> Dict[str, int]:
+        """Aggregate DeviceWorld.stats over every resident world.  The
+        bench steady-state gate reads full_uploads / steady_reuploads
+        here: after warmup a healthy run scatters rows and never
+        re-ships a full matrix."""
+        agg: Dict[str, int] = {}
+        with self._worlds_lock:
+            worlds = list(self._worlds.values())
+        for w in worlds:
+            with w.lock:
+                for k, v in w.stats.items():
+                    agg[k] = agg.get(k, 0) + int(v)
+        return agg
+
     def _basis_for(self, cm) -> np.ndarray:
         """cm.used + in-flight overlay (copy).  The committed matrix is
         copied under ITS owner's lock: a copy taken mid-commit would see
@@ -662,6 +696,7 @@ class PlacementEngine:
         import contextlib
         cm_lock = getattr(cm, "lock", None) or contextlib.nullcontext()
         with self._overlay_lock:
+            race.read("PlacementEngine._overlays", self)
             with cm_lock:
                 used = np.array(cm.used, dtype=np.float32)
             overlay = self._overlays.get(id(cm))
@@ -687,6 +722,7 @@ class PlacementEngine:
             # every retry and busy-loop the blocked-eval wakeups
             return None
         with self._overlay_lock:
+            race.write("PlacementEngine._overlays", self)
             key = id(req.cm)
             overlay = self._overlays.get(key)
             n = req.cm.used.shape[0]
@@ -1094,7 +1130,7 @@ class PlacementEngine:
         self.stats["cache_hits"] = self._cache.hits
         self.stats["cache_misses"] = self._cache.misses
         t1 = _time.time()
-        dyn_dev = jax.device_put(dyn)
+        dyn_dev = jax.device_put(dyn)  # analysis: allow(transfer-purity) — per-dispatch dynamic leaf, shipped explicitly
         sparse = all(r.count <= SPARSE_CAP for r in reqs)
         packed, _used_final = place_bulk_batch_jit(
             cap_dev, used_dev, hstack, dyn_dev, D,
@@ -1119,15 +1155,21 @@ class PlacementEngine:
         eval's placements scatter onto it (host snapshot + device in
         lockstep) so the NEXT dispatch's update() diff is already clean
         and ships zero basis rows in steady state."""
+        import jax
+
         N = reqs[0].feasible.shape[0]
+        # one EXPLICIT device->host fetch per resolve: np.asarray on the
+        # device outputs would sync implicitly, invisible to profiles and
+        # to the steady-state transfer discipline
         if isinstance(packed, tuple):       # sharded path: raw field tuple
             assign, scores, placed, n_eval, n_exh, waves = \
-                [np.asarray(x) for x in packed]
+                [np.asarray(x) for x in jax.device_get(packed)]
             assign = assign.astype(np.int32)
         else:
             sparse = all(r.count <= SPARSE_CAP for r in reqs)
             assign, scores, placed, n_eval, n_exh, waves = \
-                unpack_bulk_batch(np.asarray(packed), N, sparse=sparse)
+                unpack_bulk_batch(np.asarray(jax.device_get(packed)), N,
+                                  sparse=sparse)
         # wave-count visibility: a workload that degrades toward one
         # placement per wave shows up here instead of as mystery latency
         self.stats["waves"] += int(np.sum(waves))
@@ -1222,7 +1264,7 @@ class PlacementEngine:
         heavy += [heavy[0]] * (E - len(reqs))   # pads place nothing
         self.stats["cache_hits"] = self._cache.hits
         self.stats["cache_misses"] = self._cache.misses
-        dyn_dev = jax.device_put(dyn)
+        dyn_dev = jax.device_put(dyn)  # analysis: allow(transfer-purity) — per-dispatch dynamic leaf (basis deltas + light blocks): payload that must ship, sent explicitly so the runtime guard stays armed
         packed, _used_final = place_batch_packed_jit(
             cap_dev, used_dev, tuple(heavy), dyn_dev, (G, N, K, Vp1, S, D),
             spread_algorithm=reqs[0].spread_algorithm)

@@ -47,7 +47,16 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from nomad_tpu import chaos
 from nomad_tpu import native as _native
+from nomad_tpu.analysis import race, recompile
+
+# transfer-purity (nomad_tpu.analysis): this module is on the dispatch
+# hot path AND is the one place sanctioned to jax.device_put world bytes
+_TRANSFER_HOT_PATH = True
+_TRANSFER_UPLOAD_SITE = True
+# recompile-budget: every jit site here must be registered by name
+_RECOMPILE_TRACKED = True
 
 # dirty-row buckets: each size is one small compile of the row scatter
 ROW_BUCKETS = (64, 512, 4096)
@@ -82,6 +91,8 @@ def _single_device_fns():
         _add_rank1_fn = jax.jit(
             lambda d, r, c, dem: d.at[r].add(
                 c[:, None].astype(jnp.float32) * dem, mode="drop"))
+        recompile.register("world.set_rows", _set_rows_fn)
+        recompile.register("world.add_rank1", _add_rank1_fn)
     return _set_rows_fn, _add_rank1_fn
 
 
@@ -92,6 +103,11 @@ class DeviceWorld:
     under `self.lock` (warmup dispatches run concurrently with the
     engine thread)."""
 
+    # happens-before (nomad_tpu.analysis): the host snapshot is written
+    # by the plan applier (apply_rank1) and the engine thread (update)
+    # concurrently; both must hold `lock`.  The race detector traces it.
+    _RACE_TRACED = {"_basis_last": "lock"}
+
     def __init__(self, mesh=None):
         self.mesh = mesh
         self.lock = threading.Lock()
@@ -101,7 +117,11 @@ class DeviceWorld:
         self._basis_last: Optional[np.ndarray] = None
         self._basis_dev = None
         self.stats = {"full_uploads": 0, "rows_scattered": 0,
-                      "clean_hits": 0, "rank1_applies": 0}
+                      "clean_hits": 0, "rank1_applies": 0,
+                      # full uploads AFTER the epoch's first (churn
+                      # fallback or injected device loss): the bench's
+                      # steady-state gate asserts this stays 0
+                      "steady_reuploads": 0}
 
     # ------------------------------------------------------------ helpers
 
@@ -122,22 +142,45 @@ class DeviceWorld:
         return jax.device_put(arr) if sh is None \
             else jax.device_put(arr, sh)
 
+    def _put_operands(self, *arrays):
+        """Explicit upload of scatter operands (rows/counts/values).
+        These are the per-update payload — they must ship — but shipping
+        them IMPLICITLY (numpy straight into jit) is exactly what the
+        steady-state transfer guard forbids; on a mesh the operands are
+        replicated to match the serving kernels' P(None) in_specs."""
+        import jax
+        if self.mesh is None:
+            return tuple(jax.device_put(a) for a in arrays)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return tuple(
+            jax.device_put(a, NamedSharding(self.mesh,
+                                            P(*([None] * a.ndim))))
+            for a in arrays)
+
     def _set_rows(self, dev, rows: np.ndarray, vals: np.ndarray):
+        rows_dev, vals_dev = self._put_operands(rows, vals)
         if self.mesh is None:
             fn, _ = _single_device_fns()
-            return fn(dev, rows, vals)
+            return fn(dev, rows_dev, vals_dev)
         from nomad_tpu.parallel.sharded import serving_update_fns
         fn, _ = serving_update_fns(self.mesh)
-        return fn(dev, rows, vals)
+        return fn(dev, rows_dev, vals_dev)
 
     def _update_one(self, host: np.ndarray, last: Optional[np.ndarray],
                     dev) -> Tuple[np.ndarray, object, bool]:
         """Sync one matrix; returns (new snapshot, new device array,
         full-upload?).  Caller holds self.lock."""
+        if chaos.active is not None and \
+                chaos.active.should("world.scatter_fail"):
+            # injected device loss: forget what shipped so this update
+            # falls through to one full re-upload (deterministic
+            # recovery, nothing raises mid-dispatch)
+            last, dev = None, None
         N = host.shape[0]
         B = None
         changed = None
-        if last is not None and last.shape == host.shape:
+        if last is not None and last.shape == host.shape and \
+                dev is not None:
             changed = np.nonzero(np.any(last != host, axis=1))[0]
             if changed.size == 0:
                 self.stats["clean_hits"] += 1
@@ -174,16 +217,22 @@ class DeviceWorld:
                 self.shape = shape
                 self._cap_last = np.array(capacity, dtype=np.float32)
                 self._cap_dev = self._put_full(self._cap_last)
+                race.write("DeviceWorld._basis_last", self)
                 self._basis_last = np.array(basis, dtype=np.float32)
                 self._basis_dev = self._put_full(self._basis_last)
                 self.stats["full_uploads"] += 1
                 return self._cap_dev, self._basis_dev
             self._cap_last, self._cap_dev, full_c = self._update_one(
                 capacity, self._cap_last, self._cap_dev)
+            race.write("DeviceWorld._basis_last", self)
             self._basis_last, self._basis_dev, full_b = self._update_one(
                 basis, self._basis_last, self._basis_dev)
             if full_c or full_b:
                 self.stats["full_uploads"] += 1
+                # a full ship after the epoch's first upload means the
+                # steady state leaked world bytes (churn fallback or an
+                # injected device loss) — the bench gate watches this
+                self.stats["steady_reuploads"] += 1
             return self._cap_dev, self._basis_dev
 
     def apply_rank1(self, rows: np.ndarray, counts: np.ndarray,
@@ -193,6 +242,7 @@ class DeviceWorld:
         jitted twin), keeping them in lockstep so the next update()'s
         diff sees those rows clean."""
         with self.lock:
+            race.write("DeviceWorld._basis_last", self)
             if self._basis_last is None:
                 return                           # next update ships full
             n, r = self._basis_last.shape
@@ -207,17 +257,31 @@ class DeviceWorld:
             d[:min(len(demand), r)] = np.asarray(
                 demand, np.float32)[:r]
             _native.scatter_add_rank1(self._basis_last, rows, counts, d)
+            if chaos.active is not None and \
+                    chaos.active.should("world.scatter_fail"):
+                # injected device loss of the scatter: the host snapshot
+                # above is authoritative; drop the resident basis so the
+                # next update() re-uploads it rather than serving a
+                # basis missing this commit
+                self._basis_dev = None
+                self.stats["chaos_invalidations"] = \
+                    self.stats.get("chaos_invalidations", 0) + 1
+                return
             if self.mesh is None:
                 _, fn = _single_device_fns()
             else:
                 from nomad_tpu.parallel.sharded import serving_update_fns
                 _, fn = serving_update_fns(self.mesh)
-            self._basis_dev = fn(self._basis_dev, rows, counts, d)
+            rows_dev, counts_dev, d_dev = self._put_operands(
+                rows, counts, d)
+            self._basis_dev = fn(self._basis_dev, rows_dev, counts_dev,
+                                 d_dev)
             self.stats["rank1_applies"] += 1
 
     def host_basis(self) -> Optional[np.ndarray]:
         """Copy of the host-side basis snapshot (tests / debugging)."""
         with self.lock:
+            race.read("DeviceWorld._basis_last", self)
             return None if self._basis_last is None \
                 else self._basis_last.copy()
 

@@ -18,6 +18,7 @@ import time as _time
 from collections import defaultdict
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
+from nomad_tpu.analysis import race
 from nomad_tpu.encode.matrixizer import ClusterMatrix
 from nomad_tpu.structs import (
     Allocation,
@@ -140,6 +141,10 @@ class StateStore:
     # happen inside `with <store>._lock:` or a @requires_lock method.
     _LOCK_NAME = "_lock"
     _LOCK_ALIASES = ("_index_cv",)       # Condition wrapping the same RLock
+    # happens-before (nomad_tpu.analysis): the plan-id dedup ring is
+    # mutated by every FSM apply (leader loop, restore replay, tests'
+    # direct commits); the runtime race detector traces it.
+    _RACE_TRACED = {"_applied_plan_ids_set": "_lock"}
     _LOCK_PROTECTED = frozenset({
         "_nodes", "_jobs", "_job_versions", "_evals", "_allocs",
         "_deployments", "_job_summaries", "_allocs_by_job",
@@ -959,6 +964,7 @@ class StateStore:
         `touched` after releasing it."""
         plan_id = getattr(result, "plan_id", "")  # pre-dedup pickles lack it
         if plan_id:
+            race.write("StateStore._applied_plan_ids_set", self)
             if plan_id in self._applied_plan_ids_set:
                 return
             self._applied_plan_ids.append(plan_id)

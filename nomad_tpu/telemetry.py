@@ -60,6 +60,15 @@ class MetricsRegistry:
         with self._lock:
             self._gauges[name] = value
 
+    def set_gauges(self, values: Dict[str, float],
+                   prefix: str = "") -> None:
+        """Bulk gauge publish under ONE lock acquisition — a snapshot
+        reader never sees half of a related set (e.g. the recompile
+        budget's per-kernel counts) from two different instants."""
+        with self._lock:
+            for name, value in values.items():
+                self._gauges[prefix + name] = value
+
     def add_sample(self, name: str, value: float) -> None:
         with self._lock:
             self._samples[name].add(value)

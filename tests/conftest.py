@@ -40,3 +40,24 @@ def _lock_order_guard():
     rec.uninstall()
     cycles = rec.cycles()
     assert not cycles, "\n" + rec.render_cycles()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _race_guard():
+    """NOMAD_TPU_RACE=1 installs the happens-before detector for the
+    whole run: every lock is clock-carrying, every race.read/race.write
+    hook in production code is checked, and the session fails on any
+    unordered access pair or lock-order cycle.  Off by default (vector
+    clocks cost more than the plain lock-order recorder)."""
+    if os.environ.get("NOMAD_TPU_RACE", "0") in ("", "0"):
+        yield
+        return
+    from nomad_tpu.analysis import race as race_mod
+    from nomad_tpu.analysis.race import RaceDetector
+    det = RaceDetector().install()
+    prev, race_mod.active = race_mod.active, det
+    yield
+    race_mod.active = prev
+    det.uninstall()
+    assert det.races == [], "\n" + det.render_races()
+    assert det.cycles() == [], "\n" + det.render_cycles()

@@ -1,6 +1,13 @@
-"""Fixture chaos registry with one dead point."""
+"""Fixture chaos registry with one dead point, a required site pinned
+to a function that does not carry it, and a required point that is not
+registered at all."""
 
 FAULT_POINTS = ("rpc.drop", "plan.crash", "dead.point")
+
+REQUIRED_SITES = {
+    "plan.crash": ("apply_plan",),      # commit_plan fires it, not apply_plan
+    "ghost.point": ("rpc_send",),       # not in FAULT_POINTS
+}
 
 
 class ChaosRegistry:

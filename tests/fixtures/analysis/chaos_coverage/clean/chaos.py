@@ -1,6 +1,8 @@
-"""Registry and sites in agreement."""
+"""Registry and sites in agreement, required site satisfied."""
 
 FAULT_POINTS = ("rpc.drop", "plan.crash")
+
+REQUIRED_SITES = {"plan.crash": ("commit_plan",)}
 
 
 class ChaosRegistry:

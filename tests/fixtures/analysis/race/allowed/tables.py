@@ -1,0 +1,28 @@
+"""Same drift as bad/, suppressed at the declaration and hook lines."""
+import threading
+
+from nomad_tpu.analysis import race
+
+
+class BadDecl:
+    _RACE_TRACED = ["_ring"]                # analysis: allow(happens-before)
+
+    def __init__(self):
+        self._ring = []
+
+
+class Store:
+    _RACE_TRACED = {"_ring": "_lock", "_ghost": "_lock2"}   # analysis: allow(happens-before)
+
+    def __init__(self):
+        self._ring = []
+        self._lock = threading.Lock()
+
+    def put(self, x):
+        with self._lock:
+            race.write("Store._ring", self)
+            self._ring.append(x)
+
+
+def rogue(obj):
+    race.read("Phantom._tbl", obj)          # analysis: allow(happens-before)

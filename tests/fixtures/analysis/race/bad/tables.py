@@ -1,0 +1,30 @@
+"""Seeded happens-before drift: a malformed declaration, a declared
+attribute and lock the class never assigns, a declaration nothing
+traces, and a hook nobody declares."""
+import threading
+
+from nomad_tpu.analysis import race
+
+
+class BadDecl:
+    _RACE_TRACED = ["_ring"]                # not a literal str->str dict
+
+    def __init__(self):
+        self._ring = []
+
+
+class Store:
+    _RACE_TRACED = {"_ring": "_lock", "_ghost": "_lock2"}
+
+    def __init__(self):
+        self._ring = []
+        self._lock = threading.Lock()
+
+    def put(self, x):
+        with self._lock:
+            race.write("Store._ring", self)
+            self._ring.append(x)
+
+
+def rogue(obj):
+    race.read("Phantom._tbl", obj)          # hook nobody declares

@@ -1,0 +1,23 @@
+"""Declaration and hooks in agreement: the traced attribute and its
+lock both exist, every declared key is hooked, every hook is declared."""
+import threading
+
+from nomad_tpu.analysis import race
+
+
+class Store:
+    _RACE_TRACED = {"_ring": "_lock"}
+
+    def __init__(self):
+        self._ring = []
+        self._lock = threading.Lock()
+
+    def put(self, x):
+        with self._lock:
+            race.write("Store._ring", self)
+            self._ring.append(x)
+
+    def snapshot(self):
+        with self._lock:
+            race.read("Store._ring", self)
+            return list(self._ring)

@@ -1,0 +1,12 @@
+"""Same unregistered kernels, suppressed on the jit-site lines."""
+import jax
+
+_RECOMPILE_TRACKED = True
+
+
+@jax.jit
+def scan_kernel(x):                         # analysis: allow(recompile-budget)
+    return x * 2
+
+
+bulk_kernel = jax.jit(lambda x: x + 1)      # analysis: allow(recompile-budget)

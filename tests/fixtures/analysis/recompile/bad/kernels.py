@@ -1,0 +1,13 @@
+"""Two jitted kernels in a tracked module, neither handed to the
+recompile budget registry."""
+import jax
+
+_RECOMPILE_TRACKED = True
+
+
+@jax.jit
+def scan_kernel(x):
+    return x * 2
+
+
+bulk_kernel = jax.jit(lambda x: x + 1)

@@ -1,0 +1,18 @@
+"""Every jit site registered: decorator form via a trailing register,
+assign form via the same."""
+import jax
+
+from nomad_tpu.analysis import recompile
+
+_RECOMPILE_TRACKED = True
+
+
+@jax.jit
+def scan_kernel(x):
+    return x * 2
+
+
+bulk_kernel = jax.jit(lambda x: x + 1)
+
+recompile.register("fixture.scan", scan_kernel)
+recompile.register("fixture.bulk", bulk_kernel)

@@ -1,0 +1,28 @@
+"""Same escapes as bad/, each fenced with the allow comment."""
+import jax
+import numpy as np
+
+_TRANSFER_HOT_PATH = True
+
+
+@jax.jit
+def scatter_kernel(basis, rows):
+    return basis + rows
+
+
+def upload(basis):
+    return jax.device_put(basis)            # analysis: allow(transfer-purity)
+
+
+def drain(out_dev):
+    total = float(out_dev)                  # analysis: allow(transfer-purity)
+    first = out_dev.item()                  # analysis: allow(transfer-purity)
+    host = np.asarray(out_dev)              # analysis: allow(transfer-purity)
+    if out_dev:                             # analysis: allow(transfer-purity)
+        total += 1
+    return total, first, host
+
+
+def dispatch(basis_dev):
+    rows = np.zeros((4, 2), np.float32)
+    return scatter_kernel(basis_dev, rows)  # analysis: allow(transfer-purity)

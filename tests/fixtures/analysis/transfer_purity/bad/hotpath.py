@@ -1,0 +1,30 @@
+"""Seeded transfer-purity violations on a declared hot-path module:
+an unsanctioned upload, four flavors of implicit device->host sync, and
+a numpy operand smuggled into a jitted kernel."""
+import jax
+import numpy as np
+
+_TRANSFER_HOT_PATH = True
+
+
+@jax.jit
+def scatter_kernel(basis, rows):
+    return basis + rows
+
+
+def upload(basis):
+    return jax.device_put(basis)            # not an upload site
+
+
+def drain(out_dev):
+    total = float(out_dev)                  # host coercion
+    first = out_dev.item()                  # .item() sync
+    host = np.asarray(out_dev)              # implicit sync
+    if out_dev:                             # __bool__ sync
+        total += 1
+    return total, first, host
+
+
+def dispatch(basis_dev):
+    rows = np.zeros((4, 2), np.float32)
+    return scatter_kernel(basis_dev, rows)  # implicit host->device

@@ -1,0 +1,27 @@
+"""Hot-path module doing it right: the module is a sanctioned upload
+site, operands ship via explicit device_put, results drain via explicit
+device_get."""
+import jax
+import numpy as np
+
+_TRANSFER_HOT_PATH = True
+_TRANSFER_UPLOAD_SITE = True
+
+
+@jax.jit
+def scatter_kernel(basis, rows):
+    return basis + rows
+
+
+def upload(basis):
+    return jax.device_put(basis)
+
+
+def dispatch(basis_dev):
+    rows = np.zeros((4, 2), np.float32)
+    rows_dev = jax.device_put(rows)
+    return scatter_kernel(basis_dev, rows_dev)
+
+
+def drain(out_dev):
+    return np.asarray(jax.device_get(out_dev))

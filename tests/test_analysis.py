@@ -26,7 +26,10 @@ CASES = [
     ("lock_discipline", "lock-discipline", 1),
     ("native_abi", "native-abi", 5),
     ("jax_purity", "jax-purity", 4),
-    ("chaos_coverage", "chaos-coverage", 2),
+    ("chaos_coverage", "chaos-coverage", 4),
+    ("transfer_purity", "transfer-purity", 6),
+    ("recompile", "recompile-budget", 2),
+    ("race", "happens-before", 5),
 ]
 
 
@@ -197,6 +200,117 @@ def test_lock_order_recorder_uninstall_restores_factories():
     rec = LockOrderRecorder().install()
     rec.uninstall()
     assert threading.Lock is orig_lock and threading.RLock is orig_rlock
+
+
+# ------------------------------------------- runtime happens-before detection
+
+
+@pytest.fixture
+def race_detector():
+    """An installed RaceDetector wired to the module hooks, torn down
+    even on assertion failure (a leaked detector corrupts every later
+    test that allocates a lock)."""
+    from nomad_tpu.analysis import race as race_mod
+    from nomad_tpu.analysis.race import RaceDetector
+    det = RaceDetector().install()
+    prev, race_mod.active = race_mod.active, det
+    try:
+        yield race_mod, det
+    finally:
+        race_mod.active = prev
+        det.uninstall()
+
+
+def test_race_detector_flags_unlocked_writes(race_detector):
+    race_mod, det = race_detector
+    gate = threading.Barrier(2)
+
+    def unlocked():
+        gate.wait()
+        for _ in range(100):
+            race_mod.write("Demo._tbl", None)
+
+    ts = [threading.Thread(target=unlocked) for _ in range(2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert det.races
+    rendered = det.races[0].render()
+    assert "Demo._tbl" in rendered and "unordered" in rendered
+
+
+def test_race_detector_locked_writes_are_clean(race_detector):
+    race_mod, det = race_detector
+    lk = threading.Lock()       # allocated under install() -> wrapped
+    gate = threading.Barrier(2)
+
+    def locked():
+        gate.wait()
+        for _ in range(100):
+            with lk:
+                race_mod.write("Demo._tbl", None)
+
+    ts = [threading.Thread(target=locked) for _ in range(2)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert det.races == [], det.render_races()
+    assert det.cycles() == []
+
+
+def test_race_detector_fork_join_orders_accesses(race_detector):
+    race_mod, det = race_detector
+    race_mod.write("Demo._tbl", None)
+    t = threading.Thread(target=lambda: race_mod.write("Demo._tbl", None))
+    t.start()
+    t.join()
+    race_mod.write("Demo._tbl", None)
+    assert det.races == [], det.render_races()
+
+
+def test_race_detector_condition_handoff_is_clean(race_detector):
+    """Producer writes under the condition, consumer reads after wait:
+    the wrapped RLock's _release_save/_acquire_restore pair must carry
+    the clocks through the wait."""
+    race_mod, det = race_detector
+    cv = threading.Condition(threading.RLock())
+    ready = []
+
+    def producer():
+        with cv:
+            race_mod.write("Demo._q", None)
+            ready.append(1)
+            cv.notify()
+
+    def consumer():
+        with cv:
+            while not ready:
+                cv.wait(timeout=5.0)
+            race_mod.read("Demo._q", None)
+
+    tc = threading.Thread(target=consumer)
+    tp = threading.Thread(target=producer)
+    tc.start()
+    tp.start()
+    tc.join()
+    tp.join()
+    assert det.races == [], det.render_races()
+
+
+def test_race_detector_uninstall_restores_patches():
+    from nomad_tpu.analysis.race import RaceDetector
+    orig = (threading.Lock, threading.RLock,
+            threading.Thread.start, threading.Thread.join)
+    det = RaceDetector().install()
+    det.uninstall()
+    assert (threading.Lock, threading.RLock,
+            threading.Thread.start, threading.Thread.join) == orig
+
+
+def test_race_hooks_tolerate_missing_detector():
+    """Production hooks must be safe (and near-free) with no detector
+    installed — they run unconditionally on the hot path."""
+    from nomad_tpu.analysis import race as race_mod
+    race_mod.read("Demo._tbl", None)
+    race_mod.write("Demo._tbl", None)
 
 
 # ------------------------------------------------------ FSM replay determinism

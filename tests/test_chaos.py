@@ -309,6 +309,45 @@ def test_expired_lease_auto_nacks_and_redelivers():
     assert broker.ack(ev.id, token2) is True
 
 
+# ------------------------------------------------ device-world scatter loss
+
+
+def test_world_scatter_fail_invalidates_then_reuploads():
+    """Injected loss of the device-side rank-1 scatter: the host
+    snapshot keeps the commit (it is authoritative), the resident basis
+    is dropped rather than served stale, and the next update() restores
+    device parity with one full re-upload — counted as a steady-state
+    re-upload, which is how the bench gate sees injected device loss."""
+    import jax
+
+    from nomad_tpu.parallel.world import DeviceWorld
+
+    N, R = 16, 4
+    world = DeviceWorld(mesh=None)
+    capacity = np.full((N, R), 100.0, np.float32)
+    world.update(capacity, np.zeros((N, R), np.float32))
+
+    rows = np.array([0, 3], np.int32)
+    demand = np.array([5.0, 2.0, 0.0, 0.0], np.float32)
+    chaos.install(ChaosRegistry(seed=3, rates={"world.scatter_fail": 1.0}))
+    try:
+        world.apply_rank1(rows, np.ones(2, np.int32), demand)
+    finally:
+        chaos.uninstall()
+
+    expect = np.zeros((N, R), np.float32)
+    expect[rows] = demand
+    np.testing.assert_array_equal(world.host_basis(), expect)
+    assert world.stats["chaos_invalidations"] == 1
+    _, basis_dev = world.device_arrays()
+    assert basis_dev is None
+
+    _, basis_dev = world.update(capacity, expect)
+    got = np.asarray(jax.device_get(basis_dev))
+    np.testing.assert_array_equal(got, expect)
+    assert world.stats["steady_reuploads"] == 1
+
+
 # -------------------------------------------------- worker retry surfaces
 
 

@@ -23,7 +23,7 @@ PLANS_PER_THREAD = 20
 N_NODES = 32
 
 
-def test_concurrent_submitters_no_lost_or_duplicate_allocs():
+def _run_commit_stress():
     store = StateStore()
     nodes = [mock.node() for _ in range(N_NODES)]
     for i, n in enumerate(nodes):
@@ -108,6 +108,34 @@ def test_concurrent_submitters_no_lost_or_duplicate_allocs():
     finally:
         stop.set()
         loop.join(2)
+
+
+def test_concurrent_submitters_no_lost_or_duplicate_allocs():
+    _run_commit_stress()
+
+
+def test_commit_stress_is_race_free():
+    """The same 16-thread stress with the happens-before detector armed:
+    every lock the pipeline allocates carries a vector clock and every
+    race.read/race.write hook on the traced tables (store dedup ring,
+    applier overlay, broker leases, world snapshot) is checked for
+    unordered access pairs.  Equivalent to running this file under
+    NOMAD_TPU_RACE=1, but always on, so a dropped lock acquisition on
+    the commit path fails tier-1 rather than only the chaos CI leg."""
+    from nomad_tpu.analysis import race as race_mod
+    from nomad_tpu.analysis.race import RaceDetector
+
+    if race_mod.active is not None:
+        pytest.skip("session-level race guard already installed")
+    det = RaceDetector().install()
+    race_mod.active = det
+    try:
+        _run_commit_stress()
+    finally:
+        race_mod.active = None
+        det.uninstall()
+    assert det.races == [], "\n" + det.render_races()
+    assert det.cycles() == [], "\n" + det.render_cycles()
 
 
 def test_bench_smoke_leg():
