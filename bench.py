@@ -20,6 +20,7 @@ on stdout.
 import json
 import os
 import sys
+import threading
 import time
 
 if os.environ.get("BENCH_FORCE_CPU") == "1":
@@ -405,6 +406,168 @@ def bench_smoke(workers=8):
                         scenario="smoke")
 
 
+def bench_serving_plane(n_watchers=1200, n_blockers=12, idle_samples=200,
+                        busy_samples=400, scenario="serving_plane"):
+    """Serving-plane scenario: N concurrent event watchers (bounded
+    broker subscriptions) plus follower blocking queries over HTTP on a
+    3-server cluster, while a commit spine registers jobs through the
+    leader.  Reports follower lease-read p50/p99 idle vs busy and the
+    broker's drop/eviction counters; the hard invariant is that no
+    subscriber queue ever exceeds its bound (zero unbounded growth)."""
+    from nomad_tpu import mock
+    from nomad_tpu.agent.http import HTTPServer
+    from nomad_tpu.core.cluster import Cluster
+
+    class _Shim:
+        """agent surface for a per-server HTTP listener"""
+
+        def __init__(self, server):
+            self.server = server
+
+        def rpc(self, method, args, consistency=None):
+            return self.server.rpc_leader(method, args)
+
+    c = Cluster(3)
+    c.start()
+    stop = threading.Event()
+    threads = []
+    http = None
+    try:
+        leader = c.leader()
+        follower = c.followers()[0]
+        deadline = time.time() + 30.0
+        while not leader.raft.lease_valid() and time.time() < deadline:
+            time.sleep(0.02)
+
+        # watchers: bounded subscriptions on the follower's broker —
+        # subscriptions are objects, not threads, so >=1K of them is
+        # cheap; a small consumer pool drains them round-robin
+        subs = [follower.event_broker.subscribe({"*": ["*"]}, max_queue=64)
+                for _ in range(n_watchers)]
+        consumed = [0] * 4
+
+        def drain(slot, chunk):
+            while not stop.is_set():
+                idle = True
+                for sub in chunk:
+                    while True:
+                        ev = sub.next(timeout=0.0)
+                        if ev is None:
+                            break
+                        idle = False
+                        consumed[slot] += 1
+                if idle:
+                    time.sleep(0.005)
+
+        for k in range(4):
+            t = threading.Thread(target=drain, args=(k, subs[k::4]),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+
+        # follower blocking queries through the real HTTP path
+        # (?index&wait): each loop parks on the follower's store index
+        # and must wake with a reply index >= the one it gave
+        http = HTTPServer(_Shim(follower), port=0)
+        http.start()
+        wakeups = [0] * n_blockers
+        block_errs = [0]
+
+        def blocker(slot):
+            import urllib.request
+            while not stop.is_set():
+                idx = follower.store.latest_index
+                url = (f"http://127.0.0.1:{http.port}/v1/jobs"
+                       f"?index={idx}&wait=300ms")
+                try:
+                    with urllib.request.urlopen(url, timeout=15.0) as r:
+                        got = int(r.headers["X-Nomad-Index"])
+                        r.read()
+                    if got < idx:
+                        block_errs[0] += 1
+                    wakeups[slot] += 1
+                except Exception:       # noqa: BLE001
+                    if not stop.is_set():
+                        block_errs[0] += 1
+
+        for k in range(n_blockers):
+            t = threading.Thread(target=blocker, args=(k,), daemon=True)
+            t.start()
+            threads.append(t)
+
+        def sample(n):
+            lats = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                follower.read("Job.List", {}, consistency="default")
+                lats.append(time.perf_counter() - t0)
+            lats.sort()
+            return lats
+
+        # idle baseline: watchers + blockers attached, no commit spine
+        # (a short discarded warmup absorbs first-read cold paths so the
+        # idle p99 is a real steady-state denominator)
+        sample(20)
+        idle = sample(idle_samples)
+
+        # commit spine on the leader (register -> eval -> schedule ->
+        # raft commit -> store apply -> broker publish on every server)
+        def spine():
+            while not stop.is_set():
+                j = mock.batch_job()
+                j.task_groups[0].count = 10
+                try:
+                    leader.register_job(j)
+                except Exception:       # noqa: BLE001
+                    pass
+                time.sleep(0.002)
+
+        t = threading.Thread(target=spine, daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(0.3)                 # let the spine reach the broker
+        busy = sample(busy_samples)
+
+        stop.set()
+        for t in threads:
+            t.join(10.0)
+
+        st = follower.event_broker.stats()
+        max_q = max((s["queue_len"] for s in st["subs"]), default=0)
+        bounded = all(s["queue_len"] <= s["max_queue"] for s in st["subs"])
+        result = {
+            "watchers": n_watchers,
+            "blockers": n_blockers,
+            "events_consumed": sum(consumed),
+            "blocking_wakeups": sum(wakeups),
+            "blocking_errors": block_errs[0],
+            "read_p50_idle_ms": round(idle[len(idle) // 2] * 1000, 3),
+            "read_p99_idle_ms": round(idle[int(len(idle) * .99)] * 1000, 3),
+            "read_p50_busy_ms": round(busy[len(busy) // 2] * 1000, 3),
+            "read_p99_busy_ms": round(busy[int(len(busy) * .99)] * 1000, 3),
+            "dropped": sum(s["dropped"] for s in st["subs"]),
+            "evictions": sum(s["evictions"] for s in st["subs"]),
+            "max_queue_len": max_q,
+            "bounded": bounded,
+            "lease_reads": True,
+        }
+        log(f"{scenario}: {n_watchers} watchers / {n_blockers} blockers; "
+            f"read p50/p99 idle {result['read_p50_idle_ms']}/"
+            f"{result['read_p99_idle_ms']} ms, busy "
+            f"{result['read_p50_busy_ms']}/{result['read_p99_busy_ms']} ms; "
+            f"consumed {result['events_consumed']} events, "
+            f"{result['blocking_wakeups']} blocking wakeups, "
+            f"dropped {result['dropped']} (evictions "
+            f"{result['evictions']}), max queue {max_q}, "
+            f"bounded={bounded}")
+        return result
+    finally:
+        stop.set()
+        if http is not None:
+            http.stop()
+        c.stop()
+
+
 def bench_scan_spread(n_nodes=10000, n_jobs=60, count=100, workers=48):
     """The SCAN path at C2M shape: spread+affinity service jobs (the
     workload class the bulk wavefront excludes — spreads are active), so
@@ -655,6 +818,14 @@ def main():
         # CI leg: the same shape in seconds (tier-1 invokes this)
         rate, placed, want = bench_smoke()
         steady = _STEADY_STATE.get("smoke", {})
+        # serving-plane leg rides the smoke run: >=1K watchers +
+        # follower blocking queries on a 3-server cluster while the
+        # spine commits.  Hard-fails on unbounded subscriber queues or
+        # busy read p99 blowing past 2x idle (5 ms floor absorbs CI
+        # scheduler jitter on shared CPU runners).
+        serving = bench_serving_plane(
+            n_watchers=1024, n_blockers=8,
+            idle_samples=150, busy_samples=300)
         print(json.dumps({
             "metric": "c2m_smoke_allocs_per_sec",
             "value": round(rate, 1),
@@ -664,9 +835,19 @@ def main():
             "want": want,
             "plan_latency_ms": _PLAN_STATS,
             "steady_state": steady,
+            "serving_plane": serving,
         }), flush=True)
         if steady.get("violations"):
             log("steady-state violations:", steady["violations"])
+            sys.exit(1)
+        if not serving["bounded"]:
+            log("serving_plane: subscriber queue exceeded its bound")
+            sys.exit(1)
+        p99_cap = max(2 * serving["read_p99_idle_ms"], 5.0)
+        if serving["read_p99_busy_ms"] > p99_cap:
+            log(f"serving_plane: busy read p99 "
+                f"{serving['read_p99_busy_ms']} ms exceeds cap "
+                f"{p99_cap:.1f} ms (2x idle, 5 ms floor)")
             sys.exit(1)
         return
 
@@ -696,6 +877,12 @@ def main():
     except Exception as e:          # noqa: BLE001
         log("kernel_100k bench failed:", e)
 
+    serving = {}
+    try:
+        serving = bench_serving_plane()
+    except Exception as e:          # noqa: BLE001
+        log("serving_plane bench failed:", e)
+
     if os.environ.get("BENCH_ALL") == "1":
         # the full BASELINE.json scenario suite (tens of minutes)
         for name, fn in (("e2e_spine", bench_e2e_spine),
@@ -716,6 +903,7 @@ def main():
         "vs_baseline": round(rate / target, 4),
         "plan_latency_ms": _PLAN_STATS,
         "steady_state": _STEADY_STATE,
+        "serving_plane": serving,
     }), flush=True)
 
 

@@ -149,9 +149,19 @@ class Agent:
     def http_addr(self) -> str:
         return f"http://{self.http.host}:{self.http.port}"
 
-    def rpc(self, method: str, args: dict):
+    def rpc(self, method: str, args: dict,
+            consistency: Optional[str] = None):
         """In-process RPC into the embedded server (the agent's RPC
-        client; reference command/agent/agent.go RPC passthrough)."""
+        client; reference command/agent/agent.go RPC passthrough).
+
+        With `consistency` set and a read method, the request is served
+        from THIS server's store at a gate-established read point
+        (follower reads) instead of forwarding to the leader."""
         if self.server is None:
             raise RuntimeError("agent has no server")
+        if consistency is not None:
+            from nomad_tpu.serving.gate import READ_METHODS
+            if method in READ_METHODS:
+                result, _ctx = self.server.read(method, args, consistency)
+                return result
         return self.server.rpc_leader(method, args)

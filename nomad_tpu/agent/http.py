@@ -22,6 +22,7 @@ from typing import Optional
 
 from nomad_tpu.api.codec import from_wire, to_wire
 from nomad_tpu.rpc.endpoints import RpcError
+from nomad_tpu.serving import EventStreamer, READ_METHODS, mode_from_query
 from nomad_tpu.structs import Job
 from nomad_tpu.telemetry import global_metrics
 
@@ -71,7 +72,8 @@ class HTTPServer:
 
             do_GET = do_PUT = do_POST = do_DELETE = _dispatch
 
-            def _reply(self, code: int, obj, index: Optional[int] = None):
+            def _reply(self, code: int, obj, index: Optional[int] = None,
+                       ctx=None):
                 body = json.dumps(obj).encode()
                 try:
                     self.send_response(code)
@@ -79,6 +81,15 @@ class HTTPServer:
                     self.send_header("Content-Length", str(len(body)))
                     if index is not None:
                         self.send_header("X-Nomad-Index", str(index))
+                    if ctx is not None:
+                        # staleness metadata from the read gate
+                        # (reference setMeta, command/agent/http.go)
+                        self.send_header(
+                            "X-Nomad-KnownLeader",
+                            "true" if ctx.known_leader else "false")
+                        self.send_header(
+                            "X-Nomad-LastContact",
+                            str(int(ctx.last_contact_ms)))
                     self.end_headers()
                     self.wfile.write(body)
                 except (BrokenPipeError, ConnectionResetError):
@@ -116,7 +127,9 @@ class HTTPServer:
 
     def _route(self, h) -> None:
         url = urllib.parse.urlparse(h.path)
-        q = {k: v[-1] for k, v in urllib.parse.parse_qs(url.query).items()}
+        # keep_blank_values: bare flags like `?consistent` must survive
+        q = {k: v[-1] for k, v in urllib.parse.parse_qs(
+            url.query, keep_blank_values=True).items()}
         parts = [urllib.parse.unquote(p)
                  for p in url.path.split("/") if p]
         if not parts or parts[0] != "v1":
@@ -129,7 +142,27 @@ class HTTPServer:
         self._check_acl(parts, method, token,
                         q.get("namespace", "default"), h)
 
-        store = self.agent.server.store if self.agent.server else None
+        server = self.agent.server
+        store = server.store if server else None
+        read_ctx = None
+        if server is not None and method == "GET":
+            # establish the read point for this request's consistency
+            # mode BEFORE any blocking wait: `?consistent` pays a quorum
+            # round, default rides the leader lease, `?stale` serves
+            # whatever the local store has right now
+            mode = mode_from_query(q)
+            gate_timeout = 2.0
+            if "index" in q:
+                # blocking queries bound the whole request by `wait`
+                gate_timeout = min(_parse_wait(q.get("wait", "5s")), 600.0)
+            try:
+                read_ctx = server.serving_gate.begin_read(
+                    mode, timeout=gate_timeout)
+            except Exception as e:              # noqa: BLE001
+                # vacant or unreachable leadership: linearizable reads
+                # fail fast rather than serving possibly-stale data
+                raise HTTPError(503, f"read gate ({mode}): "
+                                     f"{type(e).__name__}: {e}")
         if store is not None and "index" in q:
             min_index = int(q["index"])
             wait = _parse_wait(q.get("wait", "5s"))
@@ -149,10 +182,19 @@ class HTTPServer:
             raise HTTPError(404, f"no handler for {method} {url.path}")
         result = handler(h, parts, q)
         if result is not _STREAMED:
-            h._reply(200, to_wire(result),
-                     index=store.latest_index if store else None)
+            index = store.latest_index if store else None
+            if index is not None and "index" in q:
+                # a blocking query must never return an index lower than
+                # the one it was given (reference blockingRPC contract)
+                index = max(index, int(q["index"]))
+            h._reply(200, to_wire(result), index=index, ctx=read_ctx)
 
     def _rpc(self, method: str, args: dict):
+        server = self.agent.server
+        if server is not None and method in READ_METHODS:
+            # the read point was established by _route's gate: serve from
+            # the LOCAL store, leader and follower alike (follower reads)
+            return server.endpoints.handle(method, args)
         return self.agent.rpc(method, args)
 
     # ------------------------------------------------------------ ACL
@@ -826,31 +868,28 @@ class HTTPServer:
                 topics.setdefault(topic, []).append(key or "*")
         if not topics:
             topics = {"*": ["*"]}
-        h_acl = getattr(h, "acl", None)
         acl_on = getattr(self.agent.server, "acl_enabled", False)
         sub = self.agent.server.event_broker.subscribe(
             topics, from_index=int(q.get("index", 0)))
+        filter_fn = None
+        if acl_on:
+            filter_fn = (lambda ev: not ev.namespace
+                         or self._ns_visible(h, ev.namespace))
+        heartbeat = _parse_wait(q["heartbeat"]) if "heartbeat" in q else None
+        streamer = EventStreamer(sub, heartbeat=heartbeat,
+                                 filter_fn=filter_fn)
         try:
             h.send_response(200)
             h.send_header("Content-Type", "application/json")
             h.send_header("Transfer-Encoding", "chunked")
             h.end_headers()
-            deadline = time.time() + float(q.get("timeout", 5.0))
-            while time.time() < deadline:
-                ev = sub.next(timeout=0.25)
-                if ev is not None and acl_on and ev.namespace and \
-                        not self._ns_visible(h, ev.namespace):
-                    ev = None               # filtered by namespace grant
-                if ev is None:
-                    chunk = b"{}\n"         # heartbeat (reference sends {})
-                else:
-                    d = ev.to_dict()
-                    d["Payload"] = to_wire(d["Payload"])
-                    chunk = (json.dumps(
-                        {"Index": ev.index, "Events": [d]}) + "\n").encode()
+
+            def write(chunk: bytes) -> None:
                 h.wfile.write(hex(len(chunk))[2:].encode() + b"\r\n"
                               + chunk + b"\r\n")
                 h.wfile.flush()
+
+            streamer.run(write, float(q.get("timeout", 5.0)))
             h.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
             pass

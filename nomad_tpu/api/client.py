@@ -39,14 +39,23 @@ class ApiClient:
     def __init__(self, address: str = "http://127.0.0.1:4646",
                  token: str = "", namespace: str = "default",
                  timeout: float = 30.0, retries: int = 2,
-                 retry_backoff: float = 0.1):
+                 retry_backoff: float = 0.1,
+                 consistency: Optional[str] = None):
         self.address = address.rstrip("/")
         self.token = token
         self.namespace = namespace
         self.timeout = timeout
         self.retries = retries
         self.retry_backoff = retry_backoff
+        # client-wide read consistency: None/"default" (leader lease),
+        # "stale" (any server, immediate), "consistent" (full read-index);
+        # per-call `consistency=` kwargs on get() override it
+        self.consistency = consistency
         self.last_index = 0
+        # staleness metadata from the most recent read (the reference's
+        # QueryMeta.LastContact / KnownLeader)
+        self.last_contact_ms = 0
+        self.known_leader = True
         self.jobs = Jobs(self)
         self.nodes = Nodes(self)
         self.evaluations = Evaluations(self)
@@ -64,8 +73,16 @@ class ApiClient:
 
     def _request(self, method: str, path: str,
                  params: Optional[Dict[str, str]] = None,
-                 body: Any = None, raw: bool = False):
+                 body: Any = None, raw: bool = False,
+                 consistency: Optional[str] = None):
         qs = dict(params or {})
+        if method == "GET":
+            mode = consistency if consistency is not None \
+                else self.consistency
+            if mode == "stale":
+                qs.setdefault("stale", "true")
+            elif mode == "consistent":
+                qs.setdefault("consistent", "true")
         url = f"{self.address}{path}"
         if qs:
             url += "?" + urllib.parse.urlencode(
@@ -88,6 +105,10 @@ class ApiClient:
                     payload = resp.read()
                     self.last_index = int(
                         resp.headers.get("X-Nomad-Index") or 0)
+                    self.last_contact_ms = int(
+                        resp.headers.get("X-Nomad-LastContact") or 0)
+                    self.known_leader = \
+                        resp.headers.get("X-Nomad-KnownLeader") != "false"
                 break
             except urllib.error.HTTPError as e:
                 body_text = e.read().decode(errors="replace")
@@ -111,8 +132,8 @@ class ApiClient:
             return payload
         return json.loads(payload) if payload else None
 
-    def get(self, path, params=None):
-        return self._request("GET", path, params)
+    def get(self, path, params=None, consistency=None):
+        return self._request("GET", path, params, consistency=consistency)
 
     def put(self, path, body=None, params=None):
         return self._request("PUT", path, params, body)

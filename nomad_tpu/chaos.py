@@ -53,6 +53,19 @@ Fault points and their injection sites:
                               (complete_many) stalls `delay_ms` before
                               taking the overlay lock, widening the
                               window where commits race dispatch
+    read.lease_expire         raft/node.py — a leader's read lease is
+                              voided at read time, forcing the full
+                              heartbeat quorum confirmation round (the
+                              slow path every lease read elides)
+    read.index_stall          raft/node.py — the leadership-confirmation
+                              round stalls `delay_ms` before probing,
+                              stretching read_index latency so batched
+                              readers pile onto one round
+    stream.subscriber_stall   serving/stream.py — the NDJSON event
+                              streamer stalls `delay_ms` mid-write, as
+                              if a consumer stopped reading: the broker
+                              must bound the queue and evict/catch-up,
+                              never grow without limit
 
 `REQUIRED_SITES` pins points to the hot-path functions that must carry
 them; the chaos-coverage linter fails if a refactor drops one.
@@ -86,14 +99,21 @@ FAULT_POINTS = (
     "snapshot.partial_write",
     "world.scatter_fail",
     "engine.complete_delay",
+    "read.lease_expire",
+    "read.index_stall",
+    "stream.subscriber_stall",
 )
 
 # Points that must be injected in these specific functions (enforced by
-# the chaos-coverage linter): the PR 6 scatter/commit hot paths.
+# the chaos-coverage linter): the PR 6 scatter/commit hot paths and the
+# PR 8 serving-plane read/stream paths.
 REQUIRED_SITES = {
     "world.scatter_fail": ("DeviceWorld.apply_rank1",
                            "DeviceWorld._update_one"),
     "engine.complete_delay": ("PlacementEngine.complete_many",),
+    "read.lease_expire": ("RaftNode.read_index",),
+    "read.index_stall": ("RaftNode._confirm_leadership",),
+    "stream.subscriber_stall": ("EventStreamer.run",),
 }
 
 

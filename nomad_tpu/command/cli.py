@@ -639,6 +639,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-token", default=os.environ.get("NOMAD_TOKEN", ""))
     p.add_argument("-namespace", default=os.environ.get(
         "NOMAD_NAMESPACE", "default"))
+    # consistency mode for reads (reference -stale / -consistent): stale
+    # lets any server answer from its local store; consistent forces a
+    # full raft read-index round; default is leader lease reads
+    p.add_argument("-stale", action="store_true",
+                   help="allow any server to answer without forwarding")
+    p.add_argument("-consistent", action="store_true",
+                   help="force a fully linearizable read-index read")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     ag = sub.add_parser("agent", help="run an agent")
@@ -882,8 +889,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
     args = build_parser().parse_args(argv)
+    consistency = ("stale" if getattr(args, "stale", False) else
+                   "consistent" if getattr(args, "consistent", False)
+                   else None)
     api = ApiClient(address=args.address, token=args.token,
-                    namespace=args.namespace)
+                    namespace=args.namespace, consistency=consistency)
     cli = Cli(api, out=out)
     try:
         return getattr(cli, args.fn)(args)

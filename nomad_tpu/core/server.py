@@ -32,6 +32,7 @@ from nomad_tpu.core.periodic import PeriodicDispatcher
 from nomad_tpu.core.plan_apply import PlanApplier
 from nomad_tpu.core.plan_queue import PlanQueue
 from nomad_tpu.core.secrets import SecretsProvider
+from nomad_tpu.serving.gate import ReadGate
 from nomad_tpu.core.worker import Worker
 from nomad_tpu.raft import (
     DurableMeta,
@@ -120,6 +121,10 @@ class Server:
         self._transport = raft_transport
         from nomad_tpu.rpc.endpoints import Endpoints
         self.endpoints = Endpoints(self)
+        # consistency-mode read gate: every server (leader or follower)
+        # serves reads from its LOCAL store once the gate establishes a
+        # read point (serving/gate.py)
+        self.serving_gate = ReadGate(self)
         self.membership = membership   # gossip (core.membership), optional
         # multi-region federation: region -> peer handle (a Server object
         # for in-process federation, or a server NAME reachable over the
@@ -182,6 +187,18 @@ class Server:
             from nomad_tpu.rpc.endpoints import RpcError
             raise RpcError("no_leader", "no cluster leader")
         return self._transport.call(self.name, f"rpc:{leader}", method, args)
+
+    # ------------------------------------------------------------- reads
+
+    def read(self, method: str, args: dict,
+             consistency: str = "default", timeout: float = 5.0):
+        """Serve a read RPC from THIS server's store at a gate-established
+        read point; returns (result, ReadContext).  This is the follower-
+        read path: nothing here touches the leader beyond what the
+        consistency mode requires (zero rounds for a valid lease, one
+        forwarded ReadIndex RPC otherwise, nothing at all for stale)."""
+        ctx = self.serving_gate.begin_read(consistency, timeout)
+        return self.endpoints.handle(method, args), ctx
 
     # ------------------------------------------------------------- regions
 

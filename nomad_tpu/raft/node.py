@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from nomad_tpu import chaos
 from nomad_tpu.raft.log import LogEntry, LogStore
 from nomad_tpu.raft.meta import DurableMeta, MetaPersistError
 from nomad_tpu.raft.snapshot import FileSnapshotStore
@@ -36,16 +37,39 @@ class NotLeaderError(Exception):
         self.leader = leader
 
 
+class _ReadBatch:
+    """One in-flight leadership-confirmation round shared by every reader
+    that arrived while it ran (reference raft ReadOnlyQueue batching): the
+    first reader runs the heartbeat quorum round, concurrent readers wait
+    on `event` and share the captured commit index."""
+
+    __slots__ = ("index", "ok", "event")
+
+    def __init__(self, index: int):
+        self.index = index          # commit_index captured BEFORE the round
+        self.ok = False             # quorum confirmed leadership at our term
+        self.event = threading.Event()
+
+
 class RaftConfig:
     def __init__(self,
                  heartbeat_interval: float = 0.05,
                  election_timeout: float = 0.2,
                  snapshot_threshold: int = 2048,
-                 max_append_entries: int = 128):
+                 max_append_entries: int = 128,
+                 lease_clock_skew: float = 0.25):
         self.heartbeat_interval = heartbeat_interval
         self.election_timeout = election_timeout
         self.snapshot_threshold = snapshot_threshold
         self.max_append_entries = max_append_entries
+        # leader-lease safety margin: a lease anchored at a quorum ack
+        # round lasts election_timeout * (1 - skew).  Stickiness means a
+        # new leader needs a full election_timeout of quorum silence
+        # first, so with any skew > 0 a deposed leader's lease expires
+        # strictly before a successor can win — even with clocks drifting
+        # by up to `lease_clock_skew` of the timeout (reference
+        # consul/nomad LeaderLeaseTimeout < ElectionTimeout).
+        self.lease_clock_skew = lease_clock_skew
 
 
 class RaftNode:
@@ -83,7 +107,20 @@ class RaftNode:
         self._match_index: Dict[str, int] = {}
         self._futures: Dict[int, concurrent.futures.Future] = {}
         self._last_contact = time.monotonic()
+        # leader lease (read path): _ack_round_start[peer] is the send
+        # time of the last append round that peer successfully acked; the
+        # lease anchors at the majority-th newest of those (self counts as
+        # "now") and extends election_timeout * (1 - lease_clock_skew)
+        self._ack_round_start: Dict[str, float] = {}
+        self._lease_until = 0.0
+        self._read_batch: Optional[_ReadBatch] = None
+        self.read_rounds = 0        # confirmation rounds run (telemetry)
         self._stop = threading.Event()
+        # commit advancement wakes the ticker (hashicorp/raft's per-peer
+        # notify channel): followers learn the new commit index on an
+        # immediate round instead of waiting out the heartbeat interval,
+        # which is what keeps follower read-index waits short under load
+        self._commit_event = threading.Event()
         self._apply_cv = threading.Condition(self._lock)
         self._fsm_lock = threading.Lock()   # serializes fsm.apply/restore
         # leadership transitions execute strictly in order through one
@@ -122,6 +159,7 @@ class RaftNode:
 
     def stop(self) -> None:
         self._stop.set()
+        self._commit_event.set()      # unblock a ticker mid-wait
         with self._apply_cv:
             self._apply_cv.notify_all()
         self.transport.deregister(self.name)
@@ -136,6 +174,7 @@ class RaftNode:
         snapshot files are left exactly as last durably written; restart
         by constructing a fresh node over the same paths."""
         self._stop.set()
+        self._commit_event.set()
         with self._apply_cv:
             self._apply_cv.notify_all()
         self.transport.deregister(self.name)
@@ -215,6 +254,142 @@ class RaftNode:
                     return
             time.sleep(0.005)
 
+    # ------------------------------------------------------------- reads
+
+    def read_index(self, timeout: float = 5.0,
+                   lease_ok: bool = True) -> int:
+        """Linearizable read point (Raft §6.4 ReadIndex + leader lease).
+
+        On the leader: return commit_index after proving leadership — via
+        a still-valid lease (zero network rounds, `lease_ok=True`) or one
+        empty-AppendEntries quorum round shared by every reader that
+        arrives while it runs.  `lease_ok=False` (the `?consistent` mode)
+        always pays the round.  On a follower: raises NotLeaderError —
+        the serving gate forwards to the leader, then waits locally via
+        `wait_applied(index)`."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            if chaos.should("read.lease_expire"):
+                self._lease_until = 0.0
+            if lease_ok and (not self.peers
+                             or time.monotonic() < self._lease_until):
+                return self.commit_index
+            if not self.peers:
+                return self.commit_index   # single voter: trivially leader
+            batch = self._read_batch
+            runs_round = batch is None
+            if runs_round:
+                batch = self._read_batch = _ReadBatch(self.commit_index)
+            term = self.term
+        if runs_round:
+            try:
+                self._confirm_leadership(batch, term)
+            finally:
+                with self._lock:
+                    if self._read_batch is batch:
+                        self._read_batch = None
+                batch.event.set()
+        else:
+            batch.event.wait(max(0.0, deadline - time.monotonic()))
+        if not batch.event.is_set():
+            raise TimeoutError("raft: read_index confirmation timed out")
+        if not batch.ok:
+            with self._lock:
+                raise NotLeaderError(self.leader_id)
+        return batch.index
+
+    def _confirm_leadership(self, batch: _ReadBatch, term: int) -> None:
+        """One empty heartbeat round: a majority acking at `term` proves no
+        higher-term leader existed when `batch.index` was captured, so
+        serving reads at that index is linearizable.  Successful acks also
+        refresh the lease, so a burst of `?consistent` reads leaves the
+        default mode round-free."""
+        chaos.maybe_delay("read.index_stall")
+        self.read_rounds += 1
+        start = time.monotonic()
+        acks = 1                                    # self
+        for peer in self.peers:
+            with self._lock:
+                if self.state != LEADER or self.term != term:
+                    return                          # deposed mid-round
+                commit = self.commit_index
+            try:
+                # prev_log_index=0 skips the consistency check: this is a
+                # pure leadership probe, not replication
+                resp = self.transport.call(self.name, peer,
+                                           "append_entries", {
+                    "term": term, "leader": self.name,
+                    "prev_log_index": 0, "prev_log_term": 0,
+                    "entries": [], "leader_commit": commit})
+            except Unreachable:
+                continue
+            except Exception:                       # noqa: BLE001
+                log.warning("raft: %s read probe to %s failed",
+                            self.name, peer, exc_info=True)
+                continue
+            with self._lock:
+                if resp["term"] > self.term:
+                    self._step_down(resp["term"])
+                    return
+                if self.state != LEADER or self.term != term:
+                    return
+                if resp.get("success"):
+                    acks += 1
+                    self._ack_round_start[peer] = start
+                    self._refresh_lease()
+        if acks * 2 > len(self.peers) + 1:
+            batch.ok = True
+
+    def _refresh_lease(self) -> None:
+        """Re-anchor the leader lease (call under self._lock, as leader).
+
+        The lease is valid while a majority — counting ourselves as of
+        "now" — acked an append round that started within
+        election_timeout * (1 - lease_clock_skew): stickiness guarantees
+        no successor can be elected until election_timeout after the
+        quorum last heard from us, so the shortened window can never
+        overlap a new leader's writes."""
+        need = (len(self.peers) + 1) // 2           # peer acks beyond self
+        if need == 0:
+            anchor = time.monotonic()
+        else:
+            starts = sorted((self._ack_round_start.get(p, 0.0)
+                             for p in self.peers), reverse=True)
+            anchor = starts[need - 1]
+        lease = anchor + self.config.election_timeout \
+            * (1.0 - self.config.lease_clock_skew)
+        if lease > self._lease_until:
+            self._lease_until = lease
+
+    def lease_valid(self) -> bool:
+        with self._lock:
+            return self.state == LEADER and (
+                not self.peers or time.monotonic() < self._lease_until)
+
+    def wait_applied(self, index: int, timeout: float = 5.0) -> bool:
+        """Block until last_applied >= index — the follower half of
+        ReadIndex.  Waits on raft's own applied counter, not the store's
+        latest_index: a read index can point at a Noop entry the store
+        never sees."""
+        deadline = time.monotonic() + timeout
+        with self._apply_cv:
+            while self.last_applied < index:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._apply_cv.wait(min(remaining, 0.05))
+            return True
+
+    def last_contact_ms(self) -> float:
+        """Milliseconds since this node last heard from a leader (0 on the
+        leader itself) — the X-Nomad-LastContact header value."""
+        with self._lock:
+            if self.state == LEADER:
+                return 0.0
+            return max(0.0, (time.monotonic() - self._last_contact) * 1e3)
+
     # ------------------------------------------------------------- ticker
 
     def _election_deadline(self) -> float:
@@ -232,7 +407,10 @@ class RaftNode:
                 if state == LEADER:
                     self._replicate_all(heartbeat=True)
                     self._maybe_compact()
-                    self._stop.wait(self.config.heartbeat_interval)
+                    # sleep a heartbeat, or less if a commit advances
+                    # (the next round propagates leader_commit at once)
+                    self._commit_event.wait(self.config.heartbeat_interval)
+                    self._commit_event.clear()
                 else:
                     if time.monotonic() >= self._election_deadline():
                         self._run_election()
@@ -330,6 +508,10 @@ class RaftNode:
             self._next_index[p] = nxt
             self._match_index[p] = 0
         self._match_index[self.name] = self.log.last_index
+        # a fresh leadership stint must re-earn its lease: ack times from
+        # a previous term could anchor a lease the quorum never granted
+        self._ack_round_start.clear()
+        self._lease_until = 0.0
         if not self.peers:
             self._advance_commit()
         log.info("raft: %s became leader (term %d)", self.name, self.term)
@@ -353,6 +535,9 @@ class RaftNode:
             # stale self-pointing leader_id would make rpc_leader forward
             # to itself in a loop until the new leader's heartbeat arrives
             self.leader_id = None
+        # a deposed (or term-bumped) node must never serve lease reads
+        self._lease_until = 0.0
+        self._ack_round_start.clear()
         self._last_contact = time.monotonic()
         if was_leader:
             for fut in self._futures.values():
@@ -413,6 +598,7 @@ class RaftNode:
             entries = self.log.entries_from(
                 nxt, self.config.max_append_entries)
             commit = self.commit_index
+        round_start = time.monotonic()
         resp = self.transport.call(self.name, peer, "append_entries", {
             "term": term, "leader": self.name,
             "prev_log_index": prev_index, "prev_log_term": prev_term,
@@ -430,6 +616,11 @@ class RaftNode:
                     self._match_index[peer] = entries[-1].index
                     self._next_index[peer] = entries[-1].index + 1
                 self._advance_commit()
+                # every successful append/heartbeat ack extends the
+                # leader lease from the time the round was SENT (the
+                # conservative anchor: leadership was proven as of then)
+                self._ack_round_start[peer] = round_start
+                self._refresh_lease()
             else:
                 # consistency check failed: back off
                 self._next_index[peer] = max(
@@ -464,6 +655,7 @@ class RaftNode:
                 and self.log.term_at(majority) == self.term:
             self.commit_index = majority
             self._apply_cv.notify_all()
+            self._commit_event.set()
 
     # ------------------------------------------------------------- apply
 
@@ -501,6 +693,8 @@ class RaftNode:
                 with self._lock:
                     self.last_applied = max(self.last_applied, i)
                     fut = self._futures.pop(i, None)
+                    # wake wait_applied() readers (the cv shares _lock)
+                    self._apply_cv.notify_all()
             if fut is not None and not fut.done():
                 if err is None:
                     fut.set_result(i)

@@ -80,8 +80,17 @@ class Endpoints:
         fn = self._methods.get(method)
         if fn is None:
             raise RpcError("unknown_method", method)
+        args = dict(args) if args else {}
+        # per-request consistency on read RPCs (reference QueryOptions
+        # riding every RPC): establish the read point before dispatch so
+        # the handler's plain store reads serve at it
+        mode = args.pop("consistency", None)
         try:
-            return fn(args or {})
+            if mode is not None:
+                from nomad_tpu.serving.gate import READ_METHODS
+                if method in READ_METHODS:
+                    self.server.serving_gate.begin_read(mode)
+            return fn(args)
         except NotLeaderError as e:
             raise RpcError("not_leader", leader=e.leader)
 
@@ -119,6 +128,20 @@ class Endpoints:
     def rpc_Raft__Apply(self, args):
         """Leader-side apply for writes forwarded from followers."""
         return self.server.apply_local(args["msg_type"], args["payload"])
+
+    def rpc_Raft__ReadIndex(self, args):
+        """Leader half of a follower read (Raft §6.4): confirm leadership
+        and return the commit index the follower must apply up to before
+        serving locally.  `lease=True` (the default consistency mode)
+        serves from a still-valid leader lease with zero quorum rounds;
+        `lease=False` (`?consistent`) always pays the heartbeat round."""
+        s = self.server
+        if s.raft is None:
+            return {"index": s.store.latest_index}
+        idx = s.raft.read_index(
+            timeout=float(args.get("timeout", 5.0)),
+            lease_ok=bool(args.get("lease", True)))
+        return {"index": idx}
 
     # ------------------------------------------------------------- jobs
 

@@ -1,0 +1,26 @@
+"""Read-scalable serving plane (reference: nomad/rpc.go forward +
+blockingOptions, api/api.go QueryOptions{AllowStale}, and the
+stream/ndjson.go event pipeline).
+
+Every server — leader or follower — can answer read RPCs from its local
+state store once a *read point* is established.  The gate
+(`serving.gate.ReadGate`) resolves the per-request consistency mode:
+
+- ``consistent``: full Raft ReadIndex (heartbeat quorum confirmation).
+- default: leader-lease read — zero network rounds in steady state.
+- ``stale``: serve immediately from any server, reporting staleness via
+  ``X-Nomad-LastContact`` / ``X-Nomad-KnownLeader``.
+
+`serving.stream.EventStreamer` is the NDJSON pump for /v1/event/stream
+over the backpressured broker in `core/events.py`.
+"""
+from nomad_tpu.serving.gate import (
+    CONSISTENT, DEFAULT, STALE, READ_METHODS,
+    ReadContext, ReadGate, mode_from_query,
+)
+from nomad_tpu.serving.stream import EventStreamer
+
+__all__ = [
+    "CONSISTENT", "DEFAULT", "STALE", "READ_METHODS",
+    "ReadContext", "ReadGate", "mode_from_query", "EventStreamer",
+]

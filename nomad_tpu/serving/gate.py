@@ -1,0 +1,136 @@
+"""Consistency-mode resolution for the serving plane.
+
+The gate turns a per-request consistency mode into a *read point*: the
+index the local store must reflect before the read is served, plus the
+staleness metadata the HTTP layer reports back
+(``X-Nomad-LastContact`` / ``X-Nomad-KnownLeader``).
+
+Mode semantics (reference api/api.go QueryOptions + nomad/rpc.go
+blockingRPC):
+
+- ``consistent`` — linearizable via the full ReadIndex protocol: the
+  leader runs a heartbeat quorum round (batched across concurrent
+  readers) and returns its commit index; a follower forwards one small
+  RPC, then waits ``last_applied >= index`` locally before serving.
+- default — linearizable via the leader lease: while the leader's last
+  quorum ack is younger than ``election_timeout * (1 - skew)`` the read
+  point costs zero network rounds on the leader and one forwarded RPC
+  (no quorum round) on a follower.
+- ``stale`` — serve immediately from the local store, whatever its
+  index; the caller learns how stale via LastContact/KnownLeader.
+
+Failure shape: on a minority partition, ``stale`` keeps serving while
+``consistent``/default fail fast — an unreachable leader raises
+immediately; a vacant leadership (election in flight) is retried only
+until the caller's timeout.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from nomad_tpu.raft import NotLeaderError
+from nomad_tpu.raft.transport import Unreachable
+from nomad_tpu.rpc.endpoints import RpcError
+
+CONSISTENT = "consistent"
+DEFAULT = "default"
+STALE = "stale"
+_MODES = (CONSISTENT, DEFAULT, STALE)
+
+# Read-only RPC methods a follower may serve from its local store once a
+# read point is established.  Everything else (writes, leader-local
+# state like Secrets, scheduler dry-runs) still forwards to the leader.
+READ_METHODS = frozenset({
+    "Status.Ping", "Status.Leader", "Status.Members", "Status.Peers",
+    "Status.Regions",
+    "Job.GetJob", "Job.List", "Job.Summary", "Job.Allocations",
+    "Job.Evaluations", "Job.ScaleStatus",
+    "Node.List", "Node.GetNode", "Node.GetAllocs", "Node.GetClientAllocs",
+    "Eval.GetEval", "Eval.List",
+    "Alloc.GetAlloc", "Alloc.List",
+    "Deployment.List", "Deployment.GetDeployment",
+    "CSIVolume.List", "CSIVolume.Get", "CSIPlugin.List", "CSIPlugin.Get",
+    "Operator.SchedulerGetConfiguration",
+    "Search.PrefixSearch",
+    "Scaling.ListPolicies", "Scaling.GetPolicy",
+    "Service.List", "Service.GetService",
+})
+
+
+def mode_from_query(q: dict) -> str:
+    """Per-request mode from HTTP query params (last value wins):
+    ``?consistent`` beats ``?stale=true``; absent both is the default."""
+    if "consistent" in q and q.get("consistent", "") not in ("0", "false"):
+        return CONSISTENT
+    if "stale" in q and q.get("stale", "") not in ("0", "false"):
+        return STALE
+    return DEFAULT
+
+
+class ReadContext:
+    """An established read point: the serve-at index plus the staleness
+    metadata emitted on the response."""
+
+    __slots__ = ("index", "known_leader", "last_contact_ms", "mode")
+
+    def __init__(self, index: int, known_leader: bool,
+                 last_contact_ms: float, mode: str):
+        self.index = index
+        self.known_leader = known_leader
+        self.last_contact_ms = last_contact_ms
+        self.mode = mode
+
+
+class ReadGate:
+    def __init__(self, server):
+        self.server = server
+
+    def begin_read(self, mode: str = DEFAULT,
+                   timeout: float = 5.0) -> ReadContext:
+        """Establish a read point for `mode`; returns once the LOCAL
+        store may serve the read.  Raises on an unreachable/vacant
+        leadership for the linearizable modes (stale never raises)."""
+        if mode not in _MODES:
+            raise ValueError(f"unknown consistency mode {mode!r}")
+        s = self.server
+        raft = s.raft
+        if raft is None:                      # dev mode: trivially current
+            return ReadContext(s.store.latest_index, True, 0.0, mode)
+        if mode == STALE:
+            return ReadContext(s.store.latest_index,
+                               raft.leader_id is not None,
+                               raft.last_contact_ms(), STALE)
+        lease_ok = mode == DEFAULT
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return self._read_point(lease_ok, deadline, mode)
+            except Unreachable:
+                raise                         # partitioned: fail fast
+            except (NotLeaderError, RpcError) as e:
+                if isinstance(e, RpcError) \
+                        and e.kind not in ("no_leader", "not_leader"):
+                    raise
+                # leadership transfer in flight: retry inside the
+                # caller's wait cap, never past it
+                if time.monotonic() + 0.05 >= deadline:
+                    raise
+                time.sleep(0.025)
+
+    def _read_point(self, lease_ok: bool, deadline: float,
+                    mode: str) -> ReadContext:
+        s, raft = self.server, self.server.raft
+        remaining = max(0.05, deadline - time.monotonic())
+        if raft.is_leader:
+            idx = raft.read_index(timeout=remaining, lease_ok=lease_ok)
+            return ReadContext(idx, True, 0.0, mode)
+        resp = s.rpc_leader("Raft.ReadIndex",
+                            {"lease": lease_ok, "timeout": remaining})
+        idx = int(resp["index"])
+        if not raft.wait_applied(idx, timeout=max(
+                0.05, deadline - time.monotonic())):
+            raise TimeoutError(
+                f"read index {idx} not applied within the wait cap "
+                f"(applied={raft.last_applied})")
+        return ReadContext(idx, True, raft.last_contact_ms(), mode)

@@ -1,0 +1,65 @@
+"""NDJSON event-stream pump (reference nomad/stream/ndjson.go: a writer
+goroutine draining a subscription with periodic `{}` heartbeats).
+
+`EventStreamer.run` drains one broker subscription into a caller
+`write(bytes)` sink.  Heartbeats are emitted only when the configured
+interval elapses with no events (``?heartbeat=`` go-duration per
+request, ``NOMAD_TPU_STREAM_HEARTBEAT`` seconds as the default) — the
+old behavior of one `{}` per idle poll quadrupled idle-stream bytes.
+
+The `stream.subscriber_stall` chaos point injects consumer stalls here:
+with it firing, the broker's bounded queues must evict + catch up, never
+grow without limit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional
+
+from nomad_tpu import chaos
+from nomad_tpu.api.codec import to_wire
+from nomad_tpu.core.events import Subscription
+
+
+def default_heartbeat() -> float:
+    return float(os.environ.get("NOMAD_TPU_STREAM_HEARTBEAT", "1.0"))
+
+
+class EventStreamer:
+    """Pumps one subscription to one sink for up to `duration` seconds."""
+
+    def __init__(self, sub: Subscription,
+                 heartbeat: Optional[float] = None,
+                 filter_fn: Optional[Callable] = None):
+        self.sub = sub
+        self.heartbeat = heartbeat if heartbeat and heartbeat > 0 \
+            else default_heartbeat()
+        self.filter_fn = filter_fn          # e.g. ACL namespace visibility
+        self.sent = 0
+        self.heartbeats = 0
+
+    def run(self, write: Callable[[bytes], None], duration: float) -> None:
+        deadline = time.monotonic() + duration
+        last_sent = time.monotonic()
+        poll = min(0.25, self.heartbeat)
+        while time.monotonic() < deadline:
+            ev = self.sub.next(timeout=poll)
+            if ev is not None and self.filter_fn is not None \
+                    and not self.filter_fn(ev):
+                ev = None                   # filtered, but not a heartbeat
+            chaos.maybe_delay("stream.subscriber_stall")
+            if ev is None:
+                now = time.monotonic()
+                if now - last_sent >= self.heartbeat:
+                    write(b"{}\n")          # reference heartbeat frame
+                    self.heartbeats += 1
+                    last_sent = now
+                continue
+            d = ev.to_dict()
+            d["Payload"] = to_wire(d["Payload"])
+            write((json.dumps({"Index": ev.index, "Events": [d]})
+                   + "\n").encode())
+            self.sent += 1
+            last_sent = time.monotonic()

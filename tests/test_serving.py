@@ -1,0 +1,471 @@
+"""Serving-plane tests: raft ReadIndex + leader leases, consistency-mode
+follower reads over RPC and HTTP, blocking queries under churn, and the
+backpressured event broker (reference: nomad/rpc.go blockingRPC +
+QueryOptions, stream/event_broker.go, stream/subscription.go)."""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import chaos, mock
+from nomad_tpu.agent.http import HTTPServer
+from nomad_tpu.chaos import ChaosRegistry
+from nomad_tpu.core.cluster import Cluster
+from nomad_tpu.core.events import Event, EventBroker
+from nomad_tpu.raft import NotLeaderError
+from nomad_tpu.serving import (
+    CONSISTENT, DEFAULT, STALE, EventStreamer, mode_from_query,
+)
+
+
+def _wait(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(3)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _leader_among(servers, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [s for s in servers
+                   if s.raft is not None and s.raft.is_leader
+                   and s._established]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise TimeoutError("no leader among subset")
+
+
+class _ShimAgent:
+    """Just enough agent surface for HTTPServer to front one Server of a
+    cluster (the per-server HTTP listener the reference runs)."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def rpc(self, method, args, consistency=None):
+        return self.server.rpc_leader(method, args)
+
+
+def _get(port, path, timeout=30.0):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return (resp.status, json.loads(resp.read() or b"null"),
+                dict(resp.headers))
+
+
+# ===================================================================== raft
+
+
+def test_read_index_reflects_committed_writes(cluster):
+    leader = cluster.leader()
+    leader.register_node(mock.node())
+    commit = leader.raft.commit_index
+    idx = leader.raft.read_index(lease_ok=False)
+    assert idx >= commit
+
+
+def test_concurrent_read_index_batches_into_few_rounds(cluster):
+    leader = cluster.leader()
+    leader.register_node(mock.node())
+    # stretch each confirmation round so concurrent readers provably
+    # pile onto an in-flight batch instead of each paying their own
+    prev = chaos.install(ChaosRegistry(
+        seed=11, rates={"read.index_stall": 1.0}, delay_ms=50.0))
+    try:
+        rounds0 = leader.raft.read_rounds
+        results = []
+        errs = []
+
+        def reader():
+            try:
+                results.append(
+                    leader.raft.read_index(timeout=10.0, lease_ok=False))
+            except Exception as e:              # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=reader) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rounds_used = leader.raft.read_rounds - rounds0
+    finally:
+        chaos.uninstall()
+    assert not errs
+    assert len(results) == 16
+    # 16 concurrent readers must share rounds (amortized ReadIndex)
+    assert rounds_used <= 5, f"{rounds_used} rounds for 16 readers"
+
+
+def test_lease_serves_reads_with_zero_rounds(cluster):
+    leader = cluster.leader()
+    leader.register_node(mock.node())
+    assert _wait(lambda: leader.raft.lease_valid(), 5.0), \
+        "steady-state heartbeat acks must establish the lease"
+    rounds0 = leader.raft.read_rounds
+    for _ in range(50):
+        leader.raft.read_index()        # default (lease) mode
+    assert leader.raft.read_rounds == rounds0, \
+        "lease reads must cost zero confirmation rounds"
+
+
+def test_lease_duration_bounded_by_election_timeout_minus_skew(cluster):
+    leader = cluster.leader()
+    assert _wait(lambda: leader.raft.lease_valid(), 5.0)
+    cfg = leader.raft.config
+    remaining = leader.raft._lease_until - time.monotonic()
+    assert remaining > 0
+    assert remaining <= cfg.election_timeout * (1 - cfg.lease_clock_skew)
+
+
+def test_deposed_leader_lease_never_overlaps_new_leader(cluster):
+    old = cluster.leader()
+    others = [s for s in cluster.servers if s is not old]
+    cluster.isolate(old)
+    # stickiness: a successor needs a full election_timeout of quorum
+    # silence first, which strictly exceeds the old lease's lifetime
+    new = _leader_among(others)
+    assert not old.raft.lease_valid(), \
+        "old leader's lease outlived the new leader's election"
+    with pytest.raises((NotLeaderError, TimeoutError)):
+        old.raft.read_index(timeout=1.0, lease_ok=False)
+    # the new leader serves linearizable reads for the majority side
+    new.register_node(mock.node())
+    assert new.raft.read_index(lease_ok=False) >= new.raft.commit_index
+    cluster.heal(old)
+
+
+def test_follower_reads_see_latest_write(cluster):
+    leader = cluster.leader()
+    follower = cluster.followers()[0]
+    node = mock.node()
+    leader.register_node(node)
+    for mode in (CONSISTENT, DEFAULT):
+        result, ctx = follower.read("Node.List", {}, consistency=mode)
+        assert any(n.id == node.id for n in result), \
+            f"{mode} follower read missed a committed write"
+        assert ctx.known_leader
+
+
+def test_stale_read_serves_local_store(cluster):
+    follower = cluster.followers()[0]
+    result, ctx = follower.read("Node.List", {}, consistency=STALE)
+    assert isinstance(result, list)
+    assert ctx.mode == STALE
+    assert ctx.last_contact_ms >= 0
+
+
+def test_rpc_consistency_arg_routes_through_gate(cluster):
+    leader = cluster.leader()
+    follower = cluster.followers()[0]
+    node = mock.node()
+    leader.register_node(node)
+    out = follower.endpoints.handle(
+        "Node.List", {"consistency": "consistent"})
+    assert any(n.id == node.id for n in out)
+    # stale works even though this server is not the leader
+    out = follower.endpoints.handle("Node.List", {"consistency": "stale"})
+    assert isinstance(out, list)
+
+
+# ===================================================================== http
+
+
+def test_http_modes_and_staleness_headers(cluster):
+    leader = cluster.leader()
+    follower = cluster.followers()[0]
+    job = mock.job()
+    leader.register_job(job)
+    idx = leader.store.latest_index
+    assert cluster.wait_replication(idx)
+    http = HTTPServer(_ShimAgent(follower), port=0)
+    http.start()
+    try:
+        for qs in ("?stale=true", "?consistent", ""):
+            status, body, hdrs = _get(http.port, f"/v1/jobs{qs}")
+            assert status == 200
+            assert any(j["ID"] == job.id for j in body), qs
+            assert hdrs["X-Nomad-KnownLeader"] == "true"
+            assert int(hdrs["X-Nomad-LastContact"]) >= 0
+            assert int(hdrs["X-Nomad-Index"]) >= idx
+    finally:
+        http.stop()
+
+
+def test_partition_stale_serves_while_consistent_fails_fast(cluster):
+    cluster.leader()
+    follower = cluster.followers()[0]
+    http = HTTPServer(_ShimAgent(follower), port=0)
+    http.start()
+    cluster.isolate(follower)
+    try:
+        # stale keeps serving from the local store on the minority side
+        status, body, hdrs = _get(http.port, "/v1/jobs?stale=true")
+        assert status == 200
+        # linearizable reads fail fast: the leader is unreachable
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(http.port, "/v1/jobs?consistent")
+        assert exc.value.code == 503
+        assert time.monotonic() - t0 < 3.0, "must fail fast, not hang"
+    finally:
+        cluster.heal(follower)
+        http.stop()
+
+
+def test_blocking_query_wakes_on_index_advance(cluster):
+    leader = cluster.leader()
+    follower = cluster.followers()[0]
+    idx = leader.store.latest_index
+    assert cluster.wait_replication(idx)
+    http = HTTPServer(_ShimAgent(follower), port=0)
+    http.start()
+    out = {}
+
+    def blocker():
+        t0 = time.monotonic()
+        status, body, hdrs = _get(
+            http.port, f"/v1/jobs?index={idx}&wait=10s")
+        out["elapsed"] = time.monotonic() - t0
+        out["status"] = status
+        out["index"] = int(hdrs["X-Nomad-Index"])
+
+    try:
+        t = threading.Thread(target=blocker)
+        t.start()
+        time.sleep(0.4)                 # let it park on the index wait
+        assert t.is_alive(), "blocking query returned before any advance"
+        leader.register_node(mock.node())
+        t.join(8.0)
+        assert not t.is_alive()
+        assert out["status"] == 200
+        # woke on the advance (one wakeup), not on the 10s wait cap
+        assert 0.3 <= out["elapsed"] < 8.0
+        assert out["index"] > idx
+    finally:
+        http.stop()
+
+
+def test_blocking_query_never_returns_lower_index(cluster):
+    cluster.leader()
+    follower = cluster.followers()[0]
+    http = HTTPServer(_ShimAgent(follower), port=0)
+    http.start()
+    try:
+        given = 10 ** 9
+        status, body, hdrs = _get(
+            http.port, f"/v1/jobs?index={given}&wait=200ms")
+        assert status == 200
+        assert int(hdrs["X-Nomad-Index"]) >= given
+    finally:
+        http.stop()
+
+
+def test_blocking_query_honors_wait_cap_during_transfer(cluster):
+    leader = cluster.leader()
+    follower = cluster.followers()[0]
+    idx = follower.store.latest_index
+    http = HTTPServer(_ShimAgent(follower), port=0)
+    http.start()
+    cluster.isolate(leader)
+    try:
+        t0 = time.monotonic()
+        try:
+            status, _, hdrs = _get(
+                http.port, f"/v1/jobs?index={idx}&wait=1s", timeout=30.0)
+            assert int(hdrs["X-Nomad-Index"]) >= idx
+        except urllib.error.HTTPError as e:
+            status = e.code             # 503 while leadership is vacant
+        elapsed = time.monotonic() - t0
+        assert status in (200, 503)
+        assert elapsed < 8.0, \
+            f"blocking query overshot its wait cap: {elapsed:.1f}s"
+    finally:
+        cluster.heal(leader)
+        http.stop()
+
+
+# =================================================================== broker
+
+
+def _ev(i, key="k", topic="Node"):
+    return Event(topic, "NodeRegistration", key, "", i, {"i": i})
+
+
+def test_subscription_queue_is_bounded_under_stalled_consumer():
+    b = EventBroker(buffer_size=64)
+    sub = b.subscribe({"*": ["*"]}, max_queue=8)
+    for i in range(1, 101):
+        b.publish([_ev(i)])
+    st = b.stats()["subs"][0]
+    assert st["queue_len"] <= 8
+    assert st["dropped"] > 0
+    assert st["evictions"] >= 1
+    assert st["catching_up"]
+
+
+def test_evicted_subscriber_catches_up_exactly_once_in_order():
+    b = EventBroker(buffer_size=256)
+    sub = b.subscribe({"*": ["*"]}, max_queue=8)
+    for i in range(1, 51):
+        b.publish([_ev(i)])
+    got = []
+    while True:
+        ev = sub.next(timeout=0.2)
+        if ev is None:
+            break
+        got.append(ev.index)
+    assert got == list(range(1, 51)), \
+        "catch-up must replay every retained event exactly once, in order"
+    st = b.stats()["subs"][0]
+    assert not st["catching_up"]
+    assert st["delivered"] == 50
+
+
+def test_catchup_applies_topic_filters():
+    b = EventBroker(buffer_size=256)
+    sub = b.subscribe({"Node": ["a"]}, max_queue=4)
+    for i in range(1, 41):
+        b.publish([_ev(i, key="a" if i % 2 else "b")])
+    got = []
+    while True:
+        ev = sub.next(timeout=0.2)
+        if ev is None:
+            break
+        got.append(ev.index)
+    assert got == [i for i in range(1, 41) if i % 2]
+
+
+def test_from_index_replays_retained_buffer():
+    b = EventBroker()
+    for i in range(1, 11):
+        b.publish([_ev(i)])
+    sub = b.subscribe({"*": ["*"]}, from_index=5)
+    got = [sub.next(0.2).index for _ in range(5)]
+    assert got == [6, 7, 8, 9, 10]
+    assert sub.next(0.05) is None
+
+
+def test_live_subscriber_sees_no_drops():
+    b = EventBroker()
+    sub = b.subscribe({"*": ["*"]}, max_queue=64)
+    got = []
+    stop = threading.Event()
+
+    def consume():
+        while not stop.is_set() or sub.queue:
+            ev = sub.next(timeout=0.05)
+            if ev is not None:
+                got.append(ev.index)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for i in range(1, 201):
+        b.publish([_ev(i)])
+        if i % 50 == 0:
+            time.sleep(0.01)
+    _wait(lambda: len(got) == 200, 5.0)
+    stop.set()
+    t.join(2.0)
+    assert got == list(range(1, 201))
+    st = b.stats()["subs"][0]
+    assert st["dropped"] == 0 and st["evictions"] == 0
+
+
+# =================================================================== stream
+
+
+def test_stream_heartbeat_interval_is_configurable():
+    b = EventBroker()
+    s = EventStreamer(b.subscribe({"*": ["*"]}), heartbeat=0.1)
+    frames = []
+    s.run(frames.append, 0.35)
+    assert 1 <= s.heartbeats <= 5
+    assert all(f == b"{}\n" for f in frames)
+    s2 = EventStreamer(b.subscribe({"*": ["*"]}), heartbeat=30.0)
+    frames2 = []
+    s2.run(frames2.append, 0.3)
+    assert s2.heartbeats == 0 and frames2 == []
+
+
+def test_stream_emits_ndjson_event_frames():
+    b = EventBroker()
+    sub = b.subscribe({"*": ["*"]})
+    s = EventStreamer(sub, heartbeat=30.0)
+    frames = []
+    t = threading.Thread(target=lambda: s.run(frames.append, 1.0))
+    t.start()
+    time.sleep(0.1)
+    b.publish([_ev(3)])
+    t.join(3.0)
+    events = [json.loads(f) for f in frames if f != b"{}\n"]
+    assert events and events[0]["Index"] == 3
+    assert events[0]["Events"][0]["Topic"] == "Node"
+
+
+# ==================================================================== chaos
+
+
+def test_chaos_lease_expire_forces_full_round(cluster):
+    leader = cluster.leader()
+    assert _wait(lambda: leader.raft.lease_valid(), 5.0)
+    prev = chaos.install(ChaosRegistry(
+        seed=1, rates={"read.lease_expire": 1.0}))
+    try:
+        r0 = leader.raft.read_rounds
+        leader.raft.read_index()
+        leader.raft.read_index()
+        assert leader.raft.read_rounds >= r0 + 2, \
+            "an expired lease must force the confirmation round"
+    finally:
+        chaos.uninstall()
+        if prev is not None:
+            chaos.install(prev)
+
+
+def test_chaos_subscriber_stall_keeps_memory_bounded():
+    b = EventBroker(buffer_size=64)
+    sub = b.subscribe({"*": ["*"]}, max_queue=8)
+    frames = []
+    prev = chaos.install(ChaosRegistry(
+        seed=2, rates={"stream.subscriber_stall": 1.0}, delay_ms=20.0))
+    try:
+        s = EventStreamer(sub, heartbeat=30.0)
+        t = threading.Thread(target=lambda: s.run(frames.append, 0.6))
+        t.start()
+        for i in range(1, 301):
+            b.publish([_ev(i)])
+            time.sleep(0.001)
+        t.join(5.0)
+    finally:
+        chaos.uninstall()
+        if prev is not None:
+            chaos.install(prev)
+    assert len(sub.queue) <= 8, "stalled consumer must not grow the queue"
+
+
+# ===================================================================== misc
+
+
+def test_mode_from_query():
+    assert mode_from_query({}) == DEFAULT
+    assert mode_from_query({"stale": "true"}) == STALE
+    assert mode_from_query({"stale": ""}) == STALE
+    assert mode_from_query({"stale": "false"}) == DEFAULT
+    assert mode_from_query({"consistent": ""}) == CONSISTENT
+    assert mode_from_query({"consistent": "", "stale": "true"}) == CONSISTENT
