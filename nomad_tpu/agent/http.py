@@ -48,6 +48,10 @@ class HTTPServer:
     def __init__(self, agent, host: str = "127.0.0.1", port: int = 0):
         self.agent = agent
         self.host = host
+        # per-request read point (one handler thread per connection):
+        # _rpc may only serve READ_METHODS from the local store when the
+        # route gate established a read point for the CURRENT request
+        self._read_local = threading.local()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -163,24 +167,28 @@ class HTTPServer:
                 # fail fast rather than serving possibly-stale data
                 raise HTTPError(503, f"read gate ({mode}): "
                                      f"{type(e).__name__}: {e}")
-        if store is not None and "index" in q:
-            min_index = int(q["index"])
-            wait = _parse_wait(q.get("wait", "5s"))
-            store.wait_for_index(min_index + 1, timeout=min(wait, 600.0))
+        self._read_local.ctx = read_ctx
+        try:
+            if store is not None and "index" in q:
+                min_index = int(q["index"])
+                wait = _parse_wait(q.get("wait", "5s"))
+                store.wait_for_index(min_index + 1, timeout=min(wait, 600.0))
 
-        m = method.lower()
-        candidates = []
-        if len(parts) >= 2:
-            candidates.append(f"_h_{m}_{parts[0]}_id")
-        candidates.append(f"_h_{m}_{parts[0]}")
-        handler = None
-        for name in candidates:
-            handler = getattr(self, name, None)
-            if handler is not None:
-                break
-        if handler is None:
-            raise HTTPError(404, f"no handler for {method} {url.path}")
-        result = handler(h, parts, q)
+            m = method.lower()
+            candidates = []
+            if len(parts) >= 2:
+                candidates.append(f"_h_{m}_{parts[0]}_id")
+            candidates.append(f"_h_{m}_{parts[0]}")
+            handler = None
+            for name in candidates:
+                handler = getattr(self, name, None)
+                if handler is not None:
+                    break
+            if handler is None:
+                raise HTTPError(404, f"no handler for {method} {url.path}")
+            result = handler(h, parts, q)
+        finally:
+            self._read_local.ctx = None
         if result is not _STREAMED:
             index = store.latest_index if store else None
             if index is not None and "index" in q:
@@ -191,9 +199,15 @@ class HTTPServer:
 
     def _rpc(self, method: str, args: dict):
         server = self.agent.server
-        if server is not None and method in READ_METHODS:
-            # the read point was established by _route's gate: serve from
-            # the LOCAL store, leader and follower alike (follower reads)
+        if server is not None and method in READ_METHODS \
+                and getattr(self._read_local, "ctx", None) is not None:
+            # a read point was established by _route's gate for THIS
+            # request: serve from the LOCAL store, leader and follower
+            # alike (follower reads).  Reads invoked without one — e.g.
+            # from POST paths like /v1/search or job evaluate/revert
+            # preconditions — forward to the leader as before, rather
+            # than reading an ungated follower store with no staleness
+            # metadata.
             return server.endpoints.handle(method, args)
         return self.agent.rpc(method, args)
 

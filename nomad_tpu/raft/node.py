@@ -38,15 +38,17 @@ class NotLeaderError(Exception):
 
 
 class _ReadBatch:
-    """One in-flight leadership-confirmation round shared by every reader
-    that arrived while it ran (reference raft ReadOnlyQueue batching): the
-    first reader runs the heartbeat quorum round, concurrent readers wait
-    on `event` and share the captured commit index."""
+    """One leadership-confirmation round shared by every reader that
+    joined before its probes went out (reference raft ReadOnlyQueue
+    batching): the first reader runs the heartbeat quorum round,
+    concurrent readers wait on `event`.  Each reader captures its OWN
+    commit index at arrival — the shared round only proves leadership,
+    and it proves it for all of them because every probe ack happens
+    after the last joiner's capture."""
 
-    __slots__ = ("index", "ok", "event")
+    __slots__ = ("ok", "event")
 
-    def __init__(self, index: int):
-        self.index = index          # commit_index captured BEFORE the round
+    def __init__(self):
         self.ok = False             # quorum confirmed leadership at our term
         self.event = threading.Event()
 
@@ -114,6 +116,10 @@ class RaftNode:
         self._ack_round_start: Dict[str, float] = {}
         self._lease_until = 0.0
         self._read_batch: Optional[_ReadBatch] = None
+        # one confirmation round in flight at a time: while it runs, the
+        # next batch stays open and accumulates joiners (their captured
+        # indexes all precede that batch's probes)
+        self._round_lock = threading.Lock()
         self.read_rounds = 0        # confirmation rounds run (telemetry)
         self._stop = threading.Event()
         # commit advancement wakes the ticker (hashicorp/raft's per-peer
@@ -278,18 +284,34 @@ class RaftNode:
                 return self.commit_index
             if not self.peers:
                 return self.commit_index   # single voter: trivially leader
+            # every reader serves at the commit index as of ITS arrival
+            # (etcd's readOnly queue): joining an in-flight batch must not
+            # hand back an index captured before a write this caller may
+            # already have seen acknowledged
+            index = self.commit_index
             batch = self._read_batch
             runs_round = batch is None
             if runs_round:
-                batch = self._read_batch = _ReadBatch(self.commit_index)
+                batch = self._read_batch = _ReadBatch()
             term = self.term
         if runs_round:
+            # the round lock serializes confirmation rounds: while a prior
+            # round runs, this batch stays published and keeps collecting
+            # joiners, and every probe ack below lands strictly after each
+            # joiner captured its index — the ordering that lets one
+            # shared round confirm all of them
+            locked = self._round_lock.acquire(
+                timeout=max(0.0, deadline - time.monotonic()))
             try:
-                self._confirm_leadership(batch, term)
-            finally:
                 with self._lock:
                     if self._read_batch is batch:
-                        self._read_batch = None
+                        self._read_batch = None   # closed: probes start now
+                    live = self.state == LEADER and self.term == term
+                if live:
+                    self._confirm_leadership(batch, term)
+            finally:
+                if locked:
+                    self._round_lock.release()
                 batch.event.set()
         else:
             batch.event.wait(max(0.0, deadline - time.monotonic()))
@@ -298,14 +320,14 @@ class RaftNode:
         if not batch.ok:
             with self._lock:
                 raise NotLeaderError(self.leader_id)
-        return batch.index
+        return index
 
     def _confirm_leadership(self, batch: _ReadBatch, term: int) -> None:
         """One empty heartbeat round: a majority acking at `term` proves no
-        higher-term leader existed when `batch.index` was captured, so
-        serving reads at that index is linearizable.  Successful acks also
-        refresh the lease, so a burst of `?consistent` reads leaves the
-        default mode round-free."""
+        higher-term leader existed when each batched reader captured its
+        index, so serving reads at those indexes is linearizable.
+        Successful acks also refresh the lease, so a burst of
+        `?consistent` reads leaves the default mode round-free."""
         chaos.maybe_delay("read.index_stall")
         self.read_rounds += 1
         start = time.monotonic()
@@ -314,15 +336,19 @@ class RaftNode:
             with self._lock:
                 if self.state != LEADER or self.term != term:
                     return                          # deposed mid-round
-                commit = self.commit_index
             try:
-                # prev_log_index=0 skips the consistency check: this is a
-                # pure leadership probe, not replication
+                # prev_log_index=0 skips the consistency check — this is a
+                # pure leadership probe, not replication — so it must also
+                # carry leader_commit=0: a real commit index here would let
+                # a follower still holding a divergent uncommitted tail
+                # from a deposed leader commit its own conflicting entries
+                # past the skipped check.  Commit propagation belongs to
+                # replication rounds, which do carry prev_log_index.
                 resp = self.transport.call(self.name, peer,
                                            "append_entries", {
                     "term": term, "leader": self.name,
                     "prev_log_index": 0, "prev_log_term": 0,
-                    "entries": [], "leader_commit": commit})
+                    "entries": [], "leader_commit": 0})
             except Unreachable:
                 continue
             except Exception:                       # noqa: BLE001

@@ -124,6 +124,15 @@ class ReadGate:
         remaining = max(0.05, deadline - time.monotonic())
         if raft.is_leader:
             idx = raft.read_index(timeout=remaining, lease_ok=lease_ok)
+            # the leader must ALSO wait for its own apply loop: a follower
+            # can apply a committed entry before the leader does, and a
+            # linearizable read served from the leader's lagging store
+            # would miss an entry a gated follower read already exposed
+            if not raft.wait_applied(idx, timeout=max(
+                    0.05, deadline - time.monotonic())):
+                raise TimeoutError(
+                    f"read index {idx} not applied within the wait cap "
+                    f"(applied={raft.last_applied})")
             return ReadContext(idx, True, 0.0, mode)
         resp = s.rpc_leader("Raft.ReadIndex",
                             {"lease": lease_ok, "timeout": remaining})
