@@ -607,7 +607,8 @@ def bench_scan_spread(n_nodes=10000, n_jobs=60, count=100, workers=48):
         s.stop()
 
 
-def bench_device_constrained(n_nodes=10000):
+def bench_device_constrained(n_nodes=10000, n_jobs=20, count=100,
+                             warm_count=50):
     """configs[3]: 10K nodes, half with GPU device groups; jobs with
     device requests and job anti-affinity."""
     from nomad_tpu.structs.resources import DeviceRequest, NodeDevice
@@ -621,19 +622,19 @@ def bench_device_constrained(n_nodes=10000):
         t0 = time.time()
         _fill_nodes(s, n_nodes, node_fn=node_fn)
         log(f"device world build: {time.time()-t0:.1f}s")
-        warm = _batch_job(50)
+        warm = _batch_job(warm_count)
         warm.task_groups[0].tasks[0].resources.devices = [
             DeviceRequest(name="gpu", count=1)]
         s.register_job(warm)
-        _wait_allocs(s.store, [warm], 50, timeout=300)
+        _wait_allocs(s.store, [warm], warm_count, timeout=300)
 
         jobs = []
-        for _ in range(20):
-            j = _batch_job(100)
+        for _ in range(n_jobs):
+            j = _batch_job(count)
             j.task_groups[0].tasks[0].resources.devices = [
                 DeviceRequest(name="gpu", count=1)]
             jobs.append(j)
-        want = 20 * 100
+        want = n_jobs * count
         t0 = time.time()
         for j in jobs:
             s.register_job(j)
@@ -647,7 +648,8 @@ def bench_device_constrained(n_nodes=10000):
         s.stop()
 
 
-def bench_preemption_heavy(n_nodes=10000, workers=48):
+def bench_preemption_heavy(n_nodes=10000, workers=48, n_service=10,
+                           service_count=50):
     """configs[4]: 10K nodes at ~95% utilization of low-priority work;
     high-priority service jobs must preempt across priority tiers."""
     s = _server(workers=workers)
@@ -666,9 +668,9 @@ def bench_preemption_heavy(n_nodes=10000, workers=48):
             s.register_job(j)
         _wait_allocs(s.store, fillers, n_nodes * 9, timeout=600)
 
-        jobs = [_service_job(50, cpu=420, mem=850, spread=False,
-                             priority=90) for _ in range(10)]
-        want = 500
+        jobs = [_service_job(service_count, cpu=420, mem=850, spread=False,
+                             priority=90) for _ in range(n_service)]
+        want = n_service * service_count
         t0 = time.time()
         for j in jobs:
             s.register_job(j)
@@ -814,6 +816,36 @@ def bench_kernel_100k_nodes(n_nodes=100_000, waves=12, per_wave=8,
 def main():
     target = 1_000_000 / 30.0       # north-star C2M rate (v5e-8)
 
+    if "--matrix" in sys.argv:
+        # chaos scenario matrix: workload shapes x phased chaos
+        # schedules on a real 3-server cluster, each cell gated on
+        # post-chaos convergence invariants (nomad_tpu/scenarios.py).
+        # `--matrix --smoke` runs the curated CI subset; `--seed N`
+        # picks the chaos seed; a NOMAD_TPU_CHAOS env spec overrides
+        # the schedule for every cell.
+        from nomad_tpu.scenarios import ALL_CELLS, SMOKE_CELLS, run_matrix
+        seed = 1
+        if "--seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        cells = SMOKE_CELLS if "--smoke" in sys.argv else ALL_CELLS
+        summary = run_matrix(cells, seed=seed, log=log)
+        print(json.dumps({
+            "metric": "scenario_matrix",
+            "seed": seed,
+            "cells": len(summary["cells"]),
+            "passed": summary["passed"],
+            "failed": summary["failed"],
+            "per_cell": [{
+                "shape": t.get("shape"), "schedule": t.get("schedule"),
+                "converged": t["convergence"].get("converged"),
+                "convergence_time_s":
+                    t["convergence"].get("convergence_time_s"),
+                "allocs_per_sec": t.get("allocs_per_sec"),
+                "plan_submit_ms": t.get("plan_submit_ms"),
+            } for t in summary["cells"]],
+        }), flush=True)
+        sys.exit(0 if summary["ok"] else 1)
+
     if "--smoke" in sys.argv:
         # CI leg: the same shape in seconds (tier-1 invokes this)
         rate, placed, want = bench_smoke()
@@ -826,6 +858,28 @@ def main():
         serving = bench_serving_plane(
             n_watchers=1024, n_blockers=8,
             idle_samples=150, busy_samples=300)
+        # per-scenario regression gate: the spread / device / preemption
+        # shapes shrunk to seconds, their plan.submit p99 capped.  The
+        # cap is generous (it catches order-of-magnitude regressions in
+        # a scenario's placement path, not CI-runner jitter) and
+        # env-overridable for slow runners.
+        p99_cap_ms = float(os.environ.get("NOMAD_TPU_SMOKE_P99_MS", "750"))
+        scenario_violations = []
+        for name, fn in (
+                ("scan_spread", lambda: bench_scan_spread(
+                    n_nodes=256, n_jobs=6, count=20, workers=8)),
+                ("device", lambda: bench_device_constrained(
+                    n_nodes=256, n_jobs=4, count=25, warm_count=10)),
+                ("preemption", lambda: bench_preemption_heavy(
+                    n_nodes=96, workers=8, n_service=2,
+                    service_count=12))):
+            fn()
+            p99 = _PLAN_STATS.get(name, {}).get(
+                "submit_ms", {}).get("p99", 0.0)
+            if p99 > p99_cap_ms:
+                scenario_violations.append(
+                    f"{name}: plan.submit p99 {p99} ms > "
+                    f"cap {p99_cap_ms} ms")
         print(json.dumps({
             "metric": "c2m_smoke_allocs_per_sec",
             "value": round(rate, 1),
@@ -839,6 +893,10 @@ def main():
         }), flush=True)
         if steady.get("violations"):
             log("steady-state violations:", steady["violations"])
+            sys.exit(1)
+        if scenario_violations:
+            for v in scenario_violations:
+                log("scenario gate:", v)
             sys.exit(1)
         if not serving["bounded"]:
             log("serving_plane: subscriber queue exceeded its bound")

@@ -14,6 +14,22 @@ where `seed` (int) seeds the RNG, `delay_ms` (float) sets the injected
 latency for `rpc.delay`, and every other key must be one of the named
 fault points below with a rate in [0, 1].
 
+Phased schedules extend the grammar with named time windows so one run
+can interleave calm -> storm -> calm:
+
+    NOMAD_TPU_CHAOS="seed=7;phase=storm:10-20;raft.partition=0.3@storm"
+
+`phase=<name>:<start>-<end>` declares a window in seconds relative to
+the registry's arm time; `<point>=<rate>@<phase>` applies the rate only
+while that phase is open.  A point may carry one base rate plus any
+number of phased rates; the effective rate at a check is the max of the
+base rate and every currently-open phase rate.  Phase windows are
+inactive until `arm()` anchors the clock (an un-armed registry behaves
+as if every phase were closed), so base rates keep the original
+whole-run semantics.  Note the draw count then depends on wall time —
+with phases a seed reproduces the schedule in distribution, not draw
+for draw.
+
 Fault points and their injection sites:
 
     rpc.drop                  rpc/tcp.py, raft/transport.py — connection
@@ -66,6 +82,17 @@ Fault points and their injection sites:
                               if a consumer stopped reading: the broker
                               must bound the queue and evict/catch-up,
                               never grow without limit
+    node.churn_kill           core/heartbeat.py — a client heartbeat is
+                              swallowed before the TTL re-arm, so the
+                              node expires through the real miss path
+                              (down/disconnected + node-update eval)
+    deploy.health_flap        scenarios.py — the health reporter flips
+                              one alloc's health report to unhealthy,
+                              driving the deployment watcher toward
+                              failure/auto-revert mid-update
+    scale.burst               scenarios.py — an autoscaling wave is
+                              amplified to the policy bound, stacking
+                              scale evals on top of in-flight ones
 
 `REQUIRED_SITES` pins points to the hot-path functions that must carry
 them; the chaos-coverage linter fails if a refactor drops one.
@@ -83,7 +110,7 @@ import random
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 FAULT_POINTS = (
     "rpc.drop",
@@ -102,6 +129,9 @@ FAULT_POINTS = (
     "read.lease_expire",
     "read.index_stall",
     "stream.subscriber_stall",
+    "node.churn_kill",
+    "deploy.health_flap",
+    "scale.burst",
 )
 
 # Points that must be injected in these specific functions (enforced by
@@ -114,6 +144,9 @@ REQUIRED_SITES = {
     "read.lease_expire": ("RaftNode.read_index",),
     "read.index_stall": ("RaftNode._confirm_leadership",),
     "stream.subscriber_stall": ("EventStreamer.run",),
+    "node.churn_kill": ("HeartbeatTracker.heartbeat",),
+    "deploy.health_flap": ("HealthReporter.tick",),
+    "scale.burst": ("AutoscaleDriver.tick",),
 }
 
 
@@ -137,7 +170,9 @@ class ChaosRegistry:
 
     def __init__(self, seed: int = 0,
                  rates: Optional[Dict[str, float]] = None,
-                 delay_ms: float = 2.0):
+                 delay_ms: float = 2.0,
+                 phases: Optional[Dict[str, Tuple[float, float]]] = None,
+                 phased: Optional[Dict[str, Dict[str, float]]] = None):
         rates = dict(rates or {})
         for point, rate in rates.items():
             if point not in FAULT_POINTS:
@@ -149,9 +184,68 @@ class ChaosRegistry:
         self.seed = int(seed)
         self.delay_ms = float(delay_ms)
         self.rates = {p: float(rates.get(p, 0.0)) for p in FAULT_POINTS}
+        # phase name -> (start_s, end_s) relative to arm()
+        self.phases: Dict[str, Tuple[float, float]] = {}
+        for name, window in (phases or {}).items():
+            start, end = float(window[0]), float(window[1])
+            if not name or any(c in name for c in ":;=@"):
+                raise ValueError(f"bad chaos phase name {name!r}")
+            if start < 0.0 or end <= start:
+                raise ValueError(f"chaos phase {name!r} window must have "
+                                 f"0 <= start < end, got {start}-{end}")
+            self.phases[name] = (start, end)
+        # point -> {phase name -> rate}; active only while armed and the
+        # phase window is open
+        self.phased: Dict[str, Dict[str, float]] = {}
+        for point, sched in (phased or {}).items():
+            if point not in FAULT_POINTS:
+                raise ValueError(f"unknown chaos fault point {point!r} "
+                                 f"(known: {', '.join(FAULT_POINTS)})")
+            for phase, rate in sched.items():
+                if phase not in self.phases:
+                    raise ValueError(
+                        f"chaos rate {point}={rate!r}@{phase} references "
+                        f"undeclared phase {phase!r} (declare it with "
+                        f"phase={phase}:<start>-<end>)")
+                if not 0.0 <= float(rate) <= 1.0:
+                    raise ValueError(f"chaos rate for {point!r}@{phase} "
+                                     f"must be in [0, 1], got {rate!r}")
+            self.phased[point] = {ph: float(r) for ph, r in sched.items()}
+        self._t0: Optional[float] = None
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
         self.stats: Dict[str, int] = defaultdict(int)
+
+    def arm(self, now: Optional[float] = None) -> None:
+        """Anchor the phase clock: phase windows are measured from here.
+        Idempotent-by-intent — re-arming restarts the schedule."""
+        self._t0 = time.monotonic() if now is None else float(now)
+
+    def elapsed(self) -> Optional[float]:
+        """Seconds since arm(), or None if not armed."""
+        if self._t0 is None:
+            return None
+        return time.monotonic() - self._t0
+
+    def phase_now(self) -> Tuple[str, ...]:
+        """Names of the phases open at this instant (empty if un-armed)."""
+        t = self.elapsed()
+        if t is None:
+            return ()
+        return tuple(name for name, (a, b) in self.phases.items()
+                     if a <= t < b)
+
+    def effective_rate(self, point: str) -> float:
+        """Base rate maxed with every currently-open phase rate."""
+        rate = self.rates.get(point, 0.0)
+        sched = self.phased.get(point)
+        if sched and self._t0 is not None:
+            t = time.monotonic() - self._t0
+            for phase, prate in sched.items():
+                a, b = self.phases[phase]
+                if a <= t < b and prate > rate:
+                    rate = prate
+        return rate
 
     @classmethod
     def from_spec(cls, spec: str) -> "ChaosRegistry":
@@ -159,6 +253,8 @@ class ChaosRegistry:
         seed = 0
         delay_ms = 2.0
         rates: Dict[str, float] = {}
+        phases: Dict[str, Tuple[float, float]] = {}
+        phased: Dict[str, Dict[str, float]] = {}
         for part in spec.split(";"):
             part = part.strip()
             if not part:
@@ -172,18 +268,41 @@ class ChaosRegistry:
                 seed = int(value)
             elif key == "delay_ms":
                 delay_ms = float(value)
+            elif key == "phase":
+                # phase=<name>:<start>-<end>
+                name, sep, window = value.partition(":")
+                start_s, dash, end_s = window.partition("-")
+                if not sep or not dash or not name.strip():
+                    raise ValueError(
+                        f"bad chaos phase {value!r}: want "
+                        f"phase=<name>:<start>-<end>")
+                phases[name.strip()] = (float(start_s), float(end_s))
+            elif "@" in value:
+                # <point>=<rate>@<phase>
+                rate_s, _, phase = value.partition("@")
+                phase = phase.strip()
+                if not phase:
+                    raise ValueError(f"bad chaos spec element {part!r}: "
+                                     f"empty phase after '@'")
+                phased.setdefault(key, {})[phase] = float(rate_s)
             else:
                 rates[key] = float(value)   # key validated by __init__
-        return cls(seed=seed, rates=rates, delay_ms=delay_ms)
+        return cls(seed=seed, rates=rates, delay_ms=delay_ms,
+                   phases=phases, phased=phased)
 
     def spec(self) -> str:
         """Round-trip back to the env-var grammar."""
         parts = [f"seed={self.seed}", f"delay_ms={self.delay_ms:g}"]
+        parts += [f"phase={n}:{a:g}-{b:g}"
+                  for n, (a, b) in self.phases.items()]
         parts += [f"{p}={r:g}" for p, r in self.rates.items() if r > 0.0]
+        parts += [f"{p}={r:g}@{ph}"
+                  for p, sched in self.phased.items()
+                  for ph, r in sched.items()]
         return ";".join(parts)
 
     def should(self, point: str) -> bool:
-        rate = self.rates.get(point, 0.0)
+        rate = self.effective_rate(point)
         if rate <= 0.0:
             return False
         with self._lock:
@@ -217,6 +336,13 @@ def install(registry: Optional[ChaosRegistry]) -> Optional[ChaosRegistry]:
 
 def uninstall() -> Optional[ChaosRegistry]:
     return install(None)
+
+
+def arm(now: Optional[float] = None) -> None:
+    """Anchor the active registry's phase clock (no-op when disabled)."""
+    reg = active
+    if reg is not None:
+        reg.arm(now)
 
 
 def should(point: str) -> bool:

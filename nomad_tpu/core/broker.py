@@ -170,6 +170,7 @@ class EvalBroker:
                         # poll auto-nacks it, so the worker's eventual ack
                         # or plan submit sees a stale token
                         expires = _time.time()
+                        self.stats["chaos_lease_expired"] += 1
                     race.write("EvalBroker._unack", self)
                     self._unack[token] = _Lease(ev, token, expires)
                     self.stats["dequeued"] += 1

@@ -75,6 +75,28 @@ class DeploymentWatcher:
         for d in self.server.store.deployments():
             if d.active():
                 self._reconcile(d, now)
+            elif d.status == DeploymentStatus.FAILED:
+                self._retry_revert(d)
+
+    def _retry_revert(self, d: Deployment) -> None:
+        """A FAILED auto-revert deployment whose revert register_job was
+        lost (leadership churn or partition between the FAILED upsert and
+        the revert landing) leaves the job stuck on the bad version with
+        nothing to retry it — the deployment is no longer active, so
+        _reconcile never sees it again.  Retry while the job still sits
+        at the deployment's version.  Any version advance (the revert
+        landing, or a newer registration) makes this a no-op, so a
+        re-entered watcher pass can never double-revert or touch a
+        deployment that has been superseded."""
+        if not any(s.auto_revert for s in d.task_groups.values()):
+            return
+        server = self.server
+        job = server.store.job_by_id(d.namespace, d.job_id)
+        if job is None or job.stop or job.version != d.job_version:
+            return
+        stable = self._latest_stable(d.namespace, d.job_id, d.job_version)
+        if stable is not None:
+            server.register_job(stable.copy())
 
     def _reconcile(self, d: Deployment, now: float) -> None:
         server = self.server

@@ -87,6 +87,16 @@ class NodeDrainer:
                 continue
             self._process_node(node, now)
 
+    def _drain_eval(self, a: Allocation, node_id: str) -> Evaluation:
+        ev = Evaluation(
+            namespace=a.namespace, priority=a.job.priority,
+            type=a.job.type, job_id=a.job_id,
+            triggered_by=EvalTrigger.NODE_DRAIN, node_id=node_id,
+            status=EvalStatus.PENDING)
+        # propose-time stamp (the FSM cone must stay deterministic)
+        ev.create_time = ev.modify_time = _time.time()
+        return ev
+
     def _process_node(self, node, now: float) -> None:
         server = self.server
         strategy = node.drain_strategy
@@ -109,14 +119,25 @@ class NodeDrainer:
                          {"node_id": node.id, "drain_strategy": None})
             return
 
+        if node.status in ("down", "disconnected"):
+            # hard-killed (or partitioned away) mid-drain: the node-update
+            # eval path owns these allocs now — the reconciler marks them
+            # lost and places replacements exactly once.  Migrate-marking
+            # or force-stopping here would race that and double-handle.
+            return
+
         deadlined = strategy.force_deadline and now >= strategy.force_deadline
-        evals: Dict[str, Evaluation] = {}
 
         if deadlined:
             # handleDeadlinedNodes (drainer.go:243): force-stop remaining
             # allocs ONCE — the stop makes them server-terminal, so they
-            # drop out of `migratable` and this branch does not re-fire
+            # drop out of `migratable` and this branch does not re-fire.
+            # Stops and their follow-up evals ride ONE raft entry: a
+            # partition between two entries could commit the stops but
+            # lose the evals, stranding the job under count with nothing
+            # left to trigger replacement.
             updates = []
+            evals: Dict[str, Evaluation] = {}
             for a in migratable:
                 u = a.copy()
                 u.desired_status = "stop"
@@ -124,42 +145,44 @@ class NodeDrainer:
                 updates.append(u)
                 key = (a.namespace, a.job_id)
                 if key not in evals and a.job is not None:
-                    evals[key] = Evaluation(
-                        namespace=a.namespace, priority=a.job.priority,
-                        type=a.job.type, job_id=a.job_id,
-                        triggered_by=EvalTrigger.NODE_DRAIN, node_id=node.id,
-                        status=EvalStatus.PENDING)
+                    evals[key] = self._drain_eval(a, node.id)
             if updates:
                 server.apply(MessageType.ALLOC_UPDATE_DESIRED_TRANSITION,
-                             {"allocs": updates})
-            if evals:
-                server.create_evals(list(evals.values()))
+                             {"allocs": updates,
+                              "evals": list(evals.values())})
             return
 
+        # group migrate marks per job so each job's transitions and its
+        # NODE_DRAIN eval commit in one raft entry (same strand hazard as
+        # the deadline branch: a mark without its eval never reschedules)
+        by_job: Dict[str, List[Allocation]] = {}
+        eval_for: Dict[str, Evaluation] = {}
+        marked: Dict[tuple, int] = {}
         for a in migratable:
             if a.desired_transition.should_migrate():
                 continue   # already in flight
             tg = a.job.lookup_task_group(a.task_group)
             max_parallel = tg.migrate.max_parallel if tg is not None else 1
             # respect per-group migrate.max_parallel: count of this
-            # group's allocs already migrating across the cluster
-            in_flight = sum(
+            # group's allocs already migrating across the cluster, plus
+            # the marks batched this tick but not yet applied
+            group_key = (a.namespace, a.job_id, a.task_group)
+            in_flight = marked.get(group_key, 0) + sum(
                 1 for other in server.store.allocs_by_job(a.namespace, a.job_id)
                 if other.task_group == a.task_group
                 and not other.terminal_status()
                 and other.desired_transition.should_migrate())
             if in_flight >= max_parallel:
                 continue
+            marked[group_key] = marked.get(group_key, 0) + 1
             u = a.copy()
             u.desired_transition = DesiredTransition(migrate=True)
-            server.apply(MessageType.ALLOC_UPDATE_DESIRED_TRANSITION,
-                         {"allocs": [u]})
             key = (a.namespace, a.job_id)
-            if key not in evals and a.job is not None:
-                evals[key] = Evaluation(
-                    namespace=a.namespace, priority=a.job.priority,
-                    type=a.job.type, job_id=a.job_id,
-                    triggered_by=EvalTrigger.NODE_DRAIN, node_id=node.id,
-                    status=EvalStatus.PENDING)
-        if evals:
-            server.create_evals(list(evals.values()))
+            by_job.setdefault(key, []).append(u)
+            if key not in eval_for and a.job is not None:
+                eval_for[key] = self._drain_eval(a, node.id)
+        for key, updates in by_job.items():
+            ev = eval_for.get(key)
+            server.apply(MessageType.ALLOC_UPDATE_DESIRED_TRANSITION,
+                         {"allocs": updates,
+                          "evals": [ev] if ev is not None else []})

@@ -12,6 +12,7 @@ import threading
 import time as _time
 from typing import Dict, Optional, Tuple
 
+from nomad_tpu import chaos
 from nomad_tpu.structs.node import NodeStatus
 
 
@@ -40,6 +41,10 @@ class HeartbeatTracker:
     def heartbeat(self, node_id: str) -> float:
         """Reset the node's TTL (Node.UpdateStatus/heartbeat RPC path).
         Returns the TTL so clients know their deadline."""
+        if chaos.active is not None and chaos.should("node.churn_kill"):
+            # swallow the re-arm: the node misses its TTL and expires
+            # through the real _invalidate path (down/disconnected)
+            return self.ttl
         deadline = _time.time() + self.ttl
         with self._lock:
             self._deadlines[node_id] = deadline

@@ -335,6 +335,11 @@ class Server:
                                  args=(stop,), name="eval-reaper", daemon=True)
             t.start()
             self._threads.append(t)
+            dup_t = threading.Thread(target=self._dup_blocked_reaper,
+                                     args=(stop,), name="dup-blocked-reaper",
+                                     daemon=True)
+            dup_t.start()
+            self._threads.append(dup_t)
             self.heartbeats.start()
             # initializeHeartbeatTimers (leader.go:347): nodes registered
             # under a previous leader get timers on the new one, so a node
@@ -442,6 +447,13 @@ class Server:
                 self.broker.enqueue(ev.copy())
             elif ev.should_block():
                 self.blocked_evals.block(ev.copy())
+        # the missed-unblock indexes died with the old leader: a node that
+        # recovered just before the failover is invisible to this tracker,
+        # so a restored eval would block forever on its stale snapshot.
+        # Give every restored eval one clean re-evaluation; the still
+        # infeasible ones re-block with a fresh snapshot_index that this
+        # leader's capacity watch covers.
+        self.blocked_evals.unblock_once(self.store.latest_index)
 
     def _failed_eval_reaper(self, stop: threading.Event) -> None:
         """Mark dead-lettered evals failed and create follow-ups
@@ -462,6 +474,24 @@ class Server:
                 self.config.failed_eval_followup_delay)
             self.create_evals([follow])
             self.broker.ack(ev.id, token)
+
+    def _dup_blocked_reaper(self, stop: threading.Event) -> None:
+        """Cancel duplicate blocked evals in the store (reference
+        reapDupBlockedEvaluations, leader.go:815): the tracker keeps one
+        blocked eval per job and drops the rest, but the dropped ones
+        would otherwise sit BLOCKED in replicated state forever."""
+        while not stop.wait(0.2):
+            if self._stop.is_set():
+                return
+            for ev in self.blocked_evals.get_duplicates():
+                cancelled = ev.copy()
+                cancelled.status = EvalStatus.CANCELLED
+                cancelled.status_description = \
+                    "existing blocked evaluation exists for this job"
+                try:
+                    self.update_eval(cancelled)
+                except Exception:               # noqa: BLE001
+                    pass                        # deposed mid-write: drop
 
     def _gc_loop(self, stop: threading.Event) -> None:
         """Leader periodic GC timers (reference leader.go:782-810 core-job

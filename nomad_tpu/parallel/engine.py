@@ -490,6 +490,15 @@ class PlacementEngine:
             futs = [ex.submit(fn, *a) for fn, a in thunks]
             for f in futs:
                 f.result()
+        # world scatter pair: the measured window's first dirty-row
+        # update must not pay its bucket's compile (shape-keyed on the
+        # world size; the bulk path runs an unsharded world even when a
+        # mesh exists)
+        from nomad_tpu.parallel.world import warm_scatter
+        cap = np.asarray(cm.capacity)
+        warm_scatter(cap.shape, mesh)
+        if mesh is not None:
+            warm_scatter(cap.shape)
         self.stats.update(stats_before)
         self._cache.hits, self._cache.misses = cache_before
 

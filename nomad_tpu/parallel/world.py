@@ -96,6 +96,30 @@ def _single_device_fns():
     return _set_rows_fn, _add_rank1_fn
 
 
+def warm_scatter(shape: tuple, mesh=None) -> None:
+    """Compile the row-scatter kernel for a world of `shape` (N, R) and
+    every ROW_BUCKET before a measured window.  The first dirty-row
+    update of an epoch otherwise pays its bucket's XLA compile inside
+    the steady state (the recompile gate flags it).  Dispatches are
+    pad-only no-ops — every row index is N, so `mode="drop"` discards
+    them — against a throwaway zero world, never a resident one."""
+    import jax
+
+    N, R = shape
+    w = DeviceWorld(mesh)
+    dev = w._put_full(np.zeros((N, R), np.float32))
+    if mesh is None:
+        set_fn, _ = _single_device_fns()
+    else:
+        from nomad_tpu.parallel.sharded import serving_update_fns
+        set_fn, _ = serving_update_fns(mesh)
+    for b in ROW_BUCKETS:
+        rows = np.full(b, N, np.int32)
+        vals = np.zeros((b, R), np.float32)
+        rows_dev, vals_dev = w._put_operands(rows, vals)
+        jax.block_until_ready(set_fn(dev, rows_dev, vals_dev))
+
+
 class DeviceWorld:
     """One epoch's device-resident (capacity, basis) pair.
 

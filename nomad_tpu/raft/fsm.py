@@ -187,7 +187,14 @@ class NomadFSM:
         self.store.update_allocs_from_client(index, p["allocs"])
 
     def _apply_alloc_desired_transition(self, index, p):
+        # reference AllocUpdateDesiredTransitionRequest carries Evals so
+        # the transition and its follow-up eval commit atomically — a
+        # partition between two entries can otherwise strand stopped
+        # allocs with no eval to replace them
         self.store.upsert_allocs(index, p["allocs"])
+        evals = p.get("evals")
+        if evals:
+            self._apply_eval_update(index, {"evals": evals})
 
     # --- plans / deployments / config
 
@@ -338,11 +345,15 @@ class NomadFSM:
             s.matrix.lock = s._lock
             for n in data["nodes"]:
                 s.matrix.upsert_node(n)
+            s._live_names = {}
             for a in data["allocs"]:
                 s._allocs[a.id] = a
                 s._allocs_by_job[(a.namespace, a.job_id)].add(a.id)
                 s._allocs_by_node[a.node_id].add(a.id)
                 s._allocs_by_eval[a.eval_id].add(a.id)
+                if not a.terminal_status():
+                    s._live_names.setdefault(
+                        (a.namespace, a.job_id, a.name), set()).add(a.id)
                 s.matrix.upsert_alloc(a)
             s._applied_plan_ids = list(data.get("applied_plan_ids", []))
             s._applied_plan_ids_set = set(s._applied_plan_ids)

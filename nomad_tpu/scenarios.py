@@ -1,0 +1,1172 @@
+"""Scenario matrix: workload shapes x phased chaos schedules, run as
+convergence soaks on a real 3-server cluster.
+
+Each matrix cell boots a data_dir-backed in-process `Cluster`, registers
+mock client nodes that heartbeat on short TTLs, drives one workload
+shape (batch spine, spread services, device-constrained, preemption,
+serving plane, rolling deploy, autoscaling ramp), and runs a *phased*
+chaos schedule against it: the `NOMAD_TPU_CHAOS` grammar's
+`phase=<name>:<a>-<b>` windows interleave calm -> storm -> calm, with
+server hard_kill/restart and partition bursts riding the storm phases.
+After chaos lifts the cell must CONVERGE, and the runner asserts the
+production invariants the reconcilers promise:
+
+    evals_drained        every eval terminal (BLOCKED allowed only for
+                         capacity-starved shapes), no broker leases, no
+                         queued plans
+    allocs_consistent    every group at its final desired count, no
+                         duplicate names among live allocs, every live
+                         alloc on a live ready node
+    fsm_identical        canonical FSM snapshots byte-equal across all
+                         members (survivors AND restarted crashers)
+    deployments_settled  no active deployments; a FAILED auto-revert
+                         deployment implies the job version moved past it
+    drained_nodes_empty  drained nodes hold no live allocs and their
+                         strategy is cleared
+
+Cells emit `BENCH_matrix_<shape>_<schedule>.json` trajectory files
+(allocs/s, plan.submit p50/p99, convergence time, invariant verdicts);
+`bench.py --matrix` runs the full matrix and `--matrix --smoke` the
+curated CI subset.
+
+The three chaos points this plane owns:
+
+    node.churn_kill     injected in HeartbeatTracker.heartbeat (a client
+                        heartbeat is swallowed, the node expires through
+                        the real TTL-miss path)
+    deploy.health_flap  injected in HealthReporter.tick below (a healthy
+                        alloc reports unhealthy, driving the deployment
+                        watcher into failure/auto-revert)
+    scale.burst         injected in AutoscaleDriver.tick below (a scale
+                        wave is amplified to the policy max bound)
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+import shutil
+import tempfile
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from nomad_tpu import chaos, mock
+from nomad_tpu.chaos import ChaosRegistry
+from nomad_tpu.core.cluster import Cluster
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.core.worker import TRANSIENT_ERRORS
+from nomad_tpu.raft import RaftConfig
+from nomad_tpu.structs import (
+    AllocClientStatus,
+    DeploymentStatus,
+    EvalStatus,
+)
+from nomad_tpu.structs.job import (
+    ReschedulePolicy,
+    ScalingPolicy,
+    UpdateStrategy,
+)
+from nomad_tpu.structs.resources import DeviceRequest, NodeDevice
+
+
+# ------------------------------------------------------------- utilities
+
+
+def _wait(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _on_leader(cluster, fn, timeout=15.0):
+    """Run fn(leader), retrying across leadership churn / chaos drops."""
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return fn(cluster.leader(timeout=5.0))
+        except TRANSIENT_ERRORS + (TimeoutError,):
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.05)
+
+
+def _canon(blob):
+    """Canonicalize an FSM snapshot for equality (pickle memoizes shared
+    references, so byte-different blobs can encode identical state):
+    re-pickle each item standalone, order-free."""
+    data = pickle.loads(blob)
+    out = {}
+    for key, val in sorted(data.items()):
+        if isinstance(val, list):
+            out[key] = sorted(pickle.dumps(v) for v in val)
+        elif isinstance(val, dict):
+            out[key] = {k: pickle.dumps(v) for k, v in sorted(val.items())}
+        else:
+            out[key] = pickle.dumps(val)
+    return out
+
+
+def _tune(server: Server) -> None:
+    """Fast redelivery so injected nacks/lease expiries resolve inside a
+    cell; applied to every incarnation (restart() builds fresh Servers
+    that would otherwise revert to the 60s production defaults)."""
+    server.broker.nack_timeout = 1.0
+    server.broker.initial_nack_delay = 0.05
+    server.broker.subsequent_nack_delay = 0.1
+
+
+def _live(allocs):
+    return [a for a in allocs if not a.terminal_status()]
+
+
+# ------------------------------------------------------------- schedules
+
+
+@dataclass(frozen=True)
+class Schedule:
+    """One phased chaos schedule: a NOMAD_TPU_CHAOS-grammar spec with a
+    `{seed}` placeholder, the total chaos window, and whether seeded
+    server churn (hard_kill/restart + partition flaps) rides the open
+    phases."""
+    name: str
+    spec: str
+    duration_s: float
+    server_churn: bool
+
+
+SCHEDULES: Dict[str, Schedule] = {
+    # calm -> node-churn storm -> calm: heartbeats swallowed, leases
+    # shed, raft traffic dropped/partitioned, servers hard-killed and
+    # restarted from their WALs mid-flight
+    "storm": Schedule(
+        name="storm",
+        spec=("seed={seed};delay_ms=1;phase=storm:0.6-3.0;"
+              "rpc.drop=0.03@storm;rpc.delay=0.08@storm;"
+              "raft.partition=0.02@storm;broker.lease_expire=0.25@storm;"
+              "node.churn_kill=0.5@storm;deploy.health_flap=0.12@storm;"
+              "scale.burst=0.25@storm"),
+        duration_s=3.8,
+        server_churn=True,
+    ),
+    # two lease-shedding windows with a calm gap: every broker dequeue
+    # hands out near-expired leases, read leases void, deployment health
+    # reports flap — no servers die, the control loops must absorb pure
+    # redelivery pressure
+    "lease_flap": Schedule(
+        name="lease_flap",
+        spec=("seed={seed};delay_ms=1;"
+              "phase=flap1:0.3-1.6;phase=flap2:2.3-3.6;"
+              "broker.lease_expire=0.5@flap1;broker.lease_expire=0.5@flap2;"
+              "read.lease_expire=0.4@flap1;read.lease_expire=0.4@flap2;"
+              "deploy.health_flap=0.2@flap1;deploy.health_flap=0.2@flap2;"
+              "scale.burst=0.35@flap1;scale.burst=0.35@flap2;"
+              "rpc.delay=0.1@flap1;rpc.delay=0.1@flap2"),
+        duration_s=4.2,
+        server_churn=False,
+    ),
+}
+
+
+# --------------------------------------------------------- shape context
+
+
+@dataclass
+class CellCtx:
+    """Mutable per-cell state shared between the runner, the drivers,
+    and the invariant checker."""
+    namespace: str = "default"
+    # job ids whose groups must sit exactly at their (final) tg.count
+    exact_jobs: List[str] = field(default_factory=list)
+    # job ids allowed below count (capacity-starved fillers)
+    at_most_jobs: List[str] = field(default_factory=list)
+    allow_blocked: bool = False
+    drain_candidates: List[str] = field(default_factory=list)
+    drained: List[str] = field(default_factory=list)
+    node_ids: List[str] = field(default_factory=list)
+    notes: Dict[str, object] = field(default_factory=dict)
+
+    def tracked_jobs(self) -> List[str]:
+        return self.exact_jobs + self.at_most_jobs
+
+
+# ---------------------------------------------------------- background
+
+
+class NodeKeeper(threading.Thread):
+    """The mock client fleet: heartbeats every node through the leader.
+    chaos `node.churn_kill` swallows re-arms inside HeartbeatTracker, so
+    under a storm nodes expire through the REAL ttl-miss path and come
+    back ready once their heartbeats land again."""
+
+    def __init__(self, cluster: Cluster, node_ids: List[str],
+                 interval: float = 0.3):
+        super().__init__(name="matrix-keeper", daemon=True)
+        self.cluster = cluster
+        self.node_ids = node_ids
+        self.interval = interval
+        self.stop_flag = threading.Event()
+
+    def run(self):
+        while not self.stop_flag.is_set():
+            try:
+                ld = self.cluster.leader(timeout=1.0)
+                for nid in self.node_ids:
+                    ld.node_heartbeat(nid)
+            except Exception:           # noqa: BLE001 — chaos/no-leader
+                pass
+            self.stop_flag.wait(self.interval)
+
+
+class HealthReporter(threading.Thread):
+    """The client health plane: marks live allocs running+healthy via
+    the real Node.UpdateAlloc RPC (raft-replicated, never a direct store
+    write — FSM parity is one of the invariants under test).  Carries
+    the `deploy.health_flap` chaos point: a firing flips one report to
+    unhealthy, which is exactly what drives the deployment watcher into
+    failure and auto-revert."""
+
+    def __init__(self, cluster: Cluster, ctx: CellCtx,
+                 interval: float = 0.15):
+        super().__init__(name="matrix-health", daemon=True)
+        self.cluster = cluster
+        self.ctx = ctx
+        self.interval = interval
+        self.stop_flag = threading.Event()
+        self.flaps = 0
+
+    def tick(self):
+        try:
+            ld = self.cluster.leader(timeout=1.0)
+        except TimeoutError:
+            return
+        updates = []
+        for job_id in list(self.ctx.tracked_jobs()):
+            for a in ld.store.allocs_by_job(self.ctx.namespace, job_id):
+                if a.terminal_status():
+                    continue
+                healthy = True
+                if a.deployment_id and chaos.active is not None \
+                        and chaos.should("deploy.health_flap"):
+                    healthy = False
+                    self.flaps += 1
+                current = (a.deployment_status or {}).get("healthy")
+                if a.client_status == AllocClientStatus.RUNNING \
+                        and current is healthy:
+                    continue
+                u = a.copy()
+                u.client_status = AllocClientStatus.RUNNING
+                u.deployment_status = {"healthy": healthy}
+                updates.append(u)
+        if updates:
+            ld.endpoints.handle("Node.UpdateAlloc", {"allocs": updates})
+
+    def run(self):
+        while not self.stop_flag.is_set():
+            try:
+                self.tick()
+            except Exception:           # noqa: BLE001 — chaos/no-leader
+                pass
+            self.stop_flag.wait(self.interval)
+
+
+class AutoscaleDriver:
+    """Scale-up/down waves through the services-scaling path (Job.Scale
+    with ScalingPolicy bounds).  Carries the `scale.burst` chaos point: a
+    firing amplifies the wave's target to the policy max, stacking a
+    burst registration on top of whatever the broker is redelivering."""
+
+    def __init__(self, cluster: Cluster, ctx: CellCtx, job_id: str,
+                 group: str, waves: List[int], policy_max: int,
+                 interval: float = 0.6):
+        self.cluster = cluster
+        self.ctx = ctx
+        self.job_id = job_id
+        self.group = group
+        self.waves = list(waves)
+        self.policy_max = policy_max
+        self.interval = interval
+        self._next_at = 0.0
+        self._wave = 0
+        self.applied: List[int] = []
+        self.bursts = 0
+
+    def tick(self, now: Optional[float] = None):
+        now = time.time() if now is None else now
+        if now < self._next_at or self._wave >= len(self.waves):
+            return
+        self._next_at = now + self.interval
+        target = self.waves[self._wave]
+        self._wave += 1
+        if chaos.active is not None and chaos.should("scale.burst"):
+            target = self.policy_max
+            self.bursts += 1
+        try:
+            _on_leader(self.cluster, lambda ld: ld.scale_job(
+                self.ctx.namespace, self.job_id, self.group, count=target,
+                message=f"matrix wave -> {target}"), timeout=5.0)
+            self.applied.append(target)
+        except TRANSIENT_ERRORS + (TimeoutError,):
+            self._wave -= 1             # wave lost to chaos: retry it
+
+
+class ChurnDriver:
+    """Seeded server churn riding the schedule's open phases: at most
+    one impaired member at a time (quorum must survive), alternating
+    power-loss hard_kill -> WAL restart with isolate -> heal partition
+    flaps."""
+
+    def __init__(self, cluster: Cluster, reg: ChaosRegistry,
+                 rng: random.Random):
+        self.cluster = cluster
+        self.reg = reg
+        self.rng = rng
+        self.dead = None                # (server, revive_at)
+        self.isolated = None            # (server, heal_at)
+        self._next_op = 0.0
+        self.kills = 0
+        self.restarts = 0
+        self.partitions = 0
+
+    def tick(self, now: Optional[float] = None):
+        now = time.time() if now is None else now
+        if self.dead is not None and now >= self.dead[1]:
+            replacement = self.cluster.restart(self.dead[0])
+            _tune(replacement)
+            self.dead = None
+            self.restarts += 1
+        if self.isolated is not None and now >= self.isolated[1]:
+            self.cluster.heal(self.isolated[0])
+            self.isolated = None
+        if not self.reg.phase_now():
+            return
+        if now < self._next_op or self.dead or self.isolated:
+            return
+        self._next_op = now + 0.45
+        victim = self.cluster.servers[
+            self.rng.randrange(len(self.cluster.servers))]
+        if self.rng.random() < 0.5:
+            self.cluster.hard_kill(victim)
+            self.dead = (victim, now + 0.7)
+            self.kills += 1
+        else:
+            self.cluster.isolate(victim)
+            self.isolated = (victim, now + 0.4)
+            self.partitions += 1
+
+    def restore(self):
+        if self.isolated is not None:
+            self.cluster.heal(self.isolated[0])
+            self.isolated = None
+        if self.dead is not None:
+            _tune(self.cluster.restart(self.dead[0]))
+            self.dead = None
+            self.restarts += 1
+
+    def events(self) -> Dict[str, int]:
+        return {"hard_kills": self.kills, "restarts": self.restarts,
+                "partitions": self.partitions}
+
+
+# --------------------------------------------------------------- shapes
+
+
+def _wait_live(cluster, ctx, job_id, want, timeout=120.0):
+    def placed():
+        try:
+            ld = cluster.leader(timeout=2.0)
+        except TimeoutError:
+            return False
+        return len(_live(ld.store.allocs_by_job(ctx.namespace, job_id))) \
+            >= want
+    if not _wait(placed, timeout):
+        raise TimeoutError(
+            f"initial placement for {job_id} did not reach {want}")
+
+
+def _service_job(count, cpu=500, mem=256, spread=False, priority=None):
+    from nomad_tpu.structs.job import Affinity, Spread
+    j = mock.job()
+    tg = j.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.cpu = cpu
+    tg.tasks[0].resources.memory_mb = mem
+    tg.ephemeral_disk.size_mb = 0
+    j.update = None
+    tg.update = None
+    if spread:
+        tg.spreads = [Spread("${attr.rack}", 50, ())]
+        tg.affinities = [Affinity("${node.datacenter}", "dc1", "=", 50)]
+    if priority is not None:
+        j.priority = priority
+    return j
+
+
+def _batch_job(count, cpu=300, mem=128):
+    j = mock.batch_job()
+    tg = j.task_groups[0]
+    tg.count = count
+    tg.tasks[0].resources.cpu = cpu
+    tg.tasks[0].resources.memory_mb = mem
+    tg.ephemeral_disk.size_mb = 0
+    # the matrix asserts exact post-chaos counts, so a storm must never
+    # exhaust the default batch policy's single reschedule attempt into
+    # a stable live-0 state
+    tg.reschedule_policy = ReschedulePolicy(
+        delay_s=0.2, delay_function="constant", unlimited=True)
+    return j
+
+
+class Shape:
+    """One workload shape.  setup() builds pre-chaos steady state (and
+    declares expectations in ctx), during() is pumped ~20x/s inside the
+    chaos window, finish() runs after chaos lifts, before invariants."""
+
+    name = "shape"
+    n_nodes = 8
+
+    def make_nodes(self, rng: random.Random):
+        nodes = []
+        for i in range(self.n_nodes):
+            n = mock.node()
+            n.attributes["rack"] = f"r{i % 4}"
+            nodes.append(n)
+        return nodes
+
+    def setup(self, cluster: Cluster, rng: random.Random, ctx: CellCtx):
+        raise NotImplementedError
+
+    def during(self, cluster: Cluster, rng: random.Random, ctx: CellCtx,
+               reg: ChaosRegistry):
+        pass
+
+    def finish(self, cluster: Cluster, ctx: CellCtx):
+        pass
+
+
+class E2ESpineShape(Shape):
+    """Batch spine: steady batch jobs placed pre-chaos, more registered
+    mid-storm; every group must sit at count afterwards."""
+
+    name = "e2e_spine"
+
+    def setup(self, cluster, rng, ctx):
+        self._extra_registered = False
+        for _ in range(3):
+            j = _batch_job(6)
+            _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+            ctx.exact_jobs.append(j.id)
+            _wait_live(cluster, ctx, j.id, 6)
+        ctx.drain_candidates = list(ctx.node_ids)
+
+    def during(self, cluster, rng, ctx, reg):
+        if self._extra_registered or not reg.phase_now():
+            return
+        self._extra_registered = True
+        for _ in range(2):
+            j = _batch_job(4)
+            _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+            ctx.exact_jobs.append(j.id)
+
+
+class ScanSpreadShape(Shape):
+    """Spread+affinity service jobs (the chained-scan placement path):
+    the spread constraints must re-solve every time churn moves allocs."""
+
+    name = "scan_spread"
+
+    def setup(self, cluster, rng, ctx):
+        self._extra_registered = False
+        for _ in range(3):
+            j = _service_job(4, spread=True)
+            _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+            ctx.exact_jobs.append(j.id)
+            _wait_live(cluster, ctx, j.id, 4)
+        ctx.drain_candidates = list(ctx.node_ids)
+
+    def during(self, cluster, rng, ctx, reg):
+        if self._extra_registered or not reg.phase_now():
+            return
+        self._extra_registered = True
+        j = _service_job(4, spread=True)
+        _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+        ctx.exact_jobs.append(j.id)
+
+
+class DeviceConstrainedShape(Shape):
+    """Half the fleet carries GPU device groups; jobs pin DeviceRequest,
+    so lost-node replacement must re-find device capacity, not just cpu."""
+
+    name = "device_constrained"
+
+    def make_nodes(self, rng):
+        nodes = super().make_nodes(rng)
+        self._device_nodes = []
+        for i, n in enumerate(nodes):
+            if i % 2 == 0:
+                n.node_resources.devices = [NodeDevice(
+                    vendor="nvidia", type="gpu", name="a100",
+                    instance_ids=[f"gpu-{n.id[:8]}-0", f"gpu-{n.id[:8]}-1"])]
+                self._device_nodes.append(n.id)
+        return nodes
+
+    def setup(self, cluster, rng, ctx):
+        self._mid_registered = False
+        for _ in range(2):
+            j = _batch_job(3)
+            j.task_groups[0].tasks[0].resources.devices = [
+                DeviceRequest(name="gpu", count=1)]
+            _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+            ctx.exact_jobs.append(j.id)
+            _wait_live(cluster, ctx, j.id, 3)
+        # draining a device node could starve instances: drain cpu-only
+        ctx.drain_candidates = [nid for nid in ctx.node_ids
+                                if nid not in self._device_nodes]
+
+    def during(self, cluster, rng, ctx, reg):
+        # a device job landing mid-chaos: the feasibility walk must find
+        # gpu instances while heartbeats are being swallowed
+        if self._mid_registered or not reg.phase_now():
+            return
+        self._mid_registered = True
+        j = _batch_job(2)
+        j.task_groups[0].tasks[0].resources.devices = [
+            DeviceRequest(name="gpu", count=1)]
+        _on_leader(cluster, lambda ld: ld.register_job(j))
+        ctx.exact_jobs.append(j.id)
+
+
+class PreemptionHeavyShape(Shape):
+    """The fleet packed with low-priority fillers; a priority-90 service
+    job lands mid-chaos and must preempt its way in.  Displaced fillers
+    legitimately block on capacity, so BLOCKED evals are allowed."""
+
+    name = "preemption_heavy"
+    n_nodes = 6
+
+    def setup(self, cluster, rng, ctx):
+        self._service_registered = False
+        import copy as _copy
+
+        def enable_preemption(ld):
+            from nomad_tpu.raft import MessageType
+            cfg = _copy.deepcopy(ld.store.scheduler_config)
+            cfg.preemption_config.service_scheduler_enabled = True
+            cfg.preemption_config.batch_scheduler_enabled = True
+            ld.apply(MessageType.SCHEDULER_CONFIG, {"config": cfg})
+        _on_leader(cluster, enable_preemption)
+        # 4 slots per node (900cpu/1800mb on 4000/8192) -> 24 slots, all
+        # taken by fillers
+        self.filler = _batch_job(24, cpu=900, mem=1800)
+        self.filler.priority = 20
+        _on_leader(cluster, lambda ld: ld.register_job(self.filler))
+        ctx.at_most_jobs.append(self.filler.id)
+        ctx.allow_blocked = True
+        _wait_live(cluster, ctx, self.filler.id, 24)
+
+    def during(self, cluster, rng, ctx, reg):
+        if self._service_registered or not reg.phase_now():
+            return
+        self._service_registered = True
+        j = _service_job(4, cpu=900, mem=1800, priority=90)
+        _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+        ctx.exact_jobs.append(j.id)
+
+
+class ServingPlaneShape(Shape):
+    """The read path under chaos: event subscriptions plus follower
+    lease reads keep running while the spine registers jobs; reads may
+    fail during churn but must resume, and the write-side invariants
+    still hold."""
+
+    name = "serving_plane"
+
+    def setup(self, cluster, rng, ctx):
+        self._extra_registered = False
+        for _ in range(2):
+            j = _service_job(4)
+            _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+            ctx.exact_jobs.append(j.id)
+            _wait_live(cluster, ctx, j.id, 4)
+        ctx.drain_candidates = list(ctx.node_ids)
+        self._stop = threading.Event()
+        self._reads_ok = [0]
+        self._reads_err = [0]
+        self._events = [0]
+        follower = cluster.followers()[0]
+        self._subs = []
+        try:
+            self._subs = [follower.event_broker.subscribe(
+                {"*": ["*"]}, max_queue=64) for _ in range(32)]
+        except Exception:               # noqa: BLE001
+            pass
+
+        def reader():
+            while not self._stop.is_set():
+                srv = cluster.servers[rng.randrange(len(cluster.servers))]
+                try:
+                    srv.read("Job.List", {}, consistency="default",
+                             timeout=1.0)
+                    self._reads_ok[0] += 1
+                except Exception:       # noqa: BLE001
+                    self._reads_err[0] += 1
+                for sub in self._subs[:8]:
+                    try:
+                        while sub.next(timeout=0.0) is not None:
+                            self._events[0] += 1
+                    except Exception:   # noqa: BLE001
+                        pass
+                time.sleep(0.01)
+
+        self._threads = [threading.Thread(target=reader, daemon=True)
+                         for _ in range(2)]
+        for t in self._threads:
+            t.start()
+
+    def during(self, cluster, rng, ctx, reg):
+        if self._extra_registered or not reg.phase_now():
+            return
+        self._extra_registered = True
+        j = _service_job(4)
+        _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+        ctx.exact_jobs.append(j.id)
+
+    def finish(self, cluster, ctx):
+        self._stop.set()
+        for t in self._threads:
+            t.join(2.0)
+        for sub in self._subs:
+            try:
+                sub.close()
+            except Exception:           # noqa: BLE001
+                pass
+        ctx.notes["reads_ok"] = self._reads_ok[0]
+        ctx.notes["reads_err"] = self._reads_err[0]
+        ctx.notes["events_consumed"] = self._events[0]
+
+
+class RollingDeployShape(Shape):
+    """Rolling deploy under churn: v0 stable and healthy, then a canary
+    + auto-revert destructive update lands mid-chaos while nodes die.
+    The deployment must settle — promoted to SUCCESSFUL, or FAILED with
+    the job auto-reverted to the stable version."""
+
+    name = "rolling_deploy"
+    n_nodes = 6
+
+    def setup(self, cluster, rng, ctx):
+        self._v1_registered = False
+        j = _service_job(4)
+        j.update = UpdateStrategy(max_parallel=2, auto_revert=True,
+                                  canary=1, auto_promote=True,
+                                  health_check="checks")
+        self.job = j
+        _on_leader(cluster, lambda ld: ld.register_job(j))
+        ctx.exact_jobs.append(j.id)
+        _wait_live(cluster, ctx, j.id, 4)
+        # v0 healthy (the HealthReporter isn't running yet in setup)
+        def mark_healthy(ld):
+            updates = []
+            for a in ld.store.allocs_by_job(ctx.namespace, j.id):
+                if a.terminal_status():
+                    continue
+                u = a.copy()
+                u.client_status = AllocClientStatus.RUNNING
+                u.deployment_status = {"healthy": True}
+                updates.append(u)
+            ld.endpoints.handle("Node.UpdateAlloc", {"allocs": updates})
+        _on_leader(cluster, mark_healthy)
+        # v0 is the stable rollback target
+        _on_leader(cluster, lambda ld: ld.set_job_stability(
+            ctx.namespace, j.id, 0, True))
+        ctx.drain_candidates = list(ctx.node_ids)
+        ctx.notes["v0_config"] = dict(
+            j.task_groups[0].tasks[0].config)
+
+    def during(self, cluster, rng, ctx, reg):
+        if self._v1_registered or not reg.phase_now():
+            return
+        self._v1_registered = True
+        v1 = self.job.copy()
+        v1.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        _on_leader(cluster, lambda ld: ld.register_job(v1))
+        ctx.notes["v1_config"] = {"command": "/bin/new"}
+
+    def finish(self, cluster, ctx):
+        def outcome(ld):
+            job = ld.store.job_by_id(ctx.namespace, self.job.id)
+            deps = [d for d in ld.store.deployments()
+                    if d.job_id == self.job.id]
+            return {"job_version": job.version if job else None,
+                    "config": dict(job.task_groups[0].tasks[0].config)
+                    if job else None,
+                    "deployments": [(d.job_version, d.status)
+                                    for d in deps]}
+        try:
+            ctx.notes["deploy_outcome"] = _on_leader(cluster, outcome,
+                                                     timeout=5.0)
+        except TRANSIENT_ERRORS + (TimeoutError,):
+            pass
+
+
+class AutoscaleRampShape(Shape):
+    """Autoscaling ramp: Job.Scale waves walk a ScalingPolicy-bounded
+    group up and down while the broker sheds leases; a final post-chaos
+    wave sets the count every invariant is measured against."""
+
+    name = "autoscale_ramp"
+    n_nodes = 6
+    FINAL = 5
+
+    def setup(self, cluster, rng, ctx):
+        j = _service_job(2)
+        j.task_groups[0].scaling = ScalingPolicy(min=1, max=10,
+                                                 enabled=True)
+        self.job = j
+        _on_leader(cluster, lambda ld: ld.register_job(j))
+        ctx.exact_jobs.append(j.id)
+        _wait_live(cluster, ctx, j.id, 2)
+        self.driver = AutoscaleDriver(
+            cluster, ctx, j.id, j.task_groups[0].name,
+            waves=[6, 3, 8, 4, 7, 3, 8, 5], policy_max=10,
+            interval=0.45)
+
+    def during(self, cluster, rng, ctx, reg):
+        self.driver.tick()
+
+    def finish(self, cluster, ctx):
+        # the settling wave: whatever the chaos window left behind, the
+        # group must converge to FINAL
+        _on_leader(cluster, lambda ld: ld.scale_job(
+            ctx.namespace, self.job.id, self.job.task_groups[0].name,
+            count=self.FINAL, message="matrix settle"), timeout=20.0)
+        ctx.notes["scale_waves_applied"] = self.driver.applied
+        ctx.notes["scale_bursts"] = self.driver.bursts
+
+
+SHAPES: Dict[str, Callable[[], Shape]] = {
+    "e2e_spine": E2ESpineShape,
+    "scan_spread": ScanSpreadShape,
+    "device_constrained": DeviceConstrainedShape,
+    "preemption_heavy": PreemptionHeavyShape,
+    "serving_plane": ServingPlaneShape,
+    "rolling_deploy": RollingDeployShape,
+    "autoscale_ramp": AutoscaleRampShape,
+}
+
+
+# ----------------------------------------------------------- invariants
+
+
+def _open_evals(ld, ctx):
+    out = []
+    for e in ld.store.evals():
+        if EvalStatus.terminal(e.status):
+            continue
+        if ctx.allow_blocked and e.status == EvalStatus.BLOCKED:
+            continue
+        out.append(e)
+    return out
+
+
+def _alloc_problems(ld, ctx) -> List[str]:
+    problems = []
+    nodes = {n.id: n for n in ld.store.nodes()}
+    for job_id in ctx.tracked_jobs():
+        exact = job_id in ctx.exact_jobs
+        job = ld.store.job_by_id(ctx.namespace, job_id)
+        if job is None:
+            problems.append(f"{job_id}: job vanished")
+            continue
+        live = _live(ld.store.allocs_by_job(ctx.namespace, job_id))
+        for tg in job.task_groups:
+            glive = [a for a in live if a.task_group == tg.name]
+            names = [a.name for a in glive]
+            if len(set(names)) != len(names):
+                dupes = sorted({n for n in names if names.count(n) > 1})
+                problems.append(
+                    f"{job_id}/{tg.name}: duplicate live allocs {dupes}")
+            if exact and len(glive) != tg.count:
+                problems.append(
+                    f"{job_id}/{tg.name}: live {len(glive)} != "
+                    f"count {tg.count}")
+            if not exact and len(glive) > tg.count:
+                problems.append(
+                    f"{job_id}/{tg.name}: live {len(glive)} > "
+                    f"count {tg.count} (orphans)")
+            for a in glive:
+                node = nodes.get(a.node_id)
+                if node is None:
+                    problems.append(
+                        f"{job_id}/{tg.name}: alloc {a.id[:8]} on "
+                        f"missing node {a.node_id[:8]}")
+                elif node.status != "ready":
+                    problems.append(
+                        f"{job_id}/{tg.name}: alloc {a.id[:8]} on "
+                        f"{node.status} node {a.node_id[:8]}")
+    return problems
+
+
+def _deployment_problems(ld, ctx) -> List[str]:
+    problems = []
+    for d in ld.store.deployments():
+        if d.active():
+            problems.append(f"deployment {d.id[:8]} still "
+                            f"{d.status} (job {d.job_id})")
+            continue
+        if d.status == DeploymentStatus.FAILED and any(
+                s.auto_revert for s in d.task_groups.values()):
+            job = ld.store.job_by_id(d.namespace, d.job_id)
+            if job is not None and not job.stop \
+                    and job.version <= d.job_version:
+                problems.append(
+                    f"deployment {d.id[:8]} FAILED with auto_revert but "
+                    f"job {d.job_id} still at version {job.version}")
+    return problems
+
+
+def _drain_problems(ld, ctx) -> List[str]:
+    problems = []
+    for nid in ctx.drained:
+        node = ld.store.node_by_id(nid)
+        if node is None:
+            continue                    # gc'd: trivially empty
+        if node.drain_strategy is not None:
+            problems.append(f"drained node {nid[:8]} still has a "
+                            f"drain strategy")
+        stuck = _live(ld.store.allocs_by_node(nid))
+        if stuck:
+            problems.append(f"drained node {nid[:8]} still holds "
+                            f"{len(stuck)} live allocs")
+    return problems
+
+
+def _quick_converged(cluster, ctx) -> bool:
+    try:
+        ld = cluster.leader(timeout=2.0)
+    except TimeoutError:
+        return False
+    if _open_evals(ld, ctx):
+        return False
+    with ld.broker._lock:
+        leases = len(ld.broker._unack)
+    if leases or ld.broker.ready_count() or ld.plan_queue._heap:
+        return False
+    if _alloc_problems(ld, ctx):
+        return False
+    if any(d.active() for d in ld.store.deployments()):
+        return False
+    if _drain_problems(ld, ctx):
+        return False
+    return True
+
+
+def check_convergence(cluster: Cluster, ctx: CellCtx,
+                      timeout: float = 60.0) -> dict:
+    """Wait for post-chaos convergence, then run the full invariant
+    battery and report per-invariant verdicts.  The battery retries a
+    few times before declaring failure: a node reviving mid-battery
+    kicks off node-update evals and legal transient states (an old
+    alloc still draining next to its replacement), which settle within
+    seconds — a genuine violation (duplicate live names, an orphaned
+    deployment, a stuck eval) persists across every retry."""
+    t0 = time.time()
+    converged = _wait(lambda: _quick_converged(cluster, ctx),
+                      timeout=timeout, interval=0.1)
+    conv_time = time.time() - t0
+
+    last = None
+    for attempt in range(3):
+        if attempt:
+            time.sleep(5.0)
+            converged = _wait(lambda: _quick_converged(cluster, ctx),
+                              timeout=15.0, interval=0.1)
+        last = _invariant_battery(cluster, ctx, converged, conv_time)
+        if last["converged"]:
+            return last
+    return last
+
+
+def _invariant_battery(cluster: Cluster, ctx: CellCtx,
+                       converged: bool, conv_time: float) -> dict:
+    ld = cluster.leader(timeout=10.0)
+    invariants: Dict[str, dict] = {}
+
+    open_evals = _open_evals(ld, ctx)
+    ev_detail = [f"{e.id[:8]}({e.status}:{e.triggered_by})"
+                 for e in open_evals[:8]]
+    with ld.broker._lock:
+        leases = len(ld.broker._unack)
+    queued = len(ld.plan_queue._heap)
+    invariants["evals_drained"] = {
+        "ok": not open_evals and not leases and not queued,
+        "detail": (f"open={ev_detail} leases={leases} plans={queued}"
+                   if (open_evals or leases or queued) else "clean"),
+    }
+
+    probs = _alloc_problems(ld, ctx)
+    invariants["allocs_consistent"] = {
+        "ok": not probs, "detail": probs[:8] or "clean"}
+
+    # identical FSM state across every member (survivors and restarted
+    # crashers) once all have applied through the leader's index
+    fsm_detail = "clean"
+    fsm_ok = False
+    try:
+        ld.raft.barrier()
+        if not cluster.wait_replication(ld.store.latest_index,
+                                        timeout=15.0):
+            fsm_detail = "replication did not catch up"
+        else:
+            # background writers (keeper heartbeats re-registering a
+            # late-recovering node, the eval reapers) can commit an entry
+            # between two members' snapshots — only an equal-index
+            # quiescent mismatch is real divergence, so retry the compare
+            # until the applied index holds still across one pass
+            for _ in range(12):
+                idx0 = ld.raft.last_applied
+                if not _wait(lambda: all(
+                        s.raft is not None
+                        and s.raft.last_applied >= idx0
+                        for s in cluster.servers), 15.0):
+                    fsm_detail = "apply lag did not catch up"
+                    break
+                blobs = {s.name: _canon(s.raft.fsm.snapshot())
+                         for s in cluster.servers}
+                ref = blobs[ld.name]
+                diverged = [name for name, blob in blobs.items()
+                            if blob != ref]
+                if not diverged:
+                    fsm_ok = True
+                    fsm_detail = "clean"
+                    break
+                tables = sorted({k for name in diverged
+                                 for k in (set(blobs[name]) | set(ref))
+                                 if blobs[name].get(k) != ref.get(k)})
+                if all(s.raft.last_applied == idx0
+                       for s in cluster.servers) \
+                        and ld.raft.last_applied == idx0:
+                    fsm_detail = (f"diverged members (quiescent): "
+                                  f"{diverged} tables={tables}")
+                    break
+                fsm_detail = (f"diverged members (index moving): "
+                              f"{diverged} tables={tables}")
+                time.sleep(0.25)
+    except Exception as e:              # noqa: BLE001
+        fsm_detail = f"snapshot compare failed: {e!r}"
+    invariants["fsm_identical"] = {"ok": fsm_ok, "detail": fsm_detail}
+
+    probs = _deployment_problems(ld, ctx)
+    invariants["deployments_settled"] = {
+        "ok": not probs, "detail": probs[:8] or "clean"}
+
+    probs = _drain_problems(ld, ctx)
+    invariants["drained_nodes_empty"] = {
+        "ok": not probs, "detail": probs[:8] or "clean"}
+
+    all_ok = converged and all(v["ok"] for v in invariants.values())
+    return {"converged": bool(converged and all_ok),
+            "convergence_time_s": round(conv_time, 2),
+            "invariants": invariants}
+
+
+# --------------------------------------------------------------- runner
+
+
+def _plan_submit_sample() -> dict:
+    from nomad_tpu.telemetry import global_metrics
+    m = global_metrics.take_sample("nomad.plan.submit")
+    return {"p50": round(m["p50"], 2), "p99": round(m["p99"], 2),
+            "count": m["count"]}
+
+
+def run_cell(shape_name: str, schedule_name: str, seed: int = 1,
+             out_dir: str = ".", spec_override: Optional[str] = None,
+             converge_timeout: float = 60.0) -> dict:
+    """Run one matrix cell and write its trajectory JSON.  Returns the
+    trajectory dict; result["convergence"]["converged"] is the verdict."""
+    shape = SHAPES[shape_name]()
+    if spec_override is not None:
+        spec = spec_override
+        sched = Schedule(name=schedule_name, spec=spec_override,
+                         duration_s=4.0, server_churn=False)
+    else:
+        sched = SCHEDULES[schedule_name]
+        spec = sched.spec.format(seed=seed)
+    reg = ChaosRegistry.from_spec(spec)
+    # crc32, not hash(): PYTHONHASHSEED randomizes hash() per process
+    # and the cell rng must reproduce for a given --seed
+    rng = random.Random(
+        (seed << 20) ^ zlib.crc32(f"{shape_name}:{sched.name}".encode()))
+    data_dir = tempfile.mkdtemp(prefix=f"matrix-{shape_name}-")
+    cfg = ServerConfig(num_schedulers=2, heartbeat_ttl=1.5,
+                       gc_interval=3600.0,
+                       failed_eval_followup_delay=0.3)
+    cluster = Cluster(3, config=cfg,
+                      raft_config=RaftConfig(heartbeat_interval=0.02,
+                                             election_timeout=0.1),
+                      data_dir=data_dir)
+    for s in cluster.servers:
+        _tune(s)
+    ctx = CellCtx()
+    keeper = health = None
+    churn = None
+    t_cell = time.time()
+    try:
+        cluster.start()
+        cluster.leader(timeout=15.0)
+
+        nodes = shape.make_nodes(rng)
+        for n in nodes:
+            _on_leader(cluster, lambda ld, n=n: ld.register_node(n))
+        ctx.node_ids = [n.id for n in nodes]
+        keeper = NodeKeeper(cluster, ctx.node_ids)
+        keeper.start()
+
+        shape.setup(cluster, rng, ctx)
+        health = HealthReporter(cluster, ctx)
+        health.start()
+
+        base_allocs = _on_leader(
+            cluster, lambda ld: len(ld.store.allocs()))
+        _plan_submit_sample()           # reset the series for this cell
+
+        # ---- chaos window
+        chaos.install(reg)
+        reg.arm()
+        if sched.server_churn:
+            churn = ChurnDriver(cluster, reg, rng)
+        try:
+            while (reg.elapsed() or 0.0) < sched.duration_s:
+                try:
+                    shape.during(cluster, rng, ctx, reg)
+                except TRANSIENT_ERRORS + (TimeoutError,):
+                    pass
+                if churn is not None:
+                    churn.tick()
+                # one mid-window drain with a deadline that expires
+                # while chaos is still biting
+                if ctx.drain_candidates and not ctx.drained \
+                        and (reg.elapsed() or 0.0) \
+                        > sched.duration_s * 0.35:
+                    nid = ctx.drain_candidates[
+                        rng.randrange(len(ctx.drain_candidates))]
+                    try:
+                        _on_leader(cluster,
+                                   lambda ld: ld.drainer.drain_node(
+                                       nid, deadline_s=1.0), timeout=5.0)
+                        ctx.drained.append(nid)
+                    except TRANSIENT_ERRORS + (TimeoutError,):
+                        pass
+                time.sleep(0.05)
+        finally:
+            chaos.uninstall()
+            if churn is not None:
+                churn.restore()
+        chaos_dt = reg.elapsed() or sched.duration_s
+
+        shape.finish(cluster, ctx)
+        convergence = check_convergence(cluster, ctx,
+                                        timeout=converge_timeout)
+        placed = _on_leader(
+            cluster, lambda ld: len(ld.store.allocs())) - base_allocs
+        plan = _plan_submit_sample()
+
+        traj = {
+            "metric": f"matrix_{shape_name}_{sched.name}",
+            "shape": shape_name,
+            "schedule": sched.name,
+            "seed": seed,
+            "chaos_spec": spec,
+            "chaos_fired": dict(reg.stats),
+            "chaos_window_s": round(chaos_dt, 2),
+            "allocs_placed": placed,
+            "allocs_per_sec": round(placed / chaos_dt, 1)
+            if chaos_dt else 0.0,
+            "plan_submit_ms": plan,
+            "server_churn": churn.events() if churn else {},
+            "drained_nodes": len(ctx.drained),
+            "convergence": convergence,
+            "notes": ctx.notes,
+            "wall_s": round(time.time() - t_cell, 1),
+        }
+        out_path = os.path.join(
+            out_dir, f"BENCH_matrix_{shape_name}_{sched.name}.json")
+        with open(out_path, "w") as f:
+            json.dump(traj, f, indent=1, default=str)
+        return traj
+    finally:
+        if keeper is not None:
+            keeper.stop_flag.set()
+        if health is not None:
+            health.stop_flag.set()
+        chaos.uninstall()
+        cluster.stop()
+        if keeper is not None:
+            keeper.join(2.0)
+        if health is not None:
+            health.join(2.0)
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+# curated subset that rides `bench.py --matrix --smoke` and the CI
+# scenario-matrix leg: one cell per headline behavior, including both
+# first-class new scenarios
+SMOKE_CELLS = [
+    ("e2e_spine", "storm"),
+    ("scan_spread", "lease_flap"),
+    ("rolling_deploy", "storm"),
+    ("autoscale_ramp", "lease_flap"),
+]
+
+ALL_CELLS = [(shape, schedule)
+             for shape in SHAPES
+             for schedule in SCHEDULES]
+
+
+def run_matrix(cells=None, seed: int = 1, out_dir: str = ".",
+               log=print) -> dict:
+    """Run a list of (shape, schedule) cells; returns a summary with
+    per-cell verdicts.  Honors a NOMAD_TPU_CHAOS env spec as a schedule
+    override for every cell (schedule name 'env')."""
+    cells = list(cells if cells is not None else ALL_CELLS)
+    spec_override = os.environ.get("NOMAD_TPU_CHAOS") or None
+    if spec_override:
+        chaos.uninstall()               # the runner installs per cell
+        cells = [(shape, "env")
+                 for shape in dict.fromkeys(s for s, _ in cells)]
+    results = []
+    failed = []
+    for shape_name, schedule_name in cells:
+        log(f"matrix cell {shape_name} x {schedule_name} (seed {seed})")
+        try:
+            traj = run_cell(shape_name, schedule_name, seed=seed,
+                            out_dir=out_dir,
+                            spec_override=spec_override)
+        except Exception as e:          # noqa: BLE001
+            log(f"  CELL ERROR: {e!r}")
+            traj = {"shape": shape_name, "schedule": schedule_name,
+                    "seed": seed, "error": repr(e),
+                    "convergence": {"converged": False,
+                                    "invariants": {}}}
+        results.append(traj)
+        conv = traj["convergence"]
+        bad = [k for k, v in conv.get("invariants", {}).items()
+               if not v["ok"]]
+        if not conv.get("converged"):
+            failed.append((shape_name, schedule_name, bad
+                           or ["no convergence"]))
+            log(f"  FAILED: {bad or 'did not converge'}")
+        else:
+            log(f"  converged in {conv['convergence_time_s']}s, "
+                f"fired={traj.get('chaos_fired')}")
+    return {"cells": results, "passed": len(results) - len(failed),
+            "failed": [{"shape": s, "schedule": c, "invariants": b}
+                       for s, c, b in failed],
+            "ok": not failed}

@@ -253,6 +253,14 @@ class GenericScheduler:
         for c in self.job.constraints:
             if "unique." in c.ltarget or "unique." in c.rtarget:
                 escaped = True
+        # device asks are per-node capacity, not class-constant: with
+        # every instance taken the whole class reads infeasible, and a
+        # blocked eval keyed on that verdict would never release when
+        # instances free up — escape class tracking instead
+        for tg in self.job.task_groups:
+            for t in tg.tasks:
+                if t.resources.devices:
+                    escaped = True
         cm = self.state.matrix
         codes = cm.class_codes
         n_classes = len(cm.class_names)
@@ -323,8 +331,12 @@ class GenericScheduler:
         job = self.job
         tg_index = {tg.name: i for i, tg in enumerate(job.task_groups)}
         groups = [stack.compile_group(job, tg) for tg in job.task_groups]
+        # constraint-only union, NOT g.feasible: readiness and capacity
+        # are transient, and a blocked eval keyed on them would mark its
+        # class ineligible forever (a down node or full device must not
+        # veto the class the recovery will unblock)
         self._last_feasible_union = np.any(
-            np.stack([g.feasible for g in groups]), axis=0)
+            np.stack([g.class_feasible for g in groups]), axis=0)
 
         # proposed-usage basis: committed usage PLUS the engine's in-flight
         # overlay (placements of concurrently scheduled, not-yet-committed

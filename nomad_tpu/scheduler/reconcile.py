@@ -45,6 +45,7 @@ ALLOC_LOST = "alloc was lost since its node is down"
 ALLOC_UNKNOWN = "alloc is unknown since its node is disconnected"
 ALLOC_CANARY = "alloc is a canary"
 ALLOC_RECONNECTED = "alloc is reconnecting"
+ALLOC_DUPLICATE = "alloc duplicates another allocation's name"
 
 
 @dataclass
@@ -305,15 +306,20 @@ class AllocReconciler:
             if a.terminal_status():
                 untainted.append(a)
                 continue
-            if draining:
+            if status == "disconnected" and supports_disconnect:
+                disconnecting.append(a)
+            elif status in ("down", "disconnected"):
+                # node state beats drain state: a node hard-killed
+                # mid-drain has lost its allocs — routing them through
+                # migrate (or leaving them untainted awaiting a migrate
+                # slot) would strand them behind a drainer that can no
+                # longer talk to the node
+                lost.append(a)
+            elif draining:
                 if a.desired_transition.should_migrate():
                     migrate.append(a)
                 else:
                     untainted.append(a)
-            elif status == "disconnected" and supports_disconnect:
-                disconnecting.append(a)
-            elif status in ("down", "disconnected"):
-                lost.append(a)
             else:
                 untainted.append(a)
         return untainted, migrate, lost, disconnecting, reconnecting
@@ -454,6 +460,37 @@ class AllocReconciler:
             upd["in_place_update"] += 1
         current_version += inplace
 
+        # --- duplicate names: two live allocs holding the same index
+        # (racing plans under node churn can both place the same name)
+        # leave the group permanently wedged — live == count means no
+        # surplus stop, and slots_left == 0 means a lost sibling is never
+        # replaced.  Stop every holder but one; keep a current-version,
+        # healthy, newest alloc by preference (the reference computeStop
+        # stops duplicate-name allocs before anything else).
+        by_index: Dict[int, List[Allocation]] = {}
+        for a in current_version + destructive:
+            idx = a.index()
+            if idx >= 0:
+                by_index.setdefault(idx, []).append(a)
+        for dupes in by_index.values():
+            if len(dupes) <= 1:
+                continue
+            dupes.sort(key=lambda a: (a in current_version, a.is_healthy(),
+                                      a.create_index, a.id), reverse=True)
+            for a in dupes[1:]:
+                res.stop.append(StopRequest(a, ALLOC_DUPLICATE))
+                if a in destructive:
+                    destructive.remove(a)
+                else:
+                    current_version.remove(a)
+                for u in inplace_copies:
+                    if u.id == a.id:
+                        inplace_copies.remove(u)
+                        res.inplace_update.remove(u)
+                        upd["in_place_update"] -= 1
+                        break
+                upd["stop"] += 1
+
         # --- canary placements for updates
         want_canaries = 0
         if requires_canaries and destructive and not self.deployment_paused \
@@ -480,9 +517,13 @@ class AllocReconciler:
             upd["migrate"] += 1
 
         # replacements for lost allocs, bounded by the group count (a lost
-        # alloc past a scale-down must not resurrect)
+        # alloc past a scale-down must not resurrect).  A lost CANARY is
+        # excluded: it is re-placed through the canary path below
+        # (want_canaries counts only surviving canaries), so a generic
+        # replacement here would double-place it and burn a count slot.
+        lost_countable = [a for a in lost if not a.is_canary()]
         slots_left = max(0, count - total_have - len(migrate) - len(reschedule_now))
-        lost_replaced = lost[:slots_left]
+        lost_replaced = lost_countable[:slots_left]
         for a in lost_replaced:
             res.place.append(PlacementRequest(
                 task_group=tg.name, name=a.name, previous_alloc=a))
@@ -497,12 +538,23 @@ class AllocReconciler:
                 res.stop.append(StopRequest(a, ALLOC_RESCHEDULED))
             upd["place"] += 1
 
+        # lost / rescheduled replacements reuse their predecessor's name:
+        # those indexes are taken, and the scale-up and canary naming
+        # below must not hand them out again (a storm that loses a node
+        # mid-canary otherwise names the canary after a lost alloc's
+        # in-flight replacement — two live allocs, one name)
+        for a in lost_replaced + reschedule_now:
+            idx = a.index()
+            if idx >= 0:
+                have_names.add(idx)
+
         # scale up: new placements for missing names (replacements for
         # migrating / lost / rescheduled allocs already hold their names)
         missing = count - (total_have + len(migrate) + len(lost_replaced)
                            + len(reschedule_now))
         if missing > 0:
-            free_idx = (i for i in range(count + missing) if i not in have_names)
+            free_idx = (i for i in range(count + missing + len(have_names))
+                        if i not in have_names)
             for _ in range(missing):
                 idx = next(free_idx)
                 have_names.add(idx)
@@ -588,6 +640,20 @@ class AllocReconciler:
                     if u.deployment_id != d.id:
                         u.deployment_id = d.id
                         u.deployment_status = None
+                # current-version allocs outside the deployment join it
+                # too: a lost-alloc replacement placed from a snapshot
+                # predating the deployment carries no deployment_id, and
+                # the watcher would wait on its health forever (the
+                # rollout wedges RUNNING until the progress deadline)
+                inplace_ids = {u.id for u in inplace_copies}
+                for a in current_version:
+                    if a.deployment_id != d.id and not a.is_canary() \
+                            and a.id not in inplace_ids \
+                            and a.id not in res.attribute_updates:
+                        u = a.copy()
+                        u.deployment_id = d.id
+                        u.deployment_status = None
+                        res.attribute_updates[a.id] = u
 
         # group is deployment-complete when nothing is pending
         complete = not destructive and not want_canaries and missing <= 0 \

@@ -86,6 +86,11 @@ class CompiledGroup:
     # per-node placement capacity for this eval (instances the group may
     # still place per node; -1 = unlimited)
     place_cap: Optional[np.ndarray] = None            # i32[N]
+    # constraint-only feasibility (datacenter/constraints/driver/volumes,
+    # no readiness or capacity): the class-constant verdict that keys
+    # blocked-eval unblocking — a down node or exhausted device must not
+    # mark its whole class permanently ineligible
+    class_feasible: Optional[np.ndarray] = None       # bool[N]
 
 
 class DenseStack:
@@ -130,9 +135,11 @@ class DenseStack:
             (c.ltarget, int(c.rtarget) if c.rtarget else 1, c in job_constraints)
             for c in constraints if c.operand == Operand.DISTINCT_PROPERTY]
 
-        mask &= fz.constraints_mask(cm, constraints)
-        mask &= fz.driver_mask(cm, drivers)
-        mask &= fz.host_volume_mask(cm, tg.volumes)
+        static = fz.constraints_mask(cm, constraints)
+        static &= fz.driver_mask(cm, drivers)
+        static &= fz.host_volume_mask(cm, tg.volumes)
+        class_feasible = cm.dc_mask(job.datacenters) & static
+        mask &= static
         if any(v.type == "csi" for v in tg.volumes.values()):
             mask &= fz.csi_volume_mask(cm, self.snapshot, job.namespace,
                                        job.id, tg.volumes)
@@ -182,7 +189,8 @@ class DenseStack:
                              feasible_pre_ports=feasible_pre_ports,
                              static_ports=static_ports,
                              device_blocked=device_blocked,
-                             place_cap=place_cap)
+                             place_cap=place_cap,
+                             class_feasible=class_feasible)
 
     # ------------------------------------------------------------- assemble
 

@@ -25,6 +25,7 @@ from nomad_tpu.structs import (
     AllocClientStatus,
     AllocDesiredStatus,
     Deployment,
+    DeploymentStatus,
     Evaluation,
     EvalStatus,
     Job,
@@ -152,7 +153,7 @@ class StateStore:
         "_namespaces", "_acl_policies", "_acl_tokens", "_acl_by_secret",
         "_csi_volumes", "_csi_plugins", "_scaling_events", "_services",
         "_services_by_alloc", "_applied_plan_ids", "_applied_plan_ids_set",
-        "_snapshot_cache",
+        "_snapshot_cache", "_live_names",
     })
 
     def __init__(self):
@@ -169,6 +170,11 @@ class StateStore:
         self._allocs_by_job: Dict[Tuple[str, str], Set[str]] = defaultdict(set)
         self._allocs_by_node: Dict[str, Set[str]] = defaultdict(set)
         self._allocs_by_eval: Dict[str, Set[str]] = defaultdict(set)
+        # derived, never serialized: (namespace, job_id, name) -> ids of
+        # non-terminal allocs holding that name (the plan-apply
+        # duplicate-name guard reads it per placement, so it must be
+        # O(1), not a scan of the job's alloc set)
+        self._live_names: Dict[Tuple[str, str, str], Set[str]] = {}
         self._evals_by_job: Dict[Tuple[str, str], Set[str]] = defaultdict(set)
         self.scheduler_config = SchedulerConfiguration()
         # namespaces table (reference nomad/state/schema.go namespaces)
@@ -594,6 +600,7 @@ class StateStore:
         self._allocs_by_job[(a.namespace, a.job_id)].discard(alloc_id)
         self._allocs_by_node[a.node_id].discard(alloc_id)
         self._allocs_by_eval[a.eval_id].discard(alloc_id)
+        self._live_name_unset(a)
         self.matrix.remove_alloc(alloc_id)
 
     @requires_lock("_lock")
@@ -612,8 +619,22 @@ class StateStore:
         self._allocs_by_job[(a.namespace, a.job_id)].add(a.id)
         self._allocs_by_node[a.node_id].add(a.id)
         self._allocs_by_eval[a.eval_id].add(a.id)
+        if a.terminal_status():
+            self._live_name_unset(a)
+        else:
+            self._live_names.setdefault(
+                (a.namespace, a.job_id, a.name), set()).add(a.id)
         self.matrix.upsert_alloc(a)
         self._update_summary(a, prev)
+
+    @requires_lock("_lock")
+    def _live_name_unset(self, a: Allocation) -> None:
+        key = (a.namespace, a.job_id, a.name)
+        ids = self._live_names.get(key)
+        if ids is not None:
+            ids.discard(a.id)
+            if not ids:
+                del self._live_names[key]
 
     @requires_lock("_lock")
     def _update_summary(self, a: Allocation, prev: Optional[Allocation]) -> None:
@@ -979,6 +1000,28 @@ class StateStore:
             self._insert_alloc(index, a)
             touched.append(a)
         for a in result.allocs_to_place:    # placements
+            # live-name guard: racing plans for one redelivered eval can
+            # both pass the submit-time token gate (the lease expires
+            # after the first enqueue but before its commit), and the
+            # loser would duplicate a name the winner already placed.
+            # Every legitimate same-name placement stops its predecessor
+            # in the same plan (alloc_updates apply above) or replaces a
+            # terminal alloc, so a live holder here is always a racer.
+            # Updates of existing allocs (same id) always apply.  System
+            # and sysbatch allocs all share one name by design (one per
+            # node), so their duplicates are scoped to the node.
+            if a.id not in self._allocs:
+                holders = self._live_names.get(
+                    (a.namespace, a.job_id, a.name))
+                if holders:
+                    per_node = a.job is not None and \
+                        a.job.type in ("system", "sysbatch")
+                    if not per_node:
+                        continue
+                    if any(o is not None and o.node_id == a.node_id
+                           for o in (self._allocs.get(i)
+                                     for i in holders)):
+                        continue
             self._insert_alloc(index, a)
             self._take_csi_claims_for_alloc(index, a)
             touched.append(a)
@@ -990,10 +1033,33 @@ class StateStore:
             touched.append(a)
         if result.deployment is not None:
             d = result.deployment
+            # one deployment per job version: concurrent/redelivered evals
+            # for the same registration can both carry a fresh deployment
+            # (each planned against a snapshot that predates the other's
+            # commit).  The first to apply wins; the loser's placements
+            # join it, instead of stranding a duplicate RUNNING deployment
+            # no allocs will ever report health for.
+            winner = None
             if d.id not in self._deployments:
-                d.create_index = index
-            d.modify_index = index
-            self._deployments[d.id] = d
+                for other in self._deployments.values():
+                    if (other.id != d.id
+                            and other.namespace == d.namespace
+                            and other.job_id == d.job_id
+                            and other.job_version == d.job_version
+                            and other.job_create_index == d.job_create_index
+                            and other.status not in (DeploymentStatus.FAILED,
+                                                     DeploymentStatus.CANCELLED)):
+                        winner = other
+                        break
+            if winner is not None:
+                for a in (result.allocs_to_place + result.alloc_updates):
+                    if a.deployment_id == d.id:
+                        a.deployment_id = winner.id
+            else:
+                if d.id not in self._deployments:
+                    d.create_index = index
+                d.modify_index = index
+                self._deployments[d.id] = d
         for upd in result.deployment_updates:
             d = self._deployments.get(upd["deployment_id"])
             if d is not None:

@@ -12,3 +12,8 @@ def rpc_send(msg):
 def commit_plan(plan):
     chaos.fire("plan.crash")
     return plan
+
+
+def tick(node_id):
+    chaos.fire("node.churn_kill")            # analysis: allow(chaos-coverage)
+    return node_id

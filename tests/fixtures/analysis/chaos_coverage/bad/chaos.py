@@ -2,11 +2,13 @@
 to a function that does not carry it, and a required point that is not
 registered at all."""
 
-FAULT_POINTS = ("rpc.drop", "plan.crash", "dead.point")
+FAULT_POINTS = ("rpc.drop", "plan.crash", "dead.point",
+                "node.churn_kill")
 
 REQUIRED_SITES = {
     "plan.crash": ("apply_plan",),      # commit_plan fires it, not apply_plan
     "ghost.point": ("rpc_send",),       # not in FAULT_POINTS
+    "node.churn_kill": ("heartbeat",),  # fired in tick, not heartbeat
 }
 
 

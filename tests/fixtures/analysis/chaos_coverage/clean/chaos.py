@@ -1,8 +1,9 @@
 """Registry and sites in agreement, required site satisfied."""
 
-FAULT_POINTS = ("rpc.drop", "plan.crash")
+FAULT_POINTS = ("rpc.drop", "plan.crash", "node.churn_kill")
 
-REQUIRED_SITES = {"plan.crash": ("commit_plan",)}
+REQUIRED_SITES = {"plan.crash": ("commit_plan",),
+                  "node.churn_kill": ("heartbeat",)}
 
 
 class ChaosRegistry:

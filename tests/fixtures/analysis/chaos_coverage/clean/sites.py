@@ -11,3 +11,10 @@ def rpc_send(msg):
 def commit_plan(plan):
     chaos.fire("plan.crash")
     return plan
+
+
+def heartbeat(node_id):
+    # swallow the re-arm: the node misses its TTL under a churn storm
+    if chaos.active is not None and chaos.active.should("node.churn_kill"):
+        return None
+    return node_id

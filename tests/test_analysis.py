@@ -26,7 +26,7 @@ CASES = [
     ("lock_discipline", "lock-discipline", 1),
     ("native_abi", "native-abi", 5),
     ("jax_purity", "jax-purity", 4),
-    ("chaos_coverage", "chaos-coverage", 4),
+    ("chaos_coverage", "chaos-coverage", 5),
     ("transfer_purity", "transfer-purity", 6),
     ("recompile", "recompile-budget", 2),
     ("race", "happens-before", 5),

@@ -269,7 +269,8 @@ def test_store_dedups_applied_plan_results_by_plan_id():
         allocs_to_place=[a1], eval_id="e1", plan_id=pid))
     assert store.alloc_by_id(a1.id) is not None
     # a replay carrying the same plan_id is ignored wholesale
-    a2 = mock.alloc_for(j, node_id=node.id)
+    # (index 1: the live-name guard would drop a re-used name anyway)
+    a2 = mock.alloc_for(j, node_id=node.id, index=1)
     store.upsert_plan_results(3, AppliedPlanResults(
         allocs_to_place=[a2], eval_id="e1", plan_id=pid))
     assert store.alloc_by_id(a2.id) is None
@@ -277,6 +278,85 @@ def test_store_dedups_applied_plan_results_by_plan_id():
     store.upsert_plan_results(4, AppliedPlanResults(
         allocs_to_place=[a2], eval_id="e1", plan_id=generate_uuid()))
     assert store.alloc_by_id(a2.id) is not None
+
+
+def test_store_drops_placement_duplicating_live_name():
+    """Racing plans for one redelivered eval both pass the submit-time
+    token gate; the loser's same-name placement is dropped at apply."""
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(1, node)
+    j = mock.job()
+    live = mock.alloc_for(j, node_id=node.id, index=0)
+    store.upsert_plan_results(2, AppliedPlanResults(
+        allocs_to_place=[live], eval_id="e1", plan_id=generate_uuid()))
+    racer = mock.alloc_for(j, node_id=node.id, index=0)
+    store.upsert_plan_results(3, AppliedPlanResults(
+        allocs_to_place=[racer], eval_id="e1", plan_id=generate_uuid()))
+    assert store.alloc_by_id(racer.id) is None
+    assert store.alloc_by_id(live.id) is not None
+    # a different name from the same job still applies
+    other = mock.alloc_for(j, node_id=node.id, index=1)
+    store.upsert_plan_results(4, AppliedPlanResults(
+        allocs_to_place=[other], eval_id="e1", plan_id=generate_uuid()))
+    assert store.alloc_by_id(other.id) is not None
+    # system jobs share one name per node by design: same name on a
+    # DIFFERENT node applies, same node is the duplicate
+    node2 = mock.node()
+    store.upsert_node(5, node2)
+    sj = mock.system_job()
+    s1 = mock.alloc_for(sj, node_id=node.id, index=0)
+    s2 = mock.alloc_for(sj, node_id=node2.id, index=0)
+    s3 = mock.alloc_for(sj, node_id=node.id, index=0)
+    store.upsert_plan_results(6, AppliedPlanResults(
+        allocs_to_place=[s1, s2, s3], eval_id="e2",
+        plan_id=generate_uuid()))
+    assert store.alloc_by_id(s1.id) is not None
+    assert store.alloc_by_id(s2.id) is not None
+    assert store.alloc_by_id(s3.id) is None
+
+
+def test_store_allows_same_name_when_holder_stops_in_same_plan():
+    """Destructive update: stop old + place new under one name rides a
+    single plan; alloc_updates apply first, so the placement lands."""
+    from nomad_tpu.structs import AllocDesiredStatus
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(1, node)
+    j = mock.job()
+    old = mock.alloc_for(j, node_id=node.id, index=0)
+    store.upsert_plan_results(2, AppliedPlanResults(
+        allocs_to_place=[old], eval_id="e1", plan_id=generate_uuid()))
+    stopped = old.copy()
+    stopped.desired_status = AllocDesiredStatus.STOP
+    repl = mock.alloc_for(j, node_id=node.id, index=0)
+    store.upsert_plan_results(3, AppliedPlanResults(
+        alloc_updates=[stopped], allocs_to_place=[repl],
+        eval_id="e2", plan_id=generate_uuid()))
+    assert store.alloc_by_id(repl.id) is not None
+    assert store.alloc_by_id(old.id).desired_status == AllocDesiredStatus.STOP
+
+
+def test_store_applies_update_of_existing_alloc_despite_dup_name():
+    """Updates (same alloc id already in the store) are never dropped,
+    even when a duplicate-name sibling exists — the reconciler's dedup
+    stop must be able to land."""
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(1, node)
+    j = mock.job()
+    a1 = mock.alloc_for(j, node_id=node.id, index=0)
+    store.upsert_plan_results(2, AppliedPlanResults(
+        allocs_to_place=[a1], eval_id="e1", plan_id=generate_uuid()))
+    a2 = mock.alloc_for(j, node_id=node.id, index=0)
+    # force the duplicate in (simulates pre-guard history)
+    store._allocs[a2.id] = a2
+    store._allocs_by_job[(a2.namespace, a2.job_id)].add(a2.id)
+    upd = a1.copy()
+    upd.deployment_id = "d-join"
+    store.upsert_plan_results(3, AppliedPlanResults(
+        allocs_to_place=[upd], eval_id="e1", plan_id=generate_uuid()))
+    assert store.alloc_by_id(a1.id).deployment_id == "d-join"
 
 
 # ----------------------------------------------------- broker lease expiry
@@ -590,3 +670,81 @@ def test_chaos_soak_converges(seed):
     finally:
         chaos.uninstall()
         cluster.stop()
+
+
+# ------------------------------------------------- phased chaos schedules
+
+
+def test_phase_grammar_roundtrip():
+    reg = ChaosRegistry.from_spec(
+        "seed=7;phase=storm:0.5-3.0;phase=calm2:4-6;"
+        "rpc.drop=0.01;broker.lease_expire=0.4@storm;"
+        "node.churn_kill=0.6@storm;scale.burst=0.2@calm2")
+    assert reg.phases == {"storm": (0.5, 3.0), "calm2": (4.0, 6.0)}
+    assert reg.phased["broker.lease_expire"]["storm"] == 0.4
+    assert reg.phased["node.churn_kill"]["storm"] == 0.6
+    assert reg.phased["scale.burst"]["calm2"] == 0.2
+    assert reg.rates["rpc.drop"] == 0.01
+    again = ChaosRegistry.from_spec(reg.spec())
+    assert again.phases == reg.phases
+    assert again.phased == reg.phased
+    assert again.rates == reg.rates
+
+
+def test_phase_grammar_rejects_garbage():
+    with pytest.raises(ValueError, match="undeclared phase"):
+        ChaosRegistry.from_spec("rpc.drop=0.1@ghost")
+    with pytest.raises(ValueError, match="window must have"):
+        ChaosRegistry.from_spec("phase=storm:3.0-1.0")
+    with pytest.raises(ValueError, match="bad chaos phase"):
+        ChaosRegistry.from_spec("phase=storm:oops")
+    with pytest.raises(ValueError, match=r"must be in \[0, 1\]"):
+        ChaosRegistry.from_spec("phase=s:0-1;rpc.drop=1.5@s")
+    with pytest.raises(ValueError, match="unknown chaos fault point"):
+        ChaosRegistry.from_spec("phase=s:0-1;rpc.dorp=0.5@s")
+    with pytest.raises(ValueError, match="empty phase"):
+        ChaosRegistry.from_spec("rpc.drop=0.5@")
+
+
+def test_phased_rates_gated_by_arm_and_window():
+    reg = ChaosRegistry.from_spec(
+        "seed=1;phase=storm:10-20;node.churn_kill=1.0@storm")
+    # not armed: phase rates contribute nothing
+    assert reg.effective_rate("node.churn_kill") == 0.0
+    assert reg.phase_now() == ()
+    # armed, inside the window (arm with a monotonic anchor 15s ago)
+    reg.arm(now=time.monotonic() - 15)
+    assert reg.phase_now() == ("storm",)
+    assert reg.effective_rate("node.churn_kill") == 1.0
+    assert reg.should("node.churn_kill") is True
+    # armed, after the window closes
+    reg.arm(now=time.monotonic() - 25)
+    assert reg.phase_now() == ()
+    assert reg.effective_rate("node.churn_kill") == 0.0
+
+
+def test_phased_rate_max_with_base_rate():
+    reg = ChaosRegistry.from_spec(
+        "phase=s:0-100;rpc.drop=0.3;rpc.drop=0.1@s")
+    reg.arm(now=time.monotonic() - 1)
+    # the open phase cannot LOWER a base rate: effective is the max
+    assert reg.effective_rate("rpc.drop") == 0.3
+
+
+def test_node_churn_kill_swallows_heartbeat_rearm():
+    node = mock.node(status=NodeStatus.READY)
+    srv = _FlakyHeartbeatServer(node, fail_times=0)
+    hb = HeartbeatTracker(srv, ttl=0.15, tick=0.02)
+    hb.start()
+    try:
+        chaos.install(ChaosRegistry(seed=3,
+                                    rates={"node.churn_kill": 1.0}))
+        hb.heartbeat(node.id)          # swallowed: TTL never re-armed
+        assert _wait(lambda: len(srv.status_calls) == 0, timeout=0.3)
+        chaos.uninstall()
+        hb.heartbeat(node.id)          # real re-arm, then expire
+        assert _wait(lambda: len(srv.status_calls) >= 1, timeout=3.0)
+    finally:
+        chaos.uninstall()
+        hb.stop()
+    assert srv.status_calls[0] == (node.id, NodeStatus.DOWN)

@@ -280,3 +280,371 @@ def test_node_gc_removes_down_nodes():
             s.store.snapshot().node_by_id(n.id) is None
     finally:
         s.stop()
+
+
+# ------------------------------- drainer under churn (deadline + down node)
+
+def test_drain_deadline_force_stops_and_replaces_atomically():
+    """Deadline expiry force-stops the remaining allocs and their
+    replacement evals ride the same raft entry: afterwards the job is
+    back at count elsewhere, each stopped alloc replaced exactly once,
+    and the drain completes."""
+    s = make_server()
+    try:
+        nodes = [mock.node() for _ in range(3)]
+        for n in nodes:
+            s.register_node(n)
+        job = mock.job()
+        job.task_groups[0].count = 3
+        job.update = None
+        job.task_groups[0].update = None
+        # no migrate slots: nothing moves before the deadline fires
+        job.task_groups[0].migrate.max_parallel = 0
+        s.register_job(job)
+        assert s.wait_for_idle(30.0)
+        victim = s.store.allocs_by_job("default", job.id)[0].node_id
+        on_victim = [a.id for a in s.store.allocs_by_node(victim)
+                     if not a.terminal_status()]
+        assert on_victim
+        s.drainer.drain_node(victim, deadline_s=0.3)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            live = [a for a in s.store.allocs_by_job("default", job.id)
+                    if not a.terminal_status()]
+            if (len(live) == 3
+                    and all(a.node_id != victim for a in live)
+                    and s.store.node_by_id(victim).drain_strategy is None):
+                break
+            time.sleep(0.05)
+        live = [a for a in s.store.allocs_by_job("default", job.id)
+                if not a.terminal_status()]
+        assert len(live) == 3
+        assert all(a.node_id != victim for a in live)
+        names = [a.name for a in live]
+        assert len(set(names)) == len(names)
+        # the force-stopped allocs carry the deadline description
+        stopped = [a for a in s.store.allocs_by_job("default", job.id)
+                   if a.id in on_victim]
+        assert all("drain deadline" in (a.desired_description or "")
+                   for a in stopped)
+        assert s.store.node_by_id(victim).drain_strategy is None
+    finally:
+        s.stop()
+
+
+def test_node_down_mid_drain_hands_allocs_to_lost_path():
+    """A node hard-killed mid-drain: the reconciler's lost path (not the
+    drainer) replaces its allocs — exactly once — and the drain then
+    completes on the emptied node."""
+    s = make_server(heartbeat_ttl=60.0)
+    try:
+        nodes = [mock.node() for _ in range(2)]
+        for n in nodes:
+            s.register_node(n)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.update = None
+        job.task_groups[0].update = None
+        # no migrate slots + far deadline: the drain is stuck, so the DOWN
+        # transition is the only way the allocs can leave the node
+        job.task_groups[0].migrate.max_parallel = 0
+        s.register_job(job)
+        assert s.wait_for_idle(30.0)
+        victim = s.store.allocs_by_job("default", job.id)[0].node_id
+        s.drainer.drain_node(victim, deadline_s=600.0)
+        time.sleep(0.2)
+        from nomad_tpu.structs.node import NodeStatus
+        s.update_node_status(victim, NodeStatus.DOWN)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            live = [a for a in s.store.allocs_by_job("default", job.id)
+                    if not a.terminal_status()]
+            if len(live) == 2 and all(a.node_id != victim for a in live):
+                break
+            time.sleep(0.05)
+        live = [a for a in s.store.allocs_by_job("default", job.id)
+                if not a.terminal_status()]
+        assert len(live) == 2
+        assert all(a.node_id != victim for a in live)
+        names = [a.name for a in live]
+        assert len(set(names)) == len(names)
+        # lost allocs went through the node-update path, not the drainer
+        lost = [a for a in s.store.allocs_by_job("default", job.id)
+                if a.client_status == AllocClientStatus.LOST]
+        assert lost
+        # the dead node emptied out, so the drain completed
+        assert s.wait_for_idle(10.0)
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and s.store.node_by_id(victim).drain_strategy is not None):
+            time.sleep(0.05)
+        assert s.store.node_by_id(victim).drain_strategy is None
+    finally:
+        s.stop()
+
+
+# ---------------------- deployment revert retry / redelivery idempotence
+
+def _healthy_report(s, job_id, healthy=True, deployment_id=None):
+    for a in s.store.allocs_by_job("default", job_id):
+        if a.terminal_status():
+            continue
+        if deployment_id is not None and a.deployment_id != deployment_id:
+            continue
+        u = a.copy()
+        u.client_status = AllocClientStatus.RUNNING
+        u.deployment_status = {"healthy": healthy}
+        s.store.update_allocs_from_client(s.next_index(), [u])
+
+
+def test_failed_autorevert_deployment_retries_lost_revert():
+    """A deployment committed as FAILED whose auto-revert register was
+    lost (leadership churn between the two writes) must still revert:
+    the watcher retries while the job sits at the deployment's version,
+    and the version guard makes the retry fire exactly once."""
+    from nomad_tpu.raft import MessageType
+    s = make_server()
+    try:
+        for _ in range(2):
+            s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.update = UpdateStrategy(max_parallel=2, auto_revert=True)
+        job.task_groups[0].update = None
+        s.register_job(job)
+        s.wait_for_idle(30.0)
+        _healthy_report(s, job.id)
+        s.store.job_by_id("default", job.id).stable = True
+
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/bad"}
+        s.register_job(job2)
+        s.wait_for_idle(30.0)
+        d = s.store.latest_deployment_by_job_id("default", job.id)
+        assert d is not None and d.job_version == job2.version
+
+        # simulate the strand: FAILED lands, the revert register did not
+        failed = d.copy()
+        failed.status = DeploymentStatus.FAILED
+        failed.status_description = DeploymentStatus.DESC_FAILED_ALLOCATIONS
+        failed.modify_time = time.time()
+        s.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": failed})
+
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            j = s.store.job_by_id("default", job.id)
+            if j.version > job2.version:
+                break
+            time.sleep(0.05)
+        j = s.store.job_by_id("default", job.id)
+        assert j.version > job2.version
+        assert j.task_groups[0].tasks[0].config == {"command": "/bin/date"}
+
+        # the revert's own deployment completes once its allocs are healthy
+        s.wait_for_idle(30.0)
+        _healthy_report(s, job.id)
+        s.wait_for_idle(30.0)
+        settled_version = s.store.job_by_id("default", job.id).version
+        # watcher keeps passing over the FAILED deployment: the version
+        # guard must make every later pass a no-op (no double revert)
+        for _ in range(3):
+            s.deployment_watcher.reconcile_all()
+            time.sleep(0.1)
+        assert s.store.job_by_id("default", job.id).version == settled_version
+    finally:
+        s.stop()
+
+
+def test_retry_revert_is_noop_for_superseded_deployment():
+    """_retry_revert must not touch a FAILED deployment the job has
+    already moved past — reverting it would resurrect a dead version."""
+    s = make_server()
+    try:
+        s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        job.update = UpdateStrategy(max_parallel=1, auto_revert=True)
+        job.task_groups[0].update = None
+        s.register_job(job)
+        s.wait_for_idle(30.0)
+        _healthy_report(s, job.id)
+        s.store.job_by_id("default", job.id).stable = True
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        s.register_job(job2)
+        s.wait_for_idle(30.0)
+        d = s.store.latest_deployment_by_job_id("default", job.id)
+        fake = d.copy()
+        fake.status = DeploymentStatus.FAILED
+        fake.job_version = job.version           # superseded by job2
+        version_before = s.store.job_by_id("default", job.id).version
+        s.deployment_watcher._retry_revert(fake)
+        assert s.store.job_by_id("default", job.id).version == version_before
+    finally:
+        s.stop()
+
+
+def test_redelivered_deployment_evals_do_not_flap_healthy_deployment():
+    """broker.lease_expire storms redeliver deployment-watcher evals;
+    processing the same watch eval again (and watcher re-passes) must
+    leave a SUCCESSFUL deployment and its job untouched."""
+    from nomad_tpu.structs import Evaluation, EvalStatus
+    from nomad_tpu.structs.evaluation import EvalTrigger
+    s = make_server()
+    try:
+        for _ in range(2):
+            s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.update = UpdateStrategy(max_parallel=2, auto_revert=True)
+        job.task_groups[0].update = None
+        s.register_job(job)
+        s.wait_for_idle(30.0)
+        _healthy_report(s, job.id)
+        s.store.job_by_id("default", job.id).stable = True
+        job2 = job.copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/v2"}
+        s.register_job(job2)
+        s.wait_for_idle(30.0)
+        d = s.store.latest_deployment_by_job_id("default", job.id)
+        _healthy_report(s, job.id, deployment_id=d.id)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if (s.store.deployment_by_id(d.id).status
+                    == DeploymentStatus.SUCCESSFUL):
+                break
+            time.sleep(0.05)
+        assert (s.store.deployment_by_id(d.id).status
+                == DeploymentStatus.SUCCESSFUL)
+        version = s.store.job_by_id("default", job.id).version
+        # storm of redelivered watch evals + watcher re-passes
+        for _ in range(4):
+            s.create_evals([Evaluation(
+                namespace="default", priority=50, type=job.type,
+                job_id=job.id, deployment_id=d.id,
+                triggered_by=EvalTrigger.DEPLOYMENT_WATCHER,
+                status=EvalStatus.PENDING)])
+            s.deployment_watcher.reconcile_all()
+        assert s.wait_for_idle(30.0)
+        assert (s.store.deployment_by_id(d.id).status
+                == DeploymentStatus.SUCCESSFUL)
+        assert s.store.job_by_id("default", job.id).version == version
+        live = [a for a in s.store.allocs_by_job("default", job.id)
+                if not a.terminal_status()]
+        assert len(live) == 2
+    finally:
+        s.stop()
+
+
+# ------------------- duplicate deployments / stranded blocked evals (storm)
+
+def test_plan_apply_dedups_deployment_per_job_version():
+    """Two evals for the same registration can race: each plans a fresh
+    deployment against a snapshot that predates the other's commit.  The
+    second plan's deployment must fold into the first — its placements
+    remapped — instead of stranding a RUNNING deployment nothing will
+    ever report health for."""
+    from nomad_tpu.state.store import AppliedPlanResults
+    from nomad_tpu.structs import Deployment
+
+    s = make_server()
+    try:
+        s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        # no update stanza: registration must not create a deployment of
+        # its own, so the two racing plans below are the only writers
+        job.update = None
+        job.task_groups[0].update = None
+        s.register_job(job)
+        s.wait_for_idle(30.0)
+        jv = s.store.job_by_id("default", job.id)
+
+        def mk_deployment():
+            return Deployment(
+                namespace="default", job_id=job.id, job_version=jv.version,
+                job_modify_index=jv.job_modify_index,
+                job_create_index=jv.create_index,
+                status=DeploymentStatus.RUNNING)
+
+        d1, d2 = mk_deployment(), mk_deployment()
+        a1 = mock.alloc_for(jv, s.store.nodes()[0].id, index=7)
+        a1.deployment_id = d1.id
+        a2 = mock.alloc_for(jv, s.store.nodes()[0].id, index=8)
+        a2.deployment_id = d2.id
+        s.store.upsert_plan_results(s.next_index(), AppliedPlanResults(
+            allocs_to_place=[a1], deployment=d1, plan_id="dup-d1"))
+        s.store.upsert_plan_results(s.next_index(), AppliedPlanResults(
+            allocs_to_place=[a2], deployment=d2, plan_id="dup-d2"))
+
+        assert s.store.deployment_by_id(d1.id) is not None
+        assert s.store.deployment_by_id(d2.id) is None
+        by_job = [d for d in s.store.deployments()
+                  if d.job_id == job.id and d.job_version == jv.version]
+        assert len(by_job) == 1
+        # the loser's placement joined the winner
+        got = next(a for a in s.store.allocs_by_job("default", job.id)
+                   if a.id == a2.id)
+        assert got.deployment_id == d1.id
+    finally:
+        s.stop()
+
+
+def test_failed_deployment_can_be_superseded_by_new_one():
+    """The per-version dedup must not eat a legitimate retry after the
+    prior deployment failed."""
+    from nomad_tpu.state.store import AppliedPlanResults
+    from nomad_tpu.structs import Deployment
+
+    s = make_server()
+    try:
+        d1 = Deployment(namespace="default", job_id="j", job_version=3,
+                        job_create_index=5, status=DeploymentStatus.FAILED)
+        d2 = Deployment(namespace="default", job_id="j", job_version=3,
+                        job_create_index=5, status=DeploymentStatus.RUNNING)
+        s.store.upsert_plan_results(s.next_index(), AppliedPlanResults(
+            deployment=d1, plan_id="sup-d1"))
+        s.store.upsert_plan_results(s.next_index(), AppliedPlanResults(
+            deployment=d2, plan_id="sup-d2"))
+        assert s.store.deployment_by_id(d1.id) is not None
+        assert s.store.deployment_by_id(d2.id) is not None
+    finally:
+        s.stop()
+
+
+def test_restored_blocked_eval_gets_one_reevaluation():
+    """Leader failover loses the missed-unblock indexes: a blocked eval
+    restored from the store would otherwise wait forever on a capacity
+    change that already happened.  _restore_evals must hand every
+    restored blocked eval one clean re-evaluation."""
+    from nomad_tpu.structs import Evaluation
+    from nomad_tpu.structs.evaluation import EvalTrigger
+
+    s = make_server()
+    try:
+        s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 1
+        s.register_job(job)
+        s.wait_for_idle(30.0)
+        # a blocked eval left over from a deposed leader: its snapshot
+        # predates the node recovery that made the job placeable, and
+        # this "new leader" has no unblock index covering it
+        stale = Evaluation(
+            namespace="default", priority=50, type=job.type, job_id=job.id,
+            triggered_by=EvalTrigger.NODE_UPDATE, status=EvalStatus.BLOCKED,
+            status_description="queued-allocs", snapshot_index=10 ** 9)
+        s.create_evals([stale])
+        stuck = s.store.eval_by_id(stale.id)
+        stuck.status = EvalStatus.BLOCKED
+        s._restore_evals()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            ev = s.store.eval_by_id(stale.id)
+            if EvalStatus.terminal(ev.status):
+                break
+            time.sleep(0.05)
+        assert EvalStatus.terminal(s.store.eval_by_id(stale.id).status), \
+            s.store.eval_by_id(stale.id).status
+    finally:
+        s.stop()

@@ -170,3 +170,234 @@ def test_reconnect_after_replacement_scales_down_surplus():
     live = {a.id for a in allocs} | {replacement.id}
     assert stopped < live
     assert allocs[0].id not in stopped or replacement.id not in stopped
+
+
+# ------------------------- node state beats drain state (churn mid-drain)
+
+
+def _draining(node):
+    from nomad_tpu.structs.node import DrainStrategy
+    node.drain_strategy = DrainStrategy(deadline_s=3600.0,
+                                        force_deadline=NOW + 3600.0,
+                                        started_at=NOW)
+    return node
+
+
+def test_down_while_draining_allocs_lost_and_replaced_exactly_once():
+    """A node hard-killed mid-drain has LOST its allocs: they must route
+    through the lost path (stop + client LOST + same-name replacement),
+    not wait behind the dead node's drainer migrate slots."""
+    j = _job()
+    nodes = [mock.node() for _ in range(3)]
+    allocs = _allocs(j, nodes)
+    dead = _draining(nodes[0])
+    dead.status = NodeStatus.DOWN
+    res = _reconcile(j, allocs, {dead.id: dead})
+
+    stops = [s for s in res.stop if s.alloc.id == allocs[0].id]
+    assert len(stops) == 1
+    assert stops[0].status_description == ALLOC_LOST
+    assert stops[0].client_status == AllocClientStatus.LOST
+    # exactly one replacement, reusing the lost alloc's name
+    places = [p for p in res.place if p.previous_alloc is allocs[0]]
+    assert len(places) == 1
+    assert places[0].name == allocs[0].name
+    # nothing about the dead node rides the migrate path
+    assert not any(s.status_description == "alloc is being migrated"
+                   for s in res.stop)
+
+
+def test_draining_ready_node_still_migrates():
+    """Sanity: the down-beats-draining reordering must not swallow the
+    normal drain path on a live draining node."""
+    from nomad_tpu.structs.alloc import DesiredTransition
+    j = _job()
+    nodes = [mock.node() for _ in range(3)]
+    allocs = _allocs(j, nodes)
+    allocs[0].desired_transition = DesiredTransition(migrate=True)
+    res = _reconcile(j, allocs, {nodes[0].id: _draining(nodes[0])})
+    places = [p for p in res.place if p.previous_alloc is allocs[0]]
+    assert len(places) == 1
+    assert not any(s.client_status == AllocClientStatus.LOST
+                   for s in res.stop)
+
+
+# ----------------------- canary naming vs lost replacements (churn storms)
+
+
+def _canary_update(j):
+    from nomad_tpu.structs.job import UpdateStrategy
+    j.task_groups[0].update = UpdateStrategy(
+        max_parallel=1, canary=1, auto_revert=True, auto_promote=True,
+        health_check="checks")
+    return j
+
+
+def test_canary_name_does_not_collide_with_lost_replacement():
+    """Storm scenario: a v0 alloc's node dies while a canary deployment
+    wants its first canary.  The lost alloc's in-flight replacement keeps
+    its name, so the canary must pick a DIFFERENT index — two live
+    allocs with one name breaks every name-keyed dedup downstream."""
+    j0 = _canary_update(_job(count=4))
+    j0.version = 0
+    j1 = j0.copy()
+    j1.version = 1
+    j1.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+    nodes = [mock.node() for _ in range(4)]
+    allocs = _allocs(j0, nodes)
+    nodes[2].status = NodeStatus.DOWN
+    res = _reconcile(j1, allocs, {nodes[2].id: nodes[2]})
+
+    names = [p.name for p in res.place]
+    assert len(names) == len(set(names)), f"duplicate placement {names}"
+    canaries = [p for p in res.place if p.is_canary]
+    assert len(canaries) == 1
+    lost_repl = [p for p in res.place if p.previous_alloc is allocs[2]]
+    assert len(lost_repl) == 1
+    assert canaries[0].name != lost_repl[0].name
+
+
+def test_lost_canary_replaced_through_canary_path_only():
+    """A canary whose node dies must come back as a canary — one canary
+    placement, no generic count-slot replacement for it."""
+    j0 = _canary_update(_job(count=3))
+    j0.version = 0
+    j1 = j0.copy()
+    j1.version = 1
+    j1.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+    nodes = [mock.node() for _ in range(4)]
+    # only 2 of 3 count slots filled: a free slot is exactly what would
+    # tempt a generic lost-replacement of the canary
+    allocs = _allocs(j0, nodes[:2])
+    dead_canary = mock.alloc_for(
+        j1, nodes[3].id, index=3,
+        client_status=AllocClientStatus.RUNNING,
+        deployment_status={"canary": True})
+    nodes[3].status = NodeStatus.DOWN
+    res = _reconcile(j1, allocs + [dead_canary],
+                     {nodes[3].id: nodes[3]})
+
+    # the dead canary is stopped as lost
+    lost_stops = [s for s in res.stop if s.alloc.id == dead_canary.id]
+    assert len(lost_stops) == 1
+    assert lost_stops[0].client_status == AllocClientStatus.LOST
+    # replaced exactly once, through the canary path
+    canaries = [p for p in res.place if p.is_canary]
+    assert len(canaries) == 1
+    assert not any(p.previous_alloc is dead_canary for p in res.place)
+    names = [p.name for p in res.place]
+    assert len(names) == len(set(names))
+
+
+# ------------------------------------------------- duplicate alloc names
+
+
+def _plain_job(count: int = 3):
+    j = mock.job()
+    j.task_groups[0].count = count
+    return j
+
+
+def test_duplicate_name_allocs_dedup_to_one():
+    """Two live allocs holding the same index (racing plans under churn)
+    must not wedge the group: live == count hides the surplus, and the
+    missing sibling index can never be placed.  The reconciler stops all
+    but one holder and re-places the missing name."""
+    from nomad_tpu.scheduler.reconcile import ALLOC_DUPLICATE
+
+    j = _plain_job(3)
+    nodes = [mock.node() for _ in range(4)]
+    a0 = mock.alloc_for(j, nodes[0].id, index=0,
+                        client_status=AllocClientStatus.RUNNING)
+    dup_old = mock.alloc_for(j, nodes[1].id, index=2,
+                             client_status=AllocClientStatus.RUNNING)
+    dup_old.create_index = 10
+    dup_new = mock.alloc_for(j, nodes[2].id, index=2,
+                             client_status=AllocClientStatus.RUNNING)
+    dup_new.create_index = 20
+
+    res = _reconcile(j, [a0, dup_old, dup_new], {})
+
+    dup_stops = [s for s in res.stop
+                 if s.status_description == ALLOC_DUPLICATE]
+    assert [s.alloc.id for s in dup_stops] == [dup_old.id]
+    # the freed slot re-places the missing index 1
+    assert [p.name for p in res.place] == [a0.name.replace("[0]", "[1]")]
+
+
+def test_duplicate_name_prefers_healthy_holder():
+    from nomad_tpu.scheduler.reconcile import ALLOC_DUPLICATE
+
+    j = _plain_job(2)
+    nodes = [mock.node() for _ in range(3)]
+    a0 = mock.alloc_for(j, nodes[0].id, index=0,
+                        client_status=AllocClientStatus.RUNNING)
+    healthy = mock.alloc_for(j, nodes[1].id, index=1,
+                             client_status=AllocClientStatus.RUNNING,
+                             deployment_status={"healthy": True})
+    healthy.create_index = 10
+    unhealthy_newer = mock.alloc_for(
+        j, nodes[2].id, index=1,
+        client_status=AllocClientStatus.RUNNING)
+    unhealthy_newer.create_index = 20
+
+    res = _reconcile(j, [a0, healthy, unhealthy_newer], {})
+
+    dup_stops = [s for s in res.stop
+                 if s.status_description == ALLOC_DUPLICATE]
+    assert [s.alloc.id for s in dup_stops] == [unhealthy_newer.id]
+    assert not res.place
+
+
+def test_unique_names_are_left_alone():
+    from nomad_tpu.scheduler.reconcile import ALLOC_DUPLICATE
+
+    j = _plain_job(3)
+    nodes = [mock.node() for _ in range(3)]
+    allocs = [mock.alloc_for(j, n.id, index=i,
+                             client_status=AllocClientStatus.RUNNING)
+              for i, n in enumerate(nodes)]
+    res = _reconcile(j, allocs, {})
+    assert not [s for s in res.stop
+                if s.status_description == ALLOC_DUPLICATE]
+    assert not res.place
+
+
+def test_current_version_alloc_outside_active_deployment_joins_it():
+    """A lost-alloc replacement placed from a snapshot that predates the
+    deployment carries no deployment_id; the watcher would wait on its
+    health forever and the rollout wedges RUNNING.  The reconciler joins
+    such allocs to the active deployment (deployment_status reset so
+    health is re-proven)."""
+    from nomad_tpu.structs import (Deployment, DeploymentState,
+                                   DeploymentStatus)
+    from nomad_tpu.structs.job import UpdateStrategy
+
+    j = _plain_job(2)
+    tg = j.task_groups[0]
+    tg.update = UpdateStrategy(max_parallel=1, health_check="checks")
+    d = Deployment(namespace=j.namespace, job_id=j.id,
+                   job_version=j.version, job_create_index=j.create_index,
+                   status=DeploymentStatus.RUNNING)
+    d.task_groups[tg.name] = DeploymentState(desired_total=2)
+
+    nodes = [mock.node() for _ in range(2)]
+    inside = mock.alloc_for(j, nodes[0].id, index=0,
+                            client_status=AllocClientStatus.RUNNING,
+                            deployment_status={"healthy": True})
+    inside.deployment_id = d.id
+    stranded = mock.alloc_for(j, nodes[1].id, index=1,
+                              client_status=AllocClientStatus.RUNNING)
+    assert stranded.deployment_id == ""
+
+    r = AllocReconciler(j, j.id, [inside, stranded], {},
+                        deployment=d, now=NOW)
+    res = r.compute()
+
+    u = res.attribute_updates.get(stranded.id)
+    assert u is not None
+    assert u.deployment_id == d.id
+    assert u.deployment_status is None
+    assert not res.place and not res.stop
+    # the alloc already inside is left alone
+    assert inside.id not in res.attribute_updates
