@@ -542,6 +542,19 @@ class HTTPServer:
         if parts[1:3] == ["scheduler", "configuration"]:
             cfg = self._rpc("Operator.SchedulerGetConfiguration", {})
             return {"SchedulerConfig": cfg}
+        if parts[1:3] == ["raft", "configuration"]:
+            cfg = self._rpc("Operator.RaftGetConfiguration", {})
+            return {
+                "Index": cfg["index"],
+                "Servers": [
+                    {"ID": n, "Node": n, "Voter": True,
+                     "Leader": n == cfg["leader"]}
+                    for n in cfg["voters"]
+                ] + [
+                    {"ID": n, "Node": n, "Voter": False, "Leader": False}
+                    for n in cfg["nonvoters"]
+                ],
+            }
         raise HTTPError(404, "unknown operator path")
 
     def _h_put_operator(self, h, parts, q):
@@ -550,6 +563,19 @@ class HTTPServer:
             cfg = from_wire(SchedulerConfiguration, h._body())
             self._rpc("Operator.SchedulerSetConfiguration", {"config": cfg})
             return {"Updated": True}
+        if parts[1:3] == ["raft", "remove-peer"]:
+            body = h._body() or {}
+            name = body.get("ID") or body.get("Node") or q.get("id", "")
+            if not name:
+                raise HTTPError(400, "missing peer id")
+            out = self._rpc("Operator.RaftRemovePeer", {"name": name})
+            return {"Index": out["index"]}
+        if parts[1:3] == ["raft", "transfer-leadership"]:
+            body = h._body() or {}
+            out = self._rpc("Operator.TransferLeadership",
+                            {"name": body.get("ID") or body.get("Node")})
+            return {"Transferred": out["transferred"],
+                    "Leader": out["leader"]}
         raise HTTPError(404, "unknown operator path")
 
     _h_post_operator = _h_put_operator

@@ -349,6 +349,18 @@ class Operator(_Section):
         return self.c.put("/v1/operator/scheduler/configuration",
                           to_wire(cfg))
 
+    def raft_get_configuration(self) -> dict:
+        """The raft membership: {"Index": n, "Servers": [{ID, Node,
+        Voter, Leader}, ...]}."""
+        return self.c.get("/v1/operator/raft/configuration")
+
+    def raft_remove_peer(self, name: str) -> dict:
+        return self.c.put("/v1/operator/raft/remove-peer", {"ID": name})
+
+    def raft_transfer_leadership(self, name: Optional[str] = None) -> dict:
+        return self.c.put("/v1/operator/raft/transfer-leadership",
+                          {"ID": name})
+
 
 class AclApi(_Section):
     def bootstrap(self) -> dict:

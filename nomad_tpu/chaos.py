@@ -93,6 +93,17 @@ Fault points and their injection sites:
     scale.burst               scenarios.py — an autoscaling wave is
                               amplified to the policy bound, stacking
                               scale evals on top of in-flight ones
+    member.join_stall         core/membership.py — a joining server's
+                              first gossip round is delayed, so autopilot
+                              sees it late and the stabilization window
+                              restarts
+    raft.config_conflict      raft/node.py — a membership change is
+                              rejected as if another were in flight,
+                              forcing the caller's retry path
+    transfer.timeout          raft/node.py — the TimeoutNow message is
+                              dropped after catch-up, so the old leader
+                              resumes and the transfer falls back to a
+                              normal election timeout
 
 `REQUIRED_SITES` pins points to the hot-path functions that must carry
 them; the chaos-coverage linter fails if a refactor drops one.
@@ -132,6 +143,9 @@ FAULT_POINTS = (
     "node.churn_kill",
     "deploy.health_flap",
     "scale.burst",
+    "member.join_stall",
+    "raft.config_conflict",
+    "transfer.timeout",
 )
 
 # Points that must be injected in these specific functions (enforced by
@@ -147,6 +161,9 @@ REQUIRED_SITES = {
     "node.churn_kill": ("HeartbeatTracker.heartbeat",),
     "deploy.health_flap": ("HealthReporter.tick",),
     "scale.burst": ("AutoscaleDriver.tick",),
+    "member.join_stall": ("Membership.join",),
+    "raft.config_conflict": ("RaftNode._append_config",),
+    "transfer.timeout": ("RaftNode.transfer_leadership",),
 }
 
 

@@ -464,7 +464,7 @@ class Cli:
     # ------------------------------------------------------------- misc
 
     def cmd_server_members(self, args) -> int:
-        members = self.api.system.members()
+        members = self.api.system.members()  # analysis: allow(lock-discipline) — SystemApi.members is an HTTP client method, not Membership's lock-protected table
         leader = self.api.system.leader()
         rows = [[m["Name"], "leader" if m["Name"] == leader else "follower"]
                 for m in members["Members"]]
@@ -531,6 +531,30 @@ class Cli:
         self.api.operator.scheduler_set_configuration(cfg)
         self.p("Scheduler configuration updated!")
         return 0
+
+    def cmd_operator_raft_list_peers(self, args) -> int:
+        cfg = self.api.operator.raft_get_configuration()
+        rows = [[s["ID"],
+                 "leader" if s.get("Leader") else "follower",
+                 "voter" if s.get("Voter") else "non-voter"]
+                for s in cfg["Servers"]]
+        self.p(_fmt_table(rows, ["Node", "State", "Voter"]))
+        return 0
+
+    def cmd_operator_raft_remove_peer(self, args) -> int:
+        out = self.api.operator.raft_remove_peer(args.peer_id)
+        self.p(f"Removed peer {args.peer_id} "
+               f"(configuration index {out['Index']})")
+        return 0
+
+    def cmd_operator_transfer_leadership(self, args) -> int:
+        out = self.api.operator.raft_transfer_leadership(
+            getattr(args, "peer_id", None))
+        if out.get("Transferred"):
+            self.p(f"Leadership transferred to {out['Leader']}")
+            return 0
+        self.p("Leadership transfer did not complete")
+        return 1
 
     def cmd_acl_bootstrap(self, args) -> int:
         t = self.api.acl.bootstrap()
@@ -810,6 +834,15 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="memory_oversubscription",
                    choices=["true", "false"], default=None)
     o.set_defaults(fn="cmd_operator_scheduler_set")
+    rft = op.add_parser("raft").add_subparsers(dest="sub2", required=True)
+    o = rft.add_parser("list-peers")
+    o.set_defaults(fn="cmd_operator_raft_list_peers")
+    o = rft.add_parser("remove-peer")
+    o.add_argument("-peer-id", dest="peer_id", required=True)
+    o.set_defaults(fn="cmd_operator_raft_remove_peer")
+    o = op.add_parser("transfer-leadership")
+    o.add_argument("-peer-id", dest="peer_id", default=None)
+    o.set_defaults(fn="cmd_operator_transfer_leadership")
 
     acl = sub.add_parser("acl", help="acl commands").add_subparsers(
         dest="sub", required=True)

@@ -14,7 +14,8 @@ import time
 from typing import List, Optional
 
 from nomad_tpu.core.server import Server, ServerConfig
-from nomad_tpu.raft import InMemTransport, RaftConfig
+from nomad_tpu.raft import (ConfigurationInFlightError, InMemTransport,
+                            NotLeaderError, RaftConfig)
 
 
 class Cluster:
@@ -23,6 +24,7 @@ class Cluster:
                  data_dir: Optional[str] = None):
         self.transport = InMemTransport()
         self._names = [f"server-{i}" for i in range(n)]
+        self._next_id = n
         self._config = config
         self._data_dir = data_dir
         # timeouts tolerate multi-hundred-ms GIL pauses (jit compiles in
@@ -32,14 +34,16 @@ class Cluster:
         self.servers: List[Server] = [self._make_server(nm)
                                       for nm in self._names]
 
-    def _make_server(self, name: str) -> Server:
+    def _make_server(self, name: str, join: bool = False) -> Server:
         cfg = self._config or ServerConfig(num_schedulers=2)
         if self._data_dir is not None:
             cfg = copy.copy(cfg)
             cfg.data_dir = self._data_dir
-        return Server(cfg, name=name, peers=self._names,
+        return Server(cfg, name=name,
+                      peers=[name] if join else self._names,
                       raft_transport=self.transport,
-                      raft_config=self.raft_config)
+                      raft_config=self.raft_config,
+                      raft_join=join)
 
     def start(self) -> None:
         for s in self.servers:
@@ -90,7 +94,105 @@ class Cluster:
         self.servers[self.servers.index(server)] = replacement
         self.transport.set_down(server.name, down=False)
         replacement.start()
+        self._refresh_address_book(replacement)
         return replacement
+
+    def _refresh_address_book(self, server: Server) -> None:
+        """A revived server may come back on a NEW port (TcpTransport):
+        re-advertise its rpc/gossip addresses so peers don't keep dialing
+        the dead one.  InMemTransport routes by name, so this is a no-op
+        there."""
+        add_peer = getattr(self.transport, "add_peer", None)
+        if add_peer is None:
+            return
+        mem = server.membership
+        if mem is None:
+            return
+        with mem._lock:
+            me = mem.members.get(server.name)
+        if me is not None:
+            add_peer(server.name, me.addr)
+            add_peer(f"rpc:{server.name}", me.addr)
+            add_peer(f"gossip:{server.name}", me.addr)
+
+    # -------------------------------------------------- elastic membership
+
+    def _on_leader_retry(self, fn, timeout: float = 10.0):
+        """Run a leader-side membership operation against whichever server
+        currently leads, retrying through leadership churn and the
+        one-change-in-flight window."""
+        deadline = time.monotonic() + timeout
+        last_exc: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                lead = self.leader(timeout=max(
+                    0.1, deadline - time.monotonic()))
+                return fn(lead)
+            except (NotLeaderError, ConfigurationInFlightError,
+                    TimeoutError) as exc:
+                last_exc = exc
+                time.sleep(0.02)
+        raise TimeoutError(
+            f"membership operation did not complete: {last_exc}")
+
+    def add_server(self, name: Optional[str] = None,
+                   timeout: float = 10.0) -> Server:
+        """Join a BLANK server to the running cluster: boot it with an
+        empty configuration (join mode — it never campaigns), then ask
+        the leader to add it as a non-voter.  It catches up via
+        replication/InstallSnapshot and autopilot promotes it to voter
+        once it stabilizes."""
+        if name is None:
+            name = f"server-{self._next_id}"
+            self._next_id += 1
+        joiner = self._make_server(name, join=True)
+        self._names.append(name)
+        self.servers.append(joiner)
+        joiner.start()
+        self._on_leader_retry(
+            lambda lead: lead.raft.add_server(name, timeout=5.0),
+            timeout=timeout)
+        return joiner
+
+    def remove_server(self, server: Server, timeout: float = 10.0) -> None:
+        """Demote + drop a member from the raft configuration (it may
+        already be dead); does not stop the process."""
+        self._on_leader_retry(
+            lambda lead: lead.raft.remove_server(server.name, timeout=5.0),
+            timeout=timeout)
+
+    def replace_server(self, server: Server,
+                       timeout: float = 15.0) -> Server:
+        """Permanently destroy a member (power loss, disk gone) and join a
+        blank replacement under a NEW name — the production server-loss
+        drill.  Returns the replacement once it is a voter."""
+        deadline = time.monotonic() + timeout
+        if not server._stop.is_set():
+            self.hard_kill(server)
+        self.servers.remove(server)
+        self._names.remove(server.name)
+        self._on_leader_retry(
+            lambda lead: lead.raft.remove_server(server.name, timeout=5.0),
+            timeout=max(0.5, deadline - time.monotonic()))
+        replacement = self.add_server(
+            timeout=max(0.5, deadline - time.monotonic()))
+        self.wait_voter(replacement.name,
+                        timeout=max(0.5, deadline - time.monotonic()))
+        return replacement
+
+    def wait_voter(self, name: str, timeout: float = 10.0) -> None:
+        """Block until autopilot has promoted `name` to voter."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                lead = self.leader(timeout=max(
+                    0.1, deadline - time.monotonic()))
+            except TimeoutError:
+                continue
+            if name in lead.raft.configuration()["voters"]:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"{name} was not promoted to voter")
 
     def isolate(self, server: Server) -> None:
         """Cut a live member off the network (it keeps running — the

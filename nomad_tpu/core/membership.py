@@ -9,6 +9,19 @@ without contact and failed after `fail_after`.  A member that learns it
 is suspected refutes by bumping its own incarnation (SWIM's refutation).
 Addresses learned from the table feed the transport's address book, so a
 member only needs ONE seed address to join a cluster.
+
+Flap/rejoin correctness (serf's refutation + tombstones):
+
+- A member that restarts with a STALE incarnation (fresh process, inc 0)
+  re-asserts aliveness past any lingering ``SUSPECT``/``FAILED``/``LEFT``
+  entry about itself: seeing such an entry at ``inc >= mine`` bumps its
+  own incarnation past it, so the next gossip round's ``ALIVE`` outranks
+  the stale claim.
+- ``LEFT``/``FAILED`` entries are reaped from the table after
+  ``reap_after`` into incarnation tombstones: an old push-pull sync
+  carrying a pre-leave ``ALIVE`` entry cannot resurrect the member —
+  only the member itself rejoining with a HIGHER incarnation clears the
+  tombstone.
 """
 from __future__ import annotations
 
@@ -18,6 +31,9 @@ import threading
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
+
+from nomad_tpu import chaos
+from nomad_tpu.analysis import race
 
 log = logging.getLogger(__name__)
 
@@ -38,19 +54,29 @@ class Member:
 
 
 class Membership:
+    # the member table and tombstones move under `self._lock` only; the
+    # happens-before checker cross-checks the race hooks below
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({"members"})
+    _RACE_TRACED = {"members": "_lock"}
+
     def __init__(self, transport, name: str, addr: Tuple[str, int],
                  interval: float = 0.2, suspect_after: float = 1.0,
-                 fail_after: float = 3.0,
+                 fail_after: float = 3.0, reap_after: float = 5.0,
                  on_change: Optional[Callable[[Member], None]] = None):
         self.transport = transport
         self.name = name
         self.interval = interval
         self.suspect_after = suspect_after
         self.fail_after = fail_after
+        self.reap_after = reap_after
         self.on_change = on_change or (lambda m: None)
         self._lock = threading.Lock()
         self.members: Dict[str, Member] = {
             name: Member(name=name, addr=tuple(addr))}
+        # name -> last seen incarnation of a reaped LEFT/FAILED member:
+        # inserts at <= that incarnation are stale resurrections
+        self._tombstones: Dict[str, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         transport.register(f"gossip:{name}", self._handle)
@@ -59,7 +85,9 @@ class Membership:
 
     def join(self, seeds: List[Tuple[str, Tuple[str, int]]]) -> None:
         """Seed the member table with (name, addr) pairs and sync once."""
+        chaos.maybe_delay("member.join_stall")
         with self._lock:
+            race.write("Membership.members", self)
             for name, addr in seeds:
                 if name != self.name and name not in self.members:
                     self.members[name] = Member(name=name, addr=tuple(addr))
@@ -98,6 +126,7 @@ class Membership:
 
     def member_list(self) -> List[dict]:
         with self._lock:
+            race.read("Membership.members", self)
             return [m.wire() for m in
                     sorted(self.members.values(), key=lambda m: m.name)]
 
@@ -138,10 +167,22 @@ class Membership:
     def _sweep(self) -> None:
         now = time.monotonic()
         with self._lock:
-            for m in self.members.values():
-                if m.name == self.name or m.status in (FAILED, LEFT):
+            race.write("Membership.members", self)
+            for m in list(self.members.values()):
+                if m.name == self.name:
                     continue
                 silent = now - m.heard_at
+                if m.status in (FAILED, LEFT):
+                    if silent > self.reap_after:
+                        # reap into a tombstone: the name disappears from
+                        # the table but its incarnation keeps gating
+                        # stale resurrections (old syncs carrying a
+                        # pre-leave ALIVE entry)
+                        self._tombstones[m.name] = max(
+                            m.incarnation,
+                            self._tombstones.get(m.name, -1))
+                        del self.members[m.name]
+                    continue
                 if m.status == ALIVE and silent > self.suspect_after:
                     self._set_status(m, SUSPECT)
                 elif m.status == SUSPECT and silent > self.fail_after:
@@ -161,19 +202,36 @@ class Membership:
 
     def _merge(self, table: List[dict]) -> None:
         with self._lock:
+            race.write("Membership.members", self)
             for entry in table:
                 name = entry["name"]
                 inc = entry["incarnation"]
                 status = entry["status"]
                 if name == self.name:
                     # SWIM refutation: someone thinks we're gone — bump
-                    # our incarnation so ALIVE outranks their claim
+                    # our incarnation so ALIVE outranks their claim.
+                    # LEFT counts too: a member that left and restarted
+                    # at incarnation 0 could otherwise NEVER rejoin (the
+                    # lingering LEFT outranks everything at its inc).
+                    # While we are deliberately leaving, don't refute —
+                    # that would resurrect us mid-goodbye.
                     me = self.members[self.name]
-                    if status in (SUSPECT, FAILED) and inc >= me.incarnation:
+                    if me.status != LEFT \
+                            and status in (SUSPECT, FAILED, LEFT) \
+                            and inc >= me.incarnation:
                         me.incarnation = inc + 1
                     continue
                 cur = self.members.get(name)
                 if cur is None:
+                    # tombstone gate: a reaped LEFT/FAILED member may only
+                    # come back with a strictly higher incarnation (a
+                    # genuine rejoin); an old sync replaying the pre-leave
+                    # entry is dropped here
+                    tomb = self._tombstones.get(name)
+                    if tomb is not None:
+                        if inc <= tomb:
+                            continue
+                        del self._tombstones[name]
                     cur = self.members[name] = Member(
                         name=name, addr=tuple(entry["addr"]),
                         incarnation=inc, status=status)
@@ -188,7 +246,13 @@ class Membership:
                         inc == cur.incarnation
                         and rank[status] > rank[cur.status]):
                     cur.incarnation = inc
-                    cur.addr = tuple(entry["addr"])
+                    new_addr = tuple(entry["addr"])
+                    if new_addr != cur.addr:
+                        # a member that came back on a new port: refresh
+                        # the transport address book, not just the table
+                        cur.addr = new_addr
+                        if hasattr(self.transport, "add_peer"):
+                            self.transport.add_peer(name, new_addr)
                     if status != cur.status:
                         self._set_status(cur, status)
                     if status == ALIVE:

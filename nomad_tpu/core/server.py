@@ -14,6 +14,7 @@ Two consensus modes, mirroring the reference's raftInmem vs raft-boltdb:
 from __future__ import annotations
 
 import itertools
+import logging
 import os
 import pickle
 import threading
@@ -35,6 +36,7 @@ from nomad_tpu.core.secrets import SecretsProvider
 from nomad_tpu.serving.gate import ReadGate
 from nomad_tpu.core.worker import Worker
 from nomad_tpu.raft import (
+    ConfigurationInFlightError,
     DurableMeta,
     FileSnapshotStore,
     LogStore,
@@ -52,6 +54,8 @@ from nomad_tpu.structs import (
     Node,
 )
 from nomad_tpu.structs.evaluation import EvalTrigger
+
+log = logging.getLogger(__name__)
 
 
 class ServerConfig:
@@ -78,7 +82,8 @@ class Server:
                  peers: Optional[List[str]] = None,
                  raft_transport=None,
                  raft_config=None,
-                 membership=None):
+                 membership=None,
+                 raft_join: bool = False):
         self.config = config or ServerConfig()
         self.name = name
         self.store = StateStore()
@@ -148,7 +153,22 @@ class Server:
                 config=raft_config, log_store=log_store, snapshots=snapshots,
                 meta=meta,
                 on_leader=self._establish_leadership,
-                on_follower=self._revoke_leadership)
+                on_follower=self._revoke_leadership,
+                join=raft_join)
+        # autopilot (reference nomad/autopilot.go): the leader promotes
+        # caught-up non-voters after a stabilization window and, when
+        # gossip runs, adds ALIVE members / removes LEFT ones / reaps
+        # FAILED ones out of the raft configuration
+        self._autopilot_interval = float(os.environ.get(
+            "NOMAD_TPU_AUTOPILOT_INTERVAL", "0.05"))
+        self._autopilot_stabilization = float(os.environ.get(
+            "NOMAD_TPU_AUTOPILOT_STABILIZATION", "0.25"))
+        self._autopilot_lag = int(os.environ.get(
+            "NOMAD_TPU_AUTOPILOT_LAG", "16"))
+        self._autopilot_reap_after = float(os.environ.get(
+            "NOMAD_TPU_AUTOPILOT_REAP_AFTER", "1.0"))
+        self._nonvoter_since: Dict[str, float] = {}
+        self._failed_since: Dict[str, float] = {}
 
     # ------------------------------------------------------------- writes
 
@@ -355,6 +375,75 @@ class Server:
                                     name="core-gc", daemon=True)
             gc_t.start()
             self._threads.append(gc_t)
+            if self.raft is not None:
+                ap_t = threading.Thread(target=self._autopilot_loop,
+                                        args=(stop,), name="autopilot",
+                                        daemon=True)
+                ap_t.start()
+                self._threads.append(ap_t)
+
+    # ------------------------------------------------------------- autopilot
+
+    def _autopilot_loop(self, stop: threading.Event) -> None:
+        self._nonvoter_since.clear()
+        self._failed_since.clear()
+        while not stop.wait(self._autopilot_interval):
+            try:
+                self._autopilot_tick()
+            except Exception:                       # noqa: BLE001
+                log.debug("autopilot tick failed", exc_info=True)
+
+    def _autopilot_tick(self) -> None:
+        """One autopilot pass (leader only): promote stabilized
+        non-voters; with gossip running, add ALIVE members to the
+        configuration as non-voters, remove LEFT ones immediately, and
+        reap FAILED ones after the reap window.  Membership changes are
+        serialized by raft's one-in-flight rule — a conflict just means
+        the next tick retries."""
+        raft = self.raft
+        if raft is None or not raft.is_leader:
+            self._nonvoter_since.clear()
+            self._failed_since.clear()
+            return
+        cfg = raft.configuration()
+        now = _time.monotonic()
+        for nv in cfg["nonvoters"]:
+            if raft.server_healthy(nv, lag=self._autopilot_lag):
+                since = self._nonvoter_since.setdefault(nv, now)
+                if now - since >= self._autopilot_stabilization:
+                    self._autopilot_change(raft.add_server, nv, voter=True)
+                    self._nonvoter_since.pop(nv, None)
+            else:
+                # health flap: the stabilization window starts over
+                self._nonvoter_since[nv] = now
+        if self.membership is None:
+            return
+        in_cfg = set(cfg["voters"]) | set(cfg["nonvoters"])
+        members = {m["name"]: m for m in self.membership.member_list()}
+        for mname, m in members.items():
+            if m["status"] == "alive" and mname not in in_cfg:
+                self._autopilot_change(raft.add_server, mname)
+            elif m["status"] == "left" and mname in in_cfg \
+                    and mname != self.name:
+                self._autopilot_change(raft.remove_server, mname)
+            elif m["status"] == "failed" and mname in in_cfg \
+                    and mname != self.name:
+                since = self._failed_since.setdefault(mname, now)
+                if now - since >= self._autopilot_reap_after:
+                    self._autopilot_change(raft.remove_server, mname)
+                    self._failed_since.pop(mname, None)
+        for mname in list(self._failed_since):
+            if members.get(mname, {}).get("status") != "failed":
+                del self._failed_since[mname]
+
+    def _autopilot_change(self, op, server: str, **kw) -> None:
+        try:
+            op(server, timeout=5.0, **kw)
+        except (NotLeaderError, ConfigurationInFlightError):
+            pass        # deposed or a change in flight: next tick retries
+        except Exception:                           # noqa: BLE001
+            log.debug("autopilot %s(%s) failed", op.__name__, server,
+                      exc_info=True)
 
     def _revoke_leadership(self) -> None:
         """revokeLeadership (reference nomad/leader.go:1099-1132)."""
@@ -382,6 +471,15 @@ class Server:
                 self._plan_thread = None
 
     def stop(self) -> None:
+        # graceful leave: a leader hands off BEFORE saying goodbye, so
+        # followers elect a successor in milliseconds instead of waiting
+        # out an election timeout of silence (transfer_leadership returns
+        # False fast when no viable target exists)
+        if self.raft is not None and self.raft.is_leader:
+            try:
+                self.raft.transfer_leadership()
+            except Exception:                      # noqa: BLE001
+                pass
         if self.membership is not None:
             try:
                 self.membership.leave()

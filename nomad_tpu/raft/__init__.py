@@ -10,7 +10,9 @@ server runs the broker/workers/plan-applier (nomad/leader.go:277).
 from nomad_tpu.raft.fsm import MessageType, NomadFSM
 from nomad_tpu.raft.log import LogEntry, LogStore, WALCorruptionError
 from nomad_tpu.raft.meta import DurableMeta, MetaPersistError
-from nomad_tpu.raft.node import NotLeaderError, RaftConfig, RaftNode
+from nomad_tpu.raft.node import (CONFIGURATION_MSG,
+                                 ConfigurationInFlightError, NotLeaderError,
+                                 RaftConfig, RaftNode)
 from nomad_tpu.raft.snapshot import FileSnapshotStore
 from nomad_tpu.raft.transport import InMemTransport
 
@@ -18,4 +20,5 @@ __all__ = [
     "MessageType", "NomadFSM", "LogEntry", "LogStore", "RaftNode",
     "RaftConfig", "NotLeaderError", "InMemTransport", "FileSnapshotStore",
     "DurableMeta", "MetaPersistError", "WALCorruptionError",
+    "CONFIGURATION_MSG", "ConfigurationInFlightError",
 ]

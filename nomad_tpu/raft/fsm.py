@@ -98,6 +98,10 @@ class NomadFSM:
             MessageType.SERVICE_REGISTER: self._apply_service_register,
             MessageType.SERVICE_DEREGISTER: self._apply_service_deregister,
             MessageType.NOOP: lambda index, p: None,
+            # cluster configuration entries (Raft §4.1) are consumed by
+            # the raft layer on append; the FSM treats them as no-ops so
+            # replicas stay byte-identical across membership changes
+            "RaftConfiguration": lambda index, p: None,
         }
         # optional table handlers registered by periphery subsystems
         self.extra: Dict[str, callable] = {}

@@ -481,6 +481,12 @@ class LogStore:
                 return self._entries[i]
             return None
 
+    def entries_of_type(self, msg_type: str) -> List[LogEntry]:
+        """All live (uncompacted) entries of one message type, in index
+        order — the configuration-recovery scan at node boot."""
+        with self._lock:
+            return [e for e in self._entries if e.msg_type == msg_type]
+
     def entries_from(self, index: int, limit: int = 64) -> List[LogEntry]:
         with self._lock:
             i = index - self.first_index

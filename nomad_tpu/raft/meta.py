@@ -43,9 +43,14 @@ class MetaPersistError(RuntimeError):
     not act as if it had been."""
 
 
-def _encode_body(term: int, voted_for: Optional[str]) -> bytes:
-    return json.dumps({"v": META_VERSION, "term": term,
-                       "voted_for": voted_for}, sort_keys=True).encode()
+def _encode_body(term: int, voted_for: Optional[str],
+                 config: Optional[dict] = None) -> bytes:
+    body = {"v": META_VERSION, "term": term, "voted_for": voted_for}
+    if config is not None:
+        # the config key joins the CRC body only when present, so files
+        # written before dynamic membership still verify unchanged
+        body["config"] = config
+    return json.dumps(body, sort_keys=True).encode()
 
 
 class DurableMeta:
@@ -55,6 +60,10 @@ class DurableMeta:
         self.path = path
         self.term = 0
         self.voted_for: Optional[str] = None
+        # best-effort mirror of the latest cluster configuration
+        # ({"voters": [...], "nonvoters": [...], "index": n}); the WAL and
+        # snapshots are the durability anchors, this is a recovery belt
+        self.config: Optional[dict] = None
         self._lock = threading.Lock()
         self._load()
 
@@ -64,7 +73,8 @@ class DurableMeta:
         try:
             with open(self.path, "rb") as fh:
                 rec = json.loads(fh.read())
-            body = _encode_body(int(rec["term"]), rec["voted_for"])
+            body = _encode_body(int(rec["term"]), rec["voted_for"],
+                                rec.get("config"))
             if int(rec["crc"]) != zlib.crc32(body):
                 raise ValueError("crc mismatch")
             if int(rec["v"]) > META_VERSION:
@@ -72,6 +82,7 @@ class DurableMeta:
                                  f"supported {META_VERSION}")
             self.term = int(rec["term"])
             self.voted_for = rec["voted_for"]
+            self.config = rec.get("config")
         except (OSError, ValueError, KeyError, TypeError,
                 json.JSONDecodeError) as exc:
             # forgetting a persisted vote re-opens the double-vote window;
@@ -87,30 +98,46 @@ class DurableMeta:
         with self._lock:
             if term == self.term and voted_for == self.voted_for:
                 return
-            rec = {"v": META_VERSION, "term": term, "voted_for": voted_for,
-                   "crc": zlib.crc32(_encode_body(term, voted_for))}
-            d = os.path.dirname(self.path) or "."
-            fd, tmp = tempfile.mkstemp(dir=d, prefix=".raft-meta-")
+            self._write(term, voted_for, self.config)
+
+    def persist_config(self, config: Optional[dict]) -> None:
+        """Durably mirror the cluster configuration; no-op when unchanged.
+        Shares the (term, voted_for) record and its write discipline."""
+        with self._lock:
+            if config == self.config:
+                return
+            self._write(self.term, self.voted_for, config)
+
+    def _write(self, term: int, voted_for: Optional[str],
+               config: Optional[dict]) -> None:
+        """Write the full record durably (call under self._lock)."""
+        rec = {"v": META_VERSION, "term": term, "voted_for": voted_for,
+               "crc": zlib.crc32(_encode_body(term, voted_for, config))}
+        if config is not None:
+            rec["config"] = config
+        d = os.path.dirname(self.path) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".raft-meta-")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(json.dumps(rec, sort_keys=True).encode())
+                fh.flush()
+                if chaos.active is not None \
+                        and chaos.should("disk.fsync_fail"):
+                    raise OSError("chaos: injected fsync failure")
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+        except OSError as exc:
             try:
-                with os.fdopen(fd, "wb") as fh:
-                    fh.write(json.dumps(rec, sort_keys=True).encode())
-                    fh.flush()
-                    if chaos.active is not None \
-                            and chaos.should("disk.fsync_fail"):
-                        raise OSError("chaos: injected fsync failure")
-                    os.fsync(fh.fileno())
-                os.replace(tmp, self.path)
-            except OSError as exc:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                raise MetaPersistError(
-                    f"could not persist term/vote to {self.path}: {exc}"
-                ) from exc
-            fsync_dir(self.path)
-            self.term = term
-            self.voted_for = voted_for
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise MetaPersistError(
+                f"could not persist term/vote to {self.path}: {exc}"
+            ) from exc
+        fsync_dir(self.path)
+        self.term = term
+        self.voted_for = voted_for
+        self.config = config
 
     def state(self) -> Tuple[int, Optional[str]]:
         with self._lock:

@@ -8,6 +8,21 @@ apply loop feeding the NomadFSM, and InstallSnapshot for followers that
 fell behind a compaction.  Designed for in-process clusters over
 InMemTransport (the reference's raftInmem test mode) — the production
 transport boundary is the same `call(dst, method, args)` surface.
+
+Dynamic membership (Raft §4.1, single-server changes): the cluster
+configuration — voters plus catch-up non-voters — is itself replicated
+as `RaftConfiguration` log entries carried in the WAL and snapshots.
+Each entry holds the complete resulting configuration, takes effect on
+APPEND (not commit), and only one change may be in flight at a time, so
+quorum arithmetic is always computed against the latest appended
+configuration and a half-replicated AddVoter already raises the commit
+bar.  `add_server`/`remove_server` are the leader-side API; a blank
+server boots with `join=True` (empty configuration, never campaigns)
+and learns the membership from the entries or snapshot the leader
+streams it.  Leadership transfer (`transfer_leadership` → TimeoutNow,
+§3.10) fences new proposals, brings the target current, and tells it to
+campaign immediately — transfer votes bypass pre-vote and leader
+stickiness so the handoff completes in milliseconds.
 """
 from __future__ import annotations
 
@@ -21,20 +36,32 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from nomad_tpu import chaos
+from nomad_tpu.analysis import race
 from nomad_tpu.raft.log import LogEntry, LogStore
 from nomad_tpu.raft.meta import DurableMeta, MetaPersistError
 from nomad_tpu.raft.snapshot import FileSnapshotStore
 from nomad_tpu.raft.transport import InMemTransport, Unreachable
+from nomad_tpu.utils import requires_lock
 
 log = logging.getLogger(__name__)
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+# log entry type carrying a full cluster configuration (Raft §4.1);
+# dispatched as a no-op by the FSM — the raft layer consumes it on append
+CONFIGURATION_MSG = "RaftConfiguration"
 
 
 class NotLeaderError(Exception):
     def __init__(self, leader: Optional[str] = None):
         super().__init__(f"not the leader (leader={leader})")
         self.leader = leader
+
+
+class ConfigurationInFlightError(Exception):
+    """A membership change is already appended but not yet committed.
+    Raft §4.1 allows exactly one configuration change in flight at a
+    time; retry once the pending entry commits."""
 
 
 class _ReadBatch:
@@ -75,6 +102,14 @@ class RaftConfig:
 
 
 class RaftNode:
+    # membership configuration tables: every access happens under
+    # `self._lock` (lexical `with`, or @requires_lock helpers whose
+    # callers hold it); `_apply_cv` is a Condition over the same RLock
+    _LOCK_NAME = "_lock"
+    _LOCK_ALIASES = ("_apply_cv",)
+    _LOCK_PROTECTED = frozenset({"_voters", "_nonvoters"})
+    _RACE_TRACED = {"_voters": "_lock"}
+
     def __init__(self, name: str, peers: List[str],
                  transport: InMemTransport, fsm,
                  config: Optional[RaftConfig] = None,
@@ -82,9 +117,21 @@ class RaftNode:
                  snapshots: Optional[FileSnapshotStore] = None,
                  meta: Optional[DurableMeta] = None,
                  on_leader: Optional[Callable[[], None]] = None,
-                 on_follower: Optional[Callable[[], None]] = None):
+                 on_follower: Optional[Callable[[], None]] = None,
+                 join: bool = False):
         self.name = name
-        self.peers = [p for p in peers if p != name]
+        # Cluster configuration (Raft §4.1): `_voters` take part in
+        # elections/quorum/leases; `_nonvoters` only receive replication
+        # while they catch up.  A joining server starts with an EMPTY
+        # configuration — it never campaigns and learns the membership
+        # from the leader's log/snapshot.  `peers` stays the replication
+        # target list (everyone but us) for compatibility.
+        self._initial_voters = [] if join else sorted(set(peers) | {name})
+        self._voters: List[str] = list(self._initial_voters)
+        self._nonvoters: List[str] = []
+        self._config_index = 0
+        self._snap_config: Optional[dict] = None
+        self.peers = [p for p in self._voters if p != name]
         self.transport = transport
         self.fsm = fsm
         self.config = config or RaftConfig()
@@ -109,6 +156,12 @@ class RaftNode:
         self._match_index: Dict[str, int] = {}
         self._futures: Dict[int, concurrent.futures.Future] = {}
         self._last_contact = time.monotonic()
+        # autopilot health inputs: when the leader last successfully
+        # replicated to each peer (append ack or snapshot install)
+        self._peer_contact: Dict[str, float] = {}
+        # leadership transfer: while set, apply() refuses new proposals
+        # and points callers at the target (it will be leader in ms)
+        self._transfer_target: Optional[str] = None
         # leader lease (read path): _ack_round_start[peer] is the send
         # time of the last append round that peer successfully acked; the
         # lease anchors at the majority-th newest of those (self counts as
@@ -141,14 +194,21 @@ class RaftNode:
         # leader; they apply normally once a leader advances commit_index
         # (its post-election no-op commits the whole prefix).
         if self.snapshots is not None:
-            latest = self.snapshots.latest()
-            if latest is not None:
-                idx, term, blob = latest
-                self.fsm.restore(blob)
-                self.last_applied = idx
-                self.commit_index = idx
-                self._last_snapshot_index = idx
-                self._last_snap_term = term
+            rec = self.snapshots.latest_full()
+            if rec is not None:
+                self.fsm.restore(rec["data"])
+                self.last_applied = rec["index"]
+                self.commit_index = rec["index"]
+                self._last_snapshot_index = rec["index"]
+                self._last_snap_term = rec["term"]
+                self._snap_config = rec.get("config")
+
+        # the configuration is part of replicated state: recover the
+        # latest one from snapshot / log tail / durable meta — an
+        # uncommitted config entry in the WAL is still effective (§4.1,
+        # effective on append survives restart)
+        with self._lock:
+            self._recompute_config(include_meta=True)
 
         transport.register(name, self._handle_rpc)
 
@@ -205,6 +265,269 @@ class RaftNode:
                         exc_info=True)
             return False
 
+    # ----------------------------------------------------- configuration
+
+    @requires_lock("_lock")
+    def _quorum(self) -> int:
+        """Votes/acks needed for a majority of the CURRENT voter set."""
+        return len(self._voters) // 2 + 1 if self._voters else 1
+
+    @requires_lock("_lock")
+    def _sole_voter(self) -> bool:
+        """True when we are the only voter (non-voters may still exist):
+        commit, leases and reads need no network round."""
+        return self._voters == [self.name]
+
+    @requires_lock("_lock")
+    def _set_config(self, voters, nonvoters, index: int) -> None:
+        """Adopt a configuration (effective on append).  Recomputes the
+        replication target list and prunes per-peer state for servers
+        that left; best-effort mirrors the config into durable meta as a
+        recovery belt alongside WAL + snapshot carriage."""
+        race.write("RaftNode._voters", self)
+        self._voters = sorted(set(voters))
+        self._nonvoters = sorted(set(nonvoters) - set(voters))
+        self._config_index = index
+        self.peers = sorted((set(self._voters) | set(self._nonvoters))
+                            - {self.name})
+        live = set(self.peers)
+        for table in (self._next_index, self._match_index,
+                      self._ack_round_start, self._peer_contact):
+            for k in list(table):
+                if k != self.name and k not in live:
+                    table.pop(k, None)
+        if self.state == LEADER:
+            nxt = self.log.last_index + 1
+            for p in self.peers:
+                self._next_index.setdefault(p, nxt)
+                self._match_index.setdefault(p, 0)
+        if self.meta is not None:
+            try:
+                self.meta.persist_config(
+                    {"voters": list(self._voters),
+                     "nonvoters": list(self._nonvoters), "index": index})
+            except MetaPersistError:
+                # WAL + snapshot still carry the config; meta is a
+                # recovery convenience, not the durability anchor
+                log.warning("raft: %s could not mirror configuration to "
+                            "meta", self.name, exc_info=True)
+
+    @requires_lock("_lock")
+    def _recompute_config(self, include_meta: bool = False) -> None:
+        """Rebuild the effective configuration from what storage actually
+        holds: the newest of (initial static config, snapshot config,
+        config entries still in the log[, durable-meta mirror]).  Used at
+        boot and after a follower truncates a conflicting suffix that may
+        have carried the configuration it was running."""
+        best = {"voters": list(self._initial_voters), "nonvoters": [],
+                "index": 0}
+        for cand in ((self._snap_config,
+                      self.meta.config if include_meta
+                      and self.meta is not None else None)):
+            if cand and cand.get("index", 0) >= best["index"]:
+                best = cand
+        for e in self.log.entries_of_type(CONFIGURATION_MSG):
+            if e.index >= best["index"]:
+                best = {"voters": list(e.payload["voters"]),
+                        "nonvoters": list(e.payload["nonvoters"]),
+                        "index": e.index}
+        self._set_config(best["voters"], best.get("nonvoters", []),
+                         best.get("index", 0))
+
+    @requires_lock("_lock")
+    def _config_at(self, index: int) -> Optional[dict]:
+        """The configuration as of log `index` (for snapshot carriage):
+        the newest config entry at or below it, else the snapshot's own
+        config, else the initial static config."""
+        best = self._snap_config
+        for e in self.log.entries_of_type(CONFIGURATION_MSG):
+            if e.index <= index and (best is None
+                                     or e.index >= best.get("index", 0)):
+                best = {"voters": list(e.payload["voters"]),
+                        "nonvoters": list(e.payload["nonvoters"]),
+                        "index": e.index}
+        if best is None and self._initial_voters:
+            best = {"voters": list(self._initial_voters), "nonvoters": [],
+                    "index": 0}
+        return best
+
+    def configuration(self) -> dict:
+        """Operator view of the replicated membership (the
+        `/v1/operator/raft/configuration` payload)."""
+        with self._lock:
+            race.read("RaftNode._voters", self)
+            return {"voters": list(self._voters),
+                    "nonvoters": list(self._nonvoters),
+                    "index": self._config_index,
+                    "leader": self.leader_id,
+                    "term": self.term}
+
+    def add_server(self, server: str, voter: bool = False,
+                   timeout: float = 10.0) -> int:
+        """AddVoter / AddNonvoter (leader only).  New servers normally
+        join as non-voters and are promoted (`voter=True` on an existing
+        non-voter) once the autopilot health gate passes; adding straight
+        to voter is allowed but raises the quorum bar immediately."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            voters, nonvoters = set(self._voters), set(self._nonvoters)
+            if voter:
+                if server in voters:
+                    return self._config_index
+                voters.add(server)
+                nonvoters.discard(server)
+            else:
+                if server in voters or server in nonvoters:
+                    return self._config_index
+                nonvoters.add(server)
+        return self._append_config(sorted(voters), sorted(nonvoters),
+                                   timeout)
+
+    def remove_server(self, server: str, timeout: float = 10.0) -> int:
+        """RemoveServer (leader only).  Removing the leader itself is
+        transfer-then-demote: hand leadership off first, then let the
+        caller retry against the successor (which performs the actual
+        removal) — the deposed leader never has to commit its own
+        removal under a quorum it no longer anchors.  If no transfer
+        target exists the leader commits its own removal and steps down
+        once the entry applies (Raft §4.2.2)."""
+        with self._lock:
+            self_removal = self.state == LEADER and server == self.name
+        if self_removal and self.transfer_leadership():
+            with self._lock:
+                raise NotLeaderError(self.leader_id)
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            voters, nonvoters = set(self._voters), set(self._nonvoters)
+            if server not in voters and server not in nonvoters:
+                return self._config_index
+            if voters == {server}:
+                raise ValueError("cannot remove the last voter")
+            voters.discard(server)
+            nonvoters.discard(server)
+        return self._append_config(sorted(voters), sorted(nonvoters),
+                                   timeout)
+
+    def _append_config(self, voters: List[str], nonvoters: List[str],
+                       timeout: float) -> int:
+        """Append one configuration entry and wait for it to commit.
+        Enforces the §4.1 one-change-in-flight rule; the new config is
+        effective the moment the entry is appended, BEFORE it commits."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            if self._transfer_target is not None:
+                raise NotLeaderError(self._transfer_target)
+            if self._config_index > self.commit_index:
+                raise ConfigurationInFlightError(
+                    f"configuration change at index {self._config_index} "
+                    f"is not yet committed (commit={self.commit_index})")
+            if chaos.active is not None \
+                    and chaos.should("raft.config_conflict"):
+                raise ConfigurationInFlightError(
+                    "chaos: injected configuration conflict")
+            index = self.log.last_index + 1
+            self.log.append(LogEntry(index, self.term, CONFIGURATION_MSG,
+                                     {"voters": list(voters),
+                                      "nonvoters": list(nonvoters)}))
+            self._set_config(voters, nonvoters, index)
+            self._match_index[self.name] = index
+            fut: concurrent.futures.Future = concurrent.futures.Future()
+            self._futures[index] = fut
+            self._advance_commit()     # sole-voter configs commit locally
+        self._replicate_all()
+        fut.result(timeout=timeout)
+        return index
+
+    def server_healthy(self, server: str, lag: int = 16) -> bool:
+        """Autopilot promotion gate (leader only): we heard an ack from
+        the server within one election timeout AND its log is within
+        `lag` entries of ours — the stabilization window the caller
+        enforces on top makes a flapping server re-earn both."""
+        with self._lock:
+            if self.state != LEADER:
+                return False
+            fresh = (time.monotonic() - self._peer_contact.get(server, 0.0)
+                     < self.config.election_timeout)
+            caught = self._match_index.get(server, 0) \
+                >= self.log.last_index - lag
+            return fresh and caught
+
+    # ----------------------------------------------------------- transfer
+
+    def transfer_leadership(self, target: Optional[str] = None,
+                            timeout: Optional[float] = None) -> bool:
+        """Graceful handoff (Raft §3.10 / TimeoutNow).  Fences new
+        proposals, brings the target fully current, then tells it to
+        campaign immediately — its RequestVote carries `transfer: True`,
+        bypassing pre-vote and leader stickiness, so the handoff lands in
+        milliseconds instead of an election timeout.  Returns True once
+        we observe our own deposition (the successor's higher term);
+        False re-arms normal proposal service."""
+        if timeout is None:
+            timeout = self.config.election_timeout * 3
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            candidates = [v for v in self._voters if v != self.name]
+            if target is None:
+                if not candidates:
+                    return False
+                target = max(candidates,
+                             key=lambda p: self._match_index.get(p, 0))
+            elif target not in candidates:
+                raise ValueError(f"transfer target {target!r} is not a "
+                                 f"voter")
+            self._transfer_target = target
+            term = self.term
+        try:
+            while True:
+                with self._lock:
+                    if self.state != LEADER or self.term != term:
+                        return False
+                    caught = self._match_index.get(target, 0) \
+                        >= self.log.last_index
+                if caught:
+                    break
+                if time.monotonic() >= deadline:
+                    return False
+                try:
+                    self._replicate_one(target)
+                except Unreachable:
+                    return False     # target gone: resume normal duty
+                except Exception:                   # noqa: BLE001
+                    log.warning("raft: %s transfer catch-up to %s failed",
+                                self.name, target, exc_info=True)
+                time.sleep(0.002)
+            if chaos.active is not None and chaos.should("transfer.timeout"):
+                # injected: the TimeoutNow never reaches the target; the
+                # caller falls back to a normal election timeout
+                return False
+            try:
+                resp = self.transport.call(self.name, target, "timeout_now",
+                                           {"term": term,
+                                            "leader": self.name})
+            except Exception:                       # noqa: BLE001
+                return False
+            if not resp.get("success"):
+                return False
+            # success manifests as our own deposition: the target's
+            # higher-term RequestVote (or its first heartbeat) steps us
+            # down; wait out the deadline for it
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self.state != LEADER or self.term != term:
+                        return True
+                time.sleep(0.002)
+            return False
+        finally:
+            with self._lock:
+                if self._transfer_target == target:
+                    self._transfer_target = None
+
     # ------------------------------------------------------------- public
 
     @property
@@ -219,6 +542,10 @@ class RaftNode:
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
+            if self._transfer_target is not None:
+                # transferring: stop taking proposals so the target can
+                # catch up to a FIXED last_index; it will be leader in ms
+                raise NotLeaderError(self._transfer_target)
             index = self.log.last_index + 1
             # The local propose path must have the same wire-faithful copy
             # semantics as a forwarded RPC (InMemTransport pickles args and
@@ -230,8 +557,7 @@ class RaftNode:
             self._match_index[self.name] = index
             fut: concurrent.futures.Future = concurrent.futures.Future()
             self._futures[index] = fut
-            if not self.peers:        # single-voter cluster commits locally
-                self._advance_commit()
+            self._advance_commit()    # sole-voter clusters commit locally
         self._replicate_all()
         fut.result(timeout=timeout)
         return index
@@ -279,10 +605,10 @@ class RaftNode:
                 raise NotLeaderError(self.leader_id)
             if chaos.should("read.lease_expire"):
                 self._lease_until = 0.0
-            if lease_ok and (not self.peers
+            if lease_ok and (self._sole_voter()
                              or time.monotonic() < self._lease_until):
                 return self.commit_index
-            if not self.peers:
+            if self._sole_voter():
                 return self.commit_index   # single voter: trivially leader
             # every reader serves at the commit index as of ITS arrival
             # (etcd's readOnly queue): joining an in-flight batch must not
@@ -331,8 +657,13 @@ class RaftNode:
         chaos.maybe_delay("read.index_stall")
         self.read_rounds += 1
         start = time.monotonic()
-        acks = 1                                    # self
-        for peer in self.peers:
+        with self._lock:
+            # leadership is proven by VOTERS only: a non-voter's ack says
+            # nothing about who the electorate follows
+            probe_peers = [v for v in self._voters if v != self.name]
+            quorum = self._quorum()
+            acks = 1 if self.name in self._voters else 0
+        for peer in probe_peers:
             with self._lock:
                 if self.state != LEADER or self.term != term:
                     return                          # deposed mid-round
@@ -365,9 +696,10 @@ class RaftNode:
                     acks += 1
                     self._ack_round_start[peer] = start
                     self._refresh_lease()
-        if acks * 2 > len(self.peers) + 1:
+        if acks >= quorum:
             batch.ok = True
 
+    @requires_lock("_lock")
     def _refresh_lease(self) -> None:
         """Re-anchor the leader lease (call under self._lock, as leader).
 
@@ -377,12 +709,15 @@ class RaftNode:
         no successor can be elected until election_timeout after the
         quorum last heard from us, so the shortened window can never
         overlap a new leader's writes."""
-        need = (len(self.peers) + 1) // 2           # peer acks beyond self
+        if self.name not in self._voters:
+            return            # a non-voter leader-in-demotion holds no lease
+        need = self._quorum() - 1                   # voter acks beyond self
         if need == 0:
             anchor = time.monotonic()
         else:
-            starts = sorted((self._ack_round_start.get(p, 0.0)
-                             for p in self.peers), reverse=True)
+            starts = sorted((self._ack_round_start.get(v, 0.0)
+                             for v in self._voters if v != self.name),
+                            reverse=True)
             anchor = starts[need - 1]
         lease = anchor + self.config.election_timeout \
             * (1.0 - self.config.lease_clock_skew)
@@ -392,7 +727,8 @@ class RaftNode:
     def lease_valid(self) -> bool:
         with self._lock:
             return self.state == LEADER and (
-                not self.peers or time.monotonic() < self._lease_until)
+                self._sole_voter()
+                or time.monotonic() < self._lease_until)
 
     def wait_applied(self, index: int, timeout: float = 5.0) -> bool:
         """Block until last_applied >= index — the follower half of
@@ -448,7 +784,7 @@ class RaftNode:
 
     # ------------------------------------------------------------- election
 
-    def _run_election(self) -> None:
+    def _run_election(self, transfer: bool = False) -> None:
         # Pre-vote round (the reference's preElectSelf): probe whether a
         # quorum WOULD vote for us before touching our real term.  A node
         # that is merely behind — restarting from its data_dir while the
@@ -457,33 +793,48 @@ class RaftNode:
         # through append responses and forces an election it cannot win,
         # over and over, for as long as catch-up takes.  Pre-votes also
         # hit no disk, so an unwinnable election costs zero fsyncs.
+        # `transfer=True` (TimeoutNow, §3.10) skips the pre-vote — the
+        # outgoing leader explicitly asked us to campaign NOW, and its own
+        # liveness is exactly what pre-vote/stickiness would hold against
+        # us.
         with self._lock:
+            if self.name not in self._voters:
+                # non-voters (joining servers, demoted members) never
+                # campaign; they wait for a leader to contact them
+                self._last_contact = time.monotonic()
+                return
+            vote_peers = [v for v in self._voters if v != self.name]
+            quorum = self._quorum()
             term = self.term + 1
             last_index = self.log.last_index
             last_term = self.log.last_term or self._snapshot_term()
-        votes = 1
-        for peer in self.peers:
-            try:
-                resp = self.transport.call(self.name, peer, "request_vote", {
-                    "term": term, "candidate": self.name, "prevote": True,
-                    "last_log_index": last_index, "last_log_term": last_term})
-            except Unreachable:
-                continue
-            except Exception:                       # noqa: BLE001
-                log.warning("raft: %s pre-vote call to %s failed",
-                            self.name, peer, exc_info=True)
-                continue
-            if resp.get("granted"):
-                votes += 1
-        if votes * 2 <= len(self.peers) + 1:
-            with self._lock:
-                # a quorum sees a live leader (or a better log); wait a
-                # full randomized timeout before probing again
-                self._last_contact = time.monotonic()
-            return
+        if not transfer:
+            votes = 1
+            for peer in vote_peers:
+                try:
+                    resp = self.transport.call(
+                        self.name, peer, "request_vote", {
+                            "term": term, "candidate": self.name,
+                            "prevote": True, "last_log_index": last_index,
+                            "last_log_term": last_term})
+                except Unreachable:
+                    continue
+                except Exception:                   # noqa: BLE001
+                    log.warning("raft: %s pre-vote call to %s failed",
+                                self.name, peer, exc_info=True)
+                    continue
+                if resp.get("granted"):
+                    votes += 1
+            if votes < quorum:
+                with self._lock:
+                    # a quorum sees a live leader (or a better log); wait a
+                    # full randomized timeout before probing again
+                    self._last_contact = time.monotonic()
+                return
         with self._lock:
             prev_term, prev_vote = self.term, self.voted_for
-            if self.term + 1 != term or self.state == LEADER:
+            if self.term + 1 != term or self.state == LEADER \
+                    or self.name not in self._voters:
                 return   # the world moved while we were pre-voting
             self.state = CANDIDATE
             self.term = term
@@ -497,13 +848,16 @@ class RaftNode:
                 return
             self.leader_id = None
             self._last_contact = time.monotonic()
+            vote_peers = [v for v in self._voters if v != self.name]
+            quorum = self._quorum()
             last_index = self.log.last_index
             last_term = self.log.last_term or self._snapshot_term()
         votes = 1
-        for peer in self.peers:
+        for peer in vote_peers:
             try:
                 resp = self.transport.call(self.name, peer, "request_vote", {
                     "term": term, "candidate": self.name,
+                    "transfer": transfer,
                     "last_log_index": last_index, "last_log_term": last_term})
             except Unreachable:
                 continue
@@ -520,7 +874,7 @@ class RaftNode:
         with self._lock:
             if self.state != CANDIDATE or self.term != term:
                 return
-            if votes * 2 > len(self.peers) + 1:
+            if votes >= quorum:
                 self._become_leader()
 
     def _become_leader(self) -> None:
@@ -538,8 +892,7 @@ class RaftNode:
         # a previous term could anchor a lease the quorum never granted
         self._ack_round_start.clear()
         self._lease_until = 0.0
-        if not self.peers:
-            self._advance_commit()
+        self._advance_commit()        # sole-voter: the no-op commits now
         log.info("raft: %s became leader (term %d)", self.name, self.term)
         self._leadership_q.put("leader")
 
@@ -589,7 +942,12 @@ class RaftNode:
                 log.exception("leadership transition failed")
 
     def _snapshot_term(self) -> int:
-        return 0
+        """Term of the newest installed snapshot: the candidate's
+        last-log-term fallback once compaction has emptied the log — a
+        fully-compacted node advertising term 0 could never win a
+        (pre-)vote against peers comparing it to the snapshot's real
+        term."""
+        return self._last_snap_term
 
     # ------------------------------------------------------------- replicate
 
@@ -646,6 +1004,7 @@ class RaftNode:
                 # leader lease from the time the round was SENT (the
                 # conservative anchor: leadership was proven as of then)
                 self._ack_round_start[peer] = round_start
+                self._peer_contact[peer] = time.monotonic()
                 self._refresh_lease()
             else:
                 # consistency check failed: back off
@@ -655,14 +1014,16 @@ class RaftNode:
     _last_snap_term = 0
 
     def _send_snapshot(self, peer: str) -> None:
-        idx = self._last_snapshot_index
-        latest = self.snapshots.latest() if self.snapshots else None
-        if latest is None:
+        rec = self.snapshots.latest_full() if self.snapshots else None
+        if rec is None:
             return
-        s_idx, s_term, blob = latest
+        s_idx, s_term = rec["index"], rec["term"]
         resp = self.transport.call(self.name, peer, "install_snapshot", {
             "term": self.term, "leader": self.name,
-            "last_index": s_idx, "last_term": s_term, "data": blob})
+            "last_index": s_idx, "last_term": s_term, "data": rec["data"],
+            # the snapshot carries the configuration as of its index so a
+            # blank joiner learns the membership without any log prefix
+            "config": rec.get("config")})
         with self._lock:
             if resp["term"] > self.term:
                 self._step_down(resp["term"])
@@ -671,12 +1032,22 @@ class RaftNode:
                 return   # follower could not persist it; retry next round
             self._next_index[peer] = s_idx + 1
             self._match_index[peer] = s_idx
+            self._peer_contact[peer] = time.monotonic()
 
+    @requires_lock("_lock")
     def _advance_commit(self) -> None:
-        """Majority match ⇒ commit (current-term entries only)."""
-        matches = sorted(self._match_index.get(p, 0)
-                         for p in self.peers + [self.name])
-        majority = matches[len(matches) // 2]
+        """Majority-of-VOTERS match ⇒ commit (current-term entries only).
+
+        The quorum is computed over the latest appended configuration —
+        effective-on-append (§4.1) means a half-replicated AddVoter
+        already raises the bar (2-of-4 can never commit), and a removed
+        leader no longer counts itself.  Non-voters replicate but never
+        advance commit."""
+        race.read("RaftNode._voters", self)
+        voters = self._voters or [self.name]
+        matches = sorted(self._match_index.get(v, 0) for v in voters)
+        quorum = len(voters) // 2 + 1
+        majority = matches[len(voters) - quorum]
         if majority > self.commit_index \
                 and self.log.term_at(majority) == self.term:
             self.commit_index = majority
@@ -721,6 +1092,16 @@ class RaftNode:
                     fut = self._futures.pop(i, None)
                     # wake wait_applied() readers (the cv shares _lock)
                     self._apply_cv.notify_all()
+                    # §4.2.2: a leader that committed its own removal
+                    # steps down once the config entry APPLIES — the
+                    # future was popped above so the caller still gets
+                    # its success before _step_down fails the rest
+                    if e.msg_type == CONFIGURATION_MSG \
+                            and self.state == LEADER \
+                            and self.name not in self._voters:
+                        log.info("raft: %s removed from configuration; "
+                                 "stepping down", self.name)
+                        self._step_down(self.term)
             if fut is not None and not fut.done():
                 if err is None:
                     fut.set_result(i)
@@ -750,10 +1131,11 @@ class RaftNode:
                 applied = self.last_applied
                 term = self.log.term_at(applied) or self._last_snap_term \
                     or self.term
+                cfg = self._config_at(applied)
             blob = self.fsm.snapshot()
         with self._lock:
             try:
-                self.snapshots.save(applied, term, blob)
+                self.snapshots.save(applied, term, blob, config=cfg)
             except Exception:                       # noqa: BLE001
                 # incl. injected snapshot.partial_write: the save did NOT
                 # land durably, so compacting the log here would orphan
@@ -764,6 +1146,7 @@ class RaftNode:
                 return
             self._last_snapshot_index = applied
             self._last_snap_term = term
+            self._snap_config = cfg
             self.log.compact(applied)
 
     # ------------------------------------------------------------- RPC
@@ -775,7 +1158,31 @@ class RaftNode:
             return self._on_append_entries(args)
         if method == "install_snapshot":
             return self._on_install_snapshot(args)
+        if method == "timeout_now":
+            return self._on_timeout_now(args)
         raise ValueError(method)
+
+    def _on_timeout_now(self, a: dict) -> dict:
+        """TimeoutNow (§3.10): the current leader asks us to campaign
+        immediately.  The election runs on its own thread — campaigning
+        inline would hold the transport handler while we call every
+        voter back through it."""
+        with self._lock:
+            if a["term"] < self.term:
+                return {"term": self.term, "success": False}
+            if self.name not in self._voters:
+                return {"term": self.term, "success": False}
+            self._last_contact = time.monotonic()
+        threading.Thread(target=self._transfer_campaign,
+                         name=f"raft-transfer-{self.name}",
+                         daemon=True).start()
+        return {"term": self.term, "success": True}
+
+    def _transfer_campaign(self) -> None:
+        try:
+            self._run_election(transfer=True)
+        except Exception:                           # noqa: BLE001
+            log.exception("raft: %s transfer campaign failed", self.name)
 
     def _on_request_vote(self, a: dict) -> dict:
         with self._lock:
@@ -783,7 +1190,10 @@ class RaftNode:
             # while we are hearing from a live leader, refuse — and do NOT
             # adopt the candidate's term.  A partitioned or catching-up
             # node cannot depose a leader the quorum still follows.
-            if self.leader_id is not None \
+            # Transfer votes (§3.10) bypass stickiness: the live leader
+            # ITSELF asked this candidate to depose it.
+            if not a.get("transfer") \
+                    and self.leader_id is not None \
                     and self.leader_id != a["candidate"] \
                     and (time.monotonic() - self._last_contact
                          < self.config.election_timeout):
@@ -849,6 +1259,17 @@ class RaftNode:
                         continue
                 fresh.append(LogEntry(idx, term, msg_type, payload))
             self.log.append_batch(fresh)
+            if fresh:
+                if fresh[0].index <= self._config_index:
+                    # the conflicting suffix we just truncated carried the
+                    # configuration we were running; fall back to what
+                    # storage still holds before adopting the new entries
+                    self._recompute_config()
+                for e in fresh:
+                    if e.msg_type == CONFIGURATION_MSG:
+                        # effective on append (§4.1), commit not required
+                        self._set_config(e.payload["voters"],
+                                         e.payload["nonvoters"], e.index)
             if a["leader_commit"] > self.commit_index:
                 self.commit_index = min(a["leader_commit"],
                                         self.log.last_index)
@@ -873,7 +1294,7 @@ class RaftNode:
             if self.snapshots is not None:
                 try:
                     self.snapshots.save(a["last_index"], a["last_term"],
-                                        a["data"])
+                                        a["data"], config=a.get("config"))
                 except Exception:                   # noqa: BLE001
                     log.warning("raft: %s could not persist installed "
                                 "snapshot; rejecting (leader retries)",
@@ -895,4 +1316,14 @@ class RaftNode:
                 self.log.compact(a["last_index"])
                 self.last_applied = max(self.last_applied, a["last_index"])
                 self.commit_index = max(self.commit_index, a["last_index"])
+                cfg = a.get("config")
+                if cfg:
+                    self._snap_config = cfg
+                    if cfg.get("index", 0) >= self._config_index:
+                        # a blank joiner learns the membership here; an
+                        # established follower only moves FORWARD (a log
+                        # tail past the snapshot may hold a newer config)
+                        self._set_config(cfg["voters"],
+                                         cfg.get("nonvoters", []),
+                                         cfg.get("index", 0))
                 return {"term": self.term, "success": True}

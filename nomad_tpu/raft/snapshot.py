@@ -44,13 +44,19 @@ class FileSnapshotStore:
         self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
 
-    def save(self, index: int, term: int, blob: bytes) -> str:
+    def save(self, index: int, term: int, blob: bytes,
+             config: Optional[dict] = None) -> str:
         with self._lock:
             name = f"snapshot-{term:010d}-{index:012d}.snap"
             path = os.path.join(self.dir, name)
-            payload = pickle.dumps(
-                {"index": index, "term": term, "data": blob},
-                protocol=pickle.HIGHEST_PROTOCOL)
+            rec_dict = {"index": index, "term": term, "data": blob}
+            if config is not None:
+                # cluster configuration as of `index` (Raft §4.1): a
+                # joiner restored from this snapshot alone must still
+                # learn the membership
+                rec_dict["config"] = config
+            payload = pickle.dumps(rec_dict,
+                                   protocol=pickle.HIGHEST_PROTOCOL)
             rec = SNAP_MAGIC + _HDR.pack(len(payload),
                                          zlib.crc32(payload)) + payload
             if chaos.active is not None \
@@ -144,6 +150,15 @@ class FileSnapshotStore:
             os.unlink(os.path.join(self.dir, old))
 
     def latest(self) -> Optional[Tuple[int, int, bytes]]:
+        rec = self.latest_full()
+        if rec is None:
+            return None
+        return rec["index"], rec["term"], rec["data"]
+
+    def latest_full(self) -> Optional[dict]:
+        """The newest valid snapshot as its full record dict — including
+        the optional `config` key that `latest()`'s legacy 3-tuple cannot
+        carry."""
         with self._lock:
             for name in reversed(self._snap_names()):
                 rec = self._read(os.path.join(self.dir, name))
@@ -151,5 +166,5 @@ class FileSnapshotStore:
                     log.warning("snapshot: skipping corrupt/torn %s; "
                                 "falling back to an older snapshot", name)
                     continue
-                return rec["index"], rec["term"], rec["data"]
+                return rec
             return None

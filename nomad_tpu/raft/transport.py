@@ -29,7 +29,7 @@ class Unreachable(Exception):
 
 
 _RAFT_METHODS = frozenset(
-    {"request_vote", "append_entries", "install_snapshot"})
+    {"request_vote", "append_entries", "install_snapshot", "timeout_now"})
 
 
 def _chaos_check(src: str, dst: str, method: str) -> None:

@@ -547,6 +547,46 @@ class Endpoints:
                           {"config": args["config"]})
         return {}
 
+    def rpc_Operator__RaftGetConfiguration(self, args):
+        """The replicated raft membership (reference
+        `/v1/operator/raft/configuration`).  Served from the LOCAL node:
+        the configuration is replicated state, and an operator debugging
+        a split wants each server's own view."""
+        s = self.server
+        if s.raft is None:
+            return {"voters": [s.name], "nonvoters": [], "index": 0,
+                    "leader": s.name if s.leader else None, "term": 0}
+        return s.raft.configuration()
+
+    def rpc_Operator__RaftRemovePeer(self, args):
+        """Force-remove a (possibly dead) server from the raft
+        configuration (reference `nomad operator raft remove-peer`)."""
+        s = self.server
+        if s.raft is None:
+            raise RpcError("no_raft", "dev mode has no raft peers")
+        try:
+            index = s.raft.remove_server(args["name"],
+                                         timeout=args.get("timeout", 10.0))
+        except NotLeaderError:
+            # incl. the transfer-then-demote hop: removing the leader
+            # itself transfers leadership first, then the successor
+            # performs the removal
+            return s.rpc_leader("Operator.RaftRemovePeer", args)
+        return {"index": index}
+
+    def rpc_Operator__TransferLeadership(self, args):
+        """Graceful leadership handoff (reference `nomad operator
+        transfer-leadership`): optional explicit target, else the most
+        caught-up voter."""
+        s = self.server
+        if s.raft is None:
+            raise RpcError("no_raft", "dev mode has no raft peers")
+        try:
+            ok = s.raft.transfer_leadership(args.get("name"))
+        except NotLeaderError:
+            return s.rpc_leader("Operator.TransferLeadership", args)
+        return {"transferred": ok, "leader": s.raft.leader_id}
+
     def rpc_Operator__SnapshotSave(self, args):
         if self.server.raft is not None:
             self.server.raft.force_snapshot()

@@ -8,6 +8,11 @@ serving plane, rolling deploy, autoscaling ramp), and runs a *phased*
 chaos schedule against it: the `NOMAD_TPU_CHAOS` grammar's
 `phase=<name>:<a>-<b>` windows interleave calm -> storm -> calm, with
 server hard_kill/restart and partition bursts riding the storm phases.
+The `server_replace` schedule runs the elastic-membership drill instead:
+the leader is permanently destroyed mid-storm and a blank replacement
+joins, catches up, and is promoted to voter by autopilot — the cell's
+invariants (including FSM byte-identity) then run against the NEW
+voter set.
 After chaos lifts the cell must CONVERGE, and the runner asserts the
 production invariants the reconcilers promise:
 
@@ -132,13 +137,15 @@ def _live(allocs):
 @dataclass(frozen=True)
 class Schedule:
     """One phased chaos schedule: a NOMAD_TPU_CHAOS-grammar spec with a
-    `{seed}` placeholder, the total chaos window, and whether seeded
-    server churn (hard_kill/restart + partition flaps) rides the open
-    phases."""
+    `{seed}` placeholder, the total chaos window, whether seeded server
+    churn (hard_kill/restart + partition flaps) rides the open phases,
+    and whether the server-loss drill (permanently destroy the leader,
+    join a blank replacement) fires mid-storm."""
     name: str
     spec: str
     duration_s: float
     server_churn: bool
+    server_replace: bool = False
 
 
 SCHEDULES: Dict[str, Schedule] = {
@@ -170,6 +177,25 @@ SCHEDULES: Dict[str, Schedule] = {
               "rpc.delay=0.1@flap1;rpc.delay=0.1@flap2"),
         duration_s=4.2,
         server_churn=False,
+    ),
+    # the elastic-membership drill: mid-storm the CURRENT LEADER is
+    # permanently destroyed (power loss, disk gone — it never comes
+    # back) and a blank server joins under a new name, catches up via
+    # snapshot, and is promoted to voter by autopilot.  The membership
+    # chaos points ride the same phase: joins stall, config appends hit
+    # the one-in-flight gate, leadership transfers time out.  Every
+    # invariant then runs against the NEW voter set.
+    "server_replace": Schedule(
+        name="server_replace",
+        spec=("seed={seed};delay_ms=1;phase=storm:0.5-3.2;"
+              "rpc.drop=0.02@storm;rpc.delay=0.05@storm;"
+              "broker.lease_expire=0.2@storm;node.churn_kill=0.3@storm;"
+              "member.join_stall=0.15@storm;"
+              "raft.config_conflict=0.05@storm;"
+              "transfer.timeout=0.2@storm"),
+        duration_s=4.0,
+        server_churn=False,
+        server_replace=True,
     ),
 }
 
@@ -372,6 +398,67 @@ class ChurnDriver:
     def events(self) -> Dict[str, int]:
         return {"hard_kills": self.kills, "restarts": self.restarts,
                 "partitions": self.partitions}
+
+
+class ReplaceDriver:
+    """The server-loss drill riding the storm phase: permanently destroy
+    the CURRENT LEADER (hard_kill, never restarted — its data_dir is
+    abandoned), remove it from the raft configuration, join a blank
+    replacement under a new name, and wait for autopilot to promote it
+    to voter.  Runs once, in a background thread (the drill spans
+    elections and catch-up, and the cell loop must keep pumping the
+    workload shape while it happens).  The invariant battery then runs
+    against the post-replacement voter set."""
+
+    def __init__(self, cluster: Cluster, reg: ChaosRegistry, ctx: CellCtx):
+        self.cluster = cluster
+        self.reg = reg
+        self.ctx = ctx
+        self.thread: Optional[threading.Thread] = None
+        self.replaced = None            # (old_name, new_name)
+        self.error: Optional[str] = None
+
+    def tick(self, now: Optional[float] = None):
+        if self.thread is not None or not self.reg.phase_now():
+            return
+        self.thread = threading.Thread(
+            target=self._run, name="matrix-replace", daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        try:
+            victim = self.cluster.leader(timeout=5.0)
+            replacement = self.cluster.replace_server(victim, timeout=30.0)
+            _tune(replacement)
+            self.replaced = (victim.name, replacement.name)
+        except Exception as e:          # noqa: BLE001 — reported below
+            self.error = repr(e)
+
+    def finish(self, timeout: float = 30.0):
+        """Join the drill thread (it may outlive the chaos window: once
+        chaos lifts its retries land quickly), then assert the
+        configuration actually moved to the new voter set."""
+        if self.thread is not None:
+            self.thread.join(timeout)
+            if self.thread.is_alive():
+                self.error = self.error or "replace drill still running"
+        elif self.replaced is None:
+            self.error = self.error or "storm phase never opened"
+        self.ctx.notes["server_replace"] = self.events()
+        if self.error is not None or self.replaced is None:
+            raise RuntimeError(
+                f"server replace did not complete: {self.error}")
+        old, new = self.replaced
+        voters = _on_leader(
+            self.cluster, lambda ld: ld.raft.configuration()["voters"])
+        self.ctx.notes["voters_after_replace"] = voters
+        if old in voters or new not in voters:
+            raise RuntimeError(
+                f"voter set did not converge after replace: {voters} "
+                f"(destroyed {old}, joined {new})")
+
+    def events(self) -> Dict[str, object]:
+        return {"replaced": self.replaced, "error": self.error}
 
 
 # --------------------------------------------------------------- shapes
@@ -1042,6 +1129,8 @@ def run_cell(shape_name: str, schedule_name: str, seed: int = 1,
         reg.arm()
         if sched.server_churn:
             churn = ChurnDriver(cluster, reg, rng)
+        replace = ReplaceDriver(cluster, reg, ctx) \
+            if sched.server_replace else None
         try:
             while (reg.elapsed() or 0.0) < sched.duration_s:
                 try:
@@ -1050,6 +1139,8 @@ def run_cell(shape_name: str, schedule_name: str, seed: int = 1,
                     pass
                 if churn is not None:
                     churn.tick()
+                if replace is not None:
+                    replace.tick()
                 # one mid-window drain with a deadline that expires
                 # while chaos is still biting
                 if ctx.drain_candidates and not ctx.drained \
@@ -1071,6 +1162,8 @@ def run_cell(shape_name: str, schedule_name: str, seed: int = 1,
                 churn.restore()
         chaos_dt = reg.elapsed() or sched.duration_s
 
+        if replace is not None:
+            replace.finish()
         shape.finish(cluster, ctx)
         convergence = check_convergence(cluster, ctx,
                                         timeout=converge_timeout)
@@ -1123,6 +1216,7 @@ SMOKE_CELLS = [
     ("scan_spread", "lease_flap"),
     ("rolling_deploy", "storm"),
     ("autoscale_ramp", "lease_flap"),
+    ("e2e_spine", "server_replace"),
 ]
 
 ALL_CELLS = [(shape, schedule)

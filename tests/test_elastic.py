@@ -1,0 +1,556 @@
+"""Elastic control plane tests: replicated raft membership
+(AddVoter/RemoveServer configuration entries, effective on append per
+Raft §4.1), leadership transfer (§3.10 TimeoutNow), the autopilot
+join/catch-up/promote lifecycle, SWIM flap/rejoin races, and the seeded
+leader-destroy/replace soak (reference analogs: hashicorp/raft
+membership tests, nomad/autopilot_test.go, serf's refutation and
+tombstone semantics)."""
+import concurrent.futures as cf
+import pickle
+import random
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import chaos, mock
+from nomad_tpu.core.cluster import Cluster
+from nomad_tpu.core.membership import (
+    ALIVE,
+    FAILED,
+    LEFT,
+    SUSPECT,
+    Membership,
+)
+from nomad_tpu.core.server import ServerConfig
+from nomad_tpu.core.worker import TRANSIENT_ERRORS
+from nomad_tpu.raft import (
+    CONFIGURATION_MSG,
+    InMemTransport,
+    MessageType,
+    NomadFSM,
+    NotLeaderError,
+    RaftConfig,
+    RaftNode,
+)
+from nomad_tpu.state import StateStore
+
+FAST = RaftConfig(heartbeat_interval=0.02, election_timeout=0.1)
+# the soak uses a wider election timeout so the "transfer beats one
+# election timeout" assertion has headroom over CI GIL pauses
+SOAK = RaftConfig(heartbeat_interval=0.02, election_timeout=0.3)
+
+
+def _wait(cond, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+def _mk_node(name, peers, transport, cfg=FAST, **kw):
+    return RaftNode(name, peers, transport, NomadFSM(StateStore()),
+                    config=cfg, **kw)
+
+
+def _elect(nodes, timeout=3.0, exclude=None):
+    """Wait for exactly one leader among `nodes` (optionally one that
+    isn't `exclude`)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [n for n in nodes if n.is_leader and n is not exclude]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.01)
+    raise TimeoutError("no single leader elected")
+
+
+def _canon(blob):
+    """Canonicalize an FSM snapshot for equality (pickle memoizes shared
+    references, so byte-different blobs can encode identical state)."""
+    data = pickle.loads(blob)
+    out = {}
+    for key, val in sorted(data.items()):
+        if isinstance(val, list):
+            out[key] = sorted(pickle.dumps(v) for v in val)
+        elif isinstance(val, dict):
+            out[key] = {k: pickle.dumps(v) for k, v in sorted(val.items())}
+        else:
+            out[key] = pickle.dumps(val)
+    return out
+
+
+def _on_leader(cluster, fn, timeout=15.0):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return fn(cluster.leader(timeout=5.0))
+        except TRANSIENT_ERRORS + (TimeoutError,):
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.05)
+
+
+# ------------------------------------------------- SWIM flap/rejoin races
+
+
+def test_restart_with_stale_incarnation_reasserts_aliveness():
+    """A member that restarts as a fresh process (incarnation 0) while
+    the cluster still carries a lingering SUSPECT/FAILED/LEFT claim about
+    its previous life must refute past it: merging the stale claim bumps
+    its own incarnation above the claim's, so its next ALIVE outranks it.
+    Without the LEFT arm a cleanly-departed member could NEVER rejoin."""
+    tr = InMemTransport()
+    for lingering in (SUSPECT, FAILED, LEFT):
+        m = Membership(tr, "a", ("127.0.0.1", 0))
+        try:
+            m._merge([{"name": "a", "addr": ("127.0.0.1", 0),
+                       "incarnation": 4, "status": lingering}])
+            with m._lock:
+                me = m.members["a"]
+                assert me.status == ALIVE, lingering
+                assert me.incarnation == 5, lingering
+        finally:
+            m.stop()
+
+
+def test_leaving_member_does_not_refute_its_own_left():
+    """The refutation must not fire while the member is deliberately
+    leaving: hearing our own LEFT echoed back mid-goodbye would bump our
+    incarnation and resurrect us as ALIVE."""
+    tr = InMemTransport()
+    m = Membership(tr, "a", ("127.0.0.1", 0))
+    try:
+        with m._lock:
+            me = m.members["a"]
+            me.status = LEFT
+            me.incarnation = 3
+        m._merge([{"name": "a", "addr": ("127.0.0.1", 0),
+                   "incarnation": 3, "status": LEFT}])
+        with m._lock:
+            assert m.members["a"].status == LEFT
+            assert m.members["a"].incarnation == 3
+    finally:
+        m.stop()
+
+
+def test_left_member_not_resurrected_by_stale_sync():
+    """LEFT entries reap into incarnation tombstones: an old push-pull
+    sync replaying the pre-leave ALIVE entry (same incarnation) must not
+    re-insert the member.  Only a genuine rejoin — a strictly higher
+    incarnation — clears the tombstone."""
+    tr = InMemTransport()
+    m = Membership(tr, "a", ("127.0.0.1", 0), reap_after=0.0)
+    try:
+        m._merge([{"name": "b", "addr": ("127.0.0.1", 1),
+                   "incarnation": 3, "status": LEFT}])
+        with m._lock:
+            m.members["b"].heard_at -= 1.0
+        m._sweep()
+        with m._lock:
+            assert "b" not in m.members
+            assert m._tombstones["b"] == 3
+        # the stale resurrection: a peer that never saw the leave syncs
+        # its old table over
+        m._merge([{"name": "b", "addr": ("127.0.0.1", 1),
+                   "incarnation": 3, "status": ALIVE}])
+        with m._lock:
+            assert "b" not in m.members
+        # the genuine rejoin (fresh process that already refuted past
+        # the old incarnation) clears the tombstone
+        m._merge([{"name": "b", "addr": ("127.0.0.1", 2),
+                   "incarnation": 4, "status": ALIVE}])
+        with m._lock:
+            assert m.members["b"].status == ALIVE
+            assert m.members["b"].addr == ("127.0.0.1", 2)
+            assert "b" not in m._tombstones
+    finally:
+        m.stop()
+
+
+# --------------------------------------------------- quorum transitions
+
+
+def test_add_voter_raises_quorum_on_append_not_commit():
+    """Raft §4.1: a configuration entry takes effect the moment it is
+    APPENDED.  With AddVoter in flight making 4 voters, two servers
+    (leader + one follower) were a majority of the old 3-voter config
+    but must NOT commit under the new one — 2-of-4 committing here is
+    exactly the split-brain window the effective-on-append rule closes."""
+    tr = InMemTransport()
+    names = ["a", "b", "c"]
+    nodes = {nm: _mk_node(nm, names, tr) for nm in names}
+    d = _mk_node("d", ["d"], tr, join=True)
+    for n in list(nodes.values()) + [d]:
+        n.start()
+    try:
+        leader = _elect(list(nodes.values()))
+        followers = [nm for nm in names if nm != leader.name]
+        # cut off one follower and the (not-yet-added) joiner: after the
+        # append the leader can reach only itself + one follower
+        tr.set_down(followers[1])
+        tr.set_down("d")
+        with pytest.raises((TimeoutError, cf.TimeoutError)):
+            leader.add_server("d", voter=True, timeout=0.4)
+        cfg = leader.configuration()
+        assert "d" in cfg["voters"]           # effective on append
+        idx = cfg["index"]
+        assert leader.commit_index < idx      # 2 of 4 did not commit
+        # a third voter coming back supplies the majority of the NEW set
+        tr.set_down(followers[1], down=False)
+        assert _wait(lambda: leader.commit_index >= idx, 5.0)
+        tr.set_down("d", down=False)
+        assert _wait(lambda: "d" in d.configuration()["voters"], 5.0)
+    finally:
+        for n in list(nodes.values()) + [d]:
+            n.stop()
+
+
+def test_remove_leader_transfers_then_demotes():
+    """RemoveServer of the leader itself is transfer-then-demote: the
+    leader hands leadership off and raises NotLeaderError so the caller
+    retries against the successor, which commits the removal.  The
+    deposed leader learns the config from replication and stops being a
+    voter (it must never campaign again)."""
+    tr = InMemTransport()
+    names = ["a", "b", "c"]
+    nodes = {nm: _mk_node(nm, names, tr) for nm in names}
+    for n in nodes.values():
+        n.start()
+    try:
+        leader = _elect(list(nodes.values()))
+        with pytest.raises(NotLeaderError):
+            leader.remove_server(leader.name, timeout=5.0)
+        successor = _elect(list(nodes.values()), exclude=leader)
+        successor.remove_server(leader.name, timeout=5.0)
+        cfg = successor.configuration()
+        assert leader.name not in cfg["voters"]
+        assert leader.name not in cfg["nonvoters"]
+        # the 2-voter remnant still commits
+        successor.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+        # the removed server goes stale (it left the replication set the
+        # moment the entry appended) but must not disrupt: its log now
+        # trails the remnant's, so pre-vote refuses it and the successor
+        # holds leadership at a stable term
+        term = successor.configuration()["term"]
+        deadline = time.monotonic() + 0.6
+        while time.monotonic() < deadline:
+            assert successor.is_leader
+            assert not leader.is_leader
+            assert successor.configuration()["term"] == term
+            time.sleep(0.02)
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_remove_last_voter_refused():
+    tr = InMemTransport()
+    n = _mk_node("a", ["a"], tr)
+    n.start()
+    try:
+        assert _wait(lambda: n.is_leader, 3.0)
+        with pytest.raises(ValueError, match="last voter"):
+            n.remove_server("a")
+    finally:
+        n.stop()
+
+
+# --------------------------------------------------- leadership transfer
+
+
+def test_transfer_leadership_beats_election_timeout():
+    """TimeoutNow skips pre-vote and leader stickiness: the handoff
+    completes in replication round-trips, not an election timeout, and
+    no committed entry is lost across it."""
+    cfg = RaftConfig(heartbeat_interval=0.05, election_timeout=1.0)
+    tr = InMemTransport()
+    names = ["a", "b", "c"]
+    nodes = {nm: _mk_node(nm, names, tr, cfg=cfg) for nm in names}
+    for n in nodes.values():
+        n.start()
+    try:
+        leader = _elect(list(nodes.values()), timeout=5.0)
+        for _ in range(3):
+            leader.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+        t0 = time.monotonic()
+        assert leader.transfer_leadership() is True
+        elapsed = time.monotonic() - t0
+        assert elapsed < cfg.election_timeout, \
+            f"transfer took {elapsed:.3f}s"
+        successor = _elect(list(nodes.values()), exclude=leader)
+        assert successor.name == leader.leader_id or successor is not leader
+        successor.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+        assert len(successor.fsm.store.nodes()) == 4
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_transfer_fences_proposals():
+    """While a transfer is in flight the leader refuses new proposals
+    (the target must catch up to a FIXED last_index); after a failed
+    transfer it resumes service."""
+    tr = InMemTransport()
+    names = ["a", "b", "c"]
+    nodes = {nm: _mk_node(nm, names, tr) for nm in names}
+    for n in nodes.values():
+        n.start()
+    try:
+        leader = _elect(list(nodes.values()))
+        target = next(nm for nm in names if nm != leader.name)
+        leader._transfer_target = target
+        with pytest.raises(NotLeaderError):
+            leader.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+        leader._transfer_target = None
+        leader.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+    finally:
+        for n in nodes.values():
+            n.stop()
+
+
+def test_graceful_stop_transfers_leadership():
+    """A leaving leader hands leadership off before closing: the cluster
+    keeps a leader (and every committed entry) across the departure."""
+    cluster = Cluster(3, config=ServerConfig(num_schedulers=2,
+                                             heartbeat_ttl=60.0),
+                      raft_config=FAST)
+    cluster.start()
+    try:
+        leader = cluster.leader()
+        node = mock.node()
+        leader.register_node(node)
+        old_name = leader.name
+        leader.stop()
+        survivors = [s for s in cluster.servers if s.name != old_name]
+        new_leader = None
+        deadline = time.monotonic() + 5.0
+        while new_leader is None and time.monotonic() < deadline:
+            ls = [s for s in survivors
+                  if s.raft is not None and s.raft.is_leader
+                  and s._established]
+            new_leader = ls[0] if len(ls) == 1 else None
+            time.sleep(0.01)
+        assert new_leader is not None
+        assert new_leader.store.node_by_id(node.id) is not None
+    finally:
+        cluster.stop()
+
+
+# ------------------------------------------- join / catch-up / promote
+
+
+def test_blank_server_joins_catches_up_and_promotes(tmp_path):
+    """A blank server boots in join mode (empty config, never
+    campaigns), is added as a non-voter, catches up via
+    InstallSnapshot + log replication, and autopilot promotes it to
+    voter once it stabilizes — ending byte-identical to the leader."""
+    cluster = Cluster(3, config=ServerConfig(num_schedulers=2,
+                                             heartbeat_ttl=60.0),
+                      raft_config=FAST, data_dir=str(tmp_path))
+    cluster.start()
+    try:
+        leader = cluster.leader()
+        for _ in range(3):
+            leader.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        _on_leader(cluster, lambda ld: ld.register_job(job))
+        assert _wait(lambda: len(
+            [a for a in cluster.leader().store.allocs_by_job(
+                "default", job.id) if not a.terminal_status()]) == 2, 15.0)
+        # compact so the joiner must restore through InstallSnapshot
+        _on_leader(cluster, lambda ld: ld.raft.force_snapshot())
+
+        joiner = cluster.add_server()
+        assert joiner.raft is not None and not joiner.raft.is_leader
+        cluster.wait_voter(joiner.name, timeout=10.0)
+        cfg = cluster.leader().raft.configuration()
+        assert joiner.name in cfg["voters"]
+
+        ld = cluster.leader()
+        ld.raft.barrier()
+        assert cluster.wait_replication(ld.store.latest_index,
+                                        timeout=10.0)
+        assert _wait(lambda: joiner.raft.last_applied
+                     >= ld.raft.last_applied, 10.0)
+        assert _canon(joiner.raft.fsm.snapshot()) \
+            == _canon(ld.raft.fsm.snapshot())
+        # the promoted voter participates in commitment
+        _on_leader(cluster, lambda ld: ld.register_node(mock.node()))
+    finally:
+        cluster.stop()
+
+
+def test_config_survives_restart(tmp_path):
+    """The replicated configuration is durable: a restarted member
+    recovers the expanded voter set from its WAL/snapshot/meta, not the
+    static seed list it booted with."""
+    cluster = Cluster(3, config=ServerConfig(num_schedulers=2,
+                                             heartbeat_ttl=60.0),
+                      raft_config=FAST, data_dir=str(tmp_path))
+    cluster.start()
+    try:
+        joiner = cluster.add_server()
+        cluster.wait_voter(joiner.name, timeout=10.0)
+        victim = next(s for s in cluster.servers
+                      if s is not joiner and not s.raft.is_leader)
+        cluster.hard_kill(victim)
+        revived = cluster.restart(victim)
+        assert _wait(lambda: joiner.name in
+                     revived.raft.configuration()["voters"], 10.0)
+    finally:
+        cluster.stop()
+
+
+# ----------------------------------------------------------------- soak
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_elastic_soak_leader_destroy_replace(seed, tmp_path):
+    """The production server-loss drill, seeded: mid-workload the LEADER
+    is permanently destroyed (hard_kill, data_dir abandoned — it never
+    comes back), removed from the configuration, and a blank replacement
+    joins, catches up, and is promoted.  Asserts across the NEW voter
+    set: single leader per term for the whole run, exactly-once
+    placement, every committed entry retained, byte-identical FSM state,
+    and a graceful transfer landing under one election timeout."""
+    cfg = ServerConfig(num_schedulers=2, heartbeat_ttl=60.0,
+                       failed_eval_followup_delay=0.3)
+    cluster = Cluster(3, config=cfg, raft_config=SOAK,
+                      data_dir=str(tmp_path))
+
+    def _tune(s):
+        s.broker.nack_timeout = 1.0
+        s.broker.initial_nack_delay = 0.05
+        s.broker.subsequent_nack_delay = 0.1
+
+    for s in cluster.servers:
+        _tune(s)
+    rng = random.Random(seed)
+
+    leaders_by_term = {}
+    stop_mon = threading.Event()
+
+    def _monitor():
+        while not stop_mon.is_set():
+            for s in list(cluster.servers):
+                r = s.raft
+                if r is None:
+                    continue
+                with r._lock:
+                    if r.state == "leader":
+                        leaders_by_term.setdefault(
+                            r.term, set()).add(s.name)
+            time.sleep(0.005)
+
+    mon = threading.Thread(target=_monitor, daemon=True)
+    jobs = []
+
+    def _add_job():
+        j = mock.job()
+        j.task_groups[0].count = 2
+        jobs.append(j)
+        _on_leader(cluster, lambda ld: ld.register_job(j))
+
+    try:
+        cluster.start()
+        mon.start()
+        for _ in range(4):
+            nd = mock.node()
+            _on_leader(cluster, lambda ld, nd=nd: ld.register_node(nd))
+        _add_job()
+
+        # a graceful handoff first: must land inside one election timeout
+        ld = cluster.leader(timeout=10.0)
+        t0 = time.monotonic()
+        assert ld.raft.transfer_leadership() is True
+        assert time.monotonic() - t0 < SOAK.election_timeout
+
+        # the drill: a commit in flight around the leader's destruction;
+        # survivors snapshot first on some seeds so the replacement
+        # exercises the InstallSnapshot catch-up path
+        _add_job()
+        victim = cluster.leader(timeout=10.0)
+        if rng.random() < 0.5:
+            for s in cluster.servers:
+                if s is not victim:
+                    s.raft.force_snapshot()
+        replacement = cluster.replace_server(victim, timeout=30.0)
+        _tune(replacement)
+        assert victim.name not in [s.name for s in cluster.servers]
+
+        _add_job()                       # the new voter set keeps serving
+
+        voters = sorted(_on_leader(
+            cluster, lambda ld: ld.raft.configuration()["voters"]))
+        assert victim.name not in voters
+        assert replacement.name in voters
+        assert len(voters) == 3
+
+        def converged():
+            try:
+                ld = cluster.leader(timeout=2.0)
+            except TimeoutError:
+                return False
+            for j in jobs:
+                live = [a for a in ld.store.allocs_by_job("default", j.id)
+                        if not a.terminal_status()]
+                if len(live) != j.task_groups[0].count:
+                    return False
+            from nomad_tpu.structs import EvalStatus
+            if any(not EvalStatus.terminal(e.status)
+                   for e in ld.store.evals()):
+                return False
+            return not ld.broker._unack and not ld.plan_queue._heap
+
+        assert _wait(converged, timeout=30.0), \
+            f"seed {seed}: no convergence after replace"
+
+        # exactly-once: requested counts exactly, no duplicate placement
+        ld = cluster.leader()
+        for j in jobs:
+            live = [a for a in ld.store.allocs_by_job("default", j.id)
+                    if not a.terminal_status()]
+            assert len(live) == j.task_groups[0].count
+            assert len({a.id for a in live}) == len(live)
+
+        # byte-identical FSM across the post-replacement voter set
+        ld.raft.barrier()
+        assert cluster.wait_replication(ld.store.latest_index,
+                                        timeout=10.0)
+        assert _wait(lambda: all(
+            s.raft.last_applied >= ld.raft.last_applied
+            for s in cluster.servers), 10.0)
+        blobs = {s.name: _canon(s.raft.fsm.snapshot())
+                 for s in cluster.servers}
+        ref = blobs[ld.name]
+        for name, blob in blobs.items():
+            assert blob == ref, f"seed {seed}: FSM divergence on {name}"
+
+        # election safety held across destruction + replacement
+        multi = {t: sorted(names) for t, names in leaders_by_term.items()
+                 if len(names) > 1}
+        assert not multi, f"seed {seed}: two leaders in one term: {multi}"
+
+        # the config history is log-carried: every surviving member can
+        # reconstruct the final voter set
+        for s in cluster.servers:
+            assert _wait(lambda s=s: sorted(
+                s.raft.configuration()["voters"]) == voters, 10.0), \
+                f"seed {seed}: {s.name} never learned the final config"
+        assert any(e.msg_type == CONFIGURATION_MSG
+                   for e in ld.raft.log.entries_of_type(CONFIGURATION_MSG))
+    finally:
+        stop_mon.set()
+        mon.join(2.0)
+        cluster.stop()
