@@ -21,6 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from nomad_tpu.api.codec import from_wire, to_wire
+from nomad_tpu.raft.transport import Unreachable
 from nomad_tpu.rpc.endpoints import RpcError
 from nomad_tpu.serving import EventStreamer, READ_METHODS, mode_from_query
 from nomad_tpu.structs import Job
@@ -67,8 +68,13 @@ class HTTPServer:
                     self._reply(e.code, {"error": e.msg})
                 except RpcError as e:
                     code = {"not_found": 404, "permission_denied": 403,
-                            "unknown_method": 404}.get(e.kind, 500)
+                            "unknown_method": 404,
+                            "no_region_leader": 503,
+                            "no_region_path": 502}.get(e.kind, 500)
                     self._reply(code, {"error": str(e)})
+                except Unreachable as e:
+                    # a `?region=` request into a dark region fails fast
+                    self._reply(503, {"error": f"region unreachable: {e}"})
                 except BrokenPipeError:
                     pass
                 except Exception as e:                   # noqa: BLE001
@@ -148,8 +154,15 @@ class HTTPServer:
 
         server = self.agent.server
         store = server.store if server else None
+        # `?region=`: a request for another region (reference
+        # QueryOptions.Region) skips the LOCAL read gate — the remote
+        # region's servers establish the read point — and instead rides
+        # the consistency mode in the RPC args (see _rpc)
+        region = q.get("region") or None
+        if server is not None and region == server.region:
+            region = None
         read_ctx = None
-        if server is not None and method == "GET":
+        if server is not None and method == "GET" and region is None:
             # establish the read point for this request's consistency
             # mode BEFORE any blocking wait: `?consistent` pays a quorum
             # round, default rides the leader lease, `?stale` serves
@@ -168,8 +181,10 @@ class HTTPServer:
                 raise HTTPError(503, f"read gate ({mode}): "
                                      f"{type(e).__name__}: {e}")
         self._read_local.ctx = read_ctx
+        self._read_local.region = region
+        self._read_local.mode = mode_from_query(q) if region else None
         try:
-            if store is not None and "index" in q:
+            if store is not None and "index" in q and region is None:
                 min_index = int(q["index"])
                 wait = _parse_wait(q.get("wait", "5s"))
                 store.wait_for_index(min_index + 1, timeout=min(wait, 600.0))
@@ -189,8 +204,13 @@ class HTTPServer:
             result = handler(h, parts, q)
         finally:
             self._read_local.ctx = None
+            self._read_local.region = None
+            self._read_local.mode = None
         if result is not _STREAMED:
-            index = store.latest_index if store else None
+            # a cross-region reply must not carry the LOCAL store's
+            # index as if it were the remote region's
+            index = store.latest_index \
+                if store is not None and region is None else None
             if index is not None and "index" in q:
                 # a blocking query must never return an index lower than
                 # the one it was given (reference blockingRPC contract)
@@ -199,6 +219,18 @@ class HTTPServer:
 
     def _rpc(self, method: str, args: dict):
         server = self.agent.server
+        region = getattr(self._read_local, "region", None)
+        if server is not None and region:
+            # cross-region request: ship the target region (and the
+            # caller's consistency mode, applied by the REMOTE region's
+            # read gate) in the args — endpoints.handle forwards it over
+            # the WAN to that region's current leader
+            args = dict(args)
+            args["region"] = region
+            mode = getattr(self._read_local, "mode", None)
+            if mode is not None and method in READ_METHODS:
+                args["consistency"] = mode
+            return server.endpoints.handle(method, args)
         if server is not None and method in READ_METHODS \
                 and getattr(self._read_local, "ctx", None) is not None:
             # a read point was established by _route's gate for THIS

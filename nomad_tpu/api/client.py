@@ -40,10 +40,15 @@ class ApiClient:
                  token: str = "", namespace: str = "default",
                  timeout: float = 30.0, retries: int = 2,
                  retry_backoff: float = 0.1,
-                 consistency: Optional[str] = None):
+                 consistency: Optional[str] = None,
+                 region: Optional[str] = None):
         self.address = address.rstrip("/")
         self.token = token
         self.namespace = namespace
+        # target region (reference api.Config.Region / QueryOptions
+        # .Region): when set, every request carries `?region=` and the
+        # contacted server forwards it over the WAN if it isn't local
+        self.region = region
         self.timeout = timeout
         self.retries = retries
         self.retry_backoff = retry_backoff
@@ -76,6 +81,8 @@ class ApiClient:
                  body: Any = None, raw: bool = False,
                  consistency: Optional[str] = None):
         qs = dict(params or {})
+        if self.region:
+            qs.setdefault("region", self.region)
         if method == "GET":
             mode = consistency if consistency is not None \
                 else self.consistency

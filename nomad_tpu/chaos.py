@@ -104,6 +104,11 @@ Fault points and their injection sites:
                               dropped after catch-up, so the old leader
                               resumes and the transfer falls back to a
                               normal election timeout
+    region.partition          federation/router.py — a cross-region
+                              forward is severed as if the WAN link were
+                              cut, exercising the router's fail-fast
+                              Unreachable path and the multiregion
+                              rollout's halt-at-region-boundary behavior
 
 `REQUIRED_SITES` pins points to the hot-path functions that must carry
 them; the chaos-coverage linter fails if a refactor drops one.
@@ -146,6 +151,7 @@ FAULT_POINTS = (
     "member.join_stall",
     "raft.config_conflict",
     "transfer.timeout",
+    "region.partition",
 )
 
 # Points that must be injected in these specific functions (enforced by
@@ -164,6 +170,7 @@ REQUIRED_SITES = {
     "member.join_stall": ("Membership.join",),
     "raft.config_conflict": ("RaftNode._append_config",),
     "transfer.timeout": ("RaftNode.transfer_leadership",),
+    "region.partition": ("RegionRouter.route",),
 }
 
 

@@ -663,6 +663,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-token", default=os.environ.get("NOMAD_TOKEN", ""))
     p.add_argument("-namespace", default=os.environ.get(
         "NOMAD_NAMESPACE", "default"))
+    # target region (reference -region): the contacted server forwards
+    # the request over the WAN when the region is not its own
+    p.add_argument("-region", default=os.environ.get("NOMAD_REGION", ""),
+                   help="region to route the request to")
     # consistency mode for reads (reference -stale / -consistent): stale
     # lets any server answer from its local store; consistent forces a
     # full raft read-index round; default is leader lease reads
@@ -926,7 +930,8 @@ def main(argv: Optional[List[str]] = None, out=sys.stdout) -> int:
                    "consistent" if getattr(args, "consistent", False)
                    else None)
     api = ApiClient(address=args.address, token=args.token,
-                    namespace=args.namespace, consistency=consistency)
+                    namespace=args.namespace, consistency=consistency,
+                    region=getattr(args, "region", "") or None)
     cli = Cli(api, out=out)
     try:
         return getattr(cli, args.fn)(args)

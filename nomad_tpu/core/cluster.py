@@ -21,9 +21,17 @@ from nomad_tpu.raft import (ConfigurationInFlightError, InMemTransport,
 class Cluster:
     def __init__(self, n: int = 3, config: Optional[ServerConfig] = None,
                  raft_config: Optional[RaftConfig] = None,
-                 data_dir: Optional[str] = None):
-        self.transport = InMemTransport()
-        self._names = [f"server-{i}" for i in range(n)]
+                 data_dir: Optional[str] = None,
+                 transport=None, name_prefix: str = "server",
+                 region: Optional[str] = None, wan: bool = False):
+        # a FederatedCluster shares ONE transport across its regional
+        # clusters (name_prefix keeps the raft spines disjoint); a
+        # standalone cluster owns its own
+        self.transport = transport if transport is not None else InMemTransport()
+        self._prefix = name_prefix
+        self._region = region
+        self._wan = wan
+        self._names = [f"{name_prefix}-{i}" for i in range(n)]
         self._next_id = n
         self._config = config
         self._data_dir = data_dir
@@ -36,14 +44,23 @@ class Cluster:
 
     def _make_server(self, name: str, join: bool = False) -> Server:
         cfg = self._config or ServerConfig(num_schedulers=2)
-        if self._data_dir is not None:
+        if self._data_dir is not None or self._region is not None:
             cfg = copy.copy(cfg)
-            cfg.data_dir = self._data_dir
+            if self._data_dir is not None:
+                cfg.data_dir = self._data_dir
+            if self._region is not None:
+                cfg.region = self._region
+        wan_pool = None
+        if self._wan:
+            from nomad_tpu.federation import WanPool
+            wan_pool = WanPool(self.transport, name, addr=(name, 0),
+                               region=cfg.region)
         return Server(cfg, name=name,
                       peers=[name] if join else self._names,
                       raft_transport=self.transport,
                       raft_config=self.raft_config,
-                      raft_join=join)
+                      raft_join=join,
+                      wan_pool=wan_pool)
 
     def start(self) -> None:
         for s in self.servers:
@@ -114,6 +131,7 @@ class Cluster:
             add_peer(server.name, me.addr)
             add_peer(f"rpc:{server.name}", me.addr)
             add_peer(f"gossip:{server.name}", me.addr)
+            add_peer(f"wan:{server.name}", me.addr)
 
     # -------------------------------------------------- elastic membership
 
@@ -143,7 +161,7 @@ class Cluster:
         replication/InstallSnapshot and autopilot promotes it to voter
         once it stabilizes."""
         if name is None:
-            name = f"server-{self._next_id}"
+            name = f"{self._prefix}-{self._next_id}"
             self._next_id += 1
         joiner = self._make_server(name, join=True)
         self._names.append(name)
@@ -212,3 +230,112 @@ class Cluster:
                 return True
             time.sleep(0.01)
         return False
+
+
+class FederatedCluster:
+    """N regional Clusters over ONE shared InMemTransport, WAN-joined
+    (reference: nomad's multi-region test topology — each region runs
+    its own raft spine, every *server* joins the shared WAN serf pool,
+    nomad/serf.go).  Region `regions[0]` seeds the WAN gossip."""
+
+    def __init__(self, regions=("global", "west"), n: int = 3,
+                 config: Optional[ServerConfig] = None,
+                 raft_config: Optional[RaftConfig] = None,
+                 data_dir: Optional[str] = None):
+        import os
+        self.transport = InMemTransport()
+        self.regions = list(regions)
+        self.clusters = {}
+        for r in self.regions:
+            self.clusters[r] = Cluster(
+                n=n, config=config, raft_config=raft_config,
+                data_dir=(os.path.join(data_dir, r) if data_dir else None),
+                transport=self.transport, name_prefix=f"{r}-server",
+                region=r, wan=True)
+
+    @property
+    def servers(self) -> List[Server]:
+        return [s for c in self.clusters.values() for s in c.servers]
+
+    def start(self) -> None:
+        for c in self.clusters.values():
+            c.start()
+        # WAN join: everyone seeds off the first region's first server
+        seed = self.clusters[self.regions[0]].servers[0].name
+        for s in self.servers:
+            if s.name != seed and s.wan_pool is not None:
+                s.wan_pool.join([(seed, (seed, 0))])
+
+    def stop(self) -> None:
+        for c in self.clusters.values():
+            c.stop()
+
+    def leader(self, region: Optional[str] = None,
+               timeout: float = 5.0) -> Server:
+        return self.clusters[region or self.regions[0]].leader(timeout)
+
+    def wait_federated(self, timeout: float = 10.0) -> None:
+        """Block until every server's WAN view covers all regions."""
+        want = sorted(self.regions)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(s.wan_pool is not None and s.wan_pool.regions() == want
+                   for s in self.servers):
+                return
+            time.sleep(0.02)
+        raise TimeoutError("WAN pool did not converge on all regions")
+
+    # ---- churn delegation: the matrix ChurnDriver drives a federated
+    # cell with the same surface as a single Cluster; each op lands on
+    # the regional cluster that owns the victim
+
+    def _owner(self, server: Server) -> Cluster:
+        for c in self.clusters.values():
+            if server in c.servers:
+                return c
+        raise ValueError(f"{server.name} is not a member of any region")
+
+    def kill(self, server: Server) -> None:
+        self._owner(server).kill(server)
+
+    def hard_kill(self, server: Server) -> None:
+        self._owner(server).hard_kill(server)
+
+    def restart(self, server: Server) -> Server:
+        owner = self._owner(server)
+        replacement = owner.restart(server)
+        # a crashed server's WAN pool died without a goodbye and the
+        # replacement boots with an empty WAN table: re-seed it off any
+        # live peer so it rejoins the federation (its bumped-by-
+        # refutation incarnation outranks the stale SUSPECT entries)
+        if replacement.wan_pool is not None:
+            seeds = [(s.name, (s.name, 0)) for s in self.servers
+                     if s is not replacement and not s._stop.is_set()]
+            if seeds:
+                replacement.wan_pool.join(seeds[:1])
+        return replacement
+
+    def isolate(self, server: Server) -> None:
+        self.transport.set_down(server.name)
+
+    def heal(self, server: Server) -> None:
+        self.transport.set_down(server.name, down=False)
+
+    def wait_replication(self, index: int, timeout: float = 5.0) -> bool:
+        return all(c.wait_replication(index, timeout)
+                   for c in self.clusters.values())
+
+    def partition_region(self, region: str, cut: bool = True) -> None:
+        """Sever (or heal) every cross-region link touching `region` —
+        the WAN cable cut.  Intra-region traffic keeps flowing, so the
+        dark region keeps its own leader and serves local reads."""
+        inside = [s.name for s in self.clusters[region].servers]
+        for rc, c in self.clusters.items():
+            if rc == region:
+                continue
+            for a in inside:
+                for b in (s.name for s in c.servers):
+                    self.transport.partition(a, b, cut=cut)
+
+    def heal_region(self, region: str) -> None:
+        self.partition_region(region, cut=False)

@@ -77,6 +77,13 @@ class DeploymentWatcher:
                 self._reconcile(d, now)
             elif d.status == DeploymentStatus.FAILED:
                 self._retry_revert(d)
+            elif d.status == DeploymentStatus.SUCCESSFUL \
+                    and d.is_multiregion and not d.multiregion_kicked:
+                # sequential multiregion rollout: this region is healthy,
+                # start the NEXT region.  Retried every pass until the
+                # kick lands — a partitioned next region halts the
+                # rollout here and it resumes after heal.
+                self._kick_next_region(d)
 
     def _retry_revert(self, d: Deployment) -> None:
         """A FAILED auto-revert deployment whose revert register_job was
@@ -97,6 +104,62 @@ class DeploymentWatcher:
         stable = self._latest_stable(d.namespace, d.job_id, d.job_version)
         if stable is not None:
             server.register_job(stable.copy())
+
+    def _kick_next_region(self, d: Deployment) -> None:
+        """Sequential multiregion rollout (reference: nomad multiregion
+        deployments): region N+1 is registered only once region N's
+        deployment went SUCCESSFUL.  Best-effort cross-region RPC — if
+        the next region is dark the kick is simply retried on the next
+        watcher pass, so a partition halts the rollout at the region
+        boundary without corrupting anything, and it resumes after heal.
+        The kicked flag is replicated so a new leader never double-kicks;
+        a Job.GetJob probe makes the kick idempotent even if the flag
+        write itself was lost to churn."""
+        from nomad_tpu.raft.transport import Unreachable
+        from nomad_tpu.rpc.endpoints import RpcError
+
+        server = self.server
+        job = server.store.job_by_id(d.namespace, d.job_id)
+        if (job is None or job.multiregion is None
+                or job.version != d.job_version):
+            # superseded by a newer registration — that version's own
+            # deployment owns the rollout now
+            self._mark_kicked(d)
+            return
+        regions = job.multiregion.region_names()
+        rollout = job.meta.get("multiregion.rollout", "")
+        if job.region not in regions or not rollout:
+            self._mark_kicked(d)
+            return
+        idx = regions.index(job.region)
+        if idx + 1 >= len(regions):
+            self._mark_kicked(d)            # last region: rollout done
+            return
+        next_region = regions[idx + 1]
+        try:
+            remote = server.rpc_region(next_region, "Job.GetJob", {
+                "namespace": d.namespace, "job_id": d.job_id})
+            already = (remote is not None and
+                       getattr(remote, "meta", {}).get(
+                           "multiregion.rollout") == rollout)
+            if not already:
+                nxt = job.multiregion_copy(next_region, rollout)
+                # must look like a fresh submission over there — strip
+                # the replicated indexes this region's store stamped on
+                nxt.version = 0
+                nxt.stable = False
+                nxt.create_index = nxt.modify_index = 0
+                nxt.job_modify_index = 0
+                server.rpc_region(next_region, "Job.Register", {"job": nxt})
+            self._mark_kicked(d)
+        except (Unreachable, RpcError):
+            return                          # region dark/churning: retry
+
+    def _mark_kicked(self, d: Deployment) -> None:
+        updated = d.copy()
+        updated.multiregion_kicked = True
+        self.server.apply(MessageType.DEPLOYMENT_UPSERT,
+                          {"deployment": _stamp(updated)})
 
     def _reconcile(self, d: Deployment, now: float) -> None:
         server = self.server
@@ -184,12 +247,22 @@ class DeploymentWatcher:
     def _mark_job_stable(self, d: Deployment) -> None:
         self.server.set_job_stability(d.namespace, d.job_id, d.job_version, True)
 
-    def _fail_deployment(self, d: Deployment, deadline: bool) -> None:
+    def _fail_deployment(self, d: Deployment, deadline: bool,
+                         from_peer_region: bool = False) -> None:
         server = self.server
         d.status = DeploymentStatus.FAILED
-        d.status_description = (DeploymentStatus.DESC_PROGRESS_DEADLINE
-                                if deadline else DeploymentStatus.DESC_FAILED_ALLOCATIONS)
+        if from_peer_region:
+            d.status_description = DeploymentStatus.DESC_MULTIREGION_FAIL
+        else:
+            d.status_description = (
+                DeploymentStatus.DESC_PROGRESS_DEADLINE
+                if deadline else DeploymentStatus.DESC_FAILED_ALLOCATIONS)
         server.apply(MessageType.DEPLOYMENT_UPSERT, {"deployment": _stamp(d)})
+        # a locally-failed multiregion deployment fails its siblings too
+        # (the from_peer_region guard stops the notification ping-ponging
+        # back to us)
+        if d.is_multiregion and not from_peer_region:
+            self._fail_sibling_regions(d)
         # auto-revert to the latest stable version
         if any(s.auto_revert for s in d.task_groups.values()):
             job = server.store.job_by_id(d.namespace, d.job_id)
@@ -200,6 +273,61 @@ class DeploymentWatcher:
                     server.register_job(revert)
                     return
         self._emit_eval(d)
+
+    def _fail_sibling_regions(self, d: Deployment) -> None:
+        """Cross-region failure propagation: tell every peer region in
+        the rollout to fail (and auto-revert) its copy of this job.
+        Best-effort — a dark region just misses the notification; its
+        rollout was halted at the region boundary anyway because the
+        SUCCESSFUL→kick chain can't cross a failed region."""
+        from nomad_tpu.raft.transport import Unreachable
+        from nomad_tpu.rpc.endpoints import RpcError
+
+        server = self.server
+        job = server.store.job_by_id(d.namespace, d.job_id)
+        if job is None or job.multiregion is None:
+            return
+        if job.multiregion.strategy.on_failure == "fail_local":
+            return
+        rollout = job.meta.get("multiregion.rollout", "")
+        for region in job.multiregion.region_names():
+            if region == server.region:
+                continue
+            try:
+                server.rpc_region(region, "Deployment.MultiregionFail", {
+                    "namespace": d.namespace, "job_id": d.job_id,
+                    "rollout": rollout})
+            except (Unreachable, RpcError):
+                continue
+
+    def multiregion_fail(self, namespace: str, job_id: str,
+                         rollout: str = "") -> bool:
+        """Receiving side of cross-region failure propagation: fail any
+        active multiregion deployment for this job (which triggers the
+        normal auto-revert path), and revert an already-promoted
+        SUCCESSFUL one back to its latest stable version.  Idempotent —
+        deployments already failed or superseded are left alone."""
+        server = self.server
+        job = server.store.job_by_id(namespace, job_id)
+        if (rollout and job is not None
+                and job.meta.get("multiregion.rollout") != rollout):
+            return False                    # different rollout generation
+        handled = False
+        for d in server.store.deployments():
+            if (d.namespace != namespace or d.job_id != job_id
+                    or not d.is_multiregion):
+                continue
+            if d.active():
+                self._fail_deployment(d.copy(), deadline=False,
+                                      from_peer_region=True)
+                handled = True
+            elif (d.status == DeploymentStatus.SUCCESSFUL
+                    and job is not None and job.version == d.job_version):
+                stable = self._latest_stable(namespace, job_id, d.job_version)
+                if stable is not None:
+                    server.register_job(stable.copy())
+                    handled = True
+        return handled
 
     def _latest_stable(self, namespace: str, job_id: str, before_version: int):
         versions = self.server.store.job_versions(namespace, job_id)

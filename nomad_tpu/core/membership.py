@@ -47,10 +47,16 @@ class Member:
     incarnation: int = 0
     status: str = ALIVE
     heard_at: float = field(default_factory=time.monotonic)
+    # gossiped key/value metadata (serf tags): the WAN pool rides region
+    # and leader-ness here.  Tags travel with the incarnation — a member
+    # re-tags itself by bumping its own incarnation, so the new tags
+    # outrank every older entry in other tables.
+    tags: Dict[str, object] = field(default_factory=dict)
 
     def wire(self) -> dict:
         return {"name": self.name, "addr": tuple(self.addr),
-                "incarnation": self.incarnation, "status": self.status}
+                "incarnation": self.incarnation, "status": self.status,
+                "tags": dict(self.tags)}
 
 
 class Membership:
@@ -63,7 +69,9 @@ class Membership:
     def __init__(self, transport, name: str, addr: Tuple[str, int],
                  interval: float = 0.2, suspect_after: float = 1.0,
                  fail_after: float = 3.0, reap_after: float = 5.0,
-                 on_change: Optional[Callable[[Member], None]] = None):
+                 on_change: Optional[Callable[[Member], None]] = None,
+                 channel: str = "gossip",
+                 tags: Optional[Dict[str, object]] = None):
         self.transport = transport
         self.name = name
         self.interval = interval
@@ -71,15 +79,21 @@ class Membership:
         self.fail_after = fail_after
         self.reap_after = reap_after
         self.on_change = on_change or (lambda m: None)
+        # handler-name prefix: the LAN pool owns "gossip:{name}"; a
+        # second pool on the same transport (the WAN federation pool)
+        # picks a distinct channel so both can coexist on one member
+        # (TcpTransport maps any "prefix:name" to the member's address)
+        self.channel = channel
         self._lock = threading.Lock()
         self.members: Dict[str, Member] = {
-            name: Member(name=name, addr=tuple(addr))}
+            name: Member(name=name, addr=tuple(addr),
+                         tags=dict(tags or {}))}
         # name -> last seen incarnation of a reaped LEFT/FAILED member:
         # inserts at <= that incarnation are stale resurrections
         self._tombstones: Dict[str, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        transport.register(f"gossip:{name}", self._handle)
+        transport.register(f"{channel}:{name}", self._handle)
 
     # ------------------------------------------------------------- admin
 
@@ -108,7 +122,8 @@ class Membership:
             me.status = LEFT
         for peer in self._peers():
             try:
-                self.transport.call(self.name, f"gossip:{peer.name}",
+                self.transport.call(self.name,
+                                    f"{self.channel}:{peer.name}",
                                     "sync", {"table": self._wire_table()})
             except Exception:                       # noqa: BLE001
                 pass
@@ -118,7 +133,19 @@ class Membership:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(1.0)
-        self.transport.deregister(f"gossip:{self.name}")
+        self.transport.deregister(f"{self.channel}:{self.name}")
+
+    def set_tags(self, tags: Dict[str, object]) -> None:
+        """Re-tag this member (serf SetTags).  Bumps our incarnation so
+        the change outranks every older entry about us and propagates on
+        the next gossip round (leader changes ride this)."""
+        with self._lock:
+            race.write("Membership.members", self)
+            me = self.members[self.name]
+            if dict(tags) == me.tags:
+                return
+            me.tags = dict(tags)
+            me.incarnation += 1
 
     def alive_members(self) -> List[Member]:
         with self._lock:
@@ -152,7 +179,7 @@ class Membership:
         peer = random.choice(peers)
         try:
             resp = self.transport.call(
-                self.name, f"gossip:{peer.name}", "sync",
+                self.name, f"{self.channel}:{peer.name}", "sync",
                 {"table": self._wire_table()})
             self._merge(resp.get("table", []))
             with self._lock:
@@ -234,7 +261,8 @@ class Membership:
                         del self._tombstones[name]
                     cur = self.members[name] = Member(
                         name=name, addr=tuple(entry["addr"]),
-                        incarnation=inc, status=status)
+                        incarnation=inc, status=status,
+                        tags=dict(entry.get("tags") or {}))
                     if hasattr(self.transport, "add_peer"):
                         self.transport.add_peer(name, cur.addr)
                     self.on_change(cur)
@@ -246,6 +274,9 @@ class Membership:
                         inc == cur.incarnation
                         and rank[status] > rank[cur.status]):
                     cur.incarnation = inc
+                    # tags ride the incarnation: the winning entry's tags
+                    # are by construction at least as fresh as ours
+                    cur.tags = dict(entry.get("tags") or {})
                     new_addr = tuple(entry["addr"])
                     if new_addr != cur.addr:
                         # a member that came back on a new port: refresh
@@ -257,6 +288,14 @@ class Membership:
                         self._set_status(cur, status)
                     if status == ALIVE:
                         cur.heard_at = time.monotonic()
+                elif inc == cur.incarnation and not cur.tags \
+                        and entry.get("tags"):
+                    # a join() seed is a bare (name, addr) stub with no
+                    # tags at incarnation 0 — the member's own entry at
+                    # the SAME incarnation carries its real tags, and
+                    # adopting them is monotone (empty -> the one
+                    # tag-set anyone has published at this incarnation)
+                    cur.tags = dict(entry["tags"])
 
     def _set_status(self, m: Member, status: str) -> None:
         m.status = status

@@ -83,7 +83,8 @@ class Server:
                  raft_transport=None,
                  raft_config=None,
                  membership=None,
-                 raft_join: bool = False):
+                 raft_join: bool = False,
+                 wan_pool=None):
         self.config = config or ServerConfig()
         self.name = name
         self.store = StateStore()
@@ -130,12 +131,18 @@ class Server:
         # serves reads from its LOCAL store once the gate establishes a
         # read point (serving/gate.py)
         self.serving_gate = ReadGate(self)
-        self.membership = membership   # gossip (core.membership), optional
-        # multi-region federation: region -> peer handle (a Server object
-        # for in-process federation, or a server NAME reachable over the
-        # shared transport — the WAN-serf analog of nomad/serf.go)
+        self.membership = membership   # LAN gossip (core.membership)
+        # multi-region federation (nomad/serf.go WAN pool + nomad/rpc.go
+        # forwardRegion): servers discover other regions over a second
+        # SWIM instance (wan_pool, channel "wan") tagged with region +
+        # leader-ness, and the router forwards RPCs to the remote
+        # region's current leader.  `_region_peers` remains as the
+        # static route table for in-process federation (dev mode).
         self.region = self.config.region
         self._region_peers: Dict[str, object] = {}
+        self.wan_pool = wan_pool
+        from nomad_tpu.federation import RegionRouter
+        self.region_router = RegionRouter(self)
         if raft_transport is not None:
             raft_transport.register(f"rpc:{name}", self.endpoints.handle)
             data_dir = self.config.data_dir
@@ -237,29 +244,27 @@ class Server:
                 other._region_peers[r] = p
 
     def federate_name(self, region: str, server_name: str) -> None:
-        """Transport-based federation route: RPCs for `region` forward to
-        `server_name` over the shared transport."""
+        """Static transport-based federation route: RPCs for `region` may
+        forward to `server_name` over the shared transport.  The WAN
+        gossip pool supersedes this once members are discovered; the
+        static entry remains a seed/fallback."""
         self._region_peers[region] = server_name
 
     def regions(self) -> List[str]:
-        return sorted({self.region, *self._region_peers})
+        """Known regions, sorted and deduped, always including ours:
+        WAN-pool-discovered regions plus static federation routes."""
+        regs = {self.region, *self._region_peers}
+        if self.wan_pool is not None:
+            regs.update(self.wan_pool.regions())
+        return sorted(regs)
 
     def rpc_region(self, region: str, method: str, args: dict):
         """Route an RPC to the right region's leader (reference
-        nomad/rpc.go:21 forwardRegion).  Local region short-circuits."""
-        if not region or region == self.region:
-            return self.rpc_leader(method, args)
-        peer = self._region_peers.get(region)
-        if peer is None:
-            from nomad_tpu.rpc.endpoints import RpcError
-            raise RpcError("no_region_path", region)
-        if isinstance(peer, str):
-            if self._transport is None:
-                from nomad_tpu.rpc.endpoints import RpcError
-                raise RpcError("no_region_path", region)
-            return self._transport.call(self.name, f"rpc:{peer}", method,
-                                        args)
-        return peer.rpc_leader(method, args)
+        nomad/rpc.go:21 forwardRegion).  Local region short-circuits;
+        remote regions go through the federation router (known-leader
+        hints, bounded retry over remote churn, Unreachable fail-fast
+        when the region is dark)."""
+        return self.region_router.route(region, method, args)
 
     def enqueue_plan(self, plan):
         """Plan-queue enqueue gated on the submitting worker still holding
@@ -309,6 +314,8 @@ class Server:
                 self.store.latest_index)
         if self.membership is not None:
             self.membership.start()
+        if self.wan_pool is not None:
+            self.wan_pool.start()
         if self.raft is not None:
             # every server runs schedulers against its replicated snapshot,
             # RPCing the leader for dequeue/ack/plan-submit (reference:
@@ -329,6 +336,11 @@ class Server:
                 return
             self._established = True
             self.leader = True
+            if self.wan_pool is not None:
+                # leadership rides the WAN tags: remote regions route to
+                # us once the re-tag gossips out (nomad/serf.go member
+                # tags carrying raft leadership)
+                self.wan_pool.set_leader(True)
             self._leader_stop = threading.Event()
             stop = self._leader_stop
             self.broker.set_enabled(True)
@@ -452,6 +464,8 @@ class Server:
                 return
             self._established = False
             self.leader = False
+            if self.wan_pool is not None:
+                self.wan_pool.set_leader(False)
             self._leader_stop.set()
             self.heartbeats.stop()
             self.deployment_watcher.stop()
@@ -486,6 +500,14 @@ class Server:
             except Exception:                      # noqa: BLE001
                 pass
             self.membership = None
+        if self.wan_pool is not None:
+            # graceful goodbye on the WAN too: remote regions see LEFT
+            # (and reap into a tombstone) instead of suspecting a failure
+            try:
+                self.wan_pool.leave()
+            except Exception:                      # noqa: BLE001
+                pass
+            self.wan_pool = None
         self._stop.set()
         for w in self.remote_workers:
             w.stop()
@@ -511,6 +533,11 @@ class Server:
         self.remote_workers = []
         if self.raft is not None:
             self.raft.crash()
+        if self.wan_pool is not None:
+            # no goodbye: remote regions must detect the failure through
+            # the WAN failure detector, not a graceful LEFT
+            self.wan_pool.stop()
+            self.wan_pool = None
         if self._transport is not None:
             self._transport.deregister(f"rpc:{self.name}")
 
@@ -664,8 +691,21 @@ class Server:
     def register_job(self, job: Job) -> Evaluation:
         """Job.Register (nomad/job_endpoint.go:81): upsert + eval.  A job
         whose region is not ours forwards to that region's servers
-        (job_endpoint.go forward via rpc.go forwardRegion)."""
+        (job_endpoint.go forward via rpc.go forwardRegion); a region
+        nobody has heard of is rejected outright — silently committing
+        it locally (or forwarding it in a loop) would strand the job."""
+        if job.multiregion is not None and job.multiregion.regions \
+                and "multiregion.rollout" not in job.meta:
+            return self._register_multiregion(job)
         if job.region and job.region != self.region:
+            known = self.regions()
+            if job.region not in known:
+                from nomad_tpu.rpc.endpoints import RpcError
+                raise RpcError(
+                    "unknown_region",
+                    f"job {job.id!r} submitted to unknown region "
+                    f"{job.region!r} (known regions: "
+                    f"{', '.join(known)})")
             resp = self.rpc_region(job.region, "Job.Register",
                                    {"job": job})
             return Evaluation(
@@ -695,6 +735,26 @@ class Server:
         if not job.is_periodic() and not job.is_parameterized():
             self.create_evals([ev])
         return ev
+
+    def _register_multiregion(self, job: Job) -> Evaluation:
+        """Expand a `multiregion` job into per-region copies and start
+        the sequential rollout at the FIRST listed region (reference
+        nomad/job_endpoint.go multiregion Register: later regions only
+        deploy after the previous region's deployment is healthy — the
+        deployment watcher kicks region N+1 when region N succeeds)."""
+        regions = [r.name for r in job.multiregion.regions]
+        known = self.regions()
+        unknown = [r for r in regions if r not in known]
+        if unknown:
+            from nomad_tpu.rpc.endpoints import RpcError
+            raise RpcError(
+                "unknown_region",
+                f"multiregion job {job.id!r} names unknown region(s) "
+                f"{', '.join(repr(r) for r in unknown)} (known regions: "
+                f"{', '.join(known)})")
+        rollout = uuid.uuid4().hex
+        first = job.multiregion_copy(regions[0], rollout)
+        return self.register_job(first)
 
     def deregister_job(self, namespace: str, job_id: str, purge: bool = False) -> Optional[Evaluation]:
         job = self.store.job_by_id(namespace, job_id)

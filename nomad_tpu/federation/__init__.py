@@ -1,0 +1,15 @@
+"""Multi-region federation plane (reference: nomad/serf.go WAN pool +
+nomad/rpc.go region forwarding).
+
+Each region runs its own raft commit spine; regions discover each other
+over a second SWIM gossip instance joining only servers (`WanPool`,
+channel "wan" so it coexists with the LAN pool on one transport), with
+members tagged region + leader-ness.  `RegionRouter` forwards RPCs to a
+remote region's current leader using those tags plus known-leader hints,
+with bounded retry across remote leader churn and `Unreachable`
+fail-fast when the region is dark.
+"""
+from nomad_tpu.federation.router import MAX_FORWARD_HOPS, RegionRouter
+from nomad_tpu.federation.wan import WanPool
+
+__all__ = ["MAX_FORWARD_HOPS", "RegionRouter", "WanPool"]

@@ -1,0 +1,101 @@
+"""WAN gossip pool (reference: nomad/serf.go — the serf WAN cluster
+every server joins, carrying ``region`` and leader-ness in its tags).
+
+A second `Membership` instance over the same transport, on channel
+"wan" so its handler names (``wan:server-1``) never collide with the
+LAN pool's (``gossip:server-1``).  Only *servers* join; clients never
+see the WAN pool.  Tags carry the member's region and whether it is
+currently its region's raft leader; leadership changes propagate by
+re-tagging (`set_leader`), which bumps the member's incarnation so the
+new claim outranks every stale entry cluster-wide.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from nomad_tpu.core.membership import ALIVE, LEFT, SUSPECT, Membership
+
+
+class WanPool(Membership):
+    """Server-only federation gossip: `Membership` on channel "wan" with
+    region/leader tags and region-indexed lookups."""
+
+    def __init__(self, transport, name: str, addr: Tuple[str, int],
+                 region: str, is_leader: bool = False, **kw):
+        self.region = region
+        kw.setdefault("channel", "wan")
+        # the WAN pool is bigger than a LAN pool (every server of every
+        # region) and SWIM-lite refreshes heard_at mostly on DIRECT
+        # contact, so the expected gap between contacts with any given
+        # peer grows with pool size — laxer timers keep a healthy pool
+        # from flapping into SUSPECT
+        kw.setdefault("suspect_after", 2.5)
+        kw.setdefault("fail_after", 6.0)
+        super().__init__(transport, name, addr,
+                         tags={"region": region,
+                               "leader": bool(is_leader)}, **kw)
+
+    # ----------------------------------------------------------- tagging
+
+    def set_leader(self, is_leader: bool) -> None:
+        """Re-tag this server's leader-ness (no-op if unchanged)."""
+        self.set_tags({"region": self.region, "leader": bool(is_leader)})
+
+    # ----------------------------------------------------------- lookups
+
+    def _entries(self) -> List[dict]:
+        # member_list() already snapshots the table under the lock with
+        # the race hooks; every read below goes through it
+        return self.member_list()
+
+    def regions(self) -> List[str]:
+        """Sorted, deduped regions with at least one non-LEFT member,
+        always including our own."""
+        regs = {self.region}
+        for m in self._entries():
+            r = (m.get("tags") or {}).get("region")
+            if r and m["status"] != LEFT:
+                regs.add(r)
+        return sorted(regs)
+
+    def region_servers(self, region: str) -> List[str]:
+        """Reachable-looking server names in `region`: ALIVE first, then
+        SUSPECT (a big pool suspects healthy members now and then, and a
+        forward attempt is the cheapest way to find out), each tier
+        sorted for determinism."""
+        alive, suspect = [], []
+        for m in self._entries():
+            if (m.get("tags") or {}).get("region") != region:
+                continue
+            if m["status"] == ALIVE:
+                alive.append(m["name"])
+            elif m["status"] == SUSPECT:
+                suspect.append(m["name"])
+        return sorted(alive) + sorted(suspect)
+
+    def region_leader(self, region: str) -> Optional[str]:
+        """The non-dead server currently tagged leader of `region`, or
+        None (elections in flight / region dark)."""
+        best = None
+        for m in self._entries():
+            tags = m.get("tags") or {}
+            if m["status"] in (ALIVE, SUSPECT) \
+                    and tags.get("region") == region and tags.get("leader"):
+                if m["status"] == ALIVE:
+                    return m["name"]
+                best = best or m["name"]
+        return best
+
+    def server_region(self, name: str) -> Optional[str]:
+        for m in self._entries():
+            if m["name"] == name:
+                return (m.get("tags") or {}).get("region")
+        return None
+
+    def members_by_region(self) -> Dict[str, List[dict]]:
+        out: Dict[str, List[dict]] = {}
+        for m in self._entries():
+            r = (m.get("tags") or {}).get("region")
+            if r:
+                out.setdefault(r, []).append(m)
+        return out

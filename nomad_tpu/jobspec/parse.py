@@ -18,6 +18,9 @@ from nomad_tpu.structs import (
     EphemeralDisk,
     Job,
     MigrateStrategy,
+    Multiregion,
+    MultiregionRegion,
+    MultiregionStrategy,
     NetworkPort,
     NetworkResource,
     PeriodicConfig,
@@ -102,6 +105,8 @@ def _job_from_block(b: HclBlock) -> Job:
     job.spreads = [_spread(s) for s in b.all("spread")]
     if b.first("update") is not None:
         job.update = _update(b.first("update"))
+    if b.first("multiregion") is not None:
+        job.multiregion = _multiregion(b.first("multiregion"))
     if b.first("periodic") is not None:
         job.periodic = _periodic(b.first("periodic"))
     if b.first("parameterized") is not None:
@@ -300,6 +305,35 @@ def _update(b: HclBlock) -> UpdateStrategy:
         auto_promote=bool(b.get("auto_promote", False)),
         canary=int(b.get("canary", 0)),
     )
+
+
+def _multiregion(b: HclBlock) -> Multiregion:
+    """multiregion { strategy { max_parallel, on_failure }
+    region "west" { count, datacenters } ... } (reference
+    jobspec2 Multiregion)."""
+    mr = Multiregion()
+    st = b.first("strategy")
+    if st is not None:
+        mr.strategy = MultiregionStrategy(
+            max_parallel=int(st.get("max_parallel", 1)),
+            on_failure=st.get("on_failure", "fail_all"))
+    for rb in b.all("region"):
+        name = rb.labels[0] if rb.labels else rb.get("name", "")
+        if not name:
+            raise HclParseError("multiregion region needs a name", 0)
+        count = rb.get("count")
+        region = MultiregionRegion(
+            name=name,
+            count=int(count) if count is not None else None,
+            datacenters=list(rb.get("datacenters", [])))
+        if rb.first("meta") is not None:
+            region.meta = {k: str(v) for k, v in
+                           rb.first("meta").attrs.items()}
+        mr.regions.append(region)
+    if not mr.regions:
+        raise HclParseError("multiregion block needs at least one "
+                            "region", 0)
+    return mr
 
 
 def _periodic(b: HclBlock) -> PeriodicConfig:

@@ -79,10 +79,15 @@ class InMemTransport:
     # --- RPC -------------------------------------------------------------
 
     def call(self, src: str, dst: str, method: str, args: dict) -> dict:
+        # fault checks run on MEMBER names ("server-1"), not handler
+        # names ("rpc:server-1"/"wan:server-1") — a downed or partitioned
+        # member loses all of its channels at once, matching a real
+        # network cut
+        src_m, dst_m = _member_of(src), _member_of(dst)
         with self._lock:
             handler = self._handlers.get(dst)
-            blocked = (dst in self._down or src in self._down
-                       or dst in self._partitions.get(src, ()))
+            blocked = (dst_m in self._down or src_m in self._down
+                       or dst_m in self._partitions.get(src_m, ()))
         if handler is None or blocked:
             raise Unreachable(f"{src}->{dst}")
         if chaos.active is not None:

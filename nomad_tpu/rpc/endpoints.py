@@ -71,16 +71,31 @@ class Endpoints:
     def handle(self, method: str, args: dict):
         # cross-region forwarding (reference nomad/rpc.go:21
         # forwardRegion): an explicit region that is not ours routes to
-        # that region's servers before any local processing
+        # that region's servers before any local processing.  The
+        # forwarded copy KEEPS the region field — a server whose WAN view
+        # is stale may hand the request to the wrong region, and the
+        # receiver must be able to forward it on — with a hop counter so
+        # two regions with mutually-stale views can't ping-pong forever.
         region = (args or {}).get("region")
         if region and region != self.server.region:
+            from nomad_tpu.federation import MAX_FORWARD_HOPS
             fwd = dict(args)
-            fwd.pop("region", None)
+            hops = int(fwd.pop("_forward_hops", 0)) + 1
+            if hops > MAX_FORWARD_HOPS:
+                raise RpcError(
+                    "forward_loop",
+                    f"{method} for region {region!r} exceeded "
+                    f"{MAX_FORWARD_HOPS} forwarding hops")
+            fwd["_forward_hops"] = hops
             return self.server.rpc_region(region, method, fwd)
         fn = self._methods.get(method)
         if fn is None:
             raise RpcError("unknown_method", method)
+        # copy before stripping routing fields — the CALLER's dict must
+        # come back unchanged (it may retry against another server)
         args = dict(args) if args else {}
+        args.pop("region", None)
+        args.pop("_forward_hops", None)
         # per-request consistency on read RPCs (reference QueryOptions
         # riding every RPC): establish the read point before dispatch so
         # the handler's plain store reads serve at it
@@ -165,7 +180,28 @@ class Endpoints:
         jobs = self.server.store.jobs()
         if ns:
             jobs = [j for j in jobs if j.namespace == ns]
+        if args.get("federated"):
+            jobs = list(jobs) + self._federated_job_list(ns)
         return jobs
+
+    def _federated_job_list(self, ns):
+        """Fan the listing out to every known remote region's leader.
+        Dark regions are skipped, not fatal — a federated listing is a
+        best-effort union (reference nomad's per-region API: the CLI
+        queries regions independently and tolerates missing ones)."""
+        from nomad_tpu.raft.transport import Unreachable
+
+        remote = []
+        for region in self.server.regions():
+            if region == self.server.region:
+                continue
+            try:
+                part = self.server.rpc_region(region, "Job.List", {
+                    **({"namespace": ns} if ns else {})})
+            except (Unreachable, RpcError):
+                continue
+            remote.extend(part or [])
+        return remote
 
     def rpc_Job__Plan(self, args):
         """Dry-run scheduling (reference Job.Plan, nomad/job_endpoint.go:
@@ -493,6 +529,15 @@ class Endpoints:
     def rpc_Deployment__Pause(self, args):
         return {"ok": self.server.deployment_watcher.pause(
             args["deployment_id"], args.get("pause", True))}
+
+    def rpc_Deployment__MultiregionFail(self, args):
+        """Cross-region failure propagation target: a peer region's
+        multiregion deployment failed, fail/revert ours.  Safe on a
+        follower — the resulting writes forward to our leader via
+        apply()."""
+        return {"ok": self.server.deployment_watcher.multiregion_fail(
+            args.get("namespace", "default"), args["job_id"],
+            args.get("rollout", ""))}
 
     # ------------------------------------------------------------- operator
 

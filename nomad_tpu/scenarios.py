@@ -4,8 +4,8 @@ convergence soaks on a real 3-server cluster.
 Each matrix cell boots a data_dir-backed in-process `Cluster`, registers
 mock client nodes that heartbeat on short TTLs, drives one workload
 shape (batch spine, spread services, device-constrained, preemption,
-serving plane, rolling deploy, autoscaling ramp), and runs a *phased*
-chaos schedule against it: the `NOMAD_TPU_CHAOS` grammar's
+serving plane, rolling deploy, autoscaling ramp, multi-region
+federation), and runs a *phased* chaos schedule against it: the `NOMAD_TPU_CHAOS` grammar's
 `phase=<name>:<a>-<b>` windows interleave calm -> storm -> calm, with
 server hard_kill/restart and partition bursts riding the storm phases.
 The `server_replace` schedule runs the elastic-membership drill instead:
@@ -196,6 +196,23 @@ SCHEDULES: Dict[str, Schedule] = {
         duration_s=4.0,
         server_churn=False,
         server_replace=True,
+    ),
+    # the WAN cable cut: during the dark phase the multi_region shape
+    # severs every cross-region link to the secondary region (and the
+    # `region.partition` point drops a slice of whatever forwards still
+    # get attempted).  The deterministic gates: `?stale` keeps serving
+    # locally on both sides, `?consistent` reads into the dark region
+    # fail fast with Unreachable, the sequential multiregion rollout
+    # HALTS at the partitioned region without corrupting either spine,
+    # and resumes to completion after the heal.  Only the multi_region
+    # shape runs this schedule (it is excluded from the core product in
+    # ALL_CELLS).
+    "region_partition": Schedule(
+        name="region_partition",
+        spec=("seed={seed};delay_ms=1;phase=dark:0.8-2.8;"
+              "region.partition=0.25@dark;rpc.delay=0.05@dark"),
+        duration_s=3.6,
+        server_churn=False,
     ),
 }
 
@@ -513,10 +530,18 @@ def _batch_job(count, cpu=300, mem=128):
 class Shape:
     """One workload shape.  setup() builds pre-chaos steady state (and
     declares expectations in ctx), during() is pumped ~20x/s inside the
-    chaos window, finish() runs after chaos lifts, before invariants."""
+    chaos window, finish() runs after chaos lifts, before invariants.
+    make_cluster()/check() let a shape swap the cluster topology (the
+    multi_region shape boots a FederatedCluster and runs the invariant
+    battery per region)."""
 
     name = "shape"
     n_nodes = 8
+
+    def make_cluster(self, cfg: ServerConfig, raft_config: RaftConfig,
+                     data_dir: str):
+        return Cluster(3, config=cfg, raft_config=raft_config,
+                       data_dir=data_dir)
 
     def make_nodes(self, rng: random.Random):
         nodes = []
@@ -535,6 +560,9 @@ class Shape:
 
     def finish(self, cluster: Cluster, ctx: CellCtx):
         pass
+
+    def check(self, cluster, ctx: CellCtx, timeout: float = 60.0) -> dict:
+        return check_convergence(cluster, ctx, timeout=timeout)
 
 
 class E2ESpineShape(Shape):
@@ -837,6 +865,214 @@ class AutoscaleRampShape(Shape):
         ctx.notes["scale_bursts"] = self.driver.bursts
 
 
+class MultiRegionShape(Shape):
+    """Federation under a WAN cut: two 3-server regions over one shared
+    transport, WAN-gossip joined, running a sequential multiregion
+    rollout (primary -> remote, with a per-region count override).  When
+    the chaos phase opens the shape severs every cross-region link (the
+    `region.partition` point additionally drops a slice of the forwards
+    that still get attempted) and only THEN releases the primary
+    rollout, so the primary deployment goes SUCCESSFUL while the next
+    region is dark.  Gated while dark: `?stale` keeps serving locally,
+    `?consistent` reads into the dark region fail fast with Unreachable,
+    and the rollout HALTS at the region boundary (the remote spine never
+    hears about the job).  After the heal the rollout must resume to
+    completion, and the invariant battery — including FSM byte-identity
+    — runs per region."""
+
+    name = "multi_region"
+    n_nodes = 4                         # per region
+    regions = ("global", "west")
+
+    def make_cluster(self, cfg, raft_config, data_dir):
+        from nomad_tpu.core.cluster import FederatedCluster
+        self.fc = FederatedCluster(regions=self.regions, n=3, config=cfg,
+                                   raft_config=raft_config,
+                                   data_dir=data_dir)
+        return self.fc
+
+    def setup(self, cluster, rng, ctx):
+        from nomad_tpu.structs import Multiregion, MultiregionRegion
+        fc = self.fc
+        fc.wait_federated(timeout=30.0)
+        self.primary, self.remote = self.regions
+        self._partitioned = self._healed = False
+        self._reg = None
+        # the runner's keeper/health drive only the primary region; the
+        # remote region gets its own client fleet + background planes
+        rc = fc.clusters[self.remote]
+        self._rctx = CellCtx()
+        rnodes = [mock.node() for _ in range(self.n_nodes)]
+        for n in rnodes:
+            _on_leader(rc, lambda ld, n=n: ld.register_node(n))
+        self._rctx.node_ids = [n.id for n in rnodes]
+        self._rkeeper = NodeKeeper(rc, self._rctx.node_ids)
+        self._rkeeper.start()
+        self._rhealth = HealthReporter(rc, self._rctx)
+        self._rhealth.start()
+        # sequential multiregion rollout with a per-region count override
+        j = mock.job()
+        tg = j.task_groups[0]
+        tg.count = 3
+        tg.tasks[0].resources.cpu = 300
+        tg.tasks[0].resources.memory_mb = 128
+        tg.ephemeral_disk.size_mb = 0
+        j.multiregion = Multiregion(regions=[
+            MultiregionRegion(name=self.primary, count=3),
+            MultiregionRegion(name=self.remote, count=2)])
+        self.job = j
+        _on_leader(cluster, lambda ld: ld.register_job(j))
+        _wait_live(cluster, ctx, j.id, 3)
+        # NOT added to ctx.exact_jobs yet: the HealthReporter must not
+        # drive the primary deployment SUCCESSFUL (and kick the remote
+        # region) before the partition is in place — during() releases
+        # the rollout when the dark phase opens
+
+    def during(self, cluster, rng, ctx, reg):
+        self._reg = reg
+        fc = self.fc
+        in_phase = bool(reg.phase_now())
+        if in_phase and not self._partitioned:
+            self._partitioned = True
+            fc.partition_region(self.remote)
+            ctx.notes["partitioned_at_s"] = round(reg.elapsed() or 0.0, 2)
+            ctx.exact_jobs.append(self.job.id)      # release the rollout
+        elif self._partitioned and not self._healed and not in_phase:
+            self._healed = True
+            fc.heal_region(self.remote)
+            ctx.notes["healed_at_s"] = round(reg.elapsed() or 0.0, 2)
+        if self._partitioned and not self._healed:
+            self._probe_dark(fc, ctx)
+
+    def _probe_dark(self, fc, ctx):
+        """Record each dark-phase gate the first time it is observed
+        (every probe is best-effort: elections may be in flight)."""
+        from nomad_tpu.raft.transport import Unreachable
+        try:
+            gl = fc.clusters[self.primary].leader(timeout=0.5)
+        except TimeoutError:
+            return
+        ns = ctx.namespace
+        if "gate_stale_local" not in ctx.notes:
+            try:
+                gl.endpoints.handle("Job.List", {"consistency": "stale"})
+                ctx.notes["gate_stale_local"] = True
+            except Exception:           # noqa: BLE001
+                pass
+        if "gate_consistent_unreachable" not in ctx.notes:
+            t0 = time.time()
+            try:
+                gl.endpoints.handle("Job.GetJob", {
+                    "namespace": ns, "job_id": self.job.id,
+                    "region": self.remote, "consistency": "consistent"})
+            except Unreachable:
+                ctx.notes["gate_consistent_unreachable"] = round(
+                    time.time() - t0, 3)
+            except Exception:           # noqa: BLE001
+                pass
+        if "gate_halt_at_boundary" not in ctx.notes:
+            try:
+                wl = fc.clusters[self.remote].leader(timeout=0.5)
+            except TimeoutError:
+                return
+            d = gl.store.latest_deployment_by_job_id(ns, self.job.id)
+            if (d is not None
+                    and d.status == DeploymentStatus.SUCCESSFUL
+                    and not d.multiregion_kicked
+                    and wl.store.job_by_id(ns, self.job.id) is None):
+                ctx.notes["gate_halt_at_boundary"] = True
+
+    def finish(self, cluster, ctx):
+        fc = self.fc
+        ns = ctx.namespace
+        if self._partitioned and not self._healed:
+            fc.heal_region(self.remote)
+            self._healed = True
+        if self.job.id not in ctx.exact_jobs:
+            ctx.exact_jobs.append(self.job.id)
+        # under the deterministic region_partition schedule every gate
+        # must have been observed and the rollout must complete; under
+        # storm the health-flap point may legitimately FAIL the primary
+        # deployment, in which case the rollout is (correctly) abandoned
+        strict = self._reg is not None and (
+            "region.partition" in self._reg.phased
+            or self._reg.rates.get("region.partition", 0.0) > 0.0)
+        pc, rc = fc.clusters[self.primary], fc.clusters[self.remote]
+
+        def primary_settled():
+            try:
+                gl = pc.leader(timeout=1.0)
+            except TimeoutError:
+                return False
+            d = gl.store.latest_deployment_by_job_id(ns, self.job.id)
+            return d is not None and d.status in (
+                DeploymentStatus.SUCCESSFUL, DeploymentStatus.FAILED)
+        _wait(primary_settled, timeout=30.0)
+        d = _on_leader(pc, lambda ld: ld.store.latest_deployment_by_job_id(
+            ns, self.job.id))
+        ctx.notes["primary_deployment"] = None if d is None else d.status
+        if strict and (d is None
+                       or d.status != DeploymentStatus.SUCCESSFUL):
+            raise RuntimeError(
+                f"primary deployment did not succeed: "
+                f"{None if d is None else d.status}")
+        if d is not None and d.status == DeploymentStatus.SUCCESSFUL:
+            # resume-post-heal: the halted kick must now land
+            def remote_arrived():
+                try:
+                    wl = rc.leader(timeout=1.0)
+                except TimeoutError:
+                    return False
+                return wl.store.job_by_id(ns, self.job.id) is not None
+            if not _wait(remote_arrived, timeout=30.0):
+                raise RuntimeError(
+                    "multiregion rollout did not resume after heal")
+            self._rctx.exact_jobs.append(self.job.id)
+            ctx.notes["gate_resume_post_heal"] = True
+            rollout = _on_leader(pc, lambda ld: ld.store.job_by_id(
+                ns, self.job.id).meta.get("multiregion.rollout"))
+            wj = _on_leader(rc, lambda ld: ld.store.job_by_id(
+                ns, self.job.id))
+            if wj.meta.get("multiregion.rollout") != rollout:
+                raise RuntimeError("remote job carries a different "
+                                   "rollout id")
+            if wj.task_groups[0].count != 2:
+                raise RuntimeError(
+                    f"per-region count override lost: remote count "
+                    f"{wj.task_groups[0].count} != 2")
+        if strict:
+            missing = [g for g in ("gate_stale_local",
+                                   "gate_consistent_unreachable",
+                                   "gate_halt_at_boundary")
+                       if g not in ctx.notes]
+            if missing:
+                raise RuntimeError(
+                    f"dark-phase gates never observed: {missing}")
+
+    def check(self, cluster, ctx, timeout: float = 60.0) -> dict:
+        """Per-region invariant battery (each region is its own raft
+        spine, so FSM byte-identity is asserted within each region)."""
+        fc = self.fc
+        ctxs = {self.primary: ctx, self.remote: self._rctx}
+        merged = {"converged": True, "convergence_time_s": 0.0,
+                  "invariants": {}}
+        try:
+            for rname in self.regions:
+                res = check_convergence(fc.clusters[rname], ctxs[rname],
+                                        timeout=timeout)
+                merged["converged"] = (merged["converged"]
+                                       and bool(res["converged"]))
+                merged["convergence_time_s"] = max(
+                    merged["convergence_time_s"],
+                    res["convergence_time_s"])
+                for k, v in res["invariants"].items():
+                    merged["invariants"][f"{rname}.{k}"] = v
+        finally:
+            self._rkeeper.stop_flag.set()
+            self._rhealth.stop_flag.set()
+        return merged
+
+
 SHAPES: Dict[str, Callable[[], Shape]] = {
     "e2e_spine": E2ESpineShape,
     "scan_spread": ScanSpreadShape,
@@ -845,6 +1081,7 @@ SHAPES: Dict[str, Callable[[], Shape]] = {
     "serving_plane": ServingPlaneShape,
     "rolling_deploy": RollingDeployShape,
     "autoscale_ramp": AutoscaleRampShape,
+    "multi_region": MultiRegionShape,
 }
 
 
@@ -1095,10 +1332,9 @@ def run_cell(shape_name: str, schedule_name: str, seed: int = 1,
     cfg = ServerConfig(num_schedulers=2, heartbeat_ttl=1.5,
                        gc_interval=3600.0,
                        failed_eval_followup_delay=0.3)
-    cluster = Cluster(3, config=cfg,
-                      raft_config=RaftConfig(heartbeat_interval=0.02,
-                                             election_timeout=0.1),
-                      data_dir=data_dir)
+    cluster = shape.make_cluster(
+        cfg, RaftConfig(heartbeat_interval=0.02, election_timeout=0.1),
+        data_dir)
     for s in cluster.servers:
         _tune(s)
     ctx = CellCtx()
@@ -1165,8 +1401,7 @@ def run_cell(shape_name: str, schedule_name: str, seed: int = 1,
         if replace is not None:
             replace.finish()
         shape.finish(cluster, ctx)
-        convergence = check_convergence(cluster, ctx,
-                                        timeout=converge_timeout)
+        convergence = shape.check(cluster, ctx, timeout=converge_timeout)
         placed = _on_leader(
             cluster, lambda ld: len(ld.store.allocs())) - base_allocs
         plan = _plan_submit_sample()
@@ -1217,11 +1452,19 @@ SMOKE_CELLS = [
     ("rolling_deploy", "storm"),
     ("autoscale_ramp", "lease_flap"),
     ("e2e_spine", "server_replace"),
+    ("multi_region", "region_partition"),
 ]
 
+# the core product crosses every single-cluster shape with every
+# single-cluster schedule; the federated shape rides only its two
+# first-class cells (storm churn across both regions, and the
+# deterministic WAN-cut drill) — region_partition makes no sense for a
+# one-region cluster and lease_flap/server_replace add nothing the
+# single-cluster cells don't already cover
 ALL_CELLS = [(shape, schedule)
-             for shape in SHAPES
-             for schedule in SCHEDULES]
+             for shape in SHAPES if shape != "multi_region"
+             for schedule in SCHEDULES if schedule != "region_partition"] \
+    + [("multi_region", "storm"), ("multi_region", "region_partition")]
 
 
 def run_matrix(cells=None, seed: int = 1, out_dir: str = ".",

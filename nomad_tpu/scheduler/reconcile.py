@@ -676,6 +676,7 @@ class AllocReconciler:
                 job_version=self.job.version,
                 job_modify_index=self.job.job_modify_index,
                 job_create_index=self.job.create_index,
+                is_multiregion=self.job.multiregion is not None,
                 status=DeploymentStatus.RUNNING,
                 status_description=DeploymentStatus.DESC_RUNNING,
                 eval_priority=self.eval_priority)

@@ -24,6 +24,9 @@ from nomad_tpu.structs.job import (
     JobStatus,
     JobType,
     MigrateStrategy,
+    Multiregion,
+    MultiregionRegion,
+    MultiregionStrategy,
     PeriodicConfig,
     ReschedulePolicy,
     RestartPolicy,

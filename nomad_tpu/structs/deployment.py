@@ -30,6 +30,8 @@ class DeploymentStatus:
     DESC_PROGRESS_DEADLINE = "Failed due to progress deadline"
     DESC_NEWER_JOB = "Cancelled due to newer version of job"
     DESC_SUCCESSFUL = "Deployment completed successfully"
+    DESC_MULTIREGION_FAIL = \
+        "Failed due to a failed deployment in a peer region"
 
 
 @dataclass
@@ -58,6 +60,10 @@ class Deployment:
     job_spec_modify_index: int = 0
     job_create_index: int = 0
     is_multiregion: bool = False
+    # set once this region's SUCCESSFUL multiregion deployment has
+    # started the NEXT region's rollout (replicated, so a new leader
+    # doesn't double-kick)
+    multiregion_kicked: bool = False
     task_groups: Dict[str, DeploymentState] = field(default_factory=dict)
     status: str = DeploymentStatus.RUNNING
     status_description: str = DeploymentStatus.DESC_RUNNING

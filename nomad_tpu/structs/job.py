@@ -130,6 +130,51 @@ class UpdateStrategy:
 
 
 @dataclass
+class MultiregionStrategy:
+    """Rollout pacing across regions (reference structs.MultiregionStrategy).
+    `on_failure="fail_all"` reverts already-promoted regions when any
+    region's deployment fails; `"fail_local"` contains the failure."""
+    max_parallel: int = 1
+    on_failure: str = "fail_all"   # "fail_all" | "fail_local"
+
+
+@dataclass
+class MultiregionRegion:
+    """One region's slice of a multiregion job: optional count override
+    applied to every task group, optional datacenter override."""
+    name: str = ""
+    count: Optional[int] = None
+    datacenters: List[str] = field(default_factory=list)
+    meta: Dict[str, str] = field(default_factory=dict)
+
+    def copy(self) -> "MultiregionRegion":
+        return replace(self, datacenters=list(self.datacenters),
+                       meta=dict(self.meta))
+
+
+@dataclass
+class Multiregion:
+    """The `multiregion` jobspec block (reference structs.Multiregion):
+    the ordered region list drives a sequential rollout — region N+1's
+    deployment starts only once region N's is healthy."""
+    strategy: MultiregionStrategy = field(default_factory=MultiregionStrategy)
+    regions: List[MultiregionRegion] = field(default_factory=list)
+
+    def region_names(self) -> List[str]:
+        return [r.name for r in self.regions]
+
+    def lookup(self, name: str) -> Optional[MultiregionRegion]:
+        for r in self.regions:
+            if r.name == name:
+                return r
+        return None
+
+    def copy(self) -> "Multiregion":
+        return replace(self, strategy=replace(self.strategy),
+                       regions=[r.copy() for r in self.regions])
+
+
+@dataclass
 class EphemeralDisk:
     sticky: bool = False
     size_mb: int = 300
@@ -281,6 +326,7 @@ class Job:
     spreads: List[Spread] = field(default_factory=list)
     task_groups: List[TaskGroup] = field(default_factory=list)
     update: Optional[UpdateStrategy] = None
+    multiregion: Optional[Multiregion] = None
     periodic: Optional[PeriodicConfig] = None
     parameterized: Optional[ParameterizedJobConfig] = None
     payload: bytes = b""
@@ -335,4 +381,26 @@ class Job:
                        affinities=list(self.affinities),
                        spreads=list(self.spreads),
                        task_groups=[tg.copy() for tg in self.task_groups],
+                       multiregion=(self.multiregion.copy()
+                                    if self.multiregion else None),
                        meta=dict(self.meta))
+
+    def multiregion_copy(self, region: str, rollout_id: str) -> "Job":
+        """The per-region slice of a multiregion job: region set, count
+        and datacenter overrides applied, the multiregion block retained
+        (the deployment watcher reads it to kick the NEXT region), and
+        the rollout id stamped in meta so re-registration is detectable
+        and the copy is never re-expanded."""
+        c = self.copy()
+        c.region = region
+        c.meta["multiregion.rollout"] = rollout_id
+        mr = c.multiregion.lookup(region) if c.multiregion else None
+        if mr is not None:
+            if mr.count is not None:
+                for tg in c.task_groups:
+                    tg.count = mr.count
+            if mr.datacenters:
+                c.datacenters = list(mr.datacenters)
+            if mr.meta:
+                c.meta.update(mr.meta)
+        return c

@@ -1,0 +1,462 @@
+"""Multi-region federation tests: WAN gossip pool, cross-region RPC
+forwarding, region validation, and multi-region job deployment
+(reference analogs: nomad/serf_test.go WAN join, nomad/rpc_test.go
+forwardRegion, nomad/job_endpoint_test.go multiregion)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.cluster import FederatedCluster
+from nomad_tpu.core.server import ServerConfig
+from nomad_tpu.federation import MAX_FORWARD_HOPS, WanPool
+from nomad_tpu.jobspec import parse_job
+from nomad_tpu.raft import InMemTransport, RaftConfig
+from nomad_tpu.raft.transport import Unreachable
+from nomad_tpu.rpc.endpoints import RpcError
+from nomad_tpu.structs import (
+    AllocClientStatus,
+    AllocDesiredStatus,
+    DeploymentStatus,
+    Multiregion,
+    MultiregionRegion,
+)
+
+FAST_RAFT = dict(heartbeat_interval=0.02, election_timeout=0.1)
+
+
+def make_fed(n: int = 1, regions=("global", "west")) -> FederatedCluster:
+    fc = FederatedCluster(
+        regions=regions, n=n,
+        config=ServerConfig(num_schedulers=2, heartbeat_ttl=60.0),
+        raft_config=RaftConfig(**FAST_RAFT))
+    fc.start()
+    fc.wait_federated(20.0)
+    return fc
+
+
+def wait_for(cond, timeout=15.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def drive_healthy(server, namespace, job_id, timeout=20.0, min_version=0):
+    """Mark a job's live allocs running+healthy through the real
+    Node.UpdateAlloc RPC until its latest deployment (for at least job
+    version `min_version`) goes SUCCESSFUL."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        d = server.store.latest_deployment_by_job_id(namespace, job_id)
+        if d is not None and d.job_version < min_version:
+            d = None
+        updates = []
+        for a in server.store.allocs_by_job(namespace, job_id):
+            if a.desired_status == AllocDesiredStatus.RUN \
+                    and not a.is_healthy():
+                u = a.copy()
+                u.client_status = AllocClientStatus.RUNNING
+                u.deployment_status = {"healthy": True}
+                updates.append(u)
+        if updates:
+            server.endpoints.handle("Node.UpdateAlloc", {"allocs": updates})
+        if d is not None and d.status == DeploymentStatus.SUCCESSFUL:
+            return d
+        time.sleep(0.05)
+    raise TimeoutError(f"deployment for {job_id} never became SUCCESSFUL")
+
+
+# -------------------------------------------------------------- WAN pool
+
+
+def test_wan_pool_regions_and_leader_tags():
+    t = InMemTransport()
+    pools = [
+        WanPool(t, "g-1", ("g-1", 0), region="global", is_leader=True,
+                interval=0.05),
+        WanPool(t, "g-2", ("g-2", 0), region="global", interval=0.05),
+        WanPool(t, "w-1", ("w-1", 0), region="west", is_leader=True,
+                interval=0.05),
+    ]
+    try:
+        for p in pools:
+            p.start()
+        for p in pools[1:]:
+            p.join([("g-1", ("g-1", 0))])
+        wait_for(lambda: all(p.regions() == ["global", "west"]
+                             for p in pools), msg="WAN convergence")
+        assert pools[2].region_leader("global") == "g-1"
+        assert pools[0].region_leader("west") == "w-1"
+        assert pools[2].region_servers("global") == ["g-1", "g-2"]
+        # leadership moves by re-tagging: the new claim's bumped
+        # incarnation outranks the old one everywhere
+        pools[0].set_leader(False)
+        pools[1].set_leader(True)
+        wait_for(lambda: pools[2].region_leader("global") == "g-2",
+                 msg="leader re-tag propagation")
+    finally:
+        for p in pools:
+            p.stop()
+
+
+def test_wan_pool_reaps_left_region_leader():
+    """A region leader that gracefully leaves is reaped into a tombstone;
+    stale gossip at the old incarnation cannot resurrect it, only a
+    strictly higher incarnation can."""
+    t = InMemTransport()
+    a = WanPool(t, "a", ("a", 0), region="global", interval=0.05,
+                suspect_after=0.3, fail_after=0.6, reap_after=0.3)
+    b = WanPool(t, "b", ("b", 0), region="west", is_leader=True,
+                interval=0.05)
+    try:
+        a.start()
+        b.start()
+        b.join([("a", ("a", 0))])
+        wait_for(lambda: a.region_leader("west") == "b",
+                 msg="west leader visible")
+        b.leave()
+        b.stop()
+        with b._lock:
+            left_inc = b.members["b"].incarnation
+        # LEFT propagates, then the silent entry is reaped into a
+        # tombstone holding its final incarnation
+        wait_for(lambda: "b" not in a.members
+                 and a._tombstones.get("b") == left_inc,
+                 msg="LEFT member reaped into tombstone")
+        assert a.region_leader("west") is None
+        assert a.regions() == ["global"]
+        # a stale pre-leave ALIVE entry (incarnation <= tombstone) is a
+        # ghost: the merge must reject it
+        stale = {"name": "b", "addr": ("b", 0), "incarnation": left_inc,
+                 "status": "alive", "tags": {"region": "west",
+                                             "leader": True}}
+        a._merge([stale])
+        assert "b" not in a.members
+        assert a.region_leader("west") is None
+        # only a strictly higher incarnation (a real rejoin) clears it
+        fresh = dict(stale, incarnation=left_inc + 1)
+        a._merge([fresh])
+        assert "b" in a.members
+        assert a.region_leader("west") == "b"
+    finally:
+        a.stop()
+        b.stop()
+
+
+# ----------------------------------------------------- forwarding + routing
+
+
+@pytest.fixture(scope="module")
+def fed():
+    fc = make_fed(n=1)
+    yield fc
+    fc.stop()
+
+
+def test_status_regions_is_wan_backed(fed):
+    for region in ("global", "west"):
+        lead = fed.leader(region)
+        assert lead.endpoints.handle("Status.Regions", {}) == \
+            ["global", "west"]
+
+
+def test_cross_region_job_register_forwards_by_job_region(fed):
+    gl, wl = fed.leader("global"), fed.leader("west")
+    for s in (gl, wl):
+        for _ in range(2):
+            s.register_node(mock.node())
+    job = mock.job()
+    job.region = "west"
+    resp = gl.endpoints.handle("Job.Register", {"job": job})
+    assert resp["eval_id"]
+    wait_for(lambda: wl.store.job_by_id("default", job.id) is not None,
+             msg="job forwarded to west")
+    assert gl.store.job_by_id("default", job.id) is None
+
+
+def test_cross_region_read_via_args_region(fed):
+    gl, wl = fed.leader("global"), fed.leader("west")
+    job = mock.job()
+    job.region = "west"
+    wl.register_job(job)
+    args = {"namespace": "default", "job_id": job.id, "region": "west"}
+    snapshot = dict(args)
+    got = gl.endpoints.handle("Job.GetJob", args)
+    assert got is not None and got.id == job.id
+    # the caller's dict must come back untouched (it may be retried
+    # against another server, which needs the region field intact)
+    assert args == snapshot
+
+
+def test_forward_hop_counter_breaks_loops(fed):
+    gl = fed.leader("global")
+    with pytest.raises(RpcError) as e:
+        gl.endpoints.handle("Job.GetJob", {
+            "namespace": "default", "job_id": "nope", "region": "west",
+            "_forward_hops": MAX_FORWARD_HOPS})
+    assert e.value.kind == "forward_loop"
+
+
+def test_unknown_region_rejected_with_known_regions(fed):
+    gl = fed.leader("global")
+    job = mock.job()
+    job.region = "mars"
+    with pytest.raises(RpcError) as e:
+        gl.register_job(job)
+    assert e.value.kind == "unknown_region"
+    assert "global" in str(e.value) and "west" in str(e.value)
+
+
+def test_stale_serves_locally_while_remote_dark_consistent_fails_fast(fed):
+    gl, wl = fed.leader("global"), fed.leader("west")
+    fed.partition_region("west")
+    try:
+        # the dark region still serves stale reads from its own store
+        assert isinstance(
+            wl.endpoints.handle("Job.List", {"namespace": None,
+                                             "consistency": "stale"}),
+            list)
+        # a consistent read INTO the dark region fails fast, not hangs
+        t0 = time.monotonic()
+        with pytest.raises((Unreachable, RpcError)):
+            gl.endpoints.handle("Job.GetJob", {
+                "namespace": "default", "job_id": "nope",
+                "region": "west", "consistency": "consistent"})
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        fed.heal_region("west")
+
+
+def test_forwarding_survives_remote_leader_churn():
+    fc = make_fed(n=3)
+    try:
+        gl = fc.leader("global")
+        for _ in range(2):
+            fc.leader("west").register_node(mock.node())
+        old = fc.leader("west")
+        fc.kill(old)
+        job = mock.job()
+        job.region = "west"
+
+        def submit():
+            try:
+                return gl.endpoints.handle("Job.Register", {"job": job})
+            except (Unreachable, RpcError, TimeoutError):
+                return None
+        resp = wait_for(submit, timeout=20.0,
+                        msg="forward through west leader churn")
+        assert resp["eval_id"]
+        new = fc.leader("west", timeout=10.0)
+        assert new is not old
+        wait_for(lambda: new.store.job_by_id("default", job.id) is not None,
+                 msg="job landed on new west leader")
+    finally:
+        fc.stop()
+
+
+class _AgentShim:
+    """Just enough of an Agent for HTTPServer to front a cluster Server."""
+
+    def __init__(self, server):
+        self.server = server
+
+    def rpc(self, method, args, consistency=None):
+        return self.server.rpc_leader(method, args)
+
+
+def test_http_and_cli_region_threading(fed):
+    """`?region=` on the HTTP API (and the SDK/CLI surfaces that emit
+    it) forwards the request to the target region's servers."""
+    from nomad_tpu.agent.http import HTTPServer
+    from nomad_tpu.api import ApiClient
+    from nomad_tpu.command.cli import main
+
+    gl, wl = fed.leader("global"), fed.leader("west")
+    job = mock.job()
+    job.region = "west"
+    wl.register_job(job)
+    http = HTTPServer(_AgentShim(gl))
+    http.start()
+    try:
+        addr = f"http://127.0.0.1:{http.port}"
+        # SDK: region= rides every request as `?region=`
+        west_api = ApiClient(addr, region="west")
+        assert west_api.jobs.info(job.id).id == job.id
+        assert west_api.system.regions() == ["global", "west"]
+        # without the region the global servers answer from their own
+        # store, where this job does not exist
+        local_api = ApiClient(addr)
+        assert job.id not in [j["ID"] for j in local_api.jobs.list()]
+        # CLI: the global -region flag routes the same way
+        import io
+        out = io.StringIO()
+        rc = main(["-address", addr, "-region", "west",
+                   "job", "status", job.id], out=out)
+        assert rc == 0
+        assert job.id in out.getvalue()
+    finally:
+        http.stop()
+
+
+# --------------------------------------------------- multiregion deployment
+
+
+def test_multiregion_jobspec_parse():
+    job = parse_job("""
+    job "fleet" {
+      datacenters = ["dc1"]
+      multiregion {
+        strategy {
+          max_parallel = 1
+          on_failure   = "fail_local"
+        }
+        region "global" { count = 3 }
+        region "west" {
+          count       = 2
+          datacenters = ["dc2"]
+        }
+      }
+      group "g" {
+        task "t" { driver = "exec" }
+      }
+    }
+    """)
+    mr = job.multiregion
+    assert mr is not None
+    assert mr.strategy.max_parallel == 1
+    assert mr.strategy.on_failure == "fail_local"
+    assert mr.region_names() == ["global", "west"]
+    assert mr.lookup("west").count == 2
+    assert mr.lookup("west").datacenters == ["dc2"]
+
+
+def test_multiregion_sequential_rollout():
+    """Submitting a multiregion job registers only the first region; the
+    next region is kicked only after the first's deployment succeeds,
+    with per-region count overrides applied."""
+    fc = make_fed(n=1)
+    try:
+        gl, wl = fc.leader("global"), fc.leader("west")
+        for s in (gl, wl):
+            for _ in range(4):
+                s.register_node(mock.node())
+        job = mock.job()
+        job.task_groups[0].count = 2
+        job.multiregion = Multiregion(regions=[
+            MultiregionRegion(name="global", count=2),
+            MultiregionRegion(name="west", count=1),
+        ])
+        gl.register_job(job)
+        wait_for(lambda: gl.store.job_by_id("default", job.id) is not None,
+                 msg="primary region registration")
+        # region 2 must NOT be registered until region 1 succeeds
+        assert wl.store.job_by_id("default", job.id) is None
+        rollout = gl.store.job_by_id("default", job.id) \
+            .meta["multiregion.rollout"]
+        assert rollout
+        d = drive_healthy(gl, "default", job.id)
+        # SUCCESSFUL primary deployment kicks the next region exactly once
+        wait_for(lambda: wl.store.job_by_id("default", job.id) is not None,
+                 msg="rollout reached west")
+        wjob = wl.store.job_by_id("default", job.id)
+        assert wjob.region == "west"
+        assert wjob.task_groups[0].count == 1          # count override
+        assert wjob.meta["multiregion.rollout"] == rollout
+        wait_for(lambda: gl.store.deployment_by_id(d.id).multiregion_kicked,
+                 msg="kick flag replicated")
+        # last region: completes without kicking anything further
+        wd = drive_healthy(wl, "default", job.id)
+        wait_for(lambda: wl.store.deployment_by_id(wd.id).multiregion_kicked,
+                 msg="terminal region marked done")
+    finally:
+        fc.stop()
+
+
+def test_multiregion_rollout_halts_at_partition_and_resumes():
+    fc = make_fed(n=1)
+    try:
+        gl, wl = fc.leader("global"), fc.leader("west")
+        for s in (gl, wl):
+            for _ in range(4):
+                s.register_node(mock.node())
+        job = mock.job()
+        job.multiregion = Multiregion(regions=[
+            MultiregionRegion(name="global"),
+            MultiregionRegion(name="west", count=1),
+        ])
+        fc.partition_region("west")
+        gl.register_job(job)
+        d = drive_healthy(gl, "default", job.id)
+        # the kick cannot cross the partition: the rollout halts at the
+        # region boundary without failing or corrupting anything
+        time.sleep(1.0)
+        assert wl.store.job_by_id("default", job.id) is None
+        assert gl.store.deployment_by_id(d.id).status == \
+            DeploymentStatus.SUCCESSFUL
+        assert not gl.store.deployment_by_id(d.id).multiregion_kicked
+        fc.heal_region("west")
+        # ...and resumes after heal (the watcher retries the kick)
+        wait_for(lambda: wl.store.job_by_id("default", job.id) is not None,
+                 timeout=20.0, msg="rollout resumed post-heal")
+        wait_for(lambda: gl.store.deployment_by_id(d.id).multiregion_kicked,
+                 msg="kick flag set post-heal")
+    finally:
+        fc.stop()
+
+
+def test_multiregion_failure_propagates_and_reverts_peer():
+    """A failed deployment in one region fails the rollout's siblings:
+    the peer region's already-SUCCESSFUL copy reverts to its latest
+    stable version."""
+    fc = make_fed(n=1)
+    try:
+        gl, wl = fc.leader("global"), fc.leader("west")
+        for s in (gl, wl):
+            for _ in range(4):
+                s.register_node(mock.node())
+        # v0: a plain stable job in the primary region (the revert target)
+        job = mock.job()
+        job.task_groups[0].count = 2
+        gl.register_job(job)
+        drive_healthy(gl, "default", job.id)
+        gl.set_job_stability("default", job.id, 0, True)
+        v0_config = dict(job.task_groups[0].tasks[0].config)
+        # v1: a destructive multiregion update
+        job2 = gl.store.job_by_id("default", job.id).copy()
+        job2.task_groups[0].tasks[0].config = {"command": "/bin/new"}
+        job2.multiregion = Multiregion(regions=[
+            MultiregionRegion(name="global", count=2),
+            MultiregionRegion(name="west", count=1),
+        ])
+        gl.register_job(job2)
+        v1 = wait_for(lambda: gl.store.job_by_id("default", job.id).version
+                      or None, msg="v1 registered")
+        drive_healthy(gl, "default", job.id, min_version=v1)
+        wait_for(lambda: wl.store.job_by_id("default", job.id) is not None,
+                 msg="rollout reached west")
+        # west's copy fails: its allocs report unhealthy
+        wd = wait_for(lambda: wl.store.latest_deployment_by_job_id(
+            "default", job.id), msg="west deployment")
+
+        def fail_west():
+            for a in wl.store.allocs_by_job("default", job.id):
+                if not a.terminal_status():
+                    u = a.copy()
+                    u.client_status = AllocClientStatus.FAILED
+                    u.deployment_status = {"healthy": False}
+                    wl.endpoints.handle("Node.UpdateAlloc",
+                                        {"allocs": [u]})
+            d = wl.store.deployment_by_id(wd.id)
+            return d is not None and d.status == DeploymentStatus.FAILED
+        wait_for(fail_west, msg="west deployment failure")
+        # the failure propagates back: global reverts to stable v0
+        wait_for(lambda: gl.store.job_by_id("default", job.id)
+                 .task_groups[0].tasks[0].config == v0_config,
+                 timeout=20.0, msg="peer region reverted to stable")
+        assert gl.store.job_by_id("default", job.id).version > job2.version
+    finally:
+        fc.stop()

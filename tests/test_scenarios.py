@@ -1,7 +1,7 @@
 """Scenario-matrix runner (nomad_tpu.scenarios): schedule grammar,
 cell wiring, chaos-carrying drivers, and one real cell end-to-end.
 
-The full 14-cell matrix is CI's job (`bench.py --matrix`); here we keep
+The full 23-cell matrix is CI's job (`bench.py --matrix`); here we keep
 the cheap structural checks plus a single soak cell so a broken runner
 fails tier-1 before it fails a 3-seed CI leg.
 """
@@ -29,8 +29,18 @@ from nomad_tpu.scenarios import (
 
 
 def test_matrix_covers_every_shape_schedule_pair():
-    assert len(ALL_CELLS) == len(SHAPES) * len(SCHEDULES)
-    assert set(ALL_CELLS) == {(sh, sc) for sh in SHAPES for sc in SCHEDULES}
+    # the core product: every single-cluster shape crossed with every
+    # single-cluster schedule; the federated shape rides exactly its two
+    # first-class cells (region_partition is multi_region-only)
+    core_shapes = [sh for sh in SHAPES if sh != "multi_region"]
+    core_scheds = [sc for sc in SCHEDULES if sc != "region_partition"]
+    expected = {(sh, sc) for sh in core_shapes for sc in core_scheds}
+    expected |= {("multi_region", "storm"),
+                 ("multi_region", "region_partition")}
+    assert set(ALL_CELLS) == expected
+    assert len(ALL_CELLS) == len(expected) == 23
+    # no duplicate cells
+    assert len(ALL_CELLS) == len(set(ALL_CELLS))
 
 
 def test_matrix_batch_jobs_reschedule_unlimited():
