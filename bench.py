@@ -20,6 +20,7 @@ on stdout.
 import json
 import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -41,6 +42,11 @@ _PLAN_STATS: dict = {}
 # budget + world re-upload watch), folded into the BENCH JSON; any
 # violation fails the --smoke leg.  NOMAD_TPU_BENCH_GUARD=0 opts out.
 _STEADY_STATE: dict = {}
+
+# per-scenario kernel-stage attribution (stage_probe.device_stages):
+# measured device_s split across feasibility/fit/score/argmax/scatter,
+# folded into the BENCH JSON so BENCH_r06 names the stage to fuse first
+_DEVICE_STAGES: dict = {}
 
 
 class _SteadyGate:
@@ -389,6 +395,18 @@ def bench_c2m_1m(n_nodes=10000, n_jobs=10000, groups_per_job=10,
         eng = get_engine()
         if eng:
             log(f"{scenario} engine stats: {eng.stats}")
+            # stage attribution runs strictly AFTER the steady gate has
+            # exited: the probe compiles its own kernels and moves data,
+            # which must not count against the gate's purity budgets
+            try:
+                from nomad_tpu.parallel import stage_probe
+                ds = stage_probe.device_stages(eng.stats, n_nodes)
+                if ds is not None:
+                    _DEVICE_STAGES[scenario] = ds
+                    log(f"{scenario} device stages: dominant="
+                        f"{ds['dominant_stage']} {ds['stages_s']}")
+            except Exception as e:  # noqa: BLE001
+                log(f"{scenario} stage probe failed: {e}")
         _log_plan_submit(scenario)
         return placed / dt, placed, want
     finally:
@@ -404,6 +422,85 @@ def bench_smoke(workers=8):
     return bench_c2m_1m(n_nodes=128, n_jobs=30, groups_per_job=5,
                         group_count=4, workers=workers, deadline_s=240.0,
                         scenario="smoke")
+
+
+def _smoke_trace_checks() -> dict:
+    """Tracing leg of --smoke (r12): (1) with no tracer installed the
+    guard every hot site uses must cost one module-attribute load —
+    measured here and capped at 1 us/op, which is "nil" against a
+    multi-ms plan submit; (2) a fully sampled run through the real spine
+    must produce causally linked spans that export as well-formed
+    Chrome-trace JSON (the file Perfetto loads)."""
+    from nomad_tpu import mock, tracing
+
+    out = {"disabled_overhead_ns_per_op": None, "spans": 0,
+           "perfetto_file": "", "perfetto_events": 0, "violations": []}
+    if tracing.active is not None:
+        tracing.uninstall()
+    n = 1_000_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if tracing.active is not None:  # the exact hot-site idiom
+            raise AssertionError("tracer installed mid-check")
+    per_ns = (time.perf_counter() - t0) / n * 1e9
+    out["disabled_overhead_ns_per_op"] = round(per_ns, 1)
+    if per_ns > 1000.0:
+        out["violations"].append(
+            f"disabled-tracing guard costs {per_ns:.0f} ns/op (> 1 us)")
+
+    tracing.install(tracing.Tracer(sample_rate=1.0, seed=7))
+    s = _server(workers=4)
+    try:
+        tracer = tracing.active
+        for _ in range(32):
+            s.register_node(mock.node())
+        j = mock.batch_job()
+        j.task_groups[0].count = 8
+        # bench drives the server directly (no HTTP front), so open the
+        # root span the agent's HTTP layer would normally start
+        ctx = tracer.new_context()
+        root = tracer.start(ctx, "bench.register_job", s.name)
+        prev = tracing.bind(tracer.child_ctx(ctx, root))
+        try:
+            s.register_job(j)
+        finally:
+            tracer.finish(root)
+            tracing.bind(prev)
+        _wait_allocs(s.store, [j], 8, timeout=60)
+        time.sleep(0.2)     # let the applier's observe-time spans land
+        spans = tracer.spans(ctx["t"])
+        out["spans"] = len(spans)
+        names = {sp.name for sp in spans}
+        for want_name in ("bench.register_job", "plan.submit",
+                          "plan.evaluate", "raft.fsm_apply"):
+            if want_name not in names:
+                out["violations"].append(
+                    f"sampled run missing span {want_name!r} "
+                    f"(got {sorted(names)})")
+        doc = tracing.chrome_trace([sp.to_dict() for sp in spans])
+        evs = doc.get("traceEvents", [])
+        out["perfetto_events"] = len(evs)
+        if not any(e.get("ph") == "X" and "ts" in e and "dur" in e
+                   for e in evs):
+            out["violations"].append("chrome trace has no X events")
+        path = os.path.join(tempfile.gettempdir(),
+                            "nomad_tpu_smoke_trace.json")
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        with open(path) as f:
+            reloaded = json.load(f)
+        if reloaded.get("displayTimeUnit") != "ms" or \
+                len(reloaded.get("traceEvents", [])) != len(evs):
+            out["violations"].append("perfetto file did not round-trip")
+        else:
+            out["perfetto_file"] = path
+    finally:
+        s.stop()
+        tracing.uninstall()
+    log(f"trace checks: {out['disabled_overhead_ns_per_op']} ns/op "
+        f"disabled; {out['spans']} spans sampled; "
+        f"{out['perfetto_events']} perfetto events")
+    return out
 
 
 def bench_serving_plane(n_watchers=1200, n_blockers=12, idle_samples=200,
@@ -880,6 +977,9 @@ def main():
                 scenario_violations.append(
                     f"{name}: plan.submit p99 {p99} ms > "
                     f"cap {p99_cap_ms} ms")
+        # tracing leg: disabled guards must be free, sampled run must
+        # export a well-formed Perfetto file (r12)
+        trace_checks = _smoke_trace_checks()
         print(json.dumps({
             "metric": "c2m_smoke_allocs_per_sec",
             "value": round(rate, 1),
@@ -890,9 +990,15 @@ def main():
             "plan_latency_ms": _PLAN_STATS,
             "steady_state": steady,
             "serving_plane": serving,
+            "device_stages": _DEVICE_STAGES.get("smoke"),
+            "tracing": trace_checks,
         }), flush=True)
         if steady.get("violations"):
             log("steady-state violations:", steady["violations"])
+            sys.exit(1)
+        if trace_checks["violations"]:
+            for v in trace_checks["violations"]:
+                log("tracing gate:", v)
             sys.exit(1)
         if scenario_violations:
             for v in scenario_violations:
@@ -962,6 +1068,7 @@ def main():
         "plan_latency_ms": _PLAN_STATS,
         "steady_state": _STEADY_STATE,
         "serving_plane": serving,
+        "device_stages": _DEVICE_STAGES.get("c2m_1m"),
     }), flush=True)
 
 

@@ -20,6 +20,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from nomad_tpu import tracing
 from nomad_tpu.api.codec import from_wire, to_wire
 from nomad_tpu.raft.transport import Unreachable
 from nomad_tpu.rpc.endpoints import RpcError
@@ -183,6 +184,17 @@ class HTTPServer:
         self._read_local.ctx = read_ctx
         self._read_local.region = region
         self._read_local.mode = mode_from_query(q) if region else None
+        # trace ingress: one sampling decision per request; unsampled
+        # requests (and a disabled tracer) skip everything below
+        tracer = tracing.active
+        tspan = tprev = None
+        if tracer is not None and parts[0] != "traces":
+            tctx = tracer.new_context()
+            if tctx is not None:
+                node = server.name if server is not None else "agent"
+                tspan = tracer.start(
+                    tctx, f"http.{method} /v1/{parts[0]}", node)
+                tprev = tracing.bind(tracer.child_ctx(tctx, tspan))
         try:
             if store is not None and "index" in q and region is None:
                 min_index = int(q["index"])
@@ -203,6 +215,9 @@ class HTTPServer:
                 raise HTTPError(404, f"no handler for {method} {url.path}")
             result = handler(h, parts, q)
         finally:
+            if tspan is not None:
+                tracer.finish(tspan)
+                tracing.bind(tprev)
             self._read_local.ctx = None
             self._read_local.region = None
             self._read_local.mode = None
@@ -219,6 +234,14 @@ class HTTPServer:
 
     def _rpc(self, method: str, args: dict):
         server = self.agent.server
+        if tracing.active is not None:
+            ctx = tracing.current()
+            if ctx is not None:
+                # sampled request: the context rides the RPC args
+                # (endpoints.handle pops it before dispatch; forwarded
+                # copies keep it, so it survives federation hops)
+                args = dict(args)
+                args[tracing.TRACE_KEY] = ctx
         region = getattr(self._read_local, "region", None)
         if server is not None and region:
             # cross-region request: ship the target region (and the
@@ -922,6 +945,31 @@ class HTTPServer:
             h.wfile.write(body)
             return _STREAMED
         return global_metrics.snapshot()
+
+    # ------------------------------------------------------------ traces
+
+    def _h_get_traces(self, h, parts, q):
+        """/v1/traces — trace summaries from the in-process span stores;
+        /v1/traces/<trace_id> — that trace's spans (`?format=chrome`
+        exports Chrome-trace JSON for Perfetto)."""
+        tracer = tracing.active
+        if tracer is None:
+            raise HTTPError(404, "tracing disabled "
+                                 "(set NOMAD_TPU_TRACE=1)")
+        return tracer.traces()
+
+    def _h_get_traces_id(self, h, parts, q):
+        tracer = tracing.active
+        if tracer is None:
+            raise HTTPError(404, "tracing disabled "
+                                 "(set NOMAD_TPU_TRACE=1)")
+        trace_id = parts[1]
+        spans = [s.to_dict() for s in tracer.spans(trace_id)]
+        if not spans:
+            raise HTTPError(404, f"no spans for trace {trace_id!r}")
+        if q.get("format") == "chrome":
+            return tracing.chrome_trace(spans)
+        return {"trace_id": trace_id, "spans": spans}
 
     # ------------------------------------------------------------ events
 

@@ -368,6 +368,26 @@ class Operator(_Section):
         return self.c.put("/v1/operator/raft/transfer-leadership",
                           {"ID": name})
 
+    # ----------------------------------------------------- tracing (r12)
+
+    def traces(self) -> list:
+        """Trace summaries from this server's span store, newest first:
+        [{trace_id, root, start, duration, spans, nodes}, ...].  404s
+        (ApiError) unless the agent runs with NOMAD_TPU_TRACE=1."""
+        return self.c.get("/v1/traces")
+
+    def trace(self, trace_id: str) -> dict:
+        """One trace's spans, start-ordered: {"trace_id": ...,
+        "spans": [{trace_id, span_id, parent_id, name, start, duration,
+        node, attrs}, ...]}."""
+        return self.c.get(f"/v1/traces/{trace_id}")
+
+    def trace_chrome(self, trace_id: str) -> dict:
+        """The same trace as Chrome-trace JSON — dump to a file and load
+        it in Perfetto / chrome://tracing."""
+        return self.c.get(f"/v1/traces/{trace_id}",
+                          params={"format": "chrome"})
+
 
 class AclApi(_Section):
     def bootstrap(self) -> dict:

@@ -556,6 +556,45 @@ class Cli:
         self.p("Leadership transfer did not complete")
         return 1
 
+    def cmd_operator_trace(self, args) -> int:
+        if not getattr(args, "trace_id", None):
+            traces = self.api.operator.traces()
+            if not traces:
+                self.p("No traces sampled (is NOMAD_TPU_TRACE=1 set "
+                       "on the agent?)")
+                return 0
+            rows = [[t["trace_id"], t["root"],
+                     f"{t['duration'] * 1000.0:.2f}ms",
+                     str(t["spans"]), ",".join(t["nodes"])]
+                    for t in traces]
+            self.p(_fmt_table(
+                rows, ["Trace ID", "Root", "Duration", "Spans",
+                       "Nodes"]))
+            return 0
+        if getattr(args, "chrome_out", None):
+            doc = self.api.operator.trace_chrome(args.trace_id)
+            with open(args.chrome_out, "w") as f:
+                json.dump(doc, f)
+            self.p(f"Wrote {len(doc['traceEvents'])} events to "
+                   f"{args.chrome_out} (open in Perfetto / "
+                   f"chrome://tracing)")
+            return 0
+        out = self.api.operator.trace(args.trace_id)
+        spans = out["spans"]
+        if not spans:
+            self.p(f"No spans for trace {args.trace_id}")
+            return 1
+        t0 = min(sp["start"] for sp in spans)
+        rows = [[f"+{(sp['start'] - t0) * 1000.0:.2f}ms",
+                 f"{sp['duration'] * 1000.0:.2f}ms",
+                 sp["node"], sp["name"],
+                 "" if not sp["parent_id"] else sp["parent_id"][:8]]
+                for sp in spans]
+        self.p(f"Trace {args.trace_id} ({len(spans)} spans)")
+        self.p(_fmt_table(rows, ["Start", "Duration", "Node", "Span",
+                                 "Parent"]))
+        return 0
+
     def cmd_acl_bootstrap(self, args) -> int:
         t = self.api.acl.bootstrap()
         self.p(f"Accessor ID = {t['AccessorID']}")
@@ -847,6 +886,13 @@ def build_parser() -> argparse.ArgumentParser:
     o = op.add_parser("transfer-leadership")
     o.add_argument("-peer-id", dest="peer_id", default=None)
     o.set_defaults(fn="cmd_operator_transfer_leadership")
+    o = op.add_parser("trace",
+                      help="list sampled traces, show one, or export "
+                           "Chrome-trace JSON for Perfetto")
+    o.add_argument("trace_id", nargs="?", default=None)
+    o.add_argument("-chrome", dest="chrome_out", default=None,
+                   metavar="FILE")
+    o.set_defaults(fn="cmd_operator_trace")
 
     acl = sub.add_parser("acl", help="acl commands").add_subparsers(
         dest="sub", required=True)

@@ -23,6 +23,7 @@ from collections import defaultdict, deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from nomad_tpu import chaos
+from nomad_tpu import tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.structs import Evaluation
 from nomad_tpu.utils import requires_lock
@@ -174,6 +175,20 @@ class EvalBroker:
                     race.write("EvalBroker._unack", self)
                     self._unack[token] = _Lease(ev, token, expires)
                     self.stats["dequeued"] += 1
+                    tracer = tracing.active
+                    if tracer is not None:
+                        # queue-wait span, stitched from the propose-time
+                        # note (the FSM's leader hook enqueues inside the
+                        # apply cone, so nothing is stamped there); the
+                        # context is re-noted for the dequeuing worker
+                        note = tracer.take_eval_note(ev.id)
+                        if note is not None:
+                            ctx, enq_ts = note
+                            tracer.emit(
+                                ctx, "broker.wait", enq_ts, _time.time(),
+                                node=getattr(self, "node_name", ""),
+                                eval_id=ev.id, sched=ev.type)
+                            tracer.note_eval(ev.id, ctx)
                     return ev, token
                 remaining = deadline - _time.time()
                 if remaining <= 0:

@@ -34,7 +34,7 @@ import numpy as np
 
 from nomad_tpu.encode.matrixizer import comparable_vec, NUM_RESOURCE_DIMS
 
-from nomad_tpu import chaos
+from nomad_tpu import chaos, tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.state.store import AppliedPlanResults, StateStore
 from nomad_tpu.structs import Allocation, Node
@@ -112,7 +112,13 @@ class PlanApplier:
             staged: List[tuple] = []
             for pending in batch:
                 try:
+                    tracer = tracing.active
+                    tnote = pending.trace if tracer is not None else None
                     t0 = _time.time()
+                    if tnote is not None:
+                        tracer.emit(tnote[0], "plan.queue_wait",
+                                    tnote[1], t0,
+                                    node=getattr(self, "node_name", ""))
                     result = self._evaluate(pending.plan)
                     global_metrics.measure_since("nomad.plan.evaluate", t0)
                     if commit_t is not None and commit_t.is_alive() and \
@@ -131,6 +137,10 @@ class PlanApplier:
                             self.stats.get("revalidated", 0) + 1
                         result = self._evaluate(pending.plan)
                     token = self._overlay_add(pending.plan, result)
+                    if tnote is not None:
+                        tracer.emit(tnote[0], "plan.evaluate",
+                                    t0, _time.time(),
+                                    node=getattr(self, "node_name", ""))
                 except Exception as e:            # noqa: BLE001
                     pending.future.set_exception(e)
                     continue
@@ -170,15 +180,30 @@ class PlanApplier:
             if applied_list:
                 if chaos.active is not None:
                     chaos.fire("plan.crash_before_commit")
-                with self._commit_lock:
-                    if self._commit_fn is not None:
-                        index = self._commit_fn(
-                            applied_list if len(applied_list) > 1
-                            else applied_list[0])
-                    else:
-                        index = self.store.latest_index + 1
-                        self.store.upsert_plan_results_many(
-                            index, applied_list)
+                # a coalesced batch commits as ONE raft apply: bind the
+                # first sampled plan's context so the synchronous raft
+                # write path on this thread emits append/commit spans
+                # into that trace
+                tprev, tbound = None, False
+                if tracing.active is not None:
+                    for pending, _r, _ap in entries:
+                        if pending.trace is not None:
+                            tprev = tracing.bind(pending.trace[0])
+                            tbound = True
+                            break
+                try:
+                    with self._commit_lock:
+                        if self._commit_fn is not None:
+                            index = self._commit_fn(
+                                applied_list if len(applied_list) > 1
+                                else applied_list[0])
+                        else:
+                            index = self.store.latest_index + 1
+                            self.store.upsert_plan_results_many(
+                                index, applied_list)
+                finally:
+                    if tbound:
+                        tracing.bind(tprev)
                 if chaos.active is not None:
                     # the write landed but futures have not resolved: the
                     # submitter sees an error, retries, and the plan-id

@@ -8,9 +8,11 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+import time
 from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
+from nomad_tpu import tracing
 from nomad_tpu.structs.plan import Plan
 
 
@@ -21,11 +23,18 @@ class LeadershipLostError(Exception):
 
 
 class PendingPlan:
-    __slots__ = ("plan", "future")
+    # trace: (ctx, enqueue_ts) for a sampled submission, else None —
+    # the applier stitches queue-wait/evaluate/raft spans from it
+    __slots__ = ("plan", "future", "trace")
 
     def __init__(self, plan: Plan):
         self.plan = plan
         self.future: Future = Future()
+        self.trace = None
+        if tracing.active is not None:
+            ctx = tracing.current()
+            if ctx is not None:
+                self.trace = (ctx, time.time())
 
 
 class PlanQueue:

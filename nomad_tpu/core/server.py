@@ -22,6 +22,7 @@ import time as _time
 import uuid
 from typing import Dict, List, Optional
 
+from nomad_tpu import tracing
 from nomad_tpu.core.blocked import BlockedEvals
 from nomad_tpu.core.broker import FAILED_QUEUE, EvalBroker
 from nomad_tpu.core.core_gc import CoreScheduler
@@ -89,9 +90,11 @@ class Server:
         self.name = name
         self.store = StateStore()
         self.broker = EvalBroker()
+        self.broker.node_name = name     # span attribution (tracing)
         self.blocked_evals = BlockedEvals(self.broker)
         self.plan_queue = PlanQueue()
         self.applier = PlanApplier(self.store, commit_fn=self._commit_plan)
+        self.applier.node_name = name
         # PreemptionEvals are created by the applier AFTER the raft apply
         # returns (reference plan_apply.go applyPlan) — creating them from
         # inside the FSM's state-change watcher would re-enter the raft
@@ -196,10 +199,18 @@ class Server:
         target; raises NotLeaderError if a follower is asked directly."""
         if self.raft is not None:
             return self.raft.apply(msg_type, payload)
+        tracer = tracing.active
+        ctx = tracing.current() if tracer is not None else None
+        t0 = _time.time() if ctx is not None else 0.0
         with self._raft_lock:
             index = self.store.latest_index + 1
             self.fsm.apply(index, msg_type, payload)
-            return index
+        if ctx is not None:
+            # dev mode (no raft): observe-time apply span — timestamps
+            # taken outside the FSM, which never reads the clock
+            tracer.emit(ctx, "raft.fsm_apply", t0, _time.time(),
+                        node=self.name, msg_type=msg_type, index=index)
+        return index
 
     def rpc_leader(self, method: str, args: dict):
         """Invoke an RPC on the leader: short-circuits locally when this
@@ -264,6 +275,14 @@ class Server:
         remote regions go through the federation router (known-leader
         hints, bounded retry over remote churn, Unreachable fail-fast
         when the region is dark)."""
+        # app-level forwards (job.region routing, leader handoffs) build
+        # fresh args: re-attach this thread's sampled trace context so
+        # the trace survives the hop like it does the _forward_hops path
+        if tracing.active is not None and tracing.TRACE_KEY not in args:
+            ctx = tracing.current()
+            if ctx is not None:
+                args = dict(args)
+                args[tracing.TRACE_KEY] = ctx
         return self.region_router.route(region, method, args)
 
     def enqueue_plan(self, plan):
@@ -686,6 +705,16 @@ class Server:
             if not c.create_time:
                 c.create_time = now
             copies.append(c)
+        tracer = tracing.active
+        if tracer is not None:
+            # propose-time trace note: the broker enqueue happens inside
+            # the FSM apply cone where nothing may stamp the clock, so
+            # the queue-wait span's start is noted here and emitted at
+            # dequeue (see EvalBroker.dequeue)
+            ctx = tracing.current()
+            if ctx is not None:
+                for c in copies:
+                    tracer.note_eval(c.id, ctx, ts=now)
         self.apply(MessageType.EVAL_UPDATE, {"evals": copies})
 
     def register_job(self, job: Job) -> Evaluation:

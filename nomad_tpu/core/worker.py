@@ -16,6 +16,7 @@ import threading
 import time
 from typing import List, Optional
 
+from nomad_tpu import tracing
 from nomad_tpu.core.plan_queue import LeadershipLostError
 from nomad_tpu.raft import NotLeaderError
 from nomad_tpu.raft.transport import Unreachable
@@ -110,6 +111,12 @@ class Worker:
         if ev is None:
             return None
         self._wait_index = self.server.store.latest_index
+        self._trace_ctx = None
+        tracer = tracing.active
+        if tracer is not None:
+            note = tracer.take_eval_note(ev.id)
+            if note is not None:
+                self._trace_ctx = note[0]
         return ev, token
 
     def _ack(self, eval_id: str, token: str) -> bool:
@@ -134,22 +141,38 @@ class Worker:
         self._snapshot = snap
         self._token = token
         ev = ev.copy()
+        # sampled eval: the scheduler invocation is a span, and the trace
+        # context stays bound for its duration so plan submission (and
+        # any follow-up evals it creates) joins the trace
+        tracer = tracing.active
+        tctx = getattr(self, "_trace_ctx", None)
+        tspan = tprev = None
+        if tracer is not None and tctx is not None:
+            tspan = tracer.start(
+                tctx, f"worker.invoke_scheduler.{ev.type}",
+                self.server.name)
+            tprev = tracing.bind(tracer.child_ctx(tctx, tspan))
         try:
-            sched = factory.new_scheduler(ev.type, snap, self)
-            t0 = time.time()
-            sched.process(ev)
-            global_metrics.measure_since(
-                f"nomad.worker.invoke_scheduler.{ev.type}", t0)
-        except TRANSIENT_ERRORS:
-            raise
-        except Exception as e:                      # noqa: BLE001
-            log.exception("eval %s failed", ev.id)
-            self.stats["failed"] += 1
-            ev.status = EvalStatus.FAILED
-            ev.status_description = str(e)
-            server.update_eval(ev)   # raises TRANSIENT -> nacked by run()
-            self._nack(ev.id, token)
-            return
+            try:
+                sched = factory.new_scheduler(ev.type, snap, self)
+                t0 = time.time()
+                sched.process(ev)
+                global_metrics.measure_since(
+                    f"nomad.worker.invoke_scheduler.{ev.type}", t0)
+            except TRANSIENT_ERRORS:
+                raise
+            except Exception as e:                      # noqa: BLE001
+                log.exception("eval %s failed", ev.id)
+                self.stats["failed"] += 1
+                ev.status = EvalStatus.FAILED
+                ev.status_description = str(e)
+                server.update_eval(ev)  # raises TRANSIENT -> run() nacks
+                self._nack(ev.id, token)
+                return
+        finally:
+            if tspan is not None:
+                tracer.finish(tspan)
+                tracing.bind(tprev)
         ev.status = EvalStatus.COMPLETE
         server.update_eval(ev)
         if self._ack(ev.id, token):
@@ -160,12 +183,23 @@ class Worker:
     def submit_plan(self, plan: Plan) -> PlanResult:
         plan.eval_token = getattr(self, "_token", "")
         t0 = time.time()
-        pending = self.server.enqueue_plan(plan)
-        # generous: under full-cluster bursts (the 1M-alloc C2M) the
-        # serialized applier legitimately backs up for minutes; an eval
-        # failed on a timed-out future gets retried from scratch even
-        # though its plan still commits — pure wasted recompute
-        res = pending.future.result(timeout=600.0)
+        tracer = tracing.active
+        tctx = tracing.current() if tracer is not None else None
+        tspan = tprev = None
+        if tctx is not None:
+            tspan = tracer.start(tctx, "plan.submit", self.server.name)
+            tprev = tracing.bind(tracer.child_ctx(tctx, tspan))
+        try:
+            pending = self.server.enqueue_plan(plan)
+            # generous: under full-cluster bursts (the 1M-alloc C2M) the
+            # serialized applier legitimately backs up for minutes; an
+            # eval failed on a timed-out future gets retried from scratch
+            # even though its plan still commits — pure wasted recompute
+            res = pending.future.result(timeout=600.0)
+        finally:
+            if tspan is not None:
+                tracer.finish(tspan)
+                tracing.bind(tprev)
         global_metrics.measure_since("nomad.plan.submit", t0)
         return res
 
@@ -226,6 +260,7 @@ class RemoteWorker(Worker):
         if resp is None:
             return None
         self._wait_index = resp.get("wait_index", 0)
+        self._trace_ctx = resp.get("trace")
         return resp["eval"], resp["token"]
 
     def _ack(self, eval_id: str, token: str) -> bool:
@@ -251,7 +286,22 @@ class RemoteWorker(Worker):
     def submit_plan(self, plan: Plan) -> PlanResult:
         plan.eval_token = getattr(self, "_token", "")
         t0 = time.time()
-        res = self._rpc("Plan.Submit", {"plan": plan})
+        args = {"plan": plan}
+        tracer = tracing.active
+        tctx = tracing.current() if tracer is not None else None
+        tspan = None
+        if tctx is not None:
+            # the submit span covers RPC + leader-side queue + apply;
+            # its child context rides the args so the leader's
+            # Plan.Submit handler (endpoints.handle) pops it and binds
+            # it for the enqueue → applier → raft chain
+            tspan = tracer.start(tctx, "plan.submit", self.server.name)
+            args[tracing.TRACE_KEY] = tracer.child_ctx(tctx, tspan)
+        try:
+            res = self._rpc("Plan.Submit", args)
+        finally:
+            if tspan is not None:
+                tracer.finish(tspan)
         global_metrics.measure_since("nomad.plan.submit", t0)
         return res
 

@@ -38,6 +38,7 @@ import numpy as np
 
 from nomad_tpu import chaos
 from nomad_tpu import native as _native
+from nomad_tpu import tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS, pad_to_bucket
 from nomad_tpu.ops.place import (
@@ -211,6 +212,7 @@ class _Request:
     deltas: List[Tuple[int, np.ndarray]]   # (row, f32[R]) sparse usage deltas
     spread_algorithm: bool
     future: Future
+    trace: object = None            # (ctx, submit_ts) for sampled evals
 
     def shape_key(self):
         i = self.inputs
@@ -237,6 +239,7 @@ class _BulkRequest:
     deltas: List[Tuple[int, np.ndarray]]
     spread_algorithm: bool
     future: Future
+    trace: object = None            # (ctx, submit_ts) for sampled evals
 
     def shape_key(self):
         return ("bulk", id(self.cm), self.spread_algorithm,
@@ -347,6 +350,10 @@ class PlacementEngine:
         will never be), releasing its in-flight usage contribution."""
         req = _Request(cm=cm, inputs=inputs, deltas=list(deltas or ()),
                        spread_algorithm=spread_algorithm, future=Future())
+        if tracing.active is not None:
+            ctx = tracing.current()
+            if ctx is not None:
+                req.trace = (ctx, _time.time())
         with self._cv:
             if self._stop:
                 raise RuntimeError("placement engine stopped")
@@ -376,6 +383,10 @@ class PlacementEngine:
             demand=np.asarray(demand, np.float32), count=int(count),
             deltas=list(deltas or ()), spread_algorithm=spread_algorithm,
             future=Future())
+        if tracing.active is not None:
+            ctx = tracing.current()
+            if ctx is not None:
+                req.trace = (ctx, _time.time())
         with self._cv:
             if self._stop:
                 raise RuntimeError("placement engine stopped")
@@ -805,10 +816,12 @@ class PlacementEngine:
                     packed, world, dper = self._dispatch_bulk_group(part)
                 t0 = _time.time()
                 fetched = jax.device_get(packed)
-                self.stats["device_s"] += _time.time() - t0
+                dev_s = _time.time() - t0
+                self.stats["device_s"] += dev_s
                 t0 = _time.time()
                 self._resolve_bulk(part, fetched, world, dper)
                 self.stats["resolve_s"] += _time.time() - t0
+                self._emit_dispatch_spans(part, dev_s, "bulk")
             self.stats["bulk_evals"] += len(reqs)
             return
 
@@ -858,7 +871,8 @@ class PlacementEngine:
 
         t0 = _time.time()
         fetched = jax.device_get(packed)
-        self.stats["device_s"] += _time.time() - t0
+        dev_s = _time.time() - t0
+        self.stats["device_s"] += dev_s
         t0 = _time.time()
         node, score, fit_s, n_eval, n_exh, top_n, top_s = \
             unpack_outputs(np.asarray(fetched))
@@ -870,6 +884,23 @@ class PlacementEngine:
             ticket = self._register(r, res)
             r.future.set_result((res, ticket))
         self.stats["resolve_s"] += _time.time() - t0
+        self._emit_dispatch_spans(reqs, dev_s, "scan")
+
+    @staticmethod
+    def _emit_dispatch_spans(reqs: List, dev_s: float, kind: str) -> None:
+        """Per-request device-dispatch spans for sampled evals: the span
+        covers submit -> resolve on the engine thread, with the shared
+        device_get window carried as an attribute (the whole group rides
+        one chained device dispatch)."""
+        tracer = tracing.active
+        if tracer is None:
+            return
+        now = _time.time()
+        for r in reqs:
+            if r.trace is not None:
+                tracer.emit(r.trace[0], "engine.dispatch", r.trace[1],
+                            now, kind=kind, batch=len(reqs),
+                            device_get_s=round(dev_s, 6))
 
     # ------------------------------------------------------- sharded path
 

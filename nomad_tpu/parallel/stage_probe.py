@@ -1,0 +1,196 @@
+"""Kernel-stage attribution probe for the bulk placement dispatch.
+
+`device_s` dominates the C2M headline (ROADMAP item 1) but go-metrics
+timers cannot say WHICH stage of the feasibility -> fit -> score ->
+argmax -> scatter wave pipeline to fuse first: the wave runs as one jit
+and XLA gives wall time per dispatch, not per stage.  This probe re-times
+each stage as its own small jitted kernel at the bench's representative
+shapes ([N, M=_FILL_GRID, R] — the exact grid `bulk_wave_grid` builds),
+derives per-stage fractions, and attributes the MEASURED `device_s`
+across them, so the BENCH JSON's `"device_stages"` section names the
+dominant stage by construction (stage sum == device_s).
+
+Deliberately NOT `_RECOMPILE_TRACKED` and NOT `_TRANSFER_HOT_PATH`: the
+probe is an offline attribution tool that must only run AFTER the bench's
+steady-state gate has exited — its compiles and transfers are not part of
+the serving hot path and must never count against the recompile budget or
+the transfer guard.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from nomad_tpu import tracing
+from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS
+
+# the canonical stage order of the wave pipeline (bulk_wave_grid +
+# _bulk_loop body); README's span-name table and BENCH_r06 use these keys
+STAGES = ("feasibility", "fit", "score", "argmax", "scatter")
+
+
+def _stage_fns():
+    """One small jit per pipeline stage, mirroring bulk_wave_grid /
+    _bulk_loop exactly (ops/place.py) so the relative costs transfer."""
+    import jax
+    import jax.numpy as jnp
+
+    from nomad_tpu.ops.fit import score_fit
+
+    @jax.jit
+    def feasibility(capacity, used, demand, feasible, ms):
+        # the [N, M, R] fill-grid mask: "does m more instances still fit"
+        util_m = used[:, None, :] + ms[None, :, None] * demand
+        fits_m = (jnp.all(util_m <= capacity[:, None, :], axis=-1)
+                  & feasible[:, None])
+        return util_m, fits_m
+
+    @jax.jit
+    def fit(capacity, util_m):
+        return score_fit(capacity[:, None, :], util_m, False) / 18.0
+
+    @jax.jit
+    def score(fit_m, coll, ms, desired_f, penalty, affinity,
+              has_affinity):
+        coll_m = coll[:, None].astype(jnp.float32) + ms[None, :] - 1.0
+        total_m = fit_m
+        n_sc = jnp.ones_like(fit_m)
+        anti_m = -(coll_m + 1.0) / jnp.maximum(desired_f, 1.0)
+        has_coll_m = coll_m > 0.0
+        total_m = total_m + jnp.where(has_coll_m, anti_m, 0.0)
+        n_sc = n_sc + has_coll_m
+        total_m = total_m - penalty[:, None]
+        n_sc = n_sc + penalty[:, None]
+        aff_on = has_affinity & (affinity != 0.0)
+        total_m = total_m + jnp.where(aff_on[:, None],
+                                      affinity[:, None], 0.0)
+        n_sc = n_sc + aff_on[:, None]
+        return total_m / n_sc
+
+    @jax.jit
+    def argmax(fits_m, score_m, ms):
+        fits = fits_m[:, 0]
+        cur = jnp.where(fits, score_m[:, 0], -jnp.inf)
+        top2 = jax.lax.top_k(cur, 2)[0]
+        second = jnp.where(cur == top2[0], top2[1], top2[0])
+        ok_m = fits_m & ((score_m > second[:, None])
+                         | (ms[None, :] == 1.0))
+        run = jnp.sum(jnp.cumprod(ok_m.astype(jnp.int32), axis=1),
+                      axis=1).astype(jnp.int32)
+        wave = fits & (cur == top2[0])
+        order = jnp.argsort(jnp.where(wave, -cur, jnp.inf))
+        return run, order
+
+    @jax.jit
+    def scatter(run, order, count, used, demand, coll):
+        base_sorted = run[order]
+        prefix = jnp.cumsum(base_sorted) - base_sorted
+        alloc_sorted = jnp.clip(count - prefix, 0, base_sorted)
+        per_node = jnp.zeros(run.shape[0],
+                             jnp.int32).at[order].set(alloc_sorted)
+        used2 = used + per_node[:, None].astype(jnp.float32) * demand
+        return used2, coll + per_node, jnp.sum(per_node)
+
+    return feasibility, fit, score, argmax, scatter
+
+
+def probe(n_nodes: int, r_dims: int = NUM_RESOURCE_DIMS,
+          iters: int = 10, warmup: int = 2) -> Dict[str, float]:
+    """Raw per-stage wall seconds (best-of-`iters` after `warmup`) at
+    shape [n_nodes, _FILL_GRID, r_dims].  Best-of is deliberate — it
+    strips dispatch jitter, which is exactly what fractions must not
+    carry."""
+    import jax
+
+    from nomad_tpu.ops.place import _FILL_GRID
+
+    rng = np.random.default_rng(0)
+    N, M, R = int(n_nodes), int(_FILL_GRID), int(r_dims)
+    dev = lambda a: jax.device_put(a)   # noqa: E731
+    capacity = dev(rng.uniform(100.0, 1000.0,
+                               (N, R)).astype(np.float32))
+    used = dev(rng.uniform(0.0, 50.0, (N, R)).astype(np.float32))
+    demand = dev(rng.uniform(1.0, 10.0, R).astype(np.float32))
+    feasible = dev(rng.random(N) < 0.9)
+    ms = dev(np.arange(1, M + 1, dtype=np.float32))
+    coll = dev(rng.integers(0, 3, N).astype(np.int32))
+    penalty = dev((rng.random(N) < 0.05).astype(np.float32))
+    affinity = dev(rng.uniform(-1.0, 1.0, N).astype(np.float32))
+    count = np.int32(256)
+    desired_f = np.float32(8.0)
+    has_affinity = np.bool_(True)
+
+    f_feas, f_fit, f_score, f_argmax, f_scatter = _stage_fns()
+    util_m, fits_m = f_feas(capacity, used, demand, feasible, ms)
+    fit_m = f_fit(capacity, util_m)
+    score_m = f_score(fit_m, coll, ms, desired_f, penalty, affinity,
+                      has_affinity)
+    run, order = f_argmax(fits_m, score_m, ms)
+
+    calls = [
+        ("feasibility", lambda: f_feas(capacity, used, demand,
+                                       feasible, ms)),
+        ("fit", lambda: f_fit(capacity, util_m)),
+        ("score", lambda: f_score(fit_m, coll, ms, desired_f, penalty,
+                                  affinity, has_affinity)),
+        ("argmax", lambda: f_argmax(fits_m, score_m, ms)),
+        ("scatter", lambda: f_scatter(run, order, count, used, demand,
+                                      coll)),
+    ]
+    out: Dict[str, float] = {}
+    for name, call in calls:
+        for _ in range(warmup):
+            jax.block_until_ready(call())
+        best = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(call())
+            best = min(best, time.perf_counter() - t0)
+        out[name] = best
+    return out
+
+
+def device_stages(engine_stats: dict, n_nodes: int,
+                  r_dims: int = NUM_RESOURCE_DIMS,
+                  iters: int = 10) -> Optional[dict]:
+    """The BENCH JSON `"device_stages"` section: the run's measured
+    `device_s` attributed across the wave pipeline by probed per-stage
+    fractions (stage sum == device_s by construction), plus the
+    dirty-row upload time the engine already measures directly.  Returns
+    None when the run recorded no device time.  When a tracer is
+    installed the probe timings are also recorded as child spans of a
+    `device.stage_probe` trace (Perfetto-exportable like any other)."""
+    device_s = float(engine_stats.get("device_s", 0.0))
+    if device_s <= 0.0:
+        return None
+    raw = probe(n_nodes, r_dims=r_dims, iters=iters)
+    total = sum(raw.values()) or 1.0
+    stages = {name: device_s * (raw[name] / total) for name in STAGES}
+    dominant = max(stages, key=stages.get)
+    section = {
+        "stages_s": {k: round(v, 6) for k, v in stages.items()},
+        "fractions": {k: round(raw[k] / total, 4) for k in STAGES},
+        "probe_raw_s": {k: round(raw[k], 6) for k in STAGES},
+        "device_s": round(device_s, 6),
+        "dirty_row_upload_s": round(
+            float(engine_stats.get("put_basis_s", 0.0)), 6),
+        "dominant_stage": dominant,
+        "n_nodes": int(n_nodes),
+    }
+    tracer = tracing.active
+    if tracer is not None:
+        ctx = tracer.new_context()
+        if ctx is not None:
+            root = tracer.start(ctx, "device.stage_probe", "bench")
+            child = tracer.child_ctx(ctx, root)
+            now = time.time()
+            t = now
+            for name in STAGES:
+                tracer.emit(child, f"device.{name}", t,
+                            t + stages[name], node="bench",
+                            fraction=section["fractions"][name])
+                t += stages[name]
+            tracer.finish(root, end=t)
+    return section

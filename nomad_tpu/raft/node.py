@@ -35,7 +35,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from nomad_tpu import chaos
+from nomad_tpu import chaos, tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.raft.log import LogEntry, LogStore
 from nomad_tpu.raft.meta import DurableMeta, MetaPersistError
@@ -155,6 +155,11 @@ class RaftNode:
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
         self._futures: Dict[int, concurrent.futures.Future] = {}
+        # tracing side table (guarded by _lock): log index -> sampled
+        # trace context, noted at propose time on the proposing node so
+        # the apply thread can emit the fsm-apply span at observe time.
+        # Context never rides in log payloads (FSM byte-identity).
+        self._trace_notes: Dict[int, dict] = {}
         self._last_contact = time.monotonic()
         # autopilot health inputs: when the leader last successfully
         # replicated to each peer (append ack or snapshot install)
@@ -539,6 +544,8 @@ class RaftNode:
               timeout: float = 10.0) -> int:
         """Append + replicate + commit + FSM-apply one entry; returns its
         log index (reference raft.Apply)."""
+        tracer = tracing.active
+        tctx = tracing.current() if tracer is not None else None
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
@@ -553,13 +560,28 @@ class RaftNode:
             # caller-side mutation of the proposal can never alias FSM state.
             entry = LogEntry(index, self.term, msg_type,
                              pickle.loads(pickle.dumps(payload)))
+            t0 = time.time() if tctx is not None else 0.0
             self.log.append(entry)
+            if tctx is not None:
+                # propose-time: the WAL append (including its fsync) is
+                # a span, and the index->context note lets _run_apply
+                # emit the fsm-apply span without touching the payload
+                tracer.emit(tctx, "raft.append", t0, time.time(),
+                            node=self.name, index=index)
+                if len(self._trace_notes) > 1024:
+                    self._trace_notes.clear()   # leadership-churn strays
+                self._trace_notes[index] = tctx
             self._match_index[self.name] = index
             fut: concurrent.futures.Future = concurrent.futures.Future()
             self._futures[index] = fut
             self._advance_commit()    # sole-voter clusters commit locally
+        t1 = time.time() if tctx is not None else 0.0
         self._replicate_all()
         fut.result(timeout=timeout)
+        if tctx is not None:
+            # replicate + quorum commit + local FSM apply wait
+            tracer.emit(tctx, "raft.commit", t1, time.time(),
+                        node=self.name, index=index)
         return index
 
     def barrier(self, timeout: float = 10.0) -> None:
@@ -1081,12 +1103,21 @@ class RaftNode:
                 with self._lock:
                     if i <= self.last_applied:   # snapshot raced us
                         continue
+                    tctx = self._trace_notes.pop(i, None)
+                tracer = tracing.active
+                ta = time.time() if tctx is not None else 0.0
                 try:
                     self.fsm.apply(e.index, e.msg_type, e.payload)
                     err = None
                 except Exception as exc:           # noqa: BLE001
                     log.exception("fsm apply failed at %d", e.index)
                     err = exc
+                if tctx is not None and tracer is not None:
+                    # observe-time: timestamps taken around the FSM call,
+                    # never inside it (the FSM must not read the clock)
+                    tracer.emit(tctx, "raft.fsm_apply", ta, time.time(),
+                                node=self.name, index=i,
+                                msg_type=e.msg_type)
                 with self._lock:
                     self.last_applied = max(self.last_applied, i)
                     fut = self._futures.pop(i, None)

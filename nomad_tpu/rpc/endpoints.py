@@ -12,6 +12,7 @@ from __future__ import annotations
 import time as _time
 from typing import Callable, Dict, Optional
 
+from nomad_tpu import tracing
 from nomad_tpu.raft import MessageType, NotLeaderError
 from nomad_tpu.structs import Evaluation, EvalStatus
 from nomad_tpu.structs.evaluation import EvalTrigger
@@ -96,6 +97,15 @@ class Endpoints:
         args = dict(args) if args else {}
         args.pop("region", None)
         args.pop("_forward_hops", None)
+        # sampled trace context (absent = unsampled): bind it to this
+        # thread for the duration of the dispatch so downstream code —
+        # plan enqueue, raft apply — can attach child spans
+        tctx = args.pop(tracing.TRACE_KEY, None)
+        tracer = tracing.active
+        tspan = tprev = None
+        if tracer is not None and tctx is not None:
+            tspan = tracer.start(tctx, f"rpc.{method}", self.server.name)
+            tprev = tracing.bind(tracer.child_ctx(tctx, tspan))
         # per-request consistency on read RPCs (reference QueryOptions
         # riding every RPC): establish the read point before dispatch so
         # the handler's plain store reads serve at it
@@ -108,6 +118,10 @@ class Endpoints:
             return fn(args)
         except NotLeaderError as e:
             raise RpcError("not_leader", leader=e.leader)
+        finally:
+            if tspan is not None:
+                tracer.finish(tspan)
+                tracing.bind(tprev)
 
     def methods(self):
         return sorted(self._methods)
@@ -454,8 +468,17 @@ class Endpoints:
         # follower worker scheduling from a snapshot older than this
         # index would not see those allocs and double-place the job
         # (reference eval_endpoint.go Dequeue GetWaitIndex).
-        return {"eval": ev, "token": token,
+        resp = {"eval": ev, "token": token,
                 "wait_index": self.server.store.latest_index}
+        tracer = tracing.active
+        if tracer is not None:
+            # hand the eval's sampled trace context (re-noted by the
+            # broker at dequeue, after the queue-wait span) to the
+            # remote worker so scheduling spans join the trace
+            note = tracer.take_eval_note(ev.id)
+            if note is not None:
+                resp["trace"] = note[0]
+        return resp
 
     def rpc_Eval__Ack(self, args):
         return {"ok": self.server.broker.ack(args["eval_id"], args["token"])}

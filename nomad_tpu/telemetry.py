@@ -12,6 +12,7 @@ exposition for /v1/metrics?format=prometheus.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 from collections import defaultdict
@@ -19,13 +20,16 @@ from typing import Dict, List
 
 
 class _Sample:
-    __slots__ = ("count", "total", "max", "values")
+    __slots__ = ("count", "total", "max", "values", "_rng")
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.max = 0.0
         self.values: List[float] = []          # bounded reservoir
+        # seeded so summaries are reproducible across runs; per-instance
+        # so concurrent series don't share generator state
+        self._rng = random.Random(0x5EED)
 
     def add(self, v: float) -> None:
         self.count += 1
@@ -33,8 +37,15 @@ class _Sample:
         self.max = max(self.max, v)
         if len(self.values) < 1024:
             self.values.append(v)
-        else:                                   # reservoir replacement
-            self.values[self.count % 1024] = v
+        else:
+            # Vitter's Algorithm R: keep the new value with probability
+            # 1024/count at a uniform slot, so every observation — not
+            # just the last 1024 — has equal weight in the percentiles.
+            # (The old `count % 1024` ring overwrote oldest-first, which
+            # biased p50/p99 toward the most recent window.)
+            j = self._rng.randrange(self.count)
+            if j < 1024:
+                self.values[j] = v
 
     def summary(self) -> dict:
         vals = sorted(self.values)
@@ -113,25 +124,45 @@ class MetricsRegistry:
             }
 
     def prometheus(self) -> str:
-        """Prometheus text exposition (metric names sanitized)."""
+        """Prometheus text exposition: every family gets HELP + TYPE,
+        counters carry the conventional `_total` suffix, and when two
+        raw names sanitize to the same exposition name only the first is
+        exported (scrapers hard-fail on duplicate TYPE blocks; the
+        skipped name is noted in a comment so the collision is
+        visible)."""
         def san(n):
             return n.replace(".", "_").replace("-", "_")
-        lines = []
+        lines: List[str] = []
+        seen: Dict[str, str] = {}   # exposition name -> raw name
+
+        def family(raw: str, name: str, kind: str) -> bool:
+            if name in seen:
+                lines.append(f"# collision: {raw!r} sanitizes to "
+                             f"{name} (already exported for "
+                             f"{seen[name]!r}); skipped")
+                return False
+            seen[name] = raw
+            lines.append(f"# HELP {name} nomad_tpu {kind} {raw}")
+            lines.append(f"# TYPE {name} {kind}")
+            return True
+
         with self._lock:
             for k, v in sorted(self._counters.items()):
-                lines.append(f"# TYPE {san(k)} counter")
-                lines.append(f"{san(k)} {v}")
+                name = san(k) + "_total"
+                if family(k, name, "counter"):
+                    lines.append(f"{name} {v}")
             for k, v in sorted(self._gauges.items()):
-                lines.append(f"# TYPE {san(k)} gauge")
-                lines.append(f"{san(k)} {v}")
+                name = san(k)
+                if family(k, name, "gauge"):
+                    lines.append(f"{name} {v}")
             for k, s in sorted(self._samples.items()):
                 m = s.summary()
                 base = san(k)
-                lines.append(f"# TYPE {base} summary")
-                lines.append(f'{base}{{quantile="0.5"}} {m["p50"]}')
-                lines.append(f'{base}{{quantile="0.99"}} {m["p99"]}')
-                lines.append(f"{base}_sum {s.total}")
-                lines.append(f"{base}_count {m['count']}")
+                if family(k, base, "summary"):
+                    lines.append(f'{base}{{quantile="0.5"}} {m["p50"]}')
+                    lines.append(f'{base}{{quantile="0.99"}} {m["p99"]}')
+                    lines.append(f"{base}_sum {s.total}")
+                    lines.append(f"{base}_count {m['count']}")
         return "\n".join(lines) + "\n"
 
 

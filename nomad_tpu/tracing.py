@@ -1,0 +1,281 @@
+"""Dapper-style sampled distributed tracing for the placement spine.
+
+A `Tracer` makes one sampling decision at ingress; sampled requests get a
+trace context — ``{"t": trace_id, "s": parent_span_id, "b": 1}`` — that
+rides RPC args end-to-end under the reserved key `TRACE_KEY`.  Absence of
+the key IS the unsampled state: no per-request flag, no allocation.  The
+tracer is installed process-wide (`install()`) or picked up from the
+environment at import, chaos-layer style:
+
+    NOMAD_TPU_TRACE=1 NOMAD_TPU_TRACE_SAMPLE=0.01 nomad agent ...
+
+Instrumentation sites pay exactly one module-attribute load + ``is not
+None`` branch when tracing is off (the chaos idiom), and only sampled
+requests allocate spans.  Span timestamps are captured at propose or
+observe time only — never inside the FSM cone, so replicas replay to
+byte-identical state (see nomad_tpu.analysis.fsm_determinism).  The raft
+spine is traced via side tables keyed off the log index on the proposing
+node; trace context never rides in log payloads.
+
+Spans land in a bounded ring `SpanStore` per server (`store_for(node)`),
+queried through `/v1/traces` + `/v1/traces/<trace_id>` and exportable as
+Chrome-trace JSON (`chrome_trace()`) for Perfetto.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from nomad_tpu.analysis import race
+
+# reserved RPC-args key the context rides under; handlers pop it before
+# dispatch so endpoint code never sees it in its own args
+TRACE_KEY = "_trace"
+
+
+class Span:
+    __slots__ = ("trace_id", "span_id", "parent_id", "name",
+                 "start", "duration", "node", "attrs")
+
+    def __init__(self, trace_id: str, span_id: str, parent_id: str,
+                 name: str, start: float, duration: float = 0.0,
+                 node: str = "", attrs: Optional[Dict[str, Any]] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start = start
+        self.duration = duration
+        self.node = node
+        self.attrs = attrs or {}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id, "name": self.name,
+                "start": self.start, "duration": self.duration,
+                "node": self.node, "attrs": self.attrs}
+
+
+class SpanStore:
+    """Bounded ring of finished spans for one server.  Shared by every
+    request thread on that server, so the ring is lock-guarded and traced
+    by the happens-before detector like the event broker's queues."""
+
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({"_spans"})
+    _RACE_TRACED = {"_spans": "_lock"}
+
+    def __init__(self, limit: int = 4096):
+        self._lock = threading.Lock()
+        self._spans: deque = deque(maxlen=limit)
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            race.write("SpanStore._spans", self)
+            self._spans.append(span)
+
+    def snapshot(self, trace_id: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            race.read("SpanStore._spans", self)
+            if trace_id is None:
+                return list(self._spans)
+            return [s for s in self._spans if s.trace_id == trace_id]
+
+    def __len__(self) -> int:
+        with self._lock:
+            race.read("SpanStore._spans", self)
+            return len(self._spans)
+
+
+class Tracer:
+    """Process-wide trace plane: sampling, span-id allocation, per-node
+    span stores, and the propose-time side tables that let the broker
+    wait and the raft pipeline be timed without touching the FSM cone."""
+
+    # evals noted at propose time but never dequeued (leadership churn,
+    # failed applies) must not leak; the table is bounded and evicts
+    # oldest-first
+    _NOTE_LIMIT = 4096
+
+    def __init__(self, sample_rate: float = 1.0, seed: int = 0,
+                 store_limit: int = 4096):
+        self.sample_rate = float(sample_rate)
+        self.store_limit = int(store_limit)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._stores: Dict[str, SpanStore] = {}
+        # eval_id -> (ctx, enqueue_ts): written at propose time (outside
+        # the FSM), read at broker dequeue to emit the queue-wait span
+        self._eval_notes: Dict[str, Tuple[dict, float]] = {}
+
+    # ------------------------------------------------------------- sampling
+
+    def _new_id(self) -> str:
+        with self._lock:
+            return "%016x" % self._rng.getrandbits(64)
+
+    def new_context(self) -> Optional[dict]:
+        """One sampling decision at ingress; None means unsampled and the
+        request proceeds with zero further tracing work anywhere."""
+        with self._lock:
+            if self._rng.random() >= self.sample_rate:
+                return None
+            return {"t": "%016x" % self._rng.getrandbits(64),
+                    "s": "", "b": 1}
+
+    # ------------------------------------------------------------- spans
+
+    def start(self, ctx: dict, name: str, node: str = "") -> Span:
+        return Span(trace_id=ctx["t"], span_id=self._new_id(),
+                    parent_id=ctx.get("s", ""), name=name,
+                    start=time.time(), node=node)
+
+    def finish(self, span: Span, end: Optional[float] = None) -> None:
+        span.duration = max(0.0, (time.time() if end is None else end)
+                            - span.start)
+        self.store_for(span.node).add(span)
+
+    def emit(self, ctx: dict, name: str, start: float, end: float,
+             node: str = "", **attrs) -> Span:
+        """Record a finished span from externally captured timestamps
+        (observe-time emission for work that already happened)."""
+        span = Span(trace_id=ctx["t"], span_id=self._new_id(),
+                    parent_id=ctx.get("s", ""), name=name, start=start,
+                    duration=max(0.0, end - start), node=node,
+                    attrs=attrs or None)
+        self.store_for(node).add(span)
+        return span
+
+    @staticmethod
+    def child_ctx(ctx: dict, span: Span) -> dict:
+        return {"t": ctx["t"], "s": span.span_id, "b": 1}
+
+    # ------------------------------------------------------------- stores
+
+    def store_for(self, node: str) -> SpanStore:
+        with self._lock:
+            st = self._stores.get(node)
+            if st is None:
+                st = self._stores[node] = SpanStore(self.store_limit)
+            return st
+
+    def spans(self, trace_id: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            stores = list(self._stores.values())
+        out: List[Span] = []
+        for st in stores:
+            out.extend(st.snapshot(trace_id))
+        out.sort(key=lambda s: s.start)
+        return out
+
+    def traces(self) -> List[Dict[str, Any]]:
+        """Trace summaries, newest first: root span name, start, total
+        duration (max span end - min span start), span count, nodes."""
+        by_id: Dict[str, List[Span]] = {}
+        for s in self.spans():
+            by_id.setdefault(s.trace_id, []).append(s)
+        out = []
+        for tid, spans in by_id.items():
+            start = min(s.start for s in spans)
+            end = max(s.start + s.duration for s in spans)
+            roots = [s for s in spans if not s.parent_id]
+            out.append({
+                "trace_id": tid,
+                "root": roots[0].name if roots else spans[0].name,
+                "start": start,
+                "duration": end - start,
+                "spans": len(spans),
+                "nodes": sorted({s.node for s in spans}),
+            })
+        out.sort(key=lambda t: t["start"], reverse=True)
+        return out
+
+    # ------------------------------------------------------------- notes
+
+    def note_eval(self, eval_id: str, ctx: dict,
+                  ts: Optional[float] = None) -> None:
+        """Propose-time note: the eval was created under `ctx` at `ts`.
+        The FSM's leader hook enqueues the eval inside the apply cone, so
+        the queue-wait span is stitched here instead: noted at propose
+        time, emitted at dequeue time."""
+        with self._lock:
+            while len(self._eval_notes) >= self._NOTE_LIMIT:
+                self._eval_notes.pop(next(iter(self._eval_notes)))
+            self._eval_notes[eval_id] = (ctx, time.time() if ts is None
+                                         else ts)
+
+    def take_eval_note(self, eval_id: str) \
+            -> Optional[Tuple[dict, float]]:
+        with self._lock:
+            return self._eval_notes.pop(eval_id, None)
+
+
+# ===================================================================== export
+
+def chrome_trace(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome-trace (Trace Event Format) JSON for Perfetto / chrome://
+    tracing: one complete ("X") event per span, one process row per
+    node, timestamps in microseconds."""
+    pids: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    for s in spans:
+        node = s.get("node") or "-"
+        pid = pids.get(node)
+        if pid is None:
+            pid = pids[node] = len(pids) + 1
+            events.append({"name": "process_name", "ph": "M", "pid": pid,
+                           "tid": 0, "args": {"name": node}})
+        ev = {"name": s["name"], "ph": "X", "pid": pid, "tid": 0,
+              "ts": s["start"] * 1e6, "dur": s["duration"] * 1e6,
+              "args": {"trace_id": s["trace_id"],
+                       "span_id": s["span_id"],
+                       "parent_id": s["parent_id"]}}
+        attrs = s.get("attrs")
+        if attrs:
+            ev["args"].update(attrs)
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ===================================================================== module
+
+# the installed tracer, or None.  Instrumentation sites test this one
+# global before doing anything else: the untraced fast path is a module
+# attribute load + is-check, nothing more (chaos.py idiom).
+active: Optional[Tracer] = None
+
+_tls = threading.local()
+
+
+def install(tracer: Optional[Tracer]) -> Optional[Tracer]:
+    global active
+    prev = active
+    active = tracer
+    return prev
+
+
+def uninstall() -> Optional[Tracer]:
+    return install(None)
+
+
+def current() -> Optional[dict]:
+    """The trace context bound to this thread, or None (unsampled)."""
+    return getattr(_tls, "ctx", None)
+
+
+def bind(ctx: Optional[dict]) -> Optional[dict]:
+    """Bind `ctx` as this thread's current trace context; returns the
+    previous binding so callers can restore it in a finally block."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    return prev
+
+
+_env = os.environ.get("NOMAD_TPU_TRACE", "")
+if _env and _env not in ("0", "false"):
+    active = Tracer(sample_rate=float(
+        os.environ.get("NOMAD_TPU_TRACE_SAMPLE", "1.0")))

@@ -212,6 +212,57 @@ def test_agent_pprof_and_monitor(api, agent):
     assert "monitor-probe-line" in body
 
 
+def test_agent_pprof_kinds(api):
+    """r12 satellite: the 'threads' alias serves stacks, a zero-length
+    ?seconds= window returns immediately with a stats dump, and unknown
+    pprof kinds 404 instead of profiling."""
+    stacks = api.get("/v1/agent/pprof/threads")
+    assert "Thread" in stacks["stacks"]
+
+    t0 = time.time()
+    prof = api.get("/v1/agent/pprof/profile?seconds=0")
+    assert time.time() - t0 < 5.0
+    assert prof["seconds"] == 0
+    assert "profile" in prof
+
+    with pytest.raises(ApiError) as e:
+        api.get("/v1/agent/pprof/heap")
+    assert e.value.status == 404
+
+
+def test_agent_monitor_stream_teardown(api, agent):
+    """r12 satellite: the monitor stream must (a) terminate itself with
+    the chunked terminator when ?timeout= expires and (b) absorb a client
+    that slams the socket shut mid-stream without taking the agent
+    down."""
+    import logging
+    import socket
+    import urllib.request
+
+    # (a) expiry terminator: the read completes when the window closes —
+    # urllib only returns once the 0-length chunk arrives
+    t0 = time.time()
+    with urllib.request.urlopen(
+            f"{agent.http_addr}/v1/agent/monitor?timeout=0.5",
+            timeout=10) as resp:
+        resp.read()
+    assert time.time() - t0 < 8.0
+
+    # (b) mid-stream disconnect: raw socket so we can hard-close while
+    # the server is still following the log ring
+    host, port = agent.http_addr.replace("http://", "").split(":")
+    sk = socket.create_connection((host, int(port)), timeout=5)
+    sk.sendall(b"GET /v1/agent/monitor?timeout=30 HTTP/1.1\r\n"
+               b"Host: x\r\nConnection: close\r\n\r\n")
+    sk.recv(4096)                       # status line (+ ring replay)
+    sk.close()
+    # force the server to write into the dead socket
+    for _ in range(3):
+        logging.getLogger("nomad_tpu.test").info("teardown-probe-line")
+        time.sleep(0.2)
+    assert api.system.leader() is not None
+
+
 def test_job_scale_http(api, agent):
     from nomad_tpu.structs.job import ScalingPolicy
     j = mock.job(id="scale-http-job")

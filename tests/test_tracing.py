@@ -1,0 +1,301 @@
+"""Distributed tracing tests (r12 tentpole): context propagation end to
+end through the spine, raft span attribution, federation-hop survival,
+span-store bounds, sampling, and Chrome-trace export.  This file is also
+the CI `tracing` leg's payload — it must stay green under
+NOMAD_TPU_RACE=1."""
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock, tracing
+from nomad_tpu.tracing import TRACE_KEY, Tracer, chrome_trace
+
+
+def _wait(cond, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def tracer():
+    t = Tracer(sample_rate=1.0, seed=42)
+    tracing.install(t)
+    yield t
+    tracing.uninstall()
+
+
+def _assert_causal(spans):
+    """Every non-root span's parent must be another span in the trace."""
+    ids = {s.span_id for s in spans}
+    roots = [s for s in spans if not s.parent_id]
+    assert roots, [s.name for s in spans]
+    for s in spans:
+        if s.parent_id:
+            assert s.parent_id in ids, (s.name, s.parent_id)
+
+
+# ------------------------------------------------------------- unit layer
+
+
+def test_sample_rate_zero_is_silent():
+    t = Tracer(sample_rate=0.0, seed=3)
+    assert all(t.new_context() is None for _ in range(100))
+    assert t.traces() == []
+
+
+def test_sampling_rate_is_honored():
+    t = Tracer(sample_rate=0.25, seed=11)
+    hits = sum(t.new_context() is not None for _ in range(4000))
+    assert 800 < hits < 1200, hits
+
+
+def test_uninstalled_guard_is_none():
+    assert tracing.active is None
+    assert tracing.current() is None
+
+
+def test_span_store_ring_is_bounded():
+    t = Tracer(sample_rate=1.0, seed=1, store_limit=64)
+    ctx = t.new_context()
+    for i in range(500):
+        t.emit(ctx, f"s{i}", float(i), float(i) + 1.0, node="n1")
+    assert len(t.store_for("n1")) == 64
+    # the ring keeps the newest spans
+    names = {s.name for s in t.spans(ctx["t"])}
+    assert "s499" in names and "s0" not in names
+
+
+def test_span_store_concurrent_add_and_snapshot(tracer):
+    """Hammer one store from writers while snapshotting — the shape the
+    race detector (NOMAD_TPU_RACE=1) audits via SpanStore._RACE_TRACED."""
+    ctx = tracer.new_context()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            tracer.emit(ctx, "w", 0.0, 1.0, node="n")
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        for _ in range(50):
+            tracer.spans(ctx["t"])
+            tracer.traces()
+    finally:
+        stop.set()
+        for th in threads:
+            th.join()
+    assert len(tracer.store_for("n")) <= tracer.store_limit
+
+
+def test_eval_note_table_is_bounded():
+    t = Tracer(sample_rate=1.0, seed=2)
+    ctx = t.new_context()
+    for i in range(t._NOTE_LIMIT + 100):
+        t.note_eval(f"ev-{i}", ctx)
+    assert len(t._eval_notes) == t._NOTE_LIMIT
+    # oldest evicted first, newest retrievable
+    assert t.take_eval_note("ev-0") is None
+    assert t.take_eval_note(f"ev-{t._NOTE_LIMIT + 99}") is not None
+
+
+def test_chrome_trace_export_shape():
+    t = Tracer(sample_rate=1.0, seed=5)
+    ctx = t.new_context()
+    root = t.start(ctx, "root", "n1")
+    child = t.start(t.child_ctx(ctx, root), "child", "n2")
+    t.finish(child)
+    t.finish(root)
+    doc = chrome_trace([s.to_dict() for s in t.spans(ctx["t"])])
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    assert all(isinstance(e["pid"], int) for e in evs)
+    meta = [e for e in evs
+            if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert {m["args"]["name"] for m in meta} == {"n1", "n2"}
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 2
+    assert all("ts" in e and "dur" in e and
+               e["args"]["trace_id"] == ctx["t"] for e in xs)
+    json.dumps(doc)     # must be JSON-serializable as-is
+
+
+# ------------------------------------------------------ dev agent (HTTP)
+
+
+def test_dev_agent_http_chain_and_api(tracer):
+    """HTTP ingress starts the root span; the context rides the RPC args
+    through scheduler invoke, plan submit, and the dev-mode apply; the
+    trace is served back over /v1/traces and exports via ?format=chrome.
+    Flipping the sample rate to 0 silences new requests entirely."""
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api import ApiClient
+
+    a = Agent(AgentConfig(http_port=0, num_schedulers=2,
+                          heartbeat_ttl=60.0))
+    a.start()
+    try:
+        for _ in range(3):
+            a.server.register_node(mock.node())
+        api = ApiClient(a.http_addr)
+        j = mock.job()
+        api.jobs.register(j)
+        a.server.wait_for_idle(10.0)
+
+        reg = _wait_trace(tracer, "http.PUT /v1/jobs",
+                          {"plan.submit", "raft.fsm_apply"})
+        spans = tracer.spans(reg["trace_id"])
+        names = {s.name for s in spans}
+        for want in ("http.PUT /v1/jobs", "rpc.Job.Register",
+                     "broker.wait", "plan.submit", "plan.queue_wait",
+                     "plan.evaluate", "raft.fsm_apply"):
+            assert want in names, (want, sorted(names))
+        assert any(n.startswith("worker.invoke_scheduler.")
+                   for n in names), sorted(names)
+        assert len(spans) >= 6
+        _assert_causal(spans)
+
+        # the trace API serves what the store holds
+        listed = api.operator.traces()
+        assert any(t["trace_id"] == reg["trace_id"] for t in listed)
+        got = api.operator.trace(reg["trace_id"])
+        assert len(got["spans"]) == len(spans)
+        doc = api.operator.trace_chrome(reg["trace_id"])
+        assert len([e for e in doc["traceEvents"]
+                    if e["ph"] == "X"]) == len(spans)
+
+        # CLI: list, show, export
+        from nomad_tpu.command.cli import main as cli_main
+        out = io.StringIO()
+        assert cli_main(["-address", a.http_addr, "operator", "trace"],
+                        out=out) == 0
+        assert reg["trace_id"] in out.getvalue()
+        out = io.StringIO()
+        assert cli_main(["-address", a.http_addr, "operator", "trace",
+                         reg["trace_id"]], out=out) == 0
+        assert "plan.submit" in out.getvalue()
+
+        # sampling off: new requests produce no new traces
+        tracer.sample_rate = 0.0
+        before = len(tracer.traces())
+        api.nodes.list()
+        api.jobs.register(mock.job())
+        a.server.wait_for_idle(10.0)
+        time.sleep(0.2)
+        assert len(tracer.traces()) == before
+    finally:
+        a.stop()
+
+
+def _wait_trace(tracer, root_name, want_names, timeout=15.0):
+    """Wait until a trace rooted at `root_name` contains `want_names`
+    (spans land asynchronously as observe-time emission catches up)."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        for t in tracer.traces():
+            if t["root"] == root_name:
+                last = t
+                names = {s.name for s in tracer.spans(t["trace_id"])}
+                if want_names <= names:
+                    return t
+        time.sleep(0.1)
+    raise AssertionError(
+        f"no trace rooted at {root_name!r} grew spans {want_names}; "
+        f"last={last}")
+
+
+# --------------------------------------------------- 3-server raft spine
+
+
+def test_cluster_plan_submit_trace_has_raft_spans(tracer):
+    """The acceptance trace: one sampled register on a real 3-server
+    raft spine shows the causally-linked chain rpc -> broker wait ->
+    scheduler invoke -> plan submit/evaluate -> raft append (WAL+fsync
+    window) -> commit -> fsm apply."""
+    from nomad_tpu.core.cluster import Cluster
+
+    c = Cluster(n=3)
+    c.start()
+    try:
+        leader = c.leader(10.0)
+        for _ in range(3):
+            leader.register_node(mock.node())
+        ctx = tracer.new_context()
+        j = mock.job()
+        j.task_groups[0].count = 2
+        leader.endpoints.handle("Job.Register",
+                                {"job": j, TRACE_KEY: ctx})
+        assert _wait(lambda: len(
+            leader.store.allocs_by_job("default", j.id)) == 2, 30)
+        assert _wait(lambda: {"raft.fsm_apply", "plan.submit"} <=
+                     {s.name for s in tracer.spans(ctx["t"])}, 10)
+
+        spans = tracer.spans(ctx["t"])
+        names = {s.name for s in spans}
+        for want in ("rpc.Job.Register", "broker.wait", "plan.submit",
+                     "plan.queue_wait", "plan.evaluate", "raft.append",
+                     "raft.commit", "raft.fsm_apply"):
+            assert want in names, (want, sorted(names))
+        assert len(spans) >= 6
+        _assert_causal(spans)
+        # all spans share the one trace id; raft spans carry the index
+        assert {s.trace_id for s in spans} == {ctx["t"]}
+        assert any(s.name == "raft.append" and
+                   s.attrs and "index" in s.attrs for s in spans)
+    finally:
+        c.stop()
+
+
+# ------------------------------------------------------------ federation
+
+
+def test_federation_hop_preserves_trace_id(tracer):
+    """A forwarded RPC keeps its trace context across the WAN hop: the
+    remote region's rpc span lands under the SAME trace_id, attributed
+    to the remote server."""
+    from nomad_tpu.core.cluster import FederatedCluster
+    from nomad_tpu.core.server import ServerConfig
+    from nomad_tpu.raft import RaftConfig
+
+    fc = FederatedCluster(
+        regions=("global", "west"), n=1,
+        config=ServerConfig(num_schedulers=2, heartbeat_ttl=60.0),
+        raft_config=RaftConfig(heartbeat_interval=0.02,
+                               election_timeout=0.1))
+    fc.start()
+    fc.wait_federated(20.0)
+    try:
+        g = fc.leader("global", 10.0)
+        w = fc.leader("west", 10.0)
+        w.register_node(mock.node())
+        ctx = tracer.new_context()
+        j = mock.job()
+        j.region = "west"
+        j.task_groups[0].count = 1
+        g.endpoints.handle("Job.Register", {"job": j, TRACE_KEY: ctx})
+        assert _wait(lambda: any(
+            s.name == "rpc.Job.Register"
+            for s in tracer.spans(ctx["t"])), 10)
+        assert _wait(lambda: w.name in {
+            s.node for s in tracer.spans(ctx["t"])
+            if s.name == "rpc.Job.Register"}, 10)
+        spans = tracer.spans(ctx["t"])
+        rpc_spans = [s for s in spans if s.name == "rpc.Job.Register"]
+        # the ingress dispatch on global AND the forwarded handling on
+        # west both land under the SAME trace id
+        assert {s.node for s in rpc_spans} == {g.name, w.name}
+        assert {s.trace_id for s in spans} == {ctx["t"]}
+        # the register landed where it was routed
+        assert _wait(lambda: w.store.job_by_id("default", j.id) is not None, 10)
+        assert g.store.job_by_id("default", j.id) is None
+    finally:
+        fc.stop()
