@@ -258,6 +258,8 @@ def required_capability(parts: List[str], method: str,
         return (f"plugin:{'write' if write else 'read'}", None)
     if head in ("namespaces", "namespace"):
         return (f"operator:{'write' if write else 'read'}", None)
+    if head in ("quotas", "quota"):
+        return (f"quota:{'write' if write else 'read'}", None)
     if head == "search":
         return (CAP_LIST_JOBS, ns)
     if head == "event":

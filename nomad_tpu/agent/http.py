@@ -69,7 +69,9 @@ class HTTPServer:
                     self._reply(e.code, {"error": e.msg})
                 except RpcError as e:
                     code = {"not_found": 404, "permission_denied": 403,
-                            "unknown_method": 404,
+                            "unknown_method": 404, "bad_request": 400,
+                            "unknown_namespace": 400,
+                            "unknown_region": 400,
                             "no_region_leader": 503,
                             "no_region_path": 502}.get(e.kind, 500)
                     self._reply(code, {"error": str(e)})
@@ -318,10 +320,29 @@ class HTTPServer:
         return acl.allows(namespace, CAP_LIST_JOBS) or \
             acl.allows(namespace, CAP_READ_JOB)
 
+    def _ns_param(self, q):
+        """Validate `?namespace=`: an unknown namespace is rejected
+        naming the known set (matching Job.Register's unknown-region
+        error shape); `*` is the wildcard list-all.  Cross-region
+        requests skip the check — only the remote region knows its
+        namespaces."""
+        ns = q.get("namespace")
+        if not ns or ns == "*":
+            return ns
+        server = self.agent.server
+        if server is None or getattr(self._read_local, "region", None):
+            return ns
+        if server.store.namespace(ns) is None:
+            known = sorted(n.name for n in server.store.namespaces())
+            raise HTTPError(
+                400, f"unknown namespace {ns!r} (known namespaces: "
+                     f"{', '.join(known)})")
+        return ns
+
     # ------------------------------------------------------------ jobs
 
     def _h_get_jobs(self, h, parts, q):
-        jobs = self._rpc("Job.List", {"namespace": q.get("namespace")})
+        jobs = self._rpc("Job.List", {"namespace": self._ns_param(q)})
         prefix = q.get("prefix", "")
         return [_job_stub(j) for j in jobs
                 if j.id.startswith(prefix)
@@ -517,7 +538,8 @@ class HTTPServer:
 
     def _h_get_evaluations(self, h, parts, q):
         prefix = q.get("prefix", "")
-        return [e for e in self._rpc("Eval.List", {})
+        return [e for e in self._rpc("Eval.List",
+                                     {"namespace": self._ns_param(q)})
                 if e.id.startswith(prefix)
                 and self._ns_visible(h, e.namespace)]
 
@@ -537,7 +559,8 @@ class HTTPServer:
 
     def _h_get_allocations(self, h, parts, q):
         prefix = q.get("prefix", "")
-        return [_alloc_stub(a) for a in self._rpc("Alloc.List", {})
+        return [_alloc_stub(a) for a in
+                self._rpc("Alloc.List", {"namespace": self._ns_param(q)})
                 if a.id.startswith(prefix)
                 and self._ns_visible(h, a.namespace)]
 
@@ -564,7 +587,9 @@ class HTTPServer:
     # ------------------------------------------------------------ deployments
 
     def _h_get_deployments(self, h, parts, q):
-        return [d for d in self._rpc("Deployment.List", {})
+        return [d for d in
+                self._rpc("Deployment.List",
+                          {"namespace": self._ns_param(q)})
                 if self._ns_visible(h, d.namespace)]
 
     def _h_get_deployment_id(self, h, parts, q):
@@ -924,8 +949,8 @@ class HTTPServer:
         namespaces = None
         if getattr(self.agent.server, "acl_enabled", False):
             store = self.agent.server.store
-            namespaces = [ns["name"] for ns in store.namespaces()
-                          if self._ns_visible(h, ns["name"])]
+            namespaces = [ns.name for ns in store.namespaces()
+                          if self._ns_visible(h, ns.name)]
         resp = self._rpc("Search.PrefixSearch", {
             "prefix": body.get("Prefix", ""),
             "context": body.get("Context", "all"),
@@ -1075,19 +1100,57 @@ class HTTPServer:
     # ------------------------------------------------------------ namespaces
 
     def _h_get_namespaces(self, h, parts, q):
-        return self.agent.server.namespaces()
+        return self._rpc("Namespace.List", {})
 
     def _h_put_namespaces(self, h, parts, q):
         body = h._body()
-        self.agent.server.upsert_namespace(body.get("Name", "default"),
-                                           body.get("Description", ""))
-        return {}
+        return self._rpc("Namespace.Upsert", {
+            "name": body.get("Name", "default"),
+            "description": body.get("Description", ""),
+            "quota": body.get("Quota", "")})
 
     _h_post_namespaces = _h_put_namespaces
 
+    def _h_get_namespace_id(self, h, parts, q):
+        ns = self.agent.server.namespace(parts[1])
+        if ns is None:
+            raise HTTPError(404, f"namespace not found: {parts[1]}")
+        return ns
+
     def _h_delete_namespace_id(self, h, parts, q):
-        self.agent.server.delete_namespace(parts[1])
-        return {}
+        return self._rpc("Namespace.Delete", {"name": parts[1]})
+
+    # ------------------------------------------------------------ quotas
+
+    def _h_get_quotas(self, h, parts, q):
+        return self._rpc("Quota.List", {})
+
+    def _h_put_quotas(self, h, parts, q):
+        from nomad_tpu.structs.namespace import QuotaSpec
+        spec = from_wire(QuotaSpec, h._body())
+        if not spec.name:
+            raise HTTPError(400, "quota spec requires a Name")
+        return self._rpc("Quota.Upsert", {"spec": spec})
+
+    _h_post_quotas = _h_put_quotas
+
+    def _h_get_quota_id(self, h, parts, q):
+        # /v1/quota/usage/<namespace> | /v1/quota/<name>
+        if parts[1] == "usage":
+            if len(parts) > 2:
+                return {"Namespace": parts[2],
+                        "Usage": self._rpc(
+                            "Quota.Usage",
+                            {"namespace": parts[2]}).get(parts[2], {})}
+            return self._rpc("Quota.Usage", {})
+        return self._rpc("Quota.GetQuota", {"name": parts[1]})
+
+    _h_put_quota_id = _h_put_quotas
+    _h_post_quota_id = _h_put_quotas
+
+    def _h_delete_quota_id(self, h, parts, q):
+        resp = self._rpc("Quota.Delete", {"name": parts[1]})
+        return resp
 
     # ------------------------------------------------------------ CSI
     # (reference command/agent/csi_endpoint.go: /v1/volumes,
@@ -1205,6 +1268,7 @@ def _node_stub(n) -> dict:
 
 def _alloc_stub(a) -> dict:
     return {"ID": a.id, "Name": a.name, "JobID": a.job_id,
+            "Namespace": a.namespace,
             "TaskGroup": a.task_group, "NodeID": a.node_id,
             "EvalID": a.eval_id, "ClientStatus": a.client_status,
             "DesiredStatus": a.desired_status,

@@ -69,6 +69,7 @@ class ApiClient:
         self.operator = Operator(self)
         self.acl = AclApi(self)
         self.namespaces = Namespaces(self)
+        self.quotas = Quotas(self)
         self.volumes = Volumes(self)
         self.plugins = Plugins(self)
         self.system = SystemApi(self)
@@ -83,6 +84,11 @@ class ApiClient:
         qs = dict(params or {})
         if self.region:
             qs.setdefault("region", self.region)
+        if self.namespace:
+            # every request carries the client's namespace unless the
+            # caller set one explicitly ("*" lists across namespaces) —
+            # the same threading as region above
+            qs.setdefault("namespace", self.namespace)
         if method == "GET":
             mode = consistency if consistency is not None \
                 else self.consistency
@@ -92,7 +98,8 @@ class ApiClient:
                 qs.setdefault("consistent", "true")
         url = f"{self.address}{path}"
         if qs:
-            url += "?" + urllib.parse.urlencode(
+            # some section helpers bake a query string into `path`
+            url += ("&" if "?" in path else "?") + urllib.parse.urlencode(
                 {k: v for k, v in qs.items() if v is not None})
         data = None
         if body is not None:
@@ -427,12 +434,40 @@ class Namespaces(_Section):
     def list(self) -> List[dict]:
         return self.c.get("/v1/namespaces")
 
-    def register(self, name: str, description: str = "") -> dict:
+    def info(self, name: str) -> dict:
+        return self.c.get(f"/v1/namespace/{name}")
+
+    def register(self, name: str, description: str = "",
+                 quota: str = "") -> dict:
         return self.c.put("/v1/namespaces",
-                          {"Name": name, "Description": description})
+                          {"Name": name, "Description": description,
+                           "Quota": quota})
 
     def delete(self, name: str) -> dict:
         return self.c.delete(f"/v1/namespace/{name}")
+
+
+class Quotas(_Section):
+    """Per-namespace resource quotas (reference api/quota.go)."""
+
+    def list(self) -> List[dict]:
+        return self.c.get("/v1/quotas")
+
+    def info(self, name: str) -> dict:
+        return self.c.get(f"/v1/quota/{name}")
+
+    def register(self, spec) -> dict:
+        body = spec if isinstance(spec, dict) else to_wire(spec)
+        return self.c.put("/v1/quotas", body)
+
+    def delete(self, name: str) -> dict:
+        return self.c.delete(f"/v1/quota/{name}")
+
+    def usage(self, namespace: str) -> dict:
+        return self.c.get(f"/v1/quota/usage/{namespace}")
+
+    def usages(self) -> dict:
+        return self.c.get("/v1/quota/usage")
 
 
 class Volumes(_Section):

@@ -109,6 +109,18 @@ Fault points and their injection sites:
                               cut, exercising the router's fail-fast
                               Unreachable path and the multiregion
                               rollout's halt-at-region-boundary behavior
+    quota.apply_stall         core/plan_apply.py — the propose-side quota
+                              admission check stalls `delay_ms`, widening
+                              the window where a leader change can route
+                              a second within-budget plan at the same
+                              namespace budget (the FSM-side check must
+                              still drop the combined overflow)
+    broker.unfair_burst       core/broker.py — the fair-share namespace
+                              pick is bypassed for one dequeue (the
+                              global priority order is used instead), as
+                              if a burst slipped past the stride
+                              accounting; the starvation bound must hold
+                              regardless
 
 `REQUIRED_SITES` pins points to the hot-path functions that must carry
 them; the chaos-coverage linter fails if a refactor drops one.
@@ -152,6 +164,8 @@ FAULT_POINTS = (
     "raft.config_conflict",
     "transfer.timeout",
     "region.partition",
+    "quota.apply_stall",
+    "broker.unfair_burst",
 )
 
 # Points that must be injected in these specific functions (enforced by
@@ -171,6 +185,8 @@ REQUIRED_SITES = {
     "raft.config_conflict": ("RaftNode._append_config",),
     "transfer.timeout": ("RaftNode.transfer_leadership",),
     "region.partition": ("RegionRouter.route",),
+    "quota.apply_stall": ("PlanApplier._evaluate",),
+    "broker.unfair_burst": ("EvalBroker.dequeue",),
 }
 
 

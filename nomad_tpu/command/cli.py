@@ -519,6 +519,11 @@ class Cli:
                f"{cfg.preemption_config.service_scheduler_enabled}")
         self.p(f"Preemption (batch jobs)    = "
                f"{cfg.preemption_config.batch_scheduler_enabled}")
+        self.p(f"Fair Dequeue               = {cfg.fair_dequeue_enabled}")
+        self.p(f"Default Namespace Weight   = "
+               f"{cfg.default_namespace_weight}")
+        for ns, w in sorted((cfg.namespace_weights or {}).items()):
+            self.p(f"Namespace Weight           = {ns}={w}")
         return 0
 
     def cmd_operator_scheduler_set(self, args) -> int:
@@ -528,6 +533,13 @@ class Cli:
         if args.memory_oversubscription is not None:
             cfg.memory_oversubscription_enabled = \
                 args.memory_oversubscription == "true"
+        if args.fair_dequeue is not None:
+            cfg.fair_dequeue_enabled = args.fair_dequeue == "true"
+        if args.default_namespace_weight is not None:
+            cfg.default_namespace_weight = args.default_namespace_weight
+        for kv in args.namespace_weight or []:
+            ns, _, w = kv.partition("=")
+            cfg.namespace_weights[ns] = int(w)
         self.api.operator.scheduler_set_configuration(cfg)
         self.p("Scheduler configuration updated!")
         return 0
@@ -620,17 +632,64 @@ class Cli:
 
     def cmd_namespace_list(self, args) -> int:
         for ns in self.api.namespaces.list():
-            self.p(f"{ns['name']}\t{ns.get('description', '')}")
+            self.p(f"{ns['name']}\t{ns.get('quota', '') or '<none>'}\t"
+                   f"{ns.get('description', '')}")
         return 0
 
     def cmd_namespace_apply(self, args) -> int:
-        self.api.namespaces.register(args.name, args.description or "")
+        self.api.namespaces.register(args.name, args.description or "",
+                                     quota=args.quota or "")
         self.p(f"Successfully applied namespace \"{args.name}\"!")
         return 0
 
     def cmd_namespace_delete(self, args) -> int:
         self.api.namespaces.delete(args.name)
         self.p(f"Successfully deleted namespace \"{args.name}\"!")
+        return 0
+
+    # ------------------------------------------------------------- quota
+
+    @staticmethod
+    def _fmt_limit(v) -> str:
+        return "-" if v is None else str(v)
+
+    def cmd_quota_list(self, args) -> int:
+        rows = [[s["name"], self._fmt_limit(s.get("cpu")),
+                 self._fmt_limit(s.get("memory_mb")),
+                 self._fmt_limit(s.get("devices")),
+                 self._fmt_limit(s.get("allocs")),
+                 s.get("description", "")]
+                for s in self.api.quotas.list()]
+        self.p(_fmt_table(rows, ["Name", "CPU", "Memory MiB", "Devices",
+                                 "Allocs", "Description"]))
+        return 0
+
+    def cmd_quota_apply(self, args) -> int:
+        spec = {"name": args.name, "description": args.description or ""}
+        for dim in ("cpu", "memory_mb", "devices", "allocs"):
+            v = getattr(args, dim)
+            if v is not None:
+                spec[dim] = v
+        self.api.quotas.register(spec)
+        self.p(f"Successfully applied quota specification \"{args.name}\"!")
+        return 0
+
+    def cmd_quota_delete(self, args) -> int:
+        self.api.quotas.delete(args.name)
+        self.p(f"Successfully deleted quota \"{args.name}\"!")
+        return 0
+
+    def cmd_quota_usage(self, args) -> int:
+        if args.usage_ns:
+            usages = {args.usage_ns: self.api.quotas.usage(
+                args.usage_ns).get("Usage") or {}}
+        else:
+            usages = self.api.quotas.usages()
+        rows = [[ns, str(u.get("cpu", 0)), str(u.get("memory_mb", 0)),
+                 str(u.get("devices", 0)), str(u.get("allocs", 0))]
+                for ns, u in sorted(usages.items())]
+        self.p(_fmt_table(rows, ["Namespace", "CPU", "Memory MiB",
+                                 "Devices", "Allocs"]))
         return 0
 
     def cmd_volume_register(self, args) -> int:
@@ -876,6 +935,14 @@ def build_parser() -> argparse.ArgumentParser:
     o.add_argument("-memory-oversubscription",
                    dest="memory_oversubscription",
                    choices=["true", "false"], default=None)
+    o.add_argument("-fair-dequeue", dest="fair_dequeue",
+                   choices=["true", "false"], default=None,
+                   help="weighted fair eval dequeue across namespaces")
+    o.add_argument("-default-namespace-weight", type=int, default=None,
+                   dest="default_namespace_weight")
+    o.add_argument("-namespace-weight", action="append",
+                   dest="namespace_weight", metavar="NS=WEIGHT",
+                   help="per-namespace dequeue weight (repeatable)")
     o.set_defaults(fn="cmd_operator_scheduler_set")
     rft = op.add_parser("raft").add_subparsers(dest="sub2", required=True)
     o = rft.add_parser("list-peers")
@@ -921,10 +988,39 @@ def build_parser() -> argparse.ArgumentParser:
     c = ns.add_parser("apply")
     c.add_argument("name")
     c.add_argument("-description", default="")
+    c.add_argument("-quota", default="",
+                   help="quota spec governing this namespace")
     c.set_defaults(fn="cmd_namespace_apply")
     c = ns.add_parser("delete")
     c.add_argument("name")
     c.set_defaults(fn="cmd_namespace_delete")
+
+    qt = sub.add_parser("quota",
+                        help="resource quota commands").add_subparsers(
+        dest="sub", required=True)
+    c = qt.add_parser("list")
+    c.set_defaults(fn="cmd_quota_list")
+    c = qt.add_parser("apply")
+    c.add_argument("name")
+    c.add_argument("-description", default="")
+    c.add_argument("-cpu", type=int, default=None,
+                   help="CPU MHz limit (omit for unlimited)")
+    c.add_argument("-memory", type=int, default=None, dest="memory_mb",
+                   help="memory MiB limit")
+    c.add_argument("-devices", type=int, default=None,
+                   help="accelerator device-count limit")
+    c.add_argument("-allocs", type=int, default=None,
+                   help="live allocation-count limit")
+    c.set_defaults(fn="cmd_quota_apply")
+    c = qt.add_parser("delete")
+    c.add_argument("name")
+    c.set_defaults(fn="cmd_quota_delete")
+    c = qt.add_parser("usage")
+    # dest kept distinct from the global -namespace flag: a subparser
+    # positional default would clobber the already-parsed global value
+    c.add_argument("usage_ns", nargs="?", default="",
+                   metavar="namespace")
+    c.set_defaults(fn="cmd_quota_usage")
 
     vol = sub.add_parser("volume",
                          help="CSI volume commands").add_subparsers(

@@ -43,6 +43,10 @@ class BlockedEvals:
         # a capacity event that fires between an eval's snapshot and its
         # block() call must immediately requeue it instead of blocking.
         self._unblock_indexes: Dict[str, int] = {}
+        # quota name -> index of the last quota-spec change; a quota raise
+        # that lands between an eval's snapshot and its block() call must
+        # requeue it (mirrors the class-keyed table above)
+        self._quota_unblock_indexes: Dict[str, int] = {}
         self._global_unblock_index = 0
         self.stats = BlockedStats()
 
@@ -62,6 +66,10 @@ class BlockedEvals:
         """Did a relevant capacity change land after this eval's snapshot?"""
         if self._global_unblock_index > ev.snapshot_index:
             return True
+        if ev.quota_limit_reached:
+            qidx = self._quota_unblock_indexes.get(ev.quota_limit_reached, 0)
+            if qidx > ev.snapshot_index:
+                return True
         elig = ev.class_eligibility or {}
         for cls, idx in self._unblock_indexes.items():
             if idx <= ev.snapshot_index:
@@ -81,7 +89,8 @@ class BlockedEvals:
                 # capacity changed between the eval's snapshot and now:
                 # requeue immediately instead of blocking forever
                 latest = max([self._global_unblock_index,
-                              *self._unblock_indexes.values()])
+                              *self._unblock_indexes.values(),
+                              *self._quota_unblock_indexes.values()])
                 missed = ev
             else:
                 missed = None
@@ -188,6 +197,9 @@ class BlockedEvals:
 
     def unblock_quota(self, quota: str, index: int) -> List[Evaluation]:
         with self._lock:
+            self._quota_unblock_indexes[quota] = max(
+                index, self._quota_unblock_indexes.get(quota, 0))
+            self._drain_woken.clear()   # real change: re-arm second chances
             ids = list(self._quota.get(quota, ()))
             released = [self._captured[i] for i in ids if i in self._captured]
             for ev in released:

@@ -11,6 +11,18 @@ Semantics reproduced:
   eval is routed to the `_failed` queue (reaped by the leader loop)
 - `wait_until` evals sit in a delay heap until due
 - expired leases auto-nack (checked lazily on broker operations)
+
+Weighted fair dequeue (this repo's multi-tenant extension, following
+stride scheduling — Waldspurger & Weihl, OSDI '95 — over per-namespace
+queues, the broker-level analog of DRF's dominant-share ordering): the
+ready queues are partitioned per (scheduler type, namespace); each
+namespace carries a virtual-time `pass` advanced by `stride = K/weight`
+on every dequeue, and the next eval comes from the runnable namespace
+with the minimum pass.  A namespace that wakes from idle has its pass
+floored to the runnable minimum, so sleeping never banks credit.  With
+one namespace (or fairness disabled via the replicated
+SchedulerConfiguration) the order degenerates to the global
+(-priority, seq) order, byte-for-byte the pre-fairness behavior.
 """
 from __future__ import annotations
 
@@ -45,7 +57,8 @@ class EvalBroker:
     # are only touched under `self._lock` or in @requires_lock helpers.
     _LOCK_NAME = "_lock"
     _LOCK_PROTECTED = frozenset({
-        "_ready", "_unack", "_attempts", "_pending", "_active_jobs",
+        "_ns_ready", "_ns_nonempty", "_fair_pass", "_fair_weights",
+        "_unack", "_attempts", "_pending", "_active_jobs",
         "_delayed", "_requeued",
     })
     # happens-before (nomad_tpu.analysis): the lease table is touched by
@@ -62,8 +75,19 @@ class EvalBroker:
         self.initial_nack_delay = initial_nack_delay
         self.subsequent_nack_delay = subsequent_nack_delay
         self._counter = itertools.count()
-        # scheduler type -> heap of (-priority, seq, eval)
-        self._ready: Dict[str, List[Tuple[int, int, Evaluation]]] = defaultdict(list)
+        # scheduler type -> namespace -> heap of (-priority, seq, eval);
+        # the per-namespace partition is what fair dequeue picks over
+        self._ns_ready: Dict[str, Dict[str, List[Tuple[int, int, Evaluation]]]] = \
+            defaultdict(dict)
+        # scheduler type -> set of namespaces with a non-empty heap (the
+        # dequeue scan walks only runnable namespaces)
+        self._ns_nonempty: Dict[str, set] = defaultdict(set)
+        # stride accounting: namespace -> virtual pass; weights come from
+        # the replicated SchedulerConfiguration via set_fair_config
+        self._fair_pass: Dict[str, float] = {}
+        self._fair_enabled = True
+        self._fair_default_weight = 1
+        self._fair_weights: Dict[str, int] = {}
         self._unack: Dict[str, _Lease] = {}
         self._attempts: Dict[str, int] = defaultdict(int)
         # (namespace, job_id) -> deque of evals waiting for the active one.
@@ -86,10 +110,31 @@ class EvalBroker:
             if not enabled:
                 self.flush()
 
+    def set_fair_config(self, cfg) -> None:
+        """Adopt the replicated SchedulerConfiguration's fairness knobs
+        (live-tunable: the FSM's leader hook pushes every applied
+        config entry here)."""
+        with self._lock:
+            self._fair_enabled = bool(
+                getattr(cfg, "fair_dequeue_enabled", True))
+            self._fair_default_weight = max(
+                1, int(getattr(cfg, "default_namespace_weight", 1) or 1))
+            self._fair_weights = dict(
+                getattr(cfg, "namespace_weights", None) or {})
+            self._lock.notify_all()
+
+    @requires_lock("_lock")
+    def _stride(self, namespace: str) -> float:
+        weight = self._fair_weights.get(
+            namespace, self._fair_default_weight)
+        return 1000.0 / max(1, int(weight))
+
     @requires_lock("_lock")
     def flush(self) -> None:
         race.write("EvalBroker._unack", self)
-        self._ready.clear()
+        self._ns_ready.clear()
+        self._ns_nonempty.clear()
+        self._fair_pass.clear()
         self._unack.clear()
         self._attempts.clear()
         self._pending.clear()
@@ -126,8 +171,28 @@ class EvalBroker:
             return
         if ev.job_id:
             self._active_jobs.add(key)
-        heapq.heappush(self._ready[ev.type], (-ev.priority, next(self._counter), ev))
+        self._push_ready_locked(ev)
         self.stats["enqueued"] += 1
+
+    @requires_lock("_lock")
+    def _push_ready_locked(self, ev: Evaluation) -> None:
+        heap = self._ns_ready[ev.type].setdefault(ev.namespace, [])
+        if not heap:
+            # namespace becomes runnable for this scheduler type.  If it
+            # was idle EVERYWHERE, floor its pass to the runnable
+            # minimum: a sleeper must not bank virtual time and then
+            # monopolize the broker on wake (stride scheduling's
+            # standard re-admission rule).
+            was_runnable = any(ev.namespace in nss
+                               for nss in self._ns_nonempty.values())
+            if not was_runnable:
+                floor = min((self._fair_pass.get(ns, 0.0)
+                             for nss in self._ns_nonempty.values()
+                             for ns in nss), default=0.0)
+                self._fair_pass[ev.namespace] = max(
+                    self._fair_pass.get(ev.namespace, 0.0), floor)
+            self._ns_nonempty[ev.type].add(ev.namespace)
+        heapq.heappush(heap, (-ev.priority, next(self._counter), ev))
 
     # ------------------------------------------------------------- dequeue
 
@@ -140,7 +205,7 @@ class EvalBroker:
             self._enqueue_locked(ev)
         while self._requeued and self._requeued[0][0] <= now:
             _, _, ev = heapq.heappop(self._requeued)
-            heapq.heappush(self._ready[ev.type], (-ev.priority, next(self._counter), ev))
+            self._push_ready_locked(ev)   # job stays active; no dedup
         # expire stale leases -> auto-nack
         race.write("EvalBroker._unack", self)
         expired = [t for t, l in self._unack.items() if l.expires_at <= now]
@@ -155,13 +220,39 @@ class EvalBroker:
         with self._lock:
             while True:
                 self._poll_timers_locked()
-                best_q, best = None, None
+                # fair pick: the runnable namespace with the minimum
+                # stride pass (ties broken by the global head order so
+                # equal-pass namespaces keep FIFO-within-priority);
+                # fairness off -> pure global (-priority, seq) order
+                fair = self._fair_enabled
+                if fair and chaos.active is not None and \
+                        chaos.active.should("broker.unfair_burst"):
+                    # one dequeue slips past the stride accounting, as
+                    # if a burst raced the pick; the pass charge below
+                    # still lands, so the debt is repaid on the next
+                    # picks and the starvation bound must still hold
+                    fair = False
+                    self.stats["fair_bypassed"] += 1
+                best_q, best_ns, best_key = None, None, None
                 for s in schedulers:
-                    q = self._ready.get(s)
-                    if q and (best is None or q[0][:2] < best[:2]):
-                        best_q, best = s, q[0]
-                if best is not None:
-                    heapq.heappop(self._ready[best_q])
+                    for ns in self._ns_nonempty.get(s, ()):
+                        head = self._ns_ready[s][ns][0]
+                        key = (self._fair_pass.get(ns, 0.0),
+                               head[0], head[1]) if fair \
+                            else (head[0], head[1])
+                        if best_key is None or key < best_key:
+                            best_q, best_ns, best_key = s, ns, key
+                if best_ns is not None:
+                    heap = self._ns_ready[best_q][best_ns]
+                    best = heapq.heappop(heap)
+                    if not heap:
+                        del self._ns_ready[best_q][best_ns]
+                        self._ns_nonempty[best_q].discard(best_ns)
+                    if self._fair_enabled:
+                        self._fair_pass[best_ns] = \
+                            self._fair_pass.get(best_ns, 0.0) + \
+                            self._stride(best_ns)
+                        self.stats["fair_picks"] += 1
                     ev = best[2]
                     token = str(uuid.uuid4())
                     expires = _time.time() + self.nack_timeout
@@ -238,8 +329,10 @@ class EvalBroker:
             # and release the job so a fresh eval can be scheduled
             self._active_jobs.discard((ev.namespace, ev.job_id))
             self._release_pending_locked((ev.namespace, ev.job_id))
-            heapq.heappush(self._ready[FAILED_QUEUE],
-                           (-ev.priority, next(self._counter), ev))
+            heap = self._ns_ready[FAILED_QUEUE].setdefault(ev.namespace, [])
+            if not heap:
+                self._ns_nonempty[FAILED_QUEUE].add(ev.namespace)
+            heapq.heappush(heap, (-ev.priority, next(self._counter), ev))
             self.stats["failed"] += 1
             return
         delay = (self.initial_nack_delay if attempts == 1
@@ -290,4 +383,24 @@ class EvalBroker:
     def ready_count(self) -> int:
         with self._lock:
             self._poll_timers_locked()
-            return sum(len(q) for s, q in self._ready.items() if s != FAILED_QUEUE)
+            return sum(len(q)
+                       for s, per_ns in self._ns_ready.items()
+                       if s != FAILED_QUEUE
+                       for q in per_ns.values())
+
+    def fair_stats(self) -> dict:
+        """broker.fair_* telemetry snapshot: per-namespace pass/weight
+        plus runnable namespace count."""
+        with self._lock:
+            runnable = set()
+            for nss in self._ns_nonempty.values():
+                runnable |= nss
+            return {
+                "enabled": self._fair_enabled,
+                "runnable_namespaces": len(runnable),
+                "pass": dict(self._fair_pass),
+                "weights": dict(self._fair_weights),
+                "default_weight": self._fair_default_weight,
+                "picks": self.stats["fair_picks"],
+                "bypassed": self.stats["fair_bypassed"],
+            }

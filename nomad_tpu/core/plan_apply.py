@@ -38,6 +38,7 @@ from nomad_tpu import chaos, tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.state.store import AppliedPlanResults, StateStore
 from nomad_tpu.structs import Allocation, Node
+from nomad_tpu.structs.namespace import alloc_quota_usage, usage_add
 from nomad_tpu.structs.node import NodeStatus
 from nomad_tpu.structs.plan import Plan, PlanResult
 from nomad_tpu.telemetry import global_metrics
@@ -251,11 +252,27 @@ class PlanApplier:
         # -> scheduler retry, safe); double-counted frees would validate
         # overcommitting plans.  Untracked in-flight frees merely delay
         # reuse of the space by one commit.
+        # The same asymmetry holds for the quota overlay below: accepted
+        # placements of quota-governed namespaces count against the
+        # budget until their commit pops; frees never do.
+        quota_delta: Dict[str, Dict[str, int]] = {}
+        governed: Dict[str, bool] = {}
+        for allocs in result.node_allocation.values():
+            for a in allocs:
+                gov = governed.get(a.namespace)
+                if gov is None:
+                    ns_obj = self.store.namespace(a.namespace)
+                    gov = governed[a.namespace] = \
+                        ns_obj is not None and bool(ns_obj.quota)
+                if gov:
+                    usage_add(quota_delta.setdefault(a.namespace, {}),
+                              alloc_quota_usage(a), +1)
         with self._overlay_lock:
             race.write("PlanApplier._overlay", self)
             self._overlay_seq += 1
             token = self._overlay_seq
-            self._overlay[token] = (used_delta, port_claim, port_free)
+            self._overlay[token] = (used_delta, port_claim, port_free,
+                                    quota_delta)
         return token
 
     def _overlay_views(self, cm):
@@ -269,7 +286,8 @@ class PlanApplier:
             with self.store._lock:
                 used = cm.used.copy()
                 port_words = cm.port_words.copy()
-            for used_delta, port_claim, port_free in self._overlay.values():
+            for used_delta, port_claim, port_free, _qd in \
+                    self._overlay.values():
                 for row, vec in used_delta.items():
                     if row < used.shape[0]:
                         used[row] += vec
@@ -369,7 +387,20 @@ class PlanApplier:
             else:
                 rejected.append(node_id)
 
-        if rejected and plan.all_at_once:
+        # namespace quota admission at propose time, in the same
+        # placement order the FSM will apply (node_allocation insertion
+        # order == _applied_for's flatten order), against committed
+        # usage + the in-flight quota overlay − this plan's own frees.
+        # The FSM re-checks authoritatively at apply (the leader-churn
+        # backstop: two leaders can each propose within-budget plans
+        # that only overflow combined); on a stable leader this check
+        # is never more permissive than the FSM's, so a propose-admit
+        # implies an apply-admit and the plan result stays truthful.
+        if chaos.active is not None:
+            chaos.maybe_delay("quota.apply_stall")
+        quota_dropped = self._quota_filter(plan, result)
+
+        if (rejected or quota_dropped) and plan.all_at_once:
             # the reference nils updates, placements, preemptions AND the
             # deployment together when AllAtOnce fails (plan_apply.go:428-436)
             result.node_allocation = {}
@@ -383,6 +414,83 @@ class PlanApplier:
             self.stats["partial"] += 1
             self.stats["rejected_nodes"] += len(rejected)
         return result
+
+    def _quota_filter(self, plan: Plan, result: PlanResult) -> int:
+        """Drop over-quota placements from the evaluated result.  Returns
+        the number of placements dropped; sets
+        ``result.quota_limit_reached`` to the exhausted spec's name so
+        the scheduler blocks the eval keyed on it instead of retrying."""
+        store = self.store
+        # resolve the governing spec per namespace in the placements
+        specs: Dict[str, object] = {}
+        for allocs in result.node_allocation.values():
+            for a in allocs:
+                if a.namespace in specs:
+                    continue
+                ns_obj = store.namespace(a.namespace)
+                spec = None
+                if ns_obj is not None and ns_obj.quota:
+                    spec = store.quota_spec(ns_obj.quota)
+                specs[a.namespace] = spec
+        if not any(spec is not None for spec in specs.values()):
+            return 0
+
+        # working view: committed usage + in-flight overlays − this
+        # plan's frees (live, non-terminal stops only — same condition
+        # as the resource `freed` vectors above)
+        view: Dict[str, Dict[str, int]] = {}
+
+        def usage(ns: str) -> Dict[str, int]:
+            got = view.get(ns)
+            if got is None:
+                got = view[ns] = store.quota_usage(ns)
+            return got
+
+        with self._overlay_lock:
+            race.read("PlanApplier._overlay", self)
+            overlay_qd = [entry[3] for entry in self._overlay.values()]
+        for qd in overlay_qd:
+            for ns, vec in qd.items():
+                if specs.get(ns) is not None:
+                    usage_add(usage(ns), vec, +1)
+        for stops in list(plan.node_update.values()) + \
+                list(plan.node_preemptions.values()):
+            for a in stops:
+                live = store.alloc_by_id(a.id)
+                if live is None or live.terminal_status():
+                    continue
+                if specs.get(live.namespace) is not None:
+                    usage_add(usage(live.namespace),
+                              alloc_quota_usage(live), -1)
+
+        dropped = 0
+        for node_id in list(result.node_allocation.keys()):
+            kept: List[Allocation] = []
+            for a in result.node_allocation[node_id]:
+                spec = specs.get(a.namespace)
+                if spec is None or store.alloc_by_id(a.id) is not None:
+                    # ungoverned namespace, or an update of an existing
+                    # alloc (the FSM admits those unconditionally too)
+                    kept.append(a)
+                    continue
+                would = dict(usage(a.namespace))
+                usage_add(would, alloc_quota_usage(a), +1)
+                if spec.admits(would):
+                    view[a.namespace] = would
+                    kept.append(a)
+                else:
+                    dropped += 1
+                    result.quota_limit_reached = spec.name
+            if dropped and len(kept) != len(result.node_allocation[node_id]):
+                if kept:
+                    result.node_allocation[node_id] = kept
+                else:
+                    del result.node_allocation[node_id]
+        if dropped:
+            self.stats["quota_dropped"] = \
+                self.stats.get("quota_dropped", 0) + dropped
+            global_metrics.incr("nomad.plan.quota_dropped", dropped)
+        return dropped
 
     def _csi_claims_ok(self, allocs: List[Allocation],
                        pending_writers: Dict[Tuple[str, str], Set[str]]

@@ -124,6 +124,10 @@ class Server:
         self.store.watch(self._on_state_change)
         self.leader = False
         self._established = False
+        # deny-by-default token enforcement on HTTP/RPC mutation paths
+        # (reference: `acl { enabled = true }` agent config)
+        if os.environ.get("NOMAD_TPU_ACL") == "1":
+            self.acl_enabled = True
 
         self.fsm = NomadFSM(self.store, hooks=self)
         self.raft: Optional[RaftNode] = None
@@ -363,6 +367,10 @@ class Server:
             self._leader_stop = threading.Event()
             stop = self._leader_stop
             self.broker.set_enabled(True)
+            # fairness knobs live in replicated SchedulerConfiguration;
+            # a fresh leader's broker must adopt the committed values
+            # (later changes arrive via the FSM's scheduler-config hook)
+            self.broker.set_fair_config(self.store.scheduler_config)
             self.blocked_evals.set_enabled(True)
             self.plan_queue.set_enabled(True)
             self._plan_thread = threading.Thread(
@@ -742,6 +750,16 @@ class Server:
                 job_id=job.id, type=job.type,
                 triggered_by=EvalTrigger.JOB_REGISTER,
                 status=EvalStatus.PENDING)
+        ns = job.namespace or "default"
+        if self.store.namespace(ns) is None:
+            # same shape as the unknown-region rejection above: naming
+            # the known set makes the typo obvious to the submitter
+            from nomad_tpu.rpc.endpoints import RpcError
+            known = sorted(n.name for n in self.store.namespaces())
+            raise RpcError(
+                "unknown_namespace",
+                f"job {job.id!r} submitted to unknown namespace "
+                f"{ns!r} (known namespaces: {', '.join(known)})")
         if not job.submit_time:
             job.submit_time = _time.time()   # propose-time, rides the log
         index = self.apply(MessageType.JOB_REGISTER, {"job": job})
@@ -1065,12 +1083,45 @@ class Server:
     def namespaces(self):
         return self.store.namespaces()
 
-    def upsert_namespace(self, name: str, description: str = "") -> None:
+    def namespace(self, name: str):
+        return self.store.namespace(name)
+
+    def upsert_namespace(self, name: str, description: str = "",
+                         quota: str = "") -> None:
+        if quota and self.store.quota_spec(quota) is None:
+            raise ValueError(f"quota spec {quota!r} does not exist")
         self.apply(MessageType.NAMESPACE_UPSERT,
-                   {"name": name, "description": description})
+                   {"name": name, "description": description,
+                    "quota": quota})
 
     def delete_namespace(self, name: str) -> None:
         self.apply(MessageType.NAMESPACE_DELETE, {"name": name})
+
+    # ------------------------------------------------------------- quotas
+
+    def upsert_quota_spec(self, spec) -> None:
+        self.apply(MessageType.QUOTA_SPEC_UPSERT, {"spec": spec})
+
+    def delete_quota_spec(self, name: str) -> None:
+        # propose-time guard mirrors the FSM's authoritative check so the
+        # caller gets the error without burning a log entry
+        for ns in self.store.namespaces():
+            if ns.quota == name:
+                raise ValueError(
+                    f"quota {name!r} referenced by namespace {ns.name!r}")
+        self.apply(MessageType.QUOTA_SPEC_DELETE, {"name": name})
+
+    def quota_specs(self):
+        return self.store.quota_specs()
+
+    def quota_spec(self, name: str):
+        return self.store.quota_spec(name)
+
+    def quota_usage(self, namespace: str):
+        return self.store.quota_usage(namespace)
+
+    def quota_usages(self):
+        return self.store.quota_usages()
 
     # ------------------------------------------------------------- helpers
 

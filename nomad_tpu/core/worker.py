@@ -201,6 +201,10 @@ class Worker:
                 tracer.finish(tspan)
                 tracing.bind(tprev)
         global_metrics.measure_since("nomad.plan.submit", t0)
+        # per-namespace latency: the fairness gate in the multi-tenant
+        # scenarios asserts on victim-tenant p99, not the global mix
+        ns = (plan.job.namespace or "default") if plan.job else "default"
+        global_metrics.measure_since(f"nomad.plan.submit.ns.{ns}", t0)
         return res
 
     def create_evals(self, evals: List[Evaluation]) -> None:
@@ -303,6 +307,8 @@ class RemoteWorker(Worker):
             if tspan is not None:
                 tracer.finish(tspan)
         global_metrics.measure_since("nomad.plan.submit", t0)
+        ns = (plan.job.namespace or "default") if plan.job else "default"
+        global_metrics.measure_since(f"nomad.plan.submit.ns.{ns}", t0)
         return res
 
     def reblock_eval(self, ev: Evaluation) -> None:

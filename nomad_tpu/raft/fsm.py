@@ -42,6 +42,8 @@ class MessageType:
     SCHEDULER_CONFIG = "SchedulerConfigRequest"
     NAMESPACE_UPSERT = "NamespaceUpsertRequest"
     NAMESPACE_DELETE = "NamespaceDeleteRequest"
+    QUOTA_SPEC_UPSERT = "QuotaSpecUpsertRequest"
+    QUOTA_SPEC_DELETE = "QuotaSpecDeleteRequest"
     CSI_VOLUME_REGISTER = "CSIVolumeRegisterRequest"
     CSI_VOLUME_DEREGISTER = "CSIVolumeDeregisterRequest"
     CSI_VOLUME_CLAIM = "CSIVolumeClaimRequest"
@@ -90,6 +92,8 @@ class NomadFSM:
             MessageType.CSI_VOLUME_CLAIM: self._apply_csi_volume_claim,
             MessageType.NAMESPACE_UPSERT: self._apply_namespace_upsert,
             MessageType.NAMESPACE_DELETE: self._apply_namespace_delete,
+            MessageType.QUOTA_SPEC_UPSERT: self._apply_quota_spec_upsert,
+            MessageType.QUOTA_SPEC_DELETE: self._apply_quota_spec_delete,
             MessageType.ACL_POLICY_UPSERT: self._apply_acl_policy_upsert,
             MessageType.ACL_POLICY_DELETE: self._apply_acl_policy_delete,
             MessageType.ACL_TOKEN_UPSERT: self._apply_acl_token_upsert,
@@ -219,6 +223,11 @@ class NomadFSM:
 
     def _apply_scheduler_config(self, index, p):
         self.store.set_scheduler_config(index, p["config"])
+        # the broker's fair-dequeue knobs are live-tunable: push the
+        # replicated config into the leader's broker on apply
+        hooks = self.hooks
+        if hooks is not None and getattr(hooks, "leader", False):
+            hooks.broker.set_fair_config(p["config"])
 
     # ------------------------------------------------------------- snapshot
 
@@ -236,11 +245,32 @@ class NomadFSM:
             index, p["namespace"], p["volume_id"], p["claim"])
 
     def _apply_namespace_upsert(self, index, p):
+        prev = self.store.namespace(p["name"])
         self.store.upsert_namespace(index, p["name"],
-                                    p.get("description", ""))
+                                    p.get("description", ""),
+                                    p.get("quota", ""))
+        # re-pointing a namespace at a different (or no) quota spec can
+        # free evals blocked on the OLD spec; one-shot unblock on the
+        # leader, mirroring the class-eligibility unblock path
+        hooks = self.hooks
+        if hooks is not None and getattr(hooks, "leader", False):
+            old_quota = getattr(prev, "quota", "") if prev else ""
+            if old_quota and old_quota != p.get("quota", ""):
+                hooks.blocked_evals.unblock_quota(old_quota, index)
 
     def _apply_namespace_delete(self, index, p):
         self.store.delete_namespace(index, p["name"])
+
+    def _apply_quota_spec_upsert(self, index, p):
+        self.store.upsert_quota_spec(index, p["spec"])
+        # a raised quota must rescue evals blocked on it (satellite of
+        # the PR 9 class-eligibility fix: quota-keyed one-shot unblock)
+        hooks = self.hooks
+        if hooks is not None and getattr(hooks, "leader", False):
+            hooks.blocked_evals.unblock_quota(p["spec"].name, index)
+
+    def _apply_quota_spec_delete(self, index, p):
+        self.store.delete_quota_spec(index, p["name"])
 
     def _apply_acl_policy_upsert(self, index, p):
         self.store.upsert_acl_policy(index, p["policy"])
@@ -291,6 +321,13 @@ class NomadFSM:
                 "job_summaries": dict(s._job_summaries),
                 "scheduler_config": s.scheduler_config,
                 "namespaces": dict(s._namespaces),
+                "quota_specs": dict(s._quota_specs),
+                # usage is restored verbatim (not rebuilt): entry
+                # creation ORDER is part of the replicated table's
+                # byte-identity, and a rebuild from the alloc list could
+                # recreate zeroed-then-repopulated entries out of order
+                "quota_usage": {k: dict(v)
+                                for k, v in s._quota_usage.items()},
                 "acl_policies": dict(s._acl_policies),
                 "acl_tokens": list(s._acl_tokens.values()),
                 "csi_volumes": dict(s._csi_volumes),
@@ -328,8 +365,28 @@ class NomadFSM:
             s._deployments = {d.id: d for d in data["deployments"]}
             s._job_summaries = dict(data["job_summaries"])
             s.scheduler_config = data["scheduler_config"]
-            s._namespaces = dict(data.get("namespaces") or {
-                "default": {"name": "default", "description": ""}})
+            from nomad_tpu.structs.namespace import Namespace
+            s._namespaces = {}
+            for name, ns in (data.get("namespaces") or {}).items():
+                if isinstance(ns, dict):   # pre-dataclass snapshots
+                    ns = Namespace(name=ns.get("name", name),
+                                   description=ns.get("description", ""))
+                s._namespaces[name] = ns
+            if "default" not in s._namespaces:
+                s._namespaces["default"] = Namespace(name="default")
+            s._quota_specs = dict(data.get("quota_specs", {}))
+            # Rebuild usage rows with the same literal keys the store's
+            # accounting uses (the outer namespace key stays the loaded
+            # object, which pickle shared with the job/alloc namespace
+            # strings), so a restored FSM re-snapshots to the same bytes
+            # as its peers — the byte-identity gate depends on pickle's
+            # string-memoization layout, not just on equal state.
+            s._quota_usage = {
+                k: {"cpu": v.get("cpu", 0),
+                    "memory_mb": v.get("memory_mb", 0),
+                    "devices": v.get("devices", 0),
+                    "allocs": v.get("allocs", 0)}
+                for k, v in data.get("quota_usage", {}).items()}
             s._acl_policies = dict(data.get("acl_policies", {}))
             s._acl_tokens = {}
             s._acl_by_secret = {}
@@ -359,6 +416,13 @@ class NomadFSM:
                     s._live_names.setdefault(
                         (a.namespace, a.job_id, a.name), set()).add(a.id)
                 s.matrix.upsert_alloc(a)
+            if "quota_usage" not in data:
+                # pre-quota snapshot: derive usage from the live allocs
+                from nomad_tpu.structs.namespace import alloc_quota_usage
+                for a in data["allocs"]:
+                    if not a.terminal_status():
+                        s._quota_usage_add(
+                            a.namespace, alloc_quota_usage(a), +1)
             s._applied_plan_ids = list(data.get("applied_plan_ids", []))
             s._applied_plan_ids_set = set(s._applied_plan_ids)
             s.latest_index = data["latest_index"]

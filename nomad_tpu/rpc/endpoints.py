@@ -192,7 +192,7 @@ class Endpoints:
     def rpc_Job__List(self, args):
         ns = args.get("namespace")
         jobs = self.server.store.jobs()
-        if ns:
+        if ns and ns != "*":        # "*" = all namespaces (wildcard list)
             jobs = [j for j in jobs if j.namespace == ns]
         if args.get("federated"):
             jobs = list(jobs) + self._federated_job_list(ns)
@@ -453,7 +453,11 @@ class Endpoints:
         return self.server.store.eval_by_id(args["eval_id"])
 
     def rpc_Eval__List(self, args):
-        return self.server.store.evals()
+        ns = args.get("namespace")
+        evals = self.server.store.evals()
+        if ns and ns != "*":
+            evals = [e for e in evals if e.namespace == ns]
+        return evals
 
     def rpc_Eval__Dequeue(self, args):
         """Worker dequeue with lease token (eval_endpoint.go:104); only the
@@ -504,7 +508,11 @@ class Endpoints:
         return self.server.store.alloc_by_id(args["alloc_id"])
 
     def rpc_Alloc__List(self, args):
-        return self.server.store.allocs()
+        ns = args.get("namespace")
+        allocs = self.server.store.allocs()
+        if ns and ns != "*":
+            allocs = [a for a in allocs if a.namespace == ns]
+        return allocs
 
     def rpc_Alloc__Stop(self, args):
         """Stop a single allocation and reschedule-evaluate its job."""
@@ -535,7 +543,11 @@ class Endpoints:
     # ------------------------------------------------------------- deploys
 
     def rpc_Deployment__List(self, args):
-        return self.server.store.deployments()
+        ns = args.get("namespace")
+        deps = self.server.store.deployments()
+        if ns and ns != "*":
+            deps = [d for d in deps if d.namespace == ns]
+        return deps
 
     def rpc_Deployment__GetDeployment(self, args):
         return self.server.store.deployment_by_id(args["deployment_id"])
@@ -706,8 +718,57 @@ class Endpoints:
             add("volumes", [v.id for v in store.csi_volumes()
                             if ns_ok(v.namespace)])
         if context in ("all", "namespaces"):
-            add("namespaces", [ns["name"] for ns in store.namespaces()])
+            add("namespaces", [ns.name for ns in store.namespaces()])
         return {"matches": out, "truncations": trunc}
+
+    # ------------------------------------------------------------- namespaces
+
+    def rpc_Namespace__List(self, args):
+        return self.server.namespaces()
+
+    def rpc_Namespace__Upsert(self, args):
+        try:
+            self.server.upsert_namespace(
+                args["name"], args.get("description", ""),
+                args.get("quota", ""))
+        except ValueError as e:
+            raise RpcError("bad_request", str(e))
+        return {}
+
+    def rpc_Namespace__Delete(self, args):
+        try:
+            self.server.delete_namespace(args["name"])
+        except ValueError as e:
+            raise RpcError("bad_request", str(e))
+        return {}
+
+    # ------------------------------------------------------------- quotas
+
+    def rpc_Quota__List(self, args):
+        return self.server.quota_specs()
+
+    def rpc_Quota__GetQuota(self, args):
+        spec = self.server.quota_spec(args["name"])
+        if spec is None:
+            raise RpcError("not_found", args["name"])
+        return spec
+
+    def rpc_Quota__Upsert(self, args):
+        self.server.upsert_quota_spec(args["spec"])
+        return {}
+
+    def rpc_Quota__Delete(self, args):
+        try:
+            self.server.delete_quota_spec(args["name"])
+        except ValueError as e:
+            raise RpcError("bad_request", str(e))
+        return {}
+
+    def rpc_Quota__Usage(self, args):
+        ns = args.get("namespace")
+        if ns and ns != "*":
+            return {ns: self.server.quota_usage(ns)}
+        return self.server.quota_usages()
 
     # ------------------------------------------------------------- scaling
 

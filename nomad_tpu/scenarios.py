@@ -4,8 +4,9 @@ convergence soaks on a real 3-server cluster.
 Each matrix cell boots a data_dir-backed in-process `Cluster`, registers
 mock client nodes that heartbeat on short TTLs, drives one workload
 shape (batch spine, spread services, device-constrained, preemption,
-serving plane, rolling deploy, autoscaling ramp, multi-region
-federation), and runs a *phased* chaos schedule against it: the `NOMAD_TPU_CHAOS` grammar's
+serving plane, rolling deploy, autoscaling ramp, multi-tenant
+fair-share, multi-region federation), and runs a *phased* chaos
+schedule against it: the `NOMAD_TPU_CHAOS` grammar's
 `phase=<name>:<a>-<b>` windows interleave calm -> storm -> calm, with
 server hard_kill/restart and partition bursts riding the storm phases.
 The `server_replace` schedule runs the elastic-membership drill instead:
@@ -229,6 +230,9 @@ class CellCtx:
     exact_jobs: List[str] = field(default_factory=list)
     # job ids allowed below count (capacity-starved fillers)
     at_most_jobs: List[str] = field(default_factory=list)
+    # multi-tenant shapes track jobs across namespaces; absent entries
+    # fall back to ctx.namespace
+    job_ns: Dict[str, str] = field(default_factory=dict)
     allow_blocked: bool = False
     drain_candidates: List[str] = field(default_factory=list)
     drained: List[str] = field(default_factory=list)
@@ -237,6 +241,9 @@ class CellCtx:
 
     def tracked_jobs(self) -> List[str]:
         return self.exact_jobs + self.at_most_jobs
+
+    def ns_of(self, job_id: str) -> str:
+        return self.job_ns.get(job_id, self.namespace)
 
 
 # ---------------------------------------------------------- background
@@ -291,7 +298,8 @@ class HealthReporter(threading.Thread):
             return
         updates = []
         for job_id in list(self.ctx.tracked_jobs()):
-            for a in ld.store.allocs_by_job(self.ctx.namespace, job_id):
+            for a in ld.store.allocs_by_job(self.ctx.ns_of(job_id),
+                                            job_id):
                 if a.terminal_status():
                     continue
                 healthy = True
@@ -487,8 +495,8 @@ def _wait_live(cluster, ctx, job_id, want, timeout=120.0):
             ld = cluster.leader(timeout=2.0)
         except TimeoutError:
             return False
-        return len(_live(ld.store.allocs_by_job(ctx.namespace, job_id))) \
-            >= want
+        return len(_live(ld.store.allocs_by_job(ctx.ns_of(job_id),
+                                                job_id))) >= want
     if not _wait(placed, timeout):
         raise TimeoutError(
             f"initial placement for {job_id} did not reach {want}")
@@ -865,6 +873,191 @@ class AutoscaleRampShape(Shape):
         ctx.notes["scale_bursts"] = self.driver.bursts
 
 
+class MultiTenantShape(Shape):
+    """1K+ registered tenants behind replicated namespaces, a small
+    active set, one abusive: the abuser floods ABUSE_JOBS submissions
+    (100x the single job each victim lands mid-window) into a 4-alloc
+    quota while the victims keep submitting.  Gated: weighted fair
+    dequeue keeps every victim's plan.submit p99 under 2x its solo
+    baseline (plus a fixed allowance for leader elections, which stall
+    a submit whether or not the abuser exists), per-namespace quota
+    usage converges to exactly the live-alloc sums on every survivor
+    (byte-identity of the usage tables rides the fsm_identical check),
+    the abuser never holds more than its quota admits, and no alloc or
+    eval ever crosses a namespace boundary."""
+
+    name = "multi_tenant"
+    TENANTS = 1024                      # registered namespaces (1K+ floor)
+    VICTIMS = 3
+    ABUSE_JOBS = 100                    # 100x each victim's one submit
+    P99_FLOOR_MS = 300.0                # one election's worth of stall
+
+    def setup(self, cluster, rng, ctx):
+        from nomad_tpu.structs import QuotaSpec
+        from nomad_tpu.telemetry import global_metrics
+        self._victims_submitted = False
+        self._abuse_sent = 0
+        self.victim_ns = [f"tenant-v{i}" for i in range(1, self.VICTIMS + 1)]
+        self.abuse_ns = "tenant-abuse"
+        self._contended: Dict[str, str] = {}
+        self._baseline: Dict[str, dict] = {}
+        _on_leader(cluster, lambda ld: ld.upsert_quota_spec(QuotaSpec(
+            name="tenant-std", description="steady tenant envelope",
+            allocs=32)))
+        _on_leader(cluster, lambda ld: ld.upsert_quota_spec(QuotaSpec(
+            name="abuse-cap", description="abusive tenant clamp",
+            allocs=4)))
+        # the registered-tenant universe: every namespace is replicated
+        # state the post-chaos FSM identity check must reproduce; the
+        # pool pipelines proposals so they batch into few commit rounds
+        import concurrent.futures as futures
+        names = [f"tenant-{i:04d}" for i in range(self.TENANTS)]
+        with futures.ThreadPoolExecutor(max_workers=8) as ex:
+            list(ex.map(
+                lambda nm: _on_leader(
+                    cluster, lambda ld, nm=nm: ld.upsert_namespace(
+                        nm, quota="tenant-std")), names))
+        for ns in self.victim_ns:
+            _on_leader(cluster, lambda ld, ns=ns: ld.upsert_namespace(
+                ns, quota="tenant-std"))
+        _on_leader(cluster, lambda ld: ld.upsert_namespace(
+            self.abuse_ns, quota="abuse-cap"))
+        # solo baseline: each victim lands jobs on the calm cluster and
+        # its per-namespace plan.submit series drains into the baseline
+        for ns in self.victim_ns:
+            global_metrics.take_sample(f"nomad.plan.submit.ns.{ns}")
+            for _ in range(2):
+                j = _batch_job(2, cpu=200, mem=64)
+                j.namespace = ns
+                _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+                ctx.exact_jobs.append(j.id)
+                ctx.job_ns[j.id] = ns
+                _wait_live(cluster, ctx, j.id, 2)
+            self._baseline[ns] = global_metrics.take_sample(
+                f"nomad.plan.submit.ns.{ns}")
+        ctx.allow_blocked = True        # quota-blocked abusive evals stay
+        ctx.drain_candidates = list(ctx.node_ids)
+
+    def during(self, cluster, rng, ctx, reg):
+        if not reg.phase_now():
+            return
+        if not self._victims_submitted:
+            self._victims_submitted = True
+            for ns in self.victim_ns:
+                j = _batch_job(2, cpu=200, mem=64)
+                j.namespace = ns
+                _on_leader(cluster, lambda ld, j=j: ld.register_job(j),
+                           timeout=3.0)
+                ctx.exact_jobs.append(j.id)
+                ctx.job_ns[j.id] = ns
+                self._contended[ns] = j.id
+        for _ in range(5):              # ~100/s against the victims' ~1
+            if self._abuse_sent >= self.ABUSE_JOBS:
+                break
+            j = _batch_job(1, cpu=200, mem=64)
+            j.namespace = self.abuse_ns
+            _on_leader(cluster, lambda ld, j=j: ld.register_job(j),
+                       timeout=3.0)
+            ctx.at_most_jobs.append(j.id)
+            ctx.job_ns[j.id] = self.abuse_ns
+            self._abuse_sent += 1
+
+    def finish(self, cluster, ctx):
+        from nomad_tpu.telemetry import global_metrics
+        for job_id in self._contended.values():
+            _wait_live(cluster, ctx, job_id, 2, timeout=45.0)
+        gate = {}
+        for ns in self.victim_ns:
+            m = global_metrics.take_sample(f"nomad.plan.submit.ns.{ns}")
+            solo = float((self._baseline.get(ns) or {}).get("p99") or 0.0)
+            limit = max(2.0 * solo, self.P99_FLOOR_MS)
+            p99 = float(m.get("p99") or 0.0)
+            gate[ns] = {"solo_p99_ms": round(solo, 2),
+                        "p99_ms": round(p99, 2),
+                        "count": m.get("count", 0),
+                        "limit_ms": round(limit, 2),
+                        "ok": p99 <= limit}
+        ctx.notes["victim_p99_gate"] = gate
+        ctx.notes["abuse_jobs_submitted"] = self._abuse_sent
+        ctx.notes["tenants_registered"] = self.TENANTS + self.VICTIMS + 1
+
+    @staticmethod
+    def _quota_problems(ld) -> List[str]:
+        from nomad_tpu.structs.namespace import alloc_quota_usage, usage_add
+        expect: Dict[str, Dict[str, int]] = {}
+        for a in ld.store.allocs():
+            if a.terminal_status():
+                continue
+            u = expect.setdefault(a.namespace, {
+                "cpu": 0, "memory_mb": 0, "devices": 0, "allocs": 0})
+            usage_add(u, alloc_quota_usage(a), +1)
+        expect = {ns: u for ns, u in expect.items() if any(u.values())}
+        actual = ld.store.quota_usages()
+        problems = [
+            f"{ns}: tracked {actual.get(ns)} != live {expect.get(ns)}"
+            for ns in sorted(set(expect) | set(actual))
+            if expect.get(ns) != actual.get(ns)]
+        for nso in ld.store.namespaces():
+            if not nso.quota:
+                continue
+            spec = ld.store.quota_spec(nso.quota)
+            u = actual.get(nso.name)
+            if spec is not None and u and not spec.admits(u):
+                problems.append(
+                    f"{nso.name}: usage {u} exceeds quota {nso.quota} "
+                    f"on {spec.exceeded_dims(u)}")
+        return problems
+
+    @staticmethod
+    def _leak_problems(ld) -> List[str]:
+        problems = []
+        for a in ld.store.allocs():
+            if a.terminal_status():
+                continue
+            job = ld.store.job_by_id(a.namespace, a.job_id)
+            if job is None:
+                problems.append(
+                    f"alloc {a.id[:8]}: no job {a.job_id} in namespace "
+                    f"{a.namespace!r}")
+            elif job.namespace != a.namespace:
+                problems.append(
+                    f"alloc {a.id[:8]}: job namespace {job.namespace!r} "
+                    f"!= alloc namespace {a.namespace!r}")
+        for e in ld.store.evals():
+            if EvalStatus.terminal(e.status):
+                continue
+            if ld.store.job_by_id(e.namespace, e.job_id) is None:
+                problems.append(
+                    f"eval {e.id[:8]}: no job {e.job_id} in namespace "
+                    f"{e.namespace!r}")
+        return problems
+
+    def check(self, cluster, ctx, timeout: float = 60.0) -> dict:
+        res = check_convergence(cluster, ctx, timeout=timeout)
+        ld = cluster.leader(timeout=10.0)
+        qprobs = lprobs = None
+        for attempt in range(3):
+            if attempt:
+                time.sleep(2.0)         # reviving nodes may still drain
+            qprobs = self._quota_problems(ld)
+            lprobs = self._leak_problems(ld)
+            if not qprobs and not lprobs:
+                break
+        res["invariants"]["quota_converged"] = {
+            "ok": not qprobs, "detail": qprobs[:8] or "clean"}
+        res["invariants"]["no_cross_ns_leakage"] = {
+            "ok": not lprobs, "detail": lprobs[:8] or "clean"}
+        gate = ctx.notes.get("victim_p99_gate") or {}
+        bad = [f"{ns}: p99 {g['p99_ms']}ms > limit {g['limit_ms']}ms"
+               for ns, g in gate.items() if not g["ok"]] \
+            if gate else ["no victim gate recorded"]
+        res["invariants"]["victim_p99_bounded"] = {
+            "ok": not bad, "detail": bad or "clean"}
+        res["converged"] = bool(res["converged"]) and not qprobs \
+            and not lprobs and not bad
+        return res
+
+
 class MultiRegionShape(Shape):
     """Federation under a WAN cut: two 3-server regions over one shared
     transport, WAN-gossip joined, running a sequential multiregion
@@ -1081,6 +1274,7 @@ SHAPES: Dict[str, Callable[[], Shape]] = {
     "serving_plane": ServingPlaneShape,
     "rolling_deploy": RollingDeployShape,
     "autoscale_ramp": AutoscaleRampShape,
+    "multi_tenant": MultiTenantShape,
     "multi_region": MultiRegionShape,
 }
 
@@ -1104,11 +1298,12 @@ def _alloc_problems(ld, ctx) -> List[str]:
     nodes = {n.id: n for n in ld.store.nodes()}
     for job_id in ctx.tracked_jobs():
         exact = job_id in ctx.exact_jobs
-        job = ld.store.job_by_id(ctx.namespace, job_id)
+        job_namespace = ctx.ns_of(job_id)
+        job = ld.store.job_by_id(job_namespace, job_id)
         if job is None:
             problems.append(f"{job_id}: job vanished")
             continue
-        live = _live(ld.store.allocs_by_job(ctx.namespace, job_id))
+        live = _live(ld.store.allocs_by_job(job_namespace, job_id))
         for tg in job.task_groups:
             glive = [a for a in live if a.task_group == tg.name]
             names = [a.name for a in glive]
@@ -1462,9 +1657,11 @@ SMOKE_CELLS = [
 # one-region cluster and lease_flap/server_replace add nothing the
 # single-cluster cells don't already cover
 ALL_CELLS = [(shape, schedule)
-             for shape in SHAPES if shape != "multi_region"
+             for shape in SHAPES
+             if shape not in ("multi_region", "multi_tenant")
              for schedule in SCHEDULES if schedule != "region_partition"] \
-    + [("multi_region", "storm"), ("multi_region", "region_partition")]
+    + [("multi_region", "storm"), ("multi_region", "region_partition")] \
+    + [("multi_tenant", "storm"), ("multi_tenant", "lease_flap")]
 
 
 def run_matrix(cells=None, seed: int = 1, out_dir: str = ".",

@@ -41,6 +41,7 @@ MAX_BATCH_SCHEDULE_ATTEMPTS = 2
 
 BLOCKED_EVAL_MAX_PLAN_DESC = "created due to placement conflicts"
 BLOCKED_EVAL_FAILED_PLACEMENT_DESC = "created to place remaining allocations"
+BLOCKED_EVAL_QUOTA_DESC = "created due to quota limit"
 
 
 class SetStatusError(Exception):
@@ -78,6 +79,19 @@ class GenericScheduler:
         while attempts < limit:
             done, made_progress = self._attempt()
             if done:
+                return
+            qname = self.plan_result.quota_limit_reached \
+                if self.plan_result is not None else ""
+            if qname:
+                # over-quota placements were dropped by the applier's
+                # quota filter; retrying cannot help until the namespace
+                # quota is raised or usage drains — block keyed on the
+                # quota so the spec-upsert hook releases this eval
+                blocked = self._make_blocked_eval(BLOCKED_EVAL_QUOTA_DESC)
+                blocked.quota_limit_reached = qname
+                self.planner.create_evals([blocked])
+                self.eval.queued_allocations = dict(self.queued_allocs)
+                self.eval.blocked_eval = blocked.id
                 return
             # a partial commit that made progress resets the retry budget
             # (reference retryMax's reset hook + progressMade, util.go:391-425)

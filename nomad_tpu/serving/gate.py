@@ -52,6 +52,7 @@ READ_METHODS = frozenset({
     "Deployment.List", "Deployment.GetDeployment",
     "CSIVolume.List", "CSIVolume.Get", "CSIPlugin.List", "CSIPlugin.Get",
     "Operator.SchedulerGetConfiguration",
+    "Namespace.List", "Quota.List", "Quota.GetQuota", "Quota.Usage",
     "Search.PrefixSearch",
     "Scaling.ListPolicies", "Scaling.GetPolicy",
     "Service.List", "Service.GetService",

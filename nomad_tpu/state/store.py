@@ -34,6 +34,8 @@ from nomad_tpu.structs import (
     SchedulerConfiguration,
 )
 from nomad_tpu.structs.evaluation import EvalTrigger
+from nomad_tpu.structs.namespace import (
+    Namespace, QuotaSpec, alloc_quota_usage, usage_add)
 from nomad_tpu.structs.node import NodeStatus, compute_node_class
 from nomad_tpu.structs.plan import Plan, PlanResult
 from nomad_tpu.utils import requires_lock
@@ -153,7 +155,7 @@ class StateStore:
         "_namespaces", "_acl_policies", "_acl_tokens", "_acl_by_secret",
         "_csi_volumes", "_csi_plugins", "_scaling_events", "_services",
         "_services_by_alloc", "_applied_plan_ids", "_applied_plan_ids_set",
-        "_snapshot_cache", "_live_names",
+        "_snapshot_cache", "_live_names", "_quota_specs", "_quota_usage",
     })
 
     def __init__(self):
@@ -178,9 +180,15 @@ class StateStore:
         self._evals_by_job: Dict[Tuple[str, str], Set[str]] = defaultdict(set)
         self.scheduler_config = SchedulerConfiguration()
         # namespaces table (reference nomad/state/schema.go namespaces)
-        self._namespaces: Dict[str, dict] = {
-            "default": {"name": "default",
-                        "description": "Default shared namespace"}}
+        self._namespaces: Dict[str, Namespace] = {
+            "default": Namespace(name="default",
+                                 description="Default shared namespace")}
+        # quota specs + replicated usage accounting.  Usage is maintained
+        # inside the same apply cone as `_live_names` (alloc liveness
+        # transitions) so every replica derives byte-identical tables;
+        # all-zero namespace entries are deleted for a canonical form.
+        self._quota_specs: Dict[str, QuotaSpec] = {}
+        self._quota_usage: Dict[str, Dict[str, int]] = {}
         # ACL tables (reference schema.go acl_policy / acl_token)
         self._acl_policies: Dict[str, object] = {}
         self._acl_tokens: Dict[str, object] = {}       # by accessor_id
@@ -601,6 +609,8 @@ class StateStore:
         self._allocs_by_node[a.node_id].discard(alloc_id)
         self._allocs_by_eval[a.eval_id].discard(alloc_id)
         self._live_name_unset(a)
+        if not a.terminal_status():
+            self._quota_usage_add(a.namespace, alloc_quota_usage(a), -1)
         self.matrix.remove_alloc(alloc_id)
 
     @requires_lock("_lock")
@@ -624,6 +634,15 @@ class StateStore:
         else:
             self._live_names.setdefault(
                 (a.namespace, a.job_id, a.name), set()).add(a.id)
+        # quota usage rides the same liveness transition as _live_names:
+        # decrement with the PREVIOUS copy's resources (an in-place
+        # update may have changed them), increment with the new one
+        prior_live = prev is not None and not prev.terminal_status()
+        new_live = not a.terminal_status()
+        if prior_live:
+            self._quota_usage_add(prev.namespace, alloc_quota_usage(prev), -1)
+        if new_live:
+            self._quota_usage_add(a.namespace, alloc_quota_usage(a), +1)
         self.matrix.upsert_alloc(a)
         self._update_summary(a, prev)
 
@@ -762,10 +781,14 @@ class StateStore:
 
     # ------------------------------------------------------------ namespaces
 
-    def upsert_namespace(self, index: int, name: str, description: str = "") -> None:
+    def upsert_namespace(self, index: int, name: str, description: str = "",
+                         quota: str = "") -> None:
         with self._lock:
-            self._namespaces[name] = {"name": name,
-                                      "description": description}
+            existing = self._namespaces.get(name)
+            ns = Namespace(name=name, description=description, quota=quota)
+            ns.create_index = existing.create_index if existing else index
+            ns.modify_index = index
+            self._namespaces[name] = ns
             self._bump(index)
 
     def delete_namespace(self, index: int, name: str) -> None:
@@ -778,9 +801,78 @@ class StateStore:
             self._namespaces.pop(name, None)
             self._bump(index)
 
-    def namespaces(self) -> List[dict]:
+    def namespaces(self) -> List[Namespace]:
         with self._lock:
             return list(self._namespaces.values())
+
+    def namespace(self, name: str) -> Optional[Namespace]:
+        with self._lock:
+            return self._namespaces.get(name)
+
+    # ------------------------------------------------------------ quotas
+
+    @requires_lock("_lock")
+    def _quota_usage_add(self, namespace: str, vec: Dict[str, int],
+                         sign: int) -> None:
+        """Canonical-form usage accounting: an entry is either absent or
+        a full {cpu, memory_mb, devices, allocs} dict, created with a
+        fixed key order, deleted when it returns to all-zero — so the
+        table is byte-identical across replicas that applied the same
+        log, independent of the path taken."""
+        u = self._quota_usage.get(namespace)
+        if u is None:
+            u = self._quota_usage[namespace] = {
+                "cpu": 0, "memory_mb": 0, "devices": 0, "allocs": 0}
+        usage_add(u, vec, sign)
+        if not any(u.values()):
+            del self._quota_usage[namespace]
+
+    @requires_lock("_lock")
+    def _quota_admits_locked(self, a: Allocation) -> Tuple[bool, str]:
+        """Would placing `a` keep its namespace inside its quota?
+        Returns (admitted, quota_spec_name)."""
+        ns = self._namespaces.get(a.namespace)
+        if ns is None or not ns.quota:
+            return True, ""
+        spec = self._quota_specs.get(ns.quota)
+        if spec is None:
+            return True, ""
+        would = dict(self._quota_usage.get(a.namespace) or {})
+        usage_add(would, alloc_quota_usage(a), +1)
+        return spec.admits(would), ns.quota
+
+    def upsert_quota_spec(self, index: int, spec: QuotaSpec) -> None:
+        with self._lock:
+            existing = self._quota_specs.get(spec.name)
+            spec.create_index = existing.create_index if existing else index
+            spec.modify_index = index
+            self._quota_specs[spec.name] = spec
+            self._bump(index)
+
+    def delete_quota_spec(self, index: int, name: str) -> None:
+        with self._lock:
+            for ns in self._namespaces.values():
+                if ns.quota == name:
+                    raise ValueError(
+                        f"quota {name!r} referenced by namespace {ns.name!r}")
+            self._quota_specs.pop(name, None)
+            self._bump(index)
+
+    def quota_spec(self, name: str) -> Optional[QuotaSpec]:
+        with self._lock:
+            return self._quota_specs.get(name)
+
+    def quota_specs(self) -> List[QuotaSpec]:
+        with self._lock:
+            return list(self._quota_specs.values())
+
+    def quota_usage(self, namespace: str) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._quota_usage.get(namespace) or {})
+
+    def quota_usages(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {ns: dict(u) for ns, u in self._quota_usage.items()}
 
     # ------------------------------------------------------------ ACL
 
@@ -1022,6 +1114,20 @@ class StateStore:
                            for o in (self._allocs.get(i)
                                      for i in holders)):
                         continue
+                # quota guard: the authoritative, replica-deterministic
+                # admission check.  The applier already checked at propose
+                # time against its overlay, but two leaders across a churn
+                # window can each propose within-budget plans that only
+                # overflow combined — the log serializes them and the
+                # SECOND one is dropped here, identically on every
+                # replica.  Stops in this same plan applied above
+                # (alloc_updates), so same-plan frees are counted.
+                admitted, quota_name = self._quota_admits_locked(a)
+                if not admitted:
+                    # pre-quota pickles lack the attr; drop silently then
+                    getattr(result, "quota_dropped", []).append(
+                        (a.id, quota_name))
+                    continue
             self._insert_alloc(index, a)
             self._take_csi_claims_for_alloc(index, a)
             touched.append(a)
@@ -1109,6 +1215,9 @@ class AppliedPlanResults:
         self.deployment_updates = deployment_updates or []
         self.eval_id = eval_id
         self.plan_id = plan_id
+        # filled by the FSM when the authoritative quota check drops a
+        # placement: [(alloc_id, quota_spec_name)]
+        self.quota_dropped: list = []
 
 
 def _shallow_copy_node(node: Node) -> Node:

@@ -72,5 +72,10 @@ from nomad_tpu.structs.deployment import (
     DeploymentStatus,
 )
 from nomad_tpu.structs.config import SchedulerConfiguration
+from nomad_tpu.structs.namespace import (
+    Namespace,
+    QuotaSpec,
+    alloc_quota_usage,
+)
 
 __all__ = [k for k in dir() if not k.startswith("_")]

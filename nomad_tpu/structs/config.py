@@ -5,6 +5,7 @@ stack-build time.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Dict
 
 
 SCHEDULER_ALGORITHM_BINPACK = "binpack"
@@ -26,6 +27,14 @@ class SchedulerConfiguration:
     memory_oversubscription_enabled: bool = False
     reject_job_registration: bool = False
     pause_eval_broker: bool = False
+    # weighted fair-share dequeue in the eval broker: per-namespace
+    # stride scheduling over `namespace_weights` (unlisted namespaces
+    # get `default_namespace_weight`).  With a single namespace (or
+    # uniform weights) the dequeue order is indistinguishable from the
+    # global (-priority, seq) order, so enabled-by-default is safe.
+    fair_dequeue_enabled: bool = True
+    default_namespace_weight: int = 1
+    namespace_weights: Dict[str, int] = field(default_factory=dict)
     create_index: int = 0
     modify_index: int = 0
 

@@ -100,6 +100,12 @@ class PlanResult:
     rejected_nodes: List[str] = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
+    # set when placements were dropped by the namespace quota check:
+    # the QuotaSpec name that was exhausted.  The scheduler blocks the
+    # eval keyed on this quota instead of burning plan retries — an
+    # over-quota placement only becomes feasible when the quota is
+    # raised or live allocs stop.
+    quota_limit_reached: str = ""
 
     def full_commit(self, plan: Plan) -> tuple:
         """Reference PlanResult.FullCommit: (full, expected, actual) placements."""

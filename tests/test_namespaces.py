@@ -1,0 +1,199 @@
+"""Namespace tests (reference analogs: nomad/state/state_store_test.go
+namespace cases, nomad/namespace_endpoint_test.go, api/namespace_test.go):
+replicated CRUD, namespace-scoped job IDs, list threading + wildcard,
+and unknown-namespace rejection at both RPC and HTTP layers."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import ApiClient, ApiError
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.rpc.endpoints import RpcError
+from nomad_tpu.state import StateStore
+
+
+# ------------------------------------------------------------------ store
+
+def test_store_seeds_default_namespace():
+    store = StateStore()
+    names = [ns.name for ns in store.namespaces()]
+    assert names == ["default"]
+
+
+def test_store_namespace_crud():
+    store = StateStore()
+    store.upsert_namespace(1, "team-a", description="team a")
+    ns = store.namespace("team-a")
+    assert ns is not None and ns.description == "team a"
+    assert ns.create_index == 1 and ns.modify_index == 1
+    # upsert preserves create_index
+    store.upsert_namespace(2, "team-a", description="renamed")
+    ns = store.namespace("team-a")
+    assert ns.create_index == 1 and ns.modify_index == 2
+    assert ns.description == "renamed"
+    store.delete_namespace(3, "team-a")
+    assert store.namespace("team-a") is None
+
+
+def test_store_default_namespace_undeletable():
+    store = StateStore()
+    with pytest.raises(ValueError):
+        store.delete_namespace(1, "default")
+
+
+def test_store_namespace_with_jobs_undeletable():
+    store = StateStore()
+    store.upsert_namespace(1, "busy")
+    j = mock.job()
+    j.namespace = "busy"
+    store.upsert_job(2, j)
+    with pytest.raises(ValueError):
+        store.delete_namespace(3, "busy")
+
+
+def test_namespace_scoped_job_ids():
+    """The same job ID coexists in two namespaces without collision."""
+    store = StateStore()
+    store.upsert_namespace(1, "a")
+    store.upsert_namespace(2, "b")
+    ja = mock.job(id="shared-id")
+    ja.namespace = "a"
+    jb = mock.job(id="shared-id")
+    jb.namespace = "b"
+    store.upsert_job(3, ja)
+    store.upsert_job(4, jb)
+    assert store.job_by_id("a", "shared-id") is ja
+    assert store.job_by_id("b", "shared-id") is jb
+    store.delete_job(5, "a", "shared-id")
+    assert store.job_by_id("a", "shared-id") is None
+    assert store.job_by_id("b", "shared-id") is jb
+
+
+# ------------------------------------------------------------------ server
+
+def test_server_namespace_replicated_crud():
+    s = Server(ServerConfig(num_schedulers=0))
+    s.start()
+    try:
+        s.upsert_namespace("team-a", description="a", quota="")
+        assert {ns.name for ns in s.namespaces()} == {"default", "team-a"}
+        s.delete_namespace("team-a")
+        assert {ns.name for ns in s.namespaces()} == {"default"}
+    finally:
+        s.stop()
+
+
+def test_register_job_unknown_namespace_names_known_set():
+    s = Server(ServerConfig(num_schedulers=0))
+    s.start()
+    try:
+        s.upsert_namespace("known-ns")
+        j = mock.job()
+        j.namespace = "nope"
+        with pytest.raises(RpcError) as e:
+            s.register_job(j)
+        assert "nope" in str(e.value)
+        assert "known-ns" in str(e.value)      # error names the known set
+    finally:
+        s.stop()
+
+
+def test_namespace_quota_must_exist():
+    s = Server(ServerConfig(num_schedulers=0))
+    s.start()
+    try:
+        with pytest.raises((RpcError, ValueError)):
+            s.upsert_namespace("team-a", quota="missing-spec")
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------------------ http
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(http_port=0, num_schedulers=2,
+                          heartbeat_ttl=60.0))
+    a.start()
+    for _ in range(4):
+        a.server.register_node(mock.node())
+    yield a
+    a.stop()
+
+
+@pytest.fixture(scope="module")
+def api(agent):
+    return ApiClient(agent.http_addr)
+
+
+def test_http_namespace_crud(api):
+    api.namespaces.register("team-http", description="via http")
+    names = {ns["name"] for ns in api.namespaces.list()}
+    assert {"default", "team-http"} <= names
+    info = api.namespaces.info("team-http")
+    assert info["description"] == "via http"
+    with pytest.raises(ApiError) as e:
+        api.namespaces.info("ghost")
+    assert e.value.status == 404
+    api.namespaces.delete("team-http")
+    assert "team-http" not in {ns["name"] for ns in api.namespaces.list()}
+
+
+def test_http_list_threading_and_wildcard(api, agent):
+    api.namespaces.register("team-a")
+    api.namespaces.register("team-b")
+    ja = mock.job(id="ns-threaded-job")
+    ja.namespace = "team-a"
+    ja.task_groups[0].count = 2
+    jb = mock.job(id="ns-threaded-job")
+    jb.namespace = "team-b"
+    jb.task_groups[0].count = 2
+    api.jobs.register(ja)
+    api.jobs.register(jb)
+    agent.server.wait_for_idle(10.0)
+
+    a_client = ApiClient(agent.http_addr, namespace="team-a")
+    a_jobs = a_client.jobs.list()
+    assert [j["ID"] for j in a_jobs] == ["ns-threaded-job"]
+    assert all(j["Namespace"] == "team-a" for j in a_jobs)
+
+    # wildcard sees both copies
+    star = ApiClient(agent.http_addr, namespace="*")
+    star_jobs = [j for j in star.jobs.list()
+                 if j["ID"] == "ns-threaded-job"]
+    assert {j["Namespace"] for j in star_jobs} == {"team-a", "team-b"}
+
+    # evals and allocs thread the same parameter
+    a_evals = a_client.evaluations.list()
+    assert a_evals and all(e.namespace == "team-a" for e in a_evals)
+    a_allocs = a_client.allocations.list()
+    assert a_allocs and all(
+        al["Namespace"] == "team-a" for al in a_allocs)
+
+    # default-namespace view is not polluted
+    assert "ns-threaded-job" not in [j["ID"] for j in api.jobs.list()]
+
+
+def test_http_unknown_namespace_rejected_naming_known(api):
+    bogus = ApiClient(api.address, namespace="no-such-ns")
+    with pytest.raises(ApiError) as e:
+        bogus.jobs.list()
+    assert e.value.status == 400
+    assert "no-such-ns" in str(e.value)
+    assert "default" in str(e.value)           # names the known set
+
+
+# ------------------------------------------------------------------ cli
+
+def test_cli_namespace_flag_and_env(monkeypatch):
+    from nomad_tpu.command.cli import build_parser
+    p = build_parser()
+    args = p.parse_args(["-namespace", "team-a", "job", "status"])
+    assert args.namespace == "team-a"
+    # the quota-usage positional must not clobber the global flag
+    args = p.parse_args(["-namespace", "team-a", "quota", "usage"])
+    assert args.namespace == "team-a" and not args.usage_ns
+    # env default is captured at parser build time, like NOMAD_REGION
+    monkeypatch.setenv("NOMAD_NAMESPACE", "from-env")
+    args = build_parser().parse_args(["job", "status"])
+    assert args.namespace == "from-env"

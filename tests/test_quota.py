@@ -1,0 +1,403 @@
+"""Quota + fair-dequeue tests (reference analogs: nomad/state quota
+cases, nomad/eval_broker_test.go fairness extension, blocked_evals
+quota-keyed unblock).  Covers: usage accounting canonical form, the
+FSM-side double-admit guard (leader-churn regression), the propose-side
+quota filter, quota-blocked eval release on spec raise (including the
+missed-unblock race), stride fair dequeue + starvation bound, and the
+live-tunable SchedulerConfiguration knobs.  The broker stress test here
+is the CI `race` leg's fair-dequeue payload."""
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.core.blocked import BlockedEvals
+from nomad_tpu.core.broker import EvalBroker
+from nomad_tpu.core.plan_apply import PlanApplier
+from nomad_tpu.core.server import Server, ServerConfig
+from nomad_tpu.state import StateStore
+from nomad_tpu.state.store import AppliedPlanResults
+from nomad_tpu.structs import QuotaSpec, alloc_quota_usage
+from nomad_tpu.structs.config import SchedulerConfiguration
+from nomad_tpu.structs.plan import Plan
+
+
+def _wait(cond, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ------------------------------------------------------------------ spec
+
+def test_quota_spec_admits_at_limit():
+    spec = QuotaSpec(name="s", cpu=1000, allocs=4)
+    assert spec.admits({"cpu": 1000, "allocs": 4})       # at-limit admits
+    assert not spec.admits({"cpu": 1001, "allocs": 4})
+    assert not spec.admits({"cpu": 0, "allocs": 5})
+    # unset dimensions are unlimited
+    assert spec.admits({"memory_mb": 10**9, "cpu": 1000, "allocs": 0})
+    assert spec.exceeded_dims({"cpu": 1001, "allocs": 5}) == \
+        ["cpu", "allocs"]
+
+
+# ------------------------------------------------------------------ store
+
+def _capped_store(alloc_limit=1, index=1):
+    store = StateStore()
+    store.upsert_quota_spec(index, QuotaSpec(name="small",
+                                             allocs=alloc_limit))
+    store.upsert_namespace(index + 1, "capped", quota="small")
+    return store
+
+
+def test_store_usage_accounting_canonical():
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(1, n)
+    j = mock.job()
+    store.upsert_job(2, j)
+    a = mock.alloc_for(j, n.id)
+    store.upsert_allocs(3, [a])
+    expect = alloc_quota_usage(a)
+    assert store.quota_usage("default") == expect
+    assert expect["allocs"] == 1 and expect["cpu"] > 0
+    # terminal transition releases usage, and the all-zero entry is
+    # dropped entirely (canonical form: byte-identical across replicas)
+    stop = mock.alloc_for(j, n.id)
+    stop.id = a.id
+    stop.client_status = "failed"
+    store.upsert_allocs(4, [stop])
+    assert store.quota_usages() == {}
+
+
+def test_store_quota_spec_crud_and_referenced_delete():
+    store = _capped_store()
+    assert [s.name for s in store.quota_specs()] == ["small"]
+    with pytest.raises(ValueError):
+        store.delete_quota_spec(5, "small")    # referenced by "capped"
+    store.upsert_namespace(6, "capped", quota="")
+    store.delete_quota_spec(7, "small")
+    assert store.quota_specs() == []
+
+
+def test_fsm_quota_guard_drops_double_admit():
+    """Leader-churn regression: two leaders each propose a within-budget
+    plan that only overflows combined.  The log serializes them; the
+    second one's placements must be dropped by the replica-deterministic
+    FSM-side check — identically on every replica."""
+    store = _capped_store(alloc_limit=1)
+    n = mock.node()
+    store.upsert_node(3, n)
+    j = mock.job()
+    j.namespace = "capped"
+    store.upsert_job(4, j)
+    a1 = mock.alloc_for(j, n.id, index=0)
+    a2 = mock.alloc_for(j, n.id, index=1)
+    a1.namespace = a2.namespace = "capped"
+    r1 = AppliedPlanResults(allocs_to_place=[a1], plan_id="p1")
+    r2 = AppliedPlanResults(allocs_to_place=[a2], plan_id="p2")
+    store.upsert_plan_results(5, r1)
+    store.upsert_plan_results(6, r2)
+    assert r1.quota_dropped == []
+    assert r2.quota_dropped == [(a2.id, "small")]
+    live = [a for a in store.allocs_by_job("capped", j.id)
+            if not a.terminal_status()]
+    assert [a.id for a in live] == [a1.id]
+    assert store.quota_usage("capped")["allocs"] == 1
+
+
+def test_fsm_quota_guard_counts_same_plan_frees():
+    """A plan that stops one alloc and places its replacement stays
+    within an allocs=1 quota: stops apply before the admission check."""
+    store = _capped_store(alloc_limit=1)
+    n = mock.node()
+    store.upsert_node(3, n)
+    j = mock.job()
+    j.namespace = "capped"
+    store.upsert_job(4, j)
+    a1 = mock.alloc_for(j, n.id, index=0)
+    a1.namespace = "capped"
+    store.upsert_plan_results(
+        5, AppliedPlanResults(allocs_to_place=[a1], plan_id="p1"))
+    stop = mock.alloc_for(j, n.id, index=0)
+    stop.id, stop.namespace = a1.id, "capped"
+    stop.desired_status = "stop"
+    stop.client_status = "complete"
+    a2 = mock.alloc_for(j, n.id, index=1)
+    a2.namespace = "capped"
+    r = AppliedPlanResults(alloc_updates=[stop], allocs_to_place=[a2],
+                           plan_id="p2")
+    store.upsert_plan_results(6, r)
+    assert r.quota_dropped == []
+    assert store.quota_usage("capped")["allocs"] == 1
+
+
+# ------------------------------------------------------------------ applier
+
+def test_plan_applier_quota_filter_drops_and_marks():
+    store = _capped_store(alloc_limit=1)
+    n = mock.node()
+    store.upsert_node(3, n)
+    j = mock.job()
+    j.namespace = "capped"
+    store.upsert_job(4, j)
+    applier = PlanApplier(store)
+    a1 = mock.alloc_for(j, n.id, index=0)
+    a2 = mock.alloc_for(j, n.id, index=1)
+    a1.namespace = a2.namespace = "capped"
+    plan = Plan(eval_id="e1", job=j)
+    plan.append_alloc(a1, j)
+    plan.append_alloc(a2, j)
+    result = applier.apply(plan)
+    placed = [a.id for allocs in result.node_allocation.values()
+              for a in allocs]
+    assert len(placed) == 1
+    assert result.quota_limit_reached == "small"
+    full, expected, actual = result.full_commit(plan)
+    assert not full and expected == 2 and actual == 1
+    # a second plan for the other placement is now fully over quota
+    plan2 = Plan(eval_id="e2", job=j)
+    a3 = mock.alloc_for(j, n.id, index=1)
+    a3.namespace = "capped"
+    plan2.append_alloc(a3, j)
+    result2 = applier.apply(plan2)
+    assert result2.quota_limit_reached == "small"
+    assert not any(result2.node_allocation.values())
+
+
+# ------------------------------------------------------------------ blocked
+
+def make_broker():
+    b = EvalBroker(nack_timeout=5.0, initial_nack_delay=0.0,
+                   subsequent_nack_delay=0.0)
+    b.set_enabled(True)
+    return b
+
+
+def test_blocked_quota_keyed_unblock():
+    b = make_broker()
+    blocked = BlockedEvals(b)
+    blocked.set_enabled(True)
+    ev = mock.eval()
+    ev.status = "blocked"
+    ev.quota_limit_reached = "small"
+    blocked.block(ev)
+    assert blocked.blocked_count() == 1
+    # raising an unrelated quota releases nothing
+    assert blocked.unblock_quota("other", 10) == []
+    released = blocked.unblock_quota("small", 11)
+    assert [e.id for e in released] == [ev.id]
+    assert b.ready_count() == 1
+    assert blocked.blocked_count() == 0
+
+
+def test_blocked_quota_missed_unblock_requeues():
+    """Regression: a quota raise that lands between the eval's snapshot
+    and its block() call must requeue the eval immediately — parking it
+    would strand the job until the NEXT quota change."""
+    b = make_broker()
+    blocked = BlockedEvals(b)
+    blocked.set_enabled(True)
+    blocked.unblock_quota("small", index=100)   # raise, nothing parked
+    ev = mock.eval()
+    ev.status = "blocked"
+    ev.quota_limit_reached = "small"
+    ev.snapshot_index = 50                      # planned before the raise
+    blocked.block(ev)
+    assert blocked.blocked_count() == 0
+    assert b.ready_count() == 1                 # requeued, not parked
+    # an eval that already saw the raise parks normally
+    ev2 = mock.eval()
+    ev2.status = "blocked"
+    ev2.quota_limit_reached = "small"
+    ev2.snapshot_index = 200
+    blocked.block(ev2)
+    assert blocked.blocked_count() == 1
+    assert b.ready_count() == 1
+
+
+# ------------------------------------------------------------------ server
+
+def test_server_quota_end_to_end_block_and_raise():
+    s = Server(ServerConfig(num_schedulers=2))
+    s.start()
+    try:
+        s.register_node(mock.node())
+        s.upsert_quota_spec(QuotaSpec(name="small", allocs=1))
+        s.upsert_namespace("capped", quota="small")
+        j = mock.job()
+        j.namespace = "capped"
+        j.task_groups[0].count = 3
+        s.register_job(j)
+
+        def live():
+            return [a for a in s.store.allocs_by_job("capped", j.id)
+                    if not a.terminal_status()]
+        assert _wait(lambda: len(live()) == 1)
+        assert _wait(lambda: s.blocked_evals.blocked_count() == 1)
+        assert s.store.quota_usage("capped")["allocs"] == 1
+        # quota raise releases the blocked eval and the rest places
+        s.upsert_quota_spec(QuotaSpec(name="small", allocs=3))
+        assert _wait(lambda: len(live()) == 3)
+        assert s.store.quota_usage("capped")["allocs"] == 3
+    finally:
+        s.stop()
+
+
+def test_server_delete_quota_spec_referenced_rejected():
+    from nomad_tpu.rpc.endpoints import RpcError
+    s = Server(ServerConfig(num_schedulers=0))
+    s.start()
+    try:
+        s.upsert_quota_spec(QuotaSpec(name="small", allocs=1))
+        s.upsert_namespace("capped", quota="small")
+        with pytest.raises((RpcError, ValueError)):
+            s.delete_quota_spec("small")
+        s.upsert_namespace("capped", quota="")
+        s.delete_quota_spec("small")
+        assert s.quota_specs() == []
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------------------------ fairness
+
+def _drain(b, n, ack=True):
+    got = []
+    for _ in range(n):
+        ev, token = b.dequeue(["service"])
+        if ev is None:
+            break
+        got.append(ev)
+        if ack:
+            b.ack(ev.id, token)
+    return got
+
+
+def test_fair_dequeue_alternates_namespaces():
+    b = make_broker()
+    for i in range(4):
+        b.enqueue(mock.eval(namespace="heavy", job_id=f"h{i}"))
+    for i in range(2):
+        b.enqueue(mock.eval(namespace="light", job_id=f"l{i}"))
+    order = [e.namespace for e in _drain(b, 6)]
+    assert order[:4] == ["heavy", "light", "heavy", "light"]
+    assert order[4:] == ["heavy", "heavy"]
+
+
+def test_fair_dequeue_respects_weights():
+    b = make_broker()
+    cfg = SchedulerConfiguration()
+    cfg.namespace_weights = {"paid": 3}
+    b.set_fair_config(cfg)
+    for i in range(6):
+        b.enqueue(mock.eval(namespace="paid", job_id=f"p{i}"))
+    for i in range(6):
+        b.enqueue(mock.eval(namespace="free", job_id=f"f{i}"))
+    first8 = [e.namespace for e in _drain(b, 8)]
+    assert first8.count("paid") == 6     # stride 1000/3 vs 1000
+    assert first8.count("free") == 2
+
+
+def test_fair_dequeue_disabled_is_global_fifo():
+    b = make_broker()
+    cfg = SchedulerConfiguration()
+    cfg.fair_dequeue_enabled = False
+    b.set_fair_config(cfg)
+    evs = [mock.eval(namespace=f"ns{i % 3}", job_id=f"j{i}")
+           for i in range(9)]
+    for e in evs:
+        b.enqueue(e)
+    got = [e.id for e in _drain(b, 9)]
+    assert got == [e.id for e in evs]    # pure (-priority, seq) order
+
+
+def test_fair_dequeue_starvation_bound():
+    """A namespace arriving late is served within one full round of the
+    runnable set: its pass floors to the runnable minimum (sleeping
+    banks no credit) so at most every current head precedes it once."""
+    b = make_broker()
+    heavies = [f"bulk{i}" for i in range(10)]
+    for ns in heavies:
+        for i in range(20):
+            b.enqueue(mock.eval(namespace=ns, job_id=f"{ns}-{i}"))
+    _drain(b, 50)                        # advance the bulk passes
+    b.enqueue(mock.eval(namespace="victim", job_id="v0"))
+    tail = [e.namespace for e in _drain(b, len(heavies) + 1)]
+    assert "victim" in tail
+    st = b.fair_stats()
+    assert st["enabled"] and st["picks"] > 0
+
+
+def test_fair_dequeue_sleeper_banks_no_credit():
+    b = make_broker()
+    for i in range(10):
+        b.enqueue(mock.eval(namespace="busy", job_id=f"b{i}"))
+    _drain(b, 6)
+    b.enqueue(mock.eval(namespace="sleeper", job_id="s0"))
+    # the sleeper gets its fair next slot, not a 6-deep repayment burst
+    order = [e.namespace for e in _drain(b, 4)]
+    assert order.count("sleeper") == 1
+
+
+def test_scheduler_config_tunes_broker_live():
+    from nomad_tpu.raft.fsm import MessageType
+    s = Server(ServerConfig(num_schedulers=0))
+    s.start()
+    try:
+        assert s.broker.fair_stats()["enabled"]
+        cfg = SchedulerConfiguration()
+        cfg.fair_dequeue_enabled = False
+        cfg.default_namespace_weight = 7
+        cfg.namespace_weights = {"paid": 3}
+        s.apply(MessageType.SCHEDULER_CONFIG, {"config": cfg})
+        assert _wait(lambda: not s.broker.fair_stats()["enabled"], 5.0)
+        st = s.broker.fair_stats()
+        assert st["default_weight"] == 7
+        assert st["weights"] == {"paid": 3}
+    finally:
+        s.stop()
+
+
+def test_fair_dequeue_concurrent_stress():
+    """CI race-leg payload: concurrent multi-namespace enqueue against a
+    pool of dequeue+ack consumers; every eval is served exactly once."""
+    b = EvalBroker(nack_timeout=10.0, initial_nack_delay=0.0,
+                   subsequent_nack_delay=0.0)
+    b.set_enabled(True)
+    total = 200
+    served = set()
+    lock = threading.Lock()
+
+    def produce(ns, count):
+        for i in range(count):
+            b.enqueue(mock.eval(namespace=ns, job_id=f"{ns}-{i}"))
+
+    def consume():
+        while True:
+            with lock:
+                if len(served) >= total:
+                    return
+            ev, token = b.dequeue(["service"], timeout=0.2)
+            if ev is None:
+                continue
+            b.ack(ev.id, token)
+            with lock:
+                served.add(ev.id)
+
+    producers = [threading.Thread(target=produce, args=(f"ns{i}", 50))
+                 for i in range(4)]
+    consumers = [threading.Thread(target=consume) for _ in range(4)]
+    for t in producers + consumers:
+        t.start()
+    for t in producers:
+        t.join(10.0)
+    for t in consumers:
+        t.join(30.0)
+    assert len(served) == total
+    assert b.ready_count() == 0

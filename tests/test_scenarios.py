@@ -30,15 +30,19 @@ from nomad_tpu.scenarios import (
 
 def test_matrix_covers_every_shape_schedule_pair():
     # the core product: every single-cluster shape crossed with every
-    # single-cluster schedule; the federated shape rides exactly its two
-    # first-class cells (region_partition is multi_region-only)
-    core_shapes = [sh for sh in SHAPES if sh != "multi_region"]
+    # single-cluster schedule; the federated and multi-tenant shapes
+    # ride exactly their first-class cells (region_partition is
+    # multi_region-only; multi_tenant gates storm + lease_flap)
+    core_shapes = [sh for sh in SHAPES
+                   if sh not in ("multi_region", "multi_tenant")]
     core_scheds = [sc for sc in SCHEDULES if sc != "region_partition"]
     expected = {(sh, sc) for sh in core_shapes for sc in core_scheds}
     expected |= {("multi_region", "storm"),
                  ("multi_region", "region_partition")}
+    expected |= {("multi_tenant", "storm"),
+                 ("multi_tenant", "lease_flap")}
     assert set(ALL_CELLS) == expected
-    assert len(ALL_CELLS) == len(expected) == 23
+    assert len(ALL_CELLS) == len(expected) == 25
     # no duplicate cells
     assert len(ALL_CELLS) == len(set(ALL_CELLS))
 

@@ -299,3 +299,44 @@ def test_federation_hop_preserves_trace_id(tracer):
         assert g.store.job_by_id("default", j.id) is None
     finally:
         fc.stop()
+
+
+def test_spans_carry_no_token_material(tracer, monkeypatch):
+    """Multi-tenant guarantee: ACL secrets never land in span names,
+    nodes, or attrs — whether the token arrives via the X-Nomad-Token
+    header or the ?token= query fallback.  A leaked secret in the trace
+    plane would hand every operator with read access to /v1/traces a
+    management credential."""
+    import json as _json
+
+    from nomad_tpu.agent import Agent, AgentConfig
+    from nomad_tpu.api import ApiClient
+
+    monkeypatch.setenv("NOMAD_TPU_ACL", "1")
+    a = Agent(AgentConfig(http_port=0, num_schedulers=2,
+                          heartbeat_ttl=60.0))
+    a.start()
+    try:
+        a.server.register_node(mock.node())
+        boot = a.server.bootstrap_acl()
+        secret = boot.secret_id
+        api = ApiClient(a.http_addr, token=secret)
+        j = mock.job()
+        j.task_groups[0].count = 1
+        api.jobs.register(j)
+        a.server.wait_for_idle(10.0)
+        # query-param token path (the header-less fallback)
+        bare = ApiClient(a.http_addr)
+        bare.get(f"/v1/jobs?token={secret}")
+        bare.put(f"/v1/namespaces?token={secret}",
+                 {"Name": "traced-ns"})
+        assert _wait(lambda: len(tracer.spans()) > 5)
+
+        blob = _json.dumps([s.to_dict() for s in tracer.spans()])
+        assert secret not in blob
+        # accessor ids are not secrets, but the secret must not appear
+        # in any recorded eval notes either
+        assert all(secret not in str(v)
+                   for v in tracer._eval_notes.values())
+    finally:
+        a.stop()
