@@ -1,30 +1,51 @@
 """nomad_tpu.analysis — static + runtime invariant analysis plane.
 
-Eight checkers over the repo tree (stdlib-only; never imports the code
-it analyzes, so this runs without jax/numpy installed):
+Eleven invariant checkers plus the suppression audit, all over the repo
+tree (stdlib-only; never imports the code it analyzes, so this runs
+without jax/numpy installed):
 
-    fsm-determinism   no wall-clock/entropy/set-iteration in the raft
-                      FSM apply cone
-    lock-discipline   declared lock-protected attrs only touched under
-                      their lock or in @requires_lock methods
-    native-abi        ctypes bindings match the extern "C" prototypes
-                      and the abi version gate
-    jax-purity        no host escapes / tracer branching in jitted
-                      kernels
-    chaos-coverage    chaos registry and injection sites agree (incl.
-                      chaos.REQUIRED_SITES pinning points to functions)
-    transfer-purity   no implicit host<->device transfers in declared
-                      hot-path modules (_TRANSFER_HOT_PATH)
-    recompile-budget  every jit site in _RECOMPILE_TRACKED modules is
-                      registered with the recompile registry
-    happens-before    _RACE_TRACED declarations and race.read/write
-                      hooks agree (the vector-clock detector is the
-                      runtime half)
+    fsm-determinism        no wall-clock/entropy/set-iteration in the
+                           raft FSM apply cone
+    lock-discipline        declared lock-protected attrs only touched
+                           under their lock or in @requires_lock methods
+    native-abi             ctypes bindings match the extern "C"
+                           prototypes and the abi version gate
+    jax-purity             no host escapes / tracer branching in jitted
+                           kernels
+    chaos-coverage         chaos registry and injection sites agree
+                           (incl. chaos.REQUIRED_SITES pinning points
+                           to functions)
+    transfer-purity        no implicit host<->device transfers in
+                           declared hot-path modules (_TRANSFER_HOT_PATH)
+    recompile-budget       every jit site in _RECOMPILE_TRACKED modules
+                           is registered with the recompile registry
+    happens-before         _RACE_TRACED declarations and race.read/write
+                           hooks agree (the vector-clock detector is the
+                           runtime half)
+    snapshot-completeness  every store table the FSM apply cone mutates
+                           round-trips through snapshot persist AND
+                           restore, and restore rebuilds derived rows
+                           through the same _SNAPSHOT_DERIVED builders
+                           the apply path uses
+    canonical-form         values flowing into replicated state stay
+                           byte-identical across peers: no set-order
+                           payloads, id()-keyed rows, order-sensitive
+                           float accumulation, or defaultdict
+                           read-materialization on persisted tables
+    wait-graph             static lock-acquisition graph (merged with
+                           the runtime LockOrderRecorder corpus):
+                           cycles, and locks held across blocking calls
+                           not declared _LOCK_BLOCKING_OK
+    allow-audit            every `# analysis: allow(...)` carries a
+                           stated reason and suppressed something this
+                           run (dead suppressions are findings)
 
-Run: `python -m nomad_tpu.analysis [--json] [--checker NAME] [--root D]`
-Suppress: `# analysis: allow(checker-name)` on the finding's line or the
-enclosing `def` line.  The runtime halves — lock-order recorder
-(`lock_order`), vector-clock race detector (`race.RaceDetector`,
+Run: `python -m nomad_tpu.analysis [--json] [--checker NAME]
+[--checkers a,b] [--lock-corpus DUMP.json] [--root D]`
+Suppress: `# analysis: allow(checker-name) — reason` on the finding's
+line or the enclosing `def` line.  The runtime halves — lock-order
+recorder (`lock_order`, `NOMAD_TPU_LOCK_ORDER=1`, dumps the corpus
+wait-graph merges), vector-clock race detector (`race.RaceDetector`,
 `NOMAD_TPU_RACE=1`), transfer guard (`transfer_purity.
 steady_state_guard`), and recompile budget (`recompile.Budget`) — are
 dynamic and not part of `run_all`.
@@ -35,11 +56,14 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
 from nomad_tpu.analysis import (
-    chaos_coverage, fsm_determinism, jax_purity, lock_discipline,
-    native_abi, race, recompile, transfer_purity,
+    allow_audit, canonical_form, chaos_coverage, fsm_determinism,
+    jax_purity, lock_discipline, native_abi, race, recompile,
+    snapshot_completeness, transfer_purity, wait_graph,
 )
 from nomad_tpu.analysis.common import Corpus, Finding, load_corpus
-from nomad_tpu.analysis.lock_order import LockOrderRecorder
+from nomad_tpu.analysis.lock_order import (
+    LockOrderRecorder, load_lock_corpus,
+)
 
 CHECKERS = {
     fsm_determinism.CHECKER: fsm_determinism.run,
@@ -50,24 +74,45 @@ CHECKERS = {
     transfer_purity.CHECKER: transfer_purity.run,
     recompile.CHECKER: recompile.run,
     race.CHECKER: race.run,
+    snapshot_completeness.CHECKER: snapshot_completeness.run,
+    canonical_form.CHECKER: canonical_form.run,
+    wait_graph.CHECKER: wait_graph.run,
+    allow_audit.CHECKER: allow_audit.run,
 }
 
 
 def run_all(root: Path, checkers: Optional[Sequence[str]] = None,
-            include_tests: bool = False) -> List[Finding]:
+            include_tests: bool = False,
+            lock_corpus: Optional[dict] = None) -> List[Finding]:
     names = list(checkers) if checkers else list(CHECKERS)
     unknown = [n for n in names if n not in CHECKERS]
     if unknown:
         raise ValueError(f"unknown checker(s): {', '.join(unknown)} "
                          f"(known: {', '.join(CHECKERS)})")
     corpus = load_corpus(root, include_tests=include_tests)
+    if lock_corpus is not None:
+        corpus.lock_corpus = lock_corpus
     findings: List[Finding] = []
-    for name in names:
-        findings.extend(CHECKERS[name](corpus))
+    requested = set(names)
+    if allow_audit.CHECKER in requested:
+        # the unused-allow audit judges `allow_used`, which only the
+        # other checkers populate — so the whole suite runs against this
+        # corpus and findings from checkers the caller did not request
+        # are discarded; the audit itself always runs last
+        for name, fn in CHECKERS.items():
+            if name == allow_audit.CHECKER:
+                continue
+            out = fn(corpus)
+            if name in requested:
+                findings.extend(out)
+        findings.extend(allow_audit.run(corpus))
+    else:
+        for name in names:
+            findings.extend(CHECKERS[name](corpus))
     findings.sort(key=lambda f: (f.path, f.line, f.checker))
     return findings
 
 
 __all__ = ["CHECKERS", "Corpus", "Finding", "LockOrderRecorder",
-           "load_corpus", "race", "recompile", "run_all",
-           "transfer_purity"]
+           "load_corpus", "load_lock_corpus", "race", "recompile",
+           "run_all", "transfer_purity"]

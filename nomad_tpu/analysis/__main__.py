@@ -1,4 +1,7 @@
-"""CLI: `python -m nomad_tpu.analysis` — exit 1 on any finding."""
+"""CLI: `python -m nomad_tpu.analysis`.
+
+Exit codes: 0 no findings, 1 findings, 2 usage/corpus error.
+"""
 from __future__ import annotations
 
 import argparse
@@ -6,7 +9,7 @@ import json
 import sys
 from pathlib import Path
 
-from nomad_tpu.analysis import CHECKERS, run_all
+from nomad_tpu.analysis import CHECKERS, load_lock_corpus, run_all
 
 
 def main(argv=None) -> int:
@@ -19,29 +22,70 @@ def main(argv=None) -> int:
     ap.add_argument("--checker", action="append", dest="checkers",
                     metavar="NAME", choices=sorted(CHECKERS),
                     help="run only this checker (repeatable)")
+    ap.add_argument("--checkers", dest="checkers_csv", metavar="A,B",
+                    help="comma-separated checker names (combines with "
+                         "--checker)")
+    ap.add_argument("--list-checkers", action="store_true",
+                    help="print the checker names, one per line, and "
+                         "exit 0")
+    ap.add_argument("--lock-corpus", type=Path, metavar="DUMP.json",
+                    help="runtime lock-order corpus "
+                         "(LockOrderRecorder.dump / "
+                         "NOMAD_TPU_LOCK_ORDER=1) merged into the "
+                         "wait-graph")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable findings on stdout")
+                    help="machine-readable report on stdout")
     args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for name in CHECKERS:
+            print(name)
+        return 0
+
+    checkers = list(args.checkers or [])
+    if args.checkers_csv:
+        checkers.extend(
+            p.strip() for p in args.checkers_csv.split(",") if p.strip())
 
     root = args.root
     if root is None:
         root = Path(__file__).resolve().parents[2]
+
+    lock_corpus = None
+    if args.lock_corpus is not None:
+        try:
+            lock_corpus = load_lock_corpus(args.lock_corpus)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: --lock-corpus {args.lock_corpus}: {e}",
+                  file=sys.stderr)
+            return 2
+
     try:
-        findings = run_all(root, checkers=args.checkers)
+        findings = run_all(root, checkers=checkers or None,
+                           lock_corpus=lock_corpus)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    ran = checkers or list(CHECKERS)
     if args.json:
-        print(json.dumps({"root": str(root),
-                          "findings": [f.to_dict() for f in findings]},
-                         indent=2))
+        counts = {name: 0 for name in ran}
+        for f in findings:
+            counts[f.checker] = counts.get(f.checker, 0) + 1
+        print(json.dumps({
+            "root": str(root),
+            "checkers": ran,
+            "lock_corpus": (str(args.lock_corpus)
+                            if args.lock_corpus else None),
+            "counts": counts,
+            "findings": [f.to_dict() for f in findings],
+        }, indent=2))
     else:
         for f in findings:
             print(f.render())
         n = len(findings)
         print(f"nomad_tpu.analysis: {n} finding{'s' if n != 1 else ''}"
-              f" in {root}")
+              f" in {root} ({len(set(ran))} checkers)")
     return 1 if findings else 0
 
 

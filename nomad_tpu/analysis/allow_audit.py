@@ -1,0 +1,69 @@
+"""allow-audit: every suppression must earn its keep.
+
+An `# analysis: allow(...)` comment is a standing exception to an
+invariant checker, so each one must carry a stated reason (the grammar
+is `# analysis: allow(names) — reason`), and each one must still be
+*doing* something — an allow no checker consulted during the run is a
+dead suppression left behind by refactored code, and dead suppressions
+are how real findings sneak back in silently.
+
+This checker audits the `allow`/`allow_reason`/`allow_used` bookkeeping
+that `SourceFile.allowed()` populates, so it MUST run after every other
+requested checker against the same Corpus (``run_all`` arranges this:
+when allow-audit is requested it runs the full suite first and discards
+the findings of checkers the caller did not ask for).
+
+Rules:
+
+- missing reason: the comment has no `— reason` tail.  Never
+  suppressible — an allow cannot excuse its own missing justification.
+- unused name: a named checker in the allow that never matched a
+  finding at that line during the run.  `allow(*)` is unused when no
+  checker at all consulted it.  Listing ``allow-audit`` itself among
+  the names opts that comment out of the unused check (for allows
+  covering findings only runtime halves would raise), but not out of
+  the reason requirement.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from nomad_tpu.analysis.common import Corpus, Finding
+
+CHECKER = "allow-audit"
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in corpus.py:
+        for ln in sorted(sf.allow):
+            names = sf.allow[ln]
+            reason = sf.allow_reason.get(ln, "")
+            if not reason:
+                findings.append(Finding(
+                    CHECKER, sf.rel, ln,
+                    "allow(%s) has no stated reason — write "
+                    "`# analysis: allow(%s) — why this is safe`"
+                    % (", ".join(sorted(names)), ", ".join(sorted(names)))))
+            if CHECKER in names:
+                # opted out of the unused check (covers runtime-half
+                # findings the static pass cannot see); reason already
+                # enforced above
+                continue
+            used = sf.allow_used.get(ln, set())
+            if "*" in names:
+                if not used:
+                    findings.append(Finding(
+                        CHECKER, sf.rel, ln,
+                        "allow(*) suppressed nothing this run — dead "
+                        "suppression; delete it or name the checker it "
+                        "is for"))
+                continue
+            dead = sorted(names - used)
+            if dead:
+                findings.append(Finding(
+                    CHECKER, sf.rel, ln,
+                    "allow(%s) suppressed nothing this run — dead "
+                    "suppression; delete the unused name%s"
+                    % (", ".join(dead), "s" if len(dead) > 1 else "")))
+    return findings

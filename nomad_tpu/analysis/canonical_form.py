@@ -1,0 +1,248 @@
+"""canonical-form: replicated bytes must not depend on hash order,
+object identity, or accumulation order.
+
+The survivor-comparison gate (tests/test_raft.py byte-identity checks)
+and snapshot install both compare *pickled bytes*, not values: two
+stores that agree on every value still diverge if a set pickles in a
+different iteration order, a float sum folds in a different order, or a
+dict materialized keys in a different sequence.  PR 13 fixed one
+instance by hand (_quota_usage_add's fixed key order + delete-at-zero);
+this checker proves the whole class, complementing fsm-determinism
+(which owns set *iteration* inside the apply cone):
+
+  set-in-record     a set-typed value placed in the snapshot record
+                    (directly or through `list(...)`) pickles in hash
+                    order — wrap it in `sorted(...)`
+  id-keyed          `id(...)` used as a dict key or subscript in the
+                    apply/snapshot/restore cones keys replicated state
+                    by process-local addresses
+  float-accum       `sum()`/`fsum()` over a set-typed operand in the
+                    apply cone folds floats in hash order
+  defaultdict-read  a Load-context subscript of a persisted defaultdict
+                    table outside the apply/restore cones materializes
+                    keys on the READ path, mutating dict layout (and so
+                    snapshot bytes) without a log entry — use `.get()`
+  canon-bypass      in-place mutation of a _CANONICAL table outside its
+                    declared canonicalizer (wholesale reassignment is
+                    the one legal outside form: replacement, not drift)
+
+Declarations consumed (state store class level):
+
+  _CANONICAL = {"_quota_usage": "_quota_usage_add"}
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, FuncInfo, attr_mutations, class_attr_types,
+    class_decl, class_methods, container_kinds, decl_str_dict, dotted,
+    enclosing_def_line, index_functions, literal_strs, resolve_fsm_stores,
+    store_bases, walk_cone,
+)
+
+CHECKER = "canonical-form"
+
+_SET_CTORS = {"set", "frozenset"}
+_SEQ_WRAPPERS = {"list", "tuple"}   # preserve iteration order of the arg
+
+
+def _is_set_typed(expr: ast.AST, bases: Set[str],
+                  set_attrs: Set[str]) -> bool:
+    """Conservatively: does `expr` evaluate to a set (whose pickle/fold
+    order is hash order)?  `sorted(...)` canonicalizes and is never
+    set-typed; `list(x)`/`tuple(x)` preserve x's order."""
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call):
+        n = dotted(expr.func)
+        ctor = n.split(".")[-1] if n else None
+        if ctor in _SET_CTORS:
+            return True
+        if ctor in _SEQ_WRAPPERS and expr.args:
+            return _is_set_typed(expr.args[0], bases, set_attrs)
+        return False
+    if isinstance(expr, ast.Attribute):
+        b = dotted(expr.value)
+        if b is not None and b in bases and expr.attr in set_attrs:
+            return True
+    if isinstance(expr, (ast.GeneratorExp, ast.ListComp)):
+        gens = expr.generators
+        if gens:
+            return _is_set_typed(gens[0].iter, bases, set_attrs)
+    return False
+
+
+def _id_key_sites(fn_node: ast.AST) -> List[int]:
+    """Lines where `id(...)` keys a structure: subscript slices and
+    dict-literal keys."""
+    def has_id_call(node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id == "id":
+                return True
+        return False
+
+    out: List[int] = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Subscript) and has_id_call(node.slice):
+            out.append(node.lineno)
+        elif isinstance(node, ast.Dict):
+            for k in node.keys:
+                if k is not None and has_id_call(k):
+                    out.append(k.lineno)
+    return out
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    files = corpus.py
+    index = index_functions(files)
+    attr_types = class_attr_types(files)
+    reported: Set[Tuple[str, int, str]] = set()
+
+    def report(sf, line: int, rule: str, msg: str,
+               chain: Tuple[str, ...] = ()) -> None:
+        key = (sf.rel, line, rule)
+        if key in reported:
+            return
+        if sf.allowed(CHECKER, line, enclosing_def_line(sf, line)):
+            return
+        reported.add(key)
+        findings.append(Finding(CHECKER, sf.rel, line, msg, chain))
+
+    for pair in resolve_fsm_stores(files, attr_types):
+        fsm_sf, fsm_cls = pair.fsm_sf, pair.fsm_cls
+        store_cls_name = pair.store_cls.name
+        universe = pair.tables
+        kinds = container_kinds(pair.store_cls)
+        set_attrs = {a for a, k in kinds.items()
+                     if k in ("set", "frozenset")}
+        canonical = decl_str_dict(class_decl(pair.store_cls, "_CANONICAL"))
+        derived = decl_str_dict(
+            class_decl(pair.store_cls, "_SNAPSHOT_DERIVED"))
+        eph_decl = class_decl(pair.store_cls, "_SNAPSHOT_EPHEMERAL")
+        ephemeral = literal_strs(eph_decl) if eph_decl is not None else set()
+        methods = class_methods(fsm_cls)
+        store_methods = class_methods(pair.store_cls)
+
+        def fi_of(fn) -> FuncInfo:
+            return FuncInfo(fsm_sf, fn, f"{fsm_cls.name}.{fn.name}")
+
+        apply_seeds = [fi_of(fn) for name, fn in methods.items()
+                       if name == "apply" or name.startswith("_apply_")]
+        snap_seeds = [fi_of(methods["snapshot"])] \
+            if "snapshot" in methods else []
+        restore_seeds = [fi_of(methods["restore"])] \
+            if "restore" in methods else []
+
+        apply_visits = list(walk_cone(index, apply_seeds, CHECKER))
+        snap_visits = list(walk_cone(index, snap_seeds, CHECKER))
+        restore_visits = list(walk_cone(index, restore_seeds, CHECKER))
+        apply_keys = {fi.key for fi, _ in apply_visits}
+        restore_keys = {fi.key for fi, _ in restore_visits}
+
+        # ---- set-in-record: set-typed values in the snapshot record
+        for fi, chain in snap_visits:
+            bases = store_bases(fi, store_cls_name, attr_types)
+            for node in ast.walk(fi.node):
+                values = []
+                if isinstance(node, ast.Dict):
+                    values = [v for v in node.values]
+                elif isinstance(node, ast.DictComp):
+                    values = [node.value]
+                for v in values:
+                    if _is_set_typed(v, bases, set_attrs):
+                        report(fi.sf, v.lineno, "set-in-record",
+                               "set-typed value in the snapshot record "
+                               "pickles in hash order (bytes differ "
+                               "across replicas) — wrap it in sorted()",
+                               chain)
+
+        # ---- id-keyed structures anywhere in the replicated cones
+        for fi, chain in apply_visits + snap_visits + restore_visits:
+            for line in _id_key_sites(fi.node):
+                report(fi.sf, line, "id-keyed",
+                       "id()-keyed structure in the replication cone: "
+                       "object addresses are process-local, so keys "
+                       "(and byte layout) differ across replicas",
+                       chain)
+
+        # ---- float accumulation order in the apply cone
+        for fi, chain in apply_visits:
+            bases = store_bases(fi, store_cls_name, attr_types)
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call) and node.args:
+                    callee = dotted(node.func)
+                    short = callee.split(".")[-1] if callee else None
+                    if short in ("sum", "fsum") and \
+                            _is_set_typed(node.args[0], bases, set_attrs):
+                        report(fi.sf, node.lineno, "float-accum",
+                               f"{short}() over a set-typed operand in "
+                               f"the FSM apply cone folds in hash order "
+                               f"— sort the operand first", chain)
+
+        # ---- defaultdict key materialization on read paths
+        dd_tables = {a for a in universe
+                     if kinds.get(a) == "defaultdict"
+                     and a not in derived and a not in ephemeral}
+        if dd_tables:
+            seen_fn: Set[str] = set()
+            for fis in index.values():
+                for fi in fis:
+                    if fi.key in seen_fn or fi.key in apply_keys \
+                            or fi.key in restore_keys:
+                        continue
+                    seen_fn.add(fi.key)
+                    bases = store_bases(fi, store_cls_name, attr_types)
+                    if not bases:
+                        continue
+                    for node in ast.walk(fi.node):
+                        if not (isinstance(node, ast.Subscript)
+                                and isinstance(node.ctx, ast.Load)):
+                            continue
+                        tgt = node.value
+                        if isinstance(tgt, ast.Attribute):
+                            b = dotted(tgt.value)
+                            if b is not None and b in bases \
+                                    and tgt.attr in dd_tables:
+                                report(fi.sf, node.lineno,
+                                       "defaultdict-read",
+                                       f"Load-subscript of persisted "
+                                       f"defaultdict table `{tgt.attr}` "
+                                       f"outside the apply/restore cones "
+                                       f"materializes keys on the read "
+                                       f"path (snapshot bytes change "
+                                       f"without a log entry) — use "
+                                       f".get()")
+
+        # ---- _CANONICAL tables: one mutation path
+        decl_node = class_decl(pair.store_cls, "_CANONICAL")
+        decl_line = getattr(decl_node, "lineno", pair.store_cls.lineno)
+        for attr, canon in sorted(canonical.items()):
+            if canon not in store_methods:
+                report(pair.store_sf, decl_line, "canon-bypass",
+                       f"_CANONICAL maps `{attr}` to `{canon}`, which "
+                       f"is not a method of {store_cls_name}")
+                continue
+            seen_fn = set()
+            for fis in index.values():
+                for fi in fis:
+                    if fi.key in seen_fn:
+                        continue
+                    seen_fn.add(fi.key)
+                    if fi.cls == store_cls_name and fi.node.name == canon:
+                        continue
+                    bases = store_bases(fi, store_cls_name, attr_types)
+                    if not bases:
+                        continue
+                    for m in attr_mutations(fi.node, bases):
+                        if m.attr != attr or m.kind == "assign":
+                            continue
+                        report(fi.sf, m.line, "canon-bypass",
+                               f"in-place mutation of canonical table "
+                               f"`{attr}` outside its canonicalizer "
+                               f"`{canon}` (key order / delete-at-zero "
+                               f"discipline bypassed)")
+    return findings

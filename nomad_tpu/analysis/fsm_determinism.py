@@ -3,8 +3,8 @@
 Replicas (and log replay onto a restored snapshot) must produce
 byte-identical state from the same log entries, so everything reachable
 from `NomadFSM.apply` may depend ONLY on the log payload and the current
-store state.  This checker computes the static call graph reachable from
-the FSM's apply/restore methods and flags:
+store state.  This checker walks the shared interprocedural cone
+(common.walk_cone) from the FSM's apply/restore methods and flags:
 
 - wall-clock reads (`time.time`, `monotonic`, `perf_counter`, datetime
   now/utcnow)
@@ -15,33 +15,21 @@ the FSM's apply/restore methods and flags:
 
 Resolution is by bare callee name over every def in the corpus — an
 over-approximation (receiver types are unknown), kept honest by the
-`# analysis: allow(fsm-determinism)` escape hatch: an allowed call line
-is neither flagged nor traversed, so leader-local side effects (broker
-enqueue, heartbeat timers) can be fenced off explicitly at the FSM
-boundary.
+allow escape hatch (see common): an allowed call line is neither
+flagged nor traversed, so leader-local side effects (broker enqueue,
+heartbeat timers) can be fenced off explicitly at the FSM boundary.
 """
 from __future__ import annotations
 
 import ast
-from pathlib import Path
-from typing import Dict, List, Optional, Set, Tuple
+from typing import List, Optional, Set, Tuple
 
 from nomad_tpu.analysis.common import (
-    Corpus, Finding, FuncInfo, SourceFile, call_name, dotted,
-    enclosing_def_line, index_functions,
+    Corpus, Finding, FuncInfo, dotted, enclosing_def_line,
+    find_fsm_classes, index_functions, walk_cone,
 )
 
 CHECKER = "fsm-determinism"
-
-# bare names whose edges are never followed: dict/list/str methods that
-# collide with ubiquitous helper names and cannot reach replicated state
-_EDGE_DENYLIST = {
-    "get", "items", "keys", "values", "append", "extend", "pop",
-    "popleft", "add", "discard", "remove", "clear", "update",
-    "setdefault", "sort", "sorted", "join", "split", "strip",
-    "startswith", "endswith", "encode", "decode", "format", "index",
-    "count", "insert", "reverse", "lower", "upper", "replace",
-}
 
 _WALLCLOCK_ATTRS = {"time", "time_ns", "monotonic", "monotonic_ns",
                     "perf_counter", "perf_counter_ns"}
@@ -85,54 +73,24 @@ def _is_set_expr(expr: ast.AST, local_sets: Set[str]) -> bool:
     return False
 
 
-def _importable(src: SourceFile, dst: SourceFile) -> bool:
-    """Edge filter: a module can only call into modules it imports (or
-    itself).  Prunes bare-name collisions like `subprocess.run` matching
-    `Worker.run` — the native module never imports the worker."""
-    if src is dst:
-        return True
-    dst_mod = dst.module
-    return any(imp == dst_mod or imp.startswith(dst_mod + ".")
-               for imp in src.imports)
-
-
-def _find_fsm_classes(files) -> List[Tuple[SourceFile, ast.ClassDef]]:
-    out = []
-    for sf in files:
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.ClassDef):
-                names = {i.name for i in node.body
-                         if isinstance(i, (ast.FunctionDef,
-                                           ast.AsyncFunctionDef))}
-                if "apply" in names and any(n.startswith("_apply_")
-                                            for n in names):
-                    out.append((sf, node))
-    return out
-
-
 def run(corpus: Corpus) -> List[Finding]:
     findings: List[Finding] = []
     index = index_functions(corpus.py)
 
     seeds: List[FuncInfo] = []
-    for sf, cls in _find_fsm_classes(corpus.py):
+    for sf, cls in find_fsm_classes(corpus.py):
         for item in cls.body:
             if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     and (item.name == "apply" or item.name == "restore"
                          or item.name.startswith("_apply_")):
                 seeds.append(FuncInfo(sf, item, f"{cls.name}.{item.name}"))
 
-    # BFS over the call graph; remember the shortest chain to each def
-    visited: Set[str] = set()
-    queue: List[Tuple[FuncInfo, Tuple[str, ...]]] = [
-        (fi, (fi.qualname,)) for fi in seeds]
     reported: Set[Tuple[str, int]] = set()
-
-    while queue:
-        fi, chain = queue.pop(0)
-        if fi.key in visited:
-            continue
-        visited.add(fi.key)
+    # sink calls are findings, not edges: their internals (stdlib) are
+    # not part of the cone
+    cone = walk_cone(index, seeds, CHECKER,
+                     prune=lambda call: _sink(call) is not None)
+    for fi, chain in cone:
         sf = fi.sf
 
         # names bound to set() expressions in this function, for the
@@ -158,14 +116,6 @@ def run(corpus: Corpus) -> List[Finding]:
                         findings.append(Finding(
                             CHECKER, sf.rel, line,
                             f"{sink} reachable from FSM apply", chain))
-                    continue
-                callee = call_name(node)
-                if callee is None or callee in _EDGE_DENYLIST:
-                    continue
-                for target in index.get(callee, ()):
-                    if target.key not in visited and \
-                            _importable(sf, target.sf):
-                        queue.append((target, chain + (target.qualname,)))
             elif isinstance(node, (ast.For, ast.comprehension)):
                 it = node.iter
                 line = getattr(node, "lineno",

@@ -32,8 +32,14 @@ or process-wide via `NOMAD_TPU_LOCK_ORDER=1` (see tests/conftest.py).
 from __future__ import annotations
 
 import _thread
+import json
 import threading
 from typing import Dict, List, Optional, Set, Tuple
+
+# Interchange format shared with the static wait-graph checker: one
+# corpus feeds both (the checker merges these runtime edges into its
+# static acquisition graph, since nodes share the alloc-site naming).
+LOCK_ORDER_FORMAT = "nomad-tpu-lock-order/1"
 
 
 def _alloc_site(skip_modules: Tuple[str, ...] = ("threading",)) -> str:
@@ -201,3 +207,29 @@ class LockOrderRecorder:
                     lines.append(f"    {a} -> {b}  (thread {thread}, "
                                  f"held {list(snap)})")
         return "\n".join(lines)
+
+    # ---- interchange with the static wait-graph checker
+
+    def to_corpus(self) -> dict:
+        """The recorded edges in the shared wait-graph corpus format."""
+        with self._meta:
+            edges = [{"a": a, "b": b, "thread": thread,
+                      "held": list(snap)}
+                     for (a, b), (thread, snap) in sorted(self.edges.items())]
+        return {"format": LOCK_ORDER_FORMAT, "edges": edges}
+
+    def dump(self, path) -> None:
+        with open(path, "w") as fh:
+            json.dump(self.to_corpus(), fh, indent=1, sort_keys=True)
+            fh.write("\n")
+
+
+def load_lock_corpus(path) -> dict:
+    """Parse and validate a dumped corpus (ValueError on foreign JSON)."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or \
+            data.get("format") != LOCK_ORDER_FORMAT:
+        raise ValueError(
+            f"{path}: not a {LOCK_ORDER_FORMAT} lock-order corpus")
+    return data

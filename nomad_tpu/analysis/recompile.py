@@ -65,7 +65,7 @@ def cache_sizes() -> Dict[str, int]:
     for key, fn in _registry.items():
         try:
             out[key] = fn._cache_size()
-        except Exception:   # analysis: allow(*) — probe must never raise
+        except Exception:   # noqa: BLE001 — probe must never raise
             out[key] = -1
     return out
 

@@ -1,0 +1,370 @@
+"""wait-graph: lock-acquisition cycles and locks held across blocking
+calls, statically.
+
+The runtime LockOrderRecorder (lock_order.py) only sees interleavings
+the test run actually hit; this checker builds the acquisition graph
+from source.  Nodes are lock *allocation sites* (`file.py:line`, the
+recorder's own naming — common.lock_alloc_sites), so a runtime corpus
+dumped by `LockOrderRecorder.dump()` / `NOMAD_TPU_LOCK_ORDER=1` merges
+edge-for-edge into the static graph and one corpus feeds both tools
+(`python -m nomad_tpu.analysis --lock-corpus <dump.json>`).
+
+Static edges come from `with <lock>:` nesting — directly nested with
+statements, plus interprocedurally: a call made while holding L adds
+L -> M for every lock M acquired anywhere in the callee's cone.
+Receivers resolve through the enclosing class, attr-typed fields
+(`self.store._lock`), annotated parameters, and local aliases
+(`s = self.store`); calls resolve receiver-aware
+(common.resolve_call_targets), since here a spurious edge manufactures
+a deadlock report.  Unresolvable lock expressions are skipped: the
+graph under-approximates, the cycle report never invents locks.
+
+Findings:
+
+  cycle          a directed cycle in the merged static+runtime graph —
+                 two paths nest the same locks in opposite orders
+                 (potential deadlock)
+  held-blocking  a blocking call (fsync, socket send/recv/accept/
+                 connect, future .result / raft commit wait,
+                 time.sleep, cv .wait) reached while a lock is held.
+                 Reported AT THE HOLDING with-statement: that is where
+                 the design decision lives.  Exemptions:
+                 - `cv.wait()` where the condition wraps the held lock
+                   (releasing it is the point of a condition variable —
+                   the _LOCK_ALIASES / Condition(self._lock) pattern)
+                 - locks declared in their class's
+                   `_LOCK_BLOCKING_OK = {"_lock": "reason"}`: locks
+                   whose JOB is to serialize blocking I/O (WAL append+
+                   fsync, RPC round-trip sockets, raft's
+                   persist-before-respond).  A reasonless declaration
+                   is itself a finding, like a reasonless allow.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, FuncInfo, SourceFile, class_attr_types, class_decl,
+    decl_str_dict, dotted, enclosing_def_line, index_functions,
+    lock_alloc_sites, receiver_classes, resolve_call_targets,
+)
+from nomad_tpu.analysis.lock_order import LOCK_ORDER_FORMAT
+
+CHECKER = "wait-graph"
+
+# attribute calls that block the calling thread
+_BLOCKING_ATTRS = {
+    "fsync": "fsync",
+    "sendall": "socket send",
+    "recv": "socket recv",
+    "recv_into": "socket recv",
+    "accept": "socket accept",
+    "connect": "socket connect",
+    "result": "future/commit wait",
+    "wait": "condition/event wait",
+    "wait_for": "condition wait",
+}
+# dotted calls that block
+_BLOCKING_DOTTED = {
+    "os.fsync": "fsync",
+    "time.sleep": "sleep",
+}
+
+
+def _lock_site(expr: ast.AST, bases: Dict[str, str],
+               sites: Dict[Tuple[str, str], str]) -> Optional[str]:
+    """`<base>.<attr>` -> alloc site when the base's class allocates
+    that lock attr, else None."""
+    if not isinstance(expr, ast.Attribute):
+        return None
+    b = dotted(expr.value)
+    if b is None:
+        return None
+    cls = bases.get(b)
+    if cls is None:
+        return None
+    return sites.get((cls, expr.attr))
+
+
+def _blocking_call(node: ast.Call, bases: Dict[str, str],
+                   sites: Dict[Tuple[str, str], str]
+                   ) -> Optional[Tuple[str, Optional[str]]]:
+    """(description, waited-cv-site-or-None) if this call blocks."""
+    d = dotted(node.func)
+    if d in _BLOCKING_DOTTED:
+        return _BLOCKING_DOTTED[d], None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS:
+        cv_site = None
+        if f.attr in ("wait", "wait_for") and \
+                isinstance(f.value, ast.Attribute):
+            b = dotted(f.value.value)
+            cls = bases.get(b) if b is not None else None
+            if cls is not None:
+                cv_site = sites.get((cls, f.value.attr))
+        return _BLOCKING_ATTRS[f.attr], cv_site
+    return None
+
+
+class _FnSummary:
+    __slots__ = ("fi", "bases", "acquires", "blocking", "callees")
+
+    def __init__(self, fi: FuncInfo):
+        self.fi = fi
+        self.bases: Dict[str, str] = {}
+        self.acquires: Set[str] = set()
+        # (rel, line, description, waited cv site) of blocking calls
+        self.blocking: List[Tuple[str, int, str, Optional[str]]] = []
+        self.callees: Set[str] = set()
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    files = corpus.py
+    index = index_functions(files)
+    attr_types = class_attr_types(files)
+    sites = lock_alloc_sites(files)
+    corpus_classes: Set[str] = {
+        fi.cls for fis in index.values() for fi in fis
+        if fi.cls is not None}
+
+    # site -> every (class, attr) that names it (Condition aliases make
+    # this one-to-many), for rendering and _LOCK_BLOCKING_OK lookup
+    site_owners: Dict[str, Set[Tuple[str, str]]] = {}
+    for (cls, attr), site in sites.items():
+        site_owners.setdefault(site, set()).add((cls, attr))
+
+    # (class, attr) -> stated reason the lock may be held across
+    # blocking calls; reasonless declarations are findings
+    blocking_ok: Dict[Tuple[str, str], str] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            decl = class_decl(node, "_LOCK_BLOCKING_OK")
+            if decl is None:
+                continue
+            entries = decl_str_dict(decl)
+            if isinstance(decl, ast.Dict):
+                for k in decl.keys:
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str) and \
+                            not entries.get(k.value, "").strip():
+                        findings.append(Finding(
+                            CHECKER, sf.rel, k.lineno,
+                            f"_LOCK_BLOCKING_OK entry `{k.value}` on "
+                            f"{node.name} states no reason"))
+            for attr, reason in entries.items():
+                if reason.strip():
+                    blocking_ok[(node.name, attr)] = reason
+
+    def site_exempt(site: str) -> bool:
+        return any(owner in blocking_ok
+                   for owner in site_owners.get(site, ()))
+
+    def held_name(site: str) -> str:
+        owners = site_owners.get(site)
+        if owners:
+            cls, attr = sorted(owners)[0]
+            return f"{cls}.{attr} ({site})"
+        return site
+
+    # ---- per-function summaries
+    summaries: Dict[str, _FnSummary] = {}
+    for fis in index.values():
+        for fi in fis:
+            if fi.key in summaries:
+                continue
+            s = _FnSummary(fi)
+            s.bases = receiver_classes(fi, attr_types)
+            summaries[fi.key] = s
+            sf = fi.sf
+            for node in ast.walk(fi.node):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        site = _lock_site(item.context_expr,
+                                          s.bases, sites)
+                        if site is not None:
+                            s.acquires.add(site)
+                elif isinstance(node, ast.Call):
+                    line = node.lineno
+                    if sf.allowed(CHECKER, line,
+                                  enclosing_def_line(sf, line)):
+                        continue
+                    blk = _blocking_call(node, s.bases, sites)
+                    if blk is not None:
+                        s.blocking.append((sf.rel, line, blk[0], blk[1]))
+                    for target in resolve_call_targets(
+                            fi, node, index, s.bases, corpus_classes):
+                        s.callees.add(target.key)
+
+    # ---- fixpoint: locks acquired / blocking calls reached in the
+    # cone below each function
+    acq_all: Dict[str, Set[str]] = {
+        k: set(s.acquires) for k, s in summaries.items()}
+    blk_all: Dict[str, List[Tuple[str, int, str, Optional[str]]]] = {
+        k: list(s.blocking) for k, s in summaries.items()}
+    changed = True
+    while changed:
+        changed = False
+        for k, s in summaries.items():
+            for c in s.callees:
+                if c == k or c not in summaries:
+                    continue
+                extra = acq_all[c] - acq_all[k]
+                if extra:
+                    acq_all[k] |= extra
+                    changed = True
+                have = {(p, ln) for (p, ln, _d, _c) in blk_all[k]}
+                for ent in blk_all[c]:
+                    if (ent[0], ent[1]) not in have and \
+                            len(blk_all[k]) < 64:
+                        blk_all[k].append(ent)
+                        changed = True
+
+    # ---- static edges + held-blocking findings
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    reported: Set[Tuple[str, int, str, int]] = set()
+
+    def blocking_finding(sf: SourceFile, hold_line: int,
+                         held_site: str, qual: str,
+                         ent: Tuple[str, int, str, Optional[str]],
+                         via: Tuple[str, ...]) -> None:
+        sink_rel, sink_line, desc, cv = ent
+        if cv is not None and cv == held_site:
+            return
+        if site_exempt(held_site):
+            return
+        key = (sf.rel, hold_line, sink_rel, sink_line)
+        if key in reported:
+            return
+        if sf.allowed(CHECKER, hold_line,
+                      enclosing_def_line(sf, hold_line)):
+            return
+        reported.add(key)
+        findings.append(Finding(
+            CHECKER, sf.rel, hold_line,
+            f"{held_name(held_site)} held across a blocking call "
+            f"({desc} at {sink_rel}:{sink_line})", via))
+
+    def scan_body(sf: SourceFile, summ: _FnSummary,
+                  node: ast.AST, held: List[Tuple[str, int]]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue   # nested defs run later, not under this lock
+            acquired: List[Tuple[str, int]] = []
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    site = _lock_site(item.context_expr,
+                                      summ.bases, sites)
+                    if site is None:
+                        continue
+                    line = item.context_expr.lineno
+                    if sf.allowed(CHECKER, line,
+                                  enclosing_def_line(sf, line)):
+                        continue
+                    for h, _hl in held:
+                        if h != site:
+                            edges.setdefault(
+                                (h, site),
+                                (sf.rel, line, summ.fi.qualname))
+                    acquired.append((site, line))
+            elif isinstance(child, ast.Call) and held:
+                h_site, h_line = held[-1]
+                blk = _blocking_call(child, summ.bases, sites)
+                if blk is not None:
+                    blocking_finding(
+                        sf, h_line, h_site, summ.fi.qualname,
+                        (sf.rel, child.lineno, blk[0], blk[1]),
+                        (summ.fi.qualname,))
+                else:
+                    for target in resolve_call_targets(
+                            summ.fi, child, index, summ.bases,
+                            corpus_classes):
+                        for m in acq_all.get(target.key, ()):
+                            for h, _hl in held:
+                                if h != m:
+                                    edges.setdefault(
+                                        (h, m),
+                                        (sf.rel, child.lineno,
+                                         summ.fi.qualname))
+                        for ent in blk_all.get(target.key, ()):
+                            blocking_finding(
+                                sf, h_line, h_site, summ.fi.qualname,
+                                ent, (summ.fi.qualname,
+                                      target.qualname))
+            held.extend(acquired)
+            scan_body(sf, summ, child, held)
+            if acquired:
+                del held[len(held) - len(acquired):]
+
+    for summ in summaries.values():
+        scan_body(summ.fi.sf, summ, summ.fi.node, [])
+
+    # ---- merge the runtime corpus (same node namespace)
+    runtime_edges: Dict[Tuple[str, str], str] = {}
+    lc = corpus.lock_corpus
+    if lc is not None:
+        if lc.get("format") != LOCK_ORDER_FORMAT:
+            findings.append(Finding(
+                CHECKER, "<lock-corpus>", 0,
+                f"lock corpus format {lc.get('format')!r} is not "
+                f"{LOCK_ORDER_FORMAT!r}"))
+        else:
+            for e in lc.get("edges", ()):
+                a, b = e.get("a"), e.get("b")
+                if a and b and a != b:
+                    runtime_edges.setdefault((a, b), e.get("thread", "?"))
+
+    # ---- cycle detection over the merged graph
+    g: Dict[str, Set[str]] = {}
+    for (a, b) in list(edges) + list(runtime_edges):
+        g.setdefault(a, set()).add(b)
+
+    out_cycles: List[List[str]] = []
+    seen: Set[Tuple[str, ...]] = set()
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in g}
+
+    def dfs(node: str, path: List[str]) -> None:
+        color[node] = GREY
+        path.append(node)
+        for nxt in sorted(g.get(node, ())):
+            c = color.get(nxt, WHITE)
+            if c == GREY:
+                cyc = path[path.index(nxt):] + [nxt]
+                canon = tuple(sorted(cyc[:-1]))
+                if canon not in seen:
+                    seen.add(canon)
+                    out_cycles.append(cyc)
+            elif c == WHITE:
+                dfs(nxt, path)
+        path.pop()
+        color[node] = BLACK
+
+    for n in sorted(g):
+        if color.get(n, WHITE) == WHITE:
+            dfs(n, [])
+
+    for cyc in out_cycles:
+        parts = []
+        loc: Tuple[str, int] = ("<lock-corpus>", 0)
+        chain: Tuple[str, ...] = ()
+        for a, b in zip(cyc, cyc[1:]):
+            if (a, b) in edges:
+                rel, line, qual = edges[(a, b)]
+                parts.append(f"{held_name(a)} -> {held_name(b)} "
+                             f"[static: {qual}]")
+                if loc[0] == "<lock-corpus>":
+                    loc, chain = (rel, line), (qual,)
+            else:
+                thread = runtime_edges.get((a, b), "?")
+                parts.append(f"{held_name(a)} -> {held_name(b)} "
+                             f"[runtime: thread {thread}]")
+        findings.append(Finding(
+            CHECKER, loc[0], loc[1],
+            "lock-order cycle (potential deadlock): " + "; ".join(parts),
+            chain))
+    return findings

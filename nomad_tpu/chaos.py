@@ -401,7 +401,7 @@ def fire(point: str) -> None:
 def maybe_delay(point: str = "rpc.delay") -> None:
     reg = active
     if reg is not None and reg.should(point):
-        time.sleep(reg.delay_ms / 1000.0)
+        time.sleep(reg.delay_ms / 1000.0)   # analysis: allow(wait-graph) — chaos fault injection sleeps on purpose
 
 
 _env_spec = os.environ.get("NOMAD_TPU_CHAOS", "")

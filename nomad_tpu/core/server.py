@@ -78,6 +78,16 @@ class ServerConfig:
 
 
 class Server:
+    # wait-graph (nomad_tpu.analysis)
+    _LOCK_BLOCKING_OK = {
+        "_leader_lock": "establish/revoke are serialized on the raft "
+                        "leadership dispatcher thread and no "
+                        "raft-internal thread takes this lock, so the "
+                        "commit barrier inside establishLeadership is "
+                        "a bounded stall (its own timeout), never a "
+                        "cycle — mirrors the reference leaderLoop",
+    }
+
     def __init__(self, config: Optional[ServerConfig] = None,
                  name: str = "server-1",
                  peers: Optional[List[str]] = None,

@@ -63,7 +63,7 @@ from nomad_tpu.parallel.world import DeviceWorld, mesh_key
 # transfer-purity (nomad_tpu.analysis): the dispatch loop is hot-path —
 # implicit host<->device movement is a finding; the few sanctioned
 # device_put sites (cache fills, per-dispatch dynamic leaf) carry
-# `# analysis: allow(transfer-purity)` annotations with their reason
+# transfer-purity suppression comments with their reason
 _TRANSFER_HOT_PATH = True
 
 # fixed sparse-delta slot count per eval: a CONSTANT so the delta axis

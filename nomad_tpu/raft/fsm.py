@@ -360,8 +360,10 @@ class NomadFSM:
             s._allocs_by_node = defaultdict(set)
             s._allocs_by_eval = defaultdict(set)
             s._evals_by_job = defaultdict(set)
+            # derived indexes go through the store's builders — the same
+            # row constructors the apply path uses (_SNAPSHOT_DERIVED)
             for e in data["evals"]:
-                s._evals_by_job[(e.namespace, e.job_id)].add(e.id)
+                s._index_eval_locked(e)
             s._deployments = {d.id: d for d in data["deployments"]}
             s._job_summaries = dict(data["job_summaries"])
             s.scheduler_config = data["scheduler_config"]
@@ -392,7 +394,7 @@ class NomadFSM:
             s._acl_by_secret = {}
             for t in data.get("acl_tokens", []):
                 s._acl_tokens[t.accessor_id] = t
-                s._acl_by_secret[t.secret_id] = t
+                s._index_acl_token_locked(t)
             s._csi_volumes = dict(data.get("csi_volumes", {}))
             s._csi_plugins = dict(data.get("csi_plugins", {}))
             s._scaling_events = {k: list(v) for k, v in
@@ -401,7 +403,7 @@ class NomadFSM:
             s._services_by_alloc = defaultdict(set)
             for sr in data.get("services", []):
                 s._services[sr.id] = sr
-                s._services_by_alloc[sr.alloc_id].add(sr.id)
+                s._index_service_locked(sr)
             s.matrix = ClusterMatrix()
             s.matrix.lock = s._lock
             for n in data["nodes"]:
@@ -409,12 +411,7 @@ class NomadFSM:
             s._live_names = {}
             for a in data["allocs"]:
                 s._allocs[a.id] = a
-                s._allocs_by_job[(a.namespace, a.job_id)].add(a.id)
-                s._allocs_by_node[a.node_id].add(a.id)
-                s._allocs_by_eval[a.eval_id].add(a.id)
-                if not a.terminal_status():
-                    s._live_names.setdefault(
-                        (a.namespace, a.job_id, a.name), set()).add(a.id)
+                s._index_alloc_locked(a)
                 s.matrix.upsert_alloc(a)
             if "quota_usage" not in data:
                 # pre-quota snapshot: derive usage from the live allocs
@@ -424,7 +421,7 @@ class NomadFSM:
                         s._quota_usage_add(
                             a.namespace, alloc_quota_usage(a), +1)
             s._applied_plan_ids = list(data.get("applied_plan_ids", []))
-            s._applied_plan_ids_set = set(s._applied_plan_ids)
+            s._reindex_applied_plan_ids_locked()
             s.latest_index = data["latest_index"]
             s._snapshot_cache = None
             s._index_cv.notify_all()

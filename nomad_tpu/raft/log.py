@@ -151,6 +151,13 @@ class LogEntry:
 
 
 class LogStore:
+    # wait-graph (nomad_tpu.analysis): locks whose JOB is to serialize
+    # blocking I/O, with the reason they may be held across it
+    _LOCK_BLOCKING_OK = {
+        "_lock": "the WAL lock serializes append+fsync by design; "
+                 "contending appenders need that durability ordering",
+    }
+
     def __init__(self, path: Optional[str] = None,
                  fsync: Optional[str] = None):
         self._lock = threading.Lock()

@@ -56,6 +56,12 @@ def _encode_body(term: int, voted_for: Optional[str],
 class DurableMeta:
     """Load-once, persist-on-change store for (term, voted_for)."""
 
+    # wait-graph (nomad_tpu.analysis)
+    _LOCK_BLOCKING_OK = {
+        "_lock": "a term/vote update must be atomic with its fsync "
+                 "(persist-before-respond), so the lock spans the write",
+    }
+
     def __init__(self, path: str):
         self.path = path
         self.term = 0

@@ -109,6 +109,13 @@ class RaftNode:
     _LOCK_ALIASES = ("_apply_cv",)
     _LOCK_PROTECTED = frozenset({"_voters", "_nonvoters"})
     _RACE_TRACED = {"_voters": "_lock"}
+    # wait-graph (nomad_tpu.analysis)
+    _LOCK_BLOCKING_OK = {
+        "_lock": "raft persist-before-respond: term/vote/log entries "
+                 "must hit disk under the state lock before any RPC "
+                 "reply or role transition (election/RPC timeouts "
+                 "bound the stall)",
+    }
 
     def __init__(self, name: str, peers: List[str],
                  transport: InMemTransport, fsm,

@@ -38,6 +38,12 @@ _HDR = struct.Struct("<II")
 
 
 class FileSnapshotStore:
+    # wait-graph (nomad_tpu.analysis)
+    _LOCK_BLOCKING_OK = {
+        "_lock": "save serializes write+fsync+rename so readers only "
+                 "ever list completed snapshots",
+    }
+
     def __init__(self, directory: str, retain: int = 2):
         self.dir = directory
         self.retain = retain

@@ -137,6 +137,13 @@ class TcpRpcClient:
     error, leader-redirect follow via an address book (helper/pool +
     forwardLeader in the reference)."""
 
+    # wait-graph (nomad_tpu.analysis)
+    _LOCK_BLOCKING_OK = {
+        "_lock": "serializes one request/response round trip on the "
+                 "pooled socket; interleaved frames would corrupt the "
+                 "stream (socket timeout bounds the stall)",
+    }
+
     def __init__(self, address, addr_book: Optional[Dict[str, tuple]] = None,
                  timeout: float = 35.0, secret: Optional[bytes] = None):
         self.address = tuple(address)

@@ -157,6 +157,29 @@ class StateStore:
         "_services_by_alloc", "_applied_plan_ids", "_applied_plan_ids_set",
         "_snapshot_cache", "_live_names", "_quota_specs", "_quota_usage",
     })
+    # snapshot-completeness (nomad_tpu.analysis): the replication
+    # contract for every _LOCK_PROTECTED table.  A table named in
+    # neither map must appear in BOTH the snapshot record and the
+    # restore path; a derived index is instead rebuilt through the
+    # named builder — the SAME row constructor the apply path uses, so
+    # restore cannot drift from apply — and an ephemeral cache
+    # legitimately dies with the process.
+    _SNAPSHOT_DERIVED = {
+        "_allocs_by_job": "_index_alloc_locked",
+        "_allocs_by_node": "_index_alloc_locked",
+        "_allocs_by_eval": "_index_alloc_locked",
+        "_live_names": "_index_alloc_locked",
+        "_evals_by_job": "_index_eval_locked",
+        "_acl_by_secret": "_index_acl_token_locked",
+        "_services_by_alloc": "_index_service_locked",
+        "_applied_plan_ids_set": "_reindex_applied_plan_ids_locked",
+    }
+    _SNAPSHOT_EPHEMERAL = frozenset({"_snapshot_cache"})
+    # canonical-form (nomad_tpu.analysis): replicated tables whose
+    # byte-identity depends on a single mutation path (fixed key order,
+    # delete-at-zero); every in-place write outside the named
+    # canonicalizer is a finding.
+    _CANONICAL = {"_quota_usage": "_quota_usage_add"}
 
     def __init__(self):
         self._lock = threading.RLock()
@@ -482,7 +505,7 @@ class StateStore:
                     e.modify_time = e.create_time
                 e.modify_index = index
                 self._evals[e.id] = e
-                self._evals_by_job[(e.namespace, e.job_id)].add(e.id)
+                self._index_eval_locked(e)
                 out.append(e)
             self._bump(index)
         for e in out:
@@ -560,7 +583,7 @@ class StateStore:
         with self._lock:
             for sr in services:
                 self._services[sr.id] = sr
-                self._services_by_alloc[sr.alloc_id].add(sr.id)
+                self._index_service_locked(sr)
             self._bump(index)
         for sr in services:
             self._notify("services", sr)
@@ -598,6 +621,42 @@ class StateStore:
             return [self._services[i]
                     for i in self._services_by_alloc.get(alloc_id, ())]
 
+    # ------------------------------------------- derived index builders
+    #
+    # The ONLY row constructors for _SNAPSHOT_DERIVED tables: the apply
+    # path calls them incrementally, snapshot restore calls them per
+    # restored row.  Keeping both paths on one function is what lets a
+    # restored follower replay the rest of the log byte-identically to
+    # a survivor that applied it live (snapshot-completeness checker).
+
+    @requires_lock("_lock")
+    def _index_eval_locked(self, e: Evaluation) -> None:
+        self._evals_by_job[(e.namespace, e.job_id)].add(e.id)
+
+    @requires_lock("_lock")
+    def _index_service_locked(self, sr) -> None:
+        self._services_by_alloc[sr.alloc_id].add(sr.id)
+
+    @requires_lock("_lock")
+    def _index_acl_token_locked(self, token) -> None:
+        self._acl_by_secret[token.secret_id] = token
+
+    @requires_lock("_lock")
+    def _index_alloc_locked(self, a: Allocation) -> None:
+        self._allocs_by_job[(a.namespace, a.job_id)].add(a.id)
+        self._allocs_by_node[a.node_id].add(a.id)
+        self._allocs_by_eval[a.eval_id].add(a.id)
+        if a.terminal_status():
+            self._live_name_unset(a)
+        else:
+            self._live_names.setdefault(
+                (a.namespace, a.job_id, a.name), set()).add(a.id)
+
+    @requires_lock("_lock")
+    def _reindex_applied_plan_ids_locked(self) -> None:
+        race.write("StateStore._applied_plan_ids_set", self)
+        self._applied_plan_ids_set = set(self._applied_plan_ids)
+
     # ------------------------------------------------------------ allocs
 
     @requires_lock("_lock")
@@ -626,14 +685,7 @@ class StateStore:
             a.job = self._jobs.get((a.namespace, a.job_id))
         a.modify_index = index
         self._allocs[a.id] = a
-        self._allocs_by_job[(a.namespace, a.job_id)].add(a.id)
-        self._allocs_by_node[a.node_id].add(a.id)
-        self._allocs_by_eval[a.eval_id].add(a.id)
-        if a.terminal_status():
-            self._live_name_unset(a)
-        else:
-            self._live_names.setdefault(
-                (a.namespace, a.job_id, a.name), set()).add(a.id)
+        self._index_alloc_locked(a)
         # quota usage rides the same liveness transition as _live_names:
         # decrement with the PREVIOUS copy's resources (an in-place
         # update may have changed them), increment with the new one
@@ -900,7 +952,7 @@ class StateStore:
             if not token.create_index:
                 token.create_index = index
             self._acl_tokens[token.accessor_id] = token
-            self._acl_by_secret[token.secret_id] = token
+            self._index_acl_token_locked(token)
             self._bump(index)
 
     def delete_acl_token(self, index: int, accessor_id: str) -> None:

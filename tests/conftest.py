@@ -27,10 +27,16 @@ import pytest
 
 
 @pytest.fixture(scope="session", autouse=True)
-def _lock_order_guard():
+def _lock_order_guard(request):
     """NOMAD_TPU_LOCK_ORDER=1 wraps every lock allocated during the run
     and fails the session if the acquisition graph has a cycle (latent
-    deadlock).  Off by default: the wrapper adds per-acquire overhead."""
+    deadlock).  Off by default: the wrapper adds per-acquire overhead.
+
+    The observed acquisition graph is dumped (LockOrderRecorder.dump,
+    the corpus format the static wait-graph checker merges via
+    `python -m nomad_tpu.analysis --lock-corpus`) to
+    NOMAD_TPU_LOCK_ORDER_DUMP when set, and always on a failing
+    session so CI failures keep the interleaving evidence."""
     if os.environ.get("NOMAD_TPU_LOCK_ORDER", "0") in ("", "0"):
         yield
         return
@@ -39,6 +45,12 @@ def _lock_order_guard():
     yield
     rec.uninstall()
     cycles = rec.cycles()
+    dump = os.environ.get("NOMAD_TPU_LOCK_ORDER_DUMP", "")
+    if not dump and (cycles or request.session.testsfailed):
+        dump = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "..", "lock-order-corpus.json")
+    if dump:
+        rec.dump(dump)
     assert not cycles, "\n" + rec.render_cycles()
 
 

@@ -1,0 +1,7 @@
+"""An allow that names allow-audit itself opts out of the unused-allow
+check (its finding only exists at runtime), but still needs a reason."""
+
+
+def prestamp(payload):
+    payload["t"] = 0.0   # analysis: allow(fsm-determinism, allow-audit) — the runtime replay gate flags this path; the static cone cannot reach it from any FSM
+    return payload

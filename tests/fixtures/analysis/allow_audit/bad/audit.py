@@ -1,0 +1,16 @@
+"""Seeded allow-audit violations: a reasonless allow (even though it
+suppresses a real finding), a dead named allow, and a dead allow(*)."""
+import time as _time
+
+
+class MiniFSM:
+    def __init__(self, store):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        self._apply_touch(index, payload)
+
+    def _apply_touch(self, index, payload):
+        payload["t"] = _time.time()   # analysis: allow(fsm-determinism)
+        limit = 1                     # analysis: allow(lock-discipline) — nothing here ever needed suppressing
+        return limit                  # analysis: allow(*) — stale blanket suppression left behind

@@ -1,0 +1,14 @@
+"""A healthy suppression: reasoned, and consulted by the checker it
+names during the run."""
+import time as _time
+
+
+class MiniFSM:
+    def __init__(self, store):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        self._apply_touch(index, payload)
+
+    def _apply_touch(self, index, payload):
+        payload["t"] = _time.time()   # analysis: allow(fsm-determinism) — fixture keeps the legacy stamp-in-apply shape; propose pre-stamps in production

@@ -1,0 +1,64 @@
+"""The same canonical-form drift, each site suppressed with a reasoned
+allow on the finding line (or its enclosing def line)."""
+import pickle
+import threading
+from collections import defaultdict
+
+
+class MiniStore:
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({"_jobs", "_tags", "_usage", "_counts"})
+    _CANONICAL = {   # analysis: allow(canonical-form) — fixture models a half-migrated declaration
+        "_counts": "_counts_add",
+        "_ghost": "_no_such_canonicalizer",
+    }
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs = {}
+        self._tags = set()
+        self._weights = set()
+        self._usage = defaultdict(dict)
+        self._counts = {}
+
+    def _counts_add(self, key, delta):
+        total = self._counts.get(key, 0) + delta
+        if total:
+            self._counts[key] = total
+        else:
+            self._counts.pop(key, None)
+
+    def bump(self, key):
+        self._counts[key] = self._counts.get(key, 0) + 1   # analysis: allow(canonical-form) — single-threaded bootstrap path, runs before replication starts
+
+    def reset_usage(self, namespace):
+        return self._usage[namespace]   # analysis: allow(canonical-form) — materialization deliberate: the namespace row must exist after this call
+
+
+class MiniFSM:
+    def __init__(self, store: MiniStore):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        if msg_type == "job":
+            self._apply_job(index, payload)
+
+    def _apply_job(self, index, payload):   # analysis: allow(canonical-form) — legacy payload shape kept until the format version bump
+        job = payload["job"]
+        s = self.store
+        s._jobs[id(job)] = job
+        s._tags.add(job["tag"])
+        job["weight"] = sum(s._weights)
+
+    def snapshot(self):
+        s = self.store
+        return pickle.dumps({
+            "jobs": dict(s._jobs),
+            "tags": list(s._tags),   # analysis: allow(canonical-form) — tag order normalized by the consumer on load
+        })
+
+    def restore(self, blob):
+        data = pickle.loads(blob)
+        s = self.store
+        s._jobs = dict(data["jobs"])
+        s._tags = set(data["tags"])

@@ -1,0 +1,66 @@
+"""Seeded canonical-form violations: a set pickled into the snapshot
+record, an id()-keyed table, hash-order float accumulation, a
+read-path defaultdict materialization, and _CANONICAL drift (missing
+canonicalizer + an in-place mutation bypassing the declared one)."""
+import pickle
+import threading
+from collections import defaultdict
+
+
+class MiniStore:
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({"_jobs", "_tags", "_usage", "_counts"})
+    _CANONICAL = {
+        "_counts": "_counts_add",
+        "_ghost": "_no_such_canonicalizer",
+    }
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs = {}
+        self._tags = set()
+        self._weights = set()
+        self._usage = defaultdict(dict)
+        self._counts = {}
+
+    def _counts_add(self, key, delta):
+        total = self._counts.get(key, 0) + delta
+        if total:
+            self._counts[key] = total
+        else:
+            self._counts.pop(key, None)
+
+    def bump(self, key):
+        self._counts[key] = self._counts.get(key, 0) + 1   # bypass
+
+
+class MiniFSM:
+    def __init__(self, store: MiniStore):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        if msg_type == "job":
+            self._apply_job(index, payload)
+
+    def _apply_job(self, index, payload):
+        job = payload["job"]
+        s = self.store
+        s._jobs[id(job)] = job                       # id()-keyed row
+        s._tags.add(job["tag"])
+        job["weight"] = sum(s._weights)              # hash-order fold
+
+    def snapshot(self):
+        s = self.store
+        return pickle.dumps({
+            "jobs": dict(s._jobs),
+            "tags": list(s._tags),                   # hash-order pickle
+        })
+
+    def restore(self, blob):
+        data = pickle.loads(blob)
+        s = self.store
+        s._jobs = dict(data["jobs"])
+        s._tags = set(data["tags"])
+
+    def usage_for(self, namespace):
+        return self.store._usage[namespace]          # read materializes

@@ -1,0 +1,60 @@
+"""Canonical replication state: sorted set persistence, value-keyed
+rows, order-independent accumulation, .get() on read paths, and every
+_counts mutation routed through the declared canonicalizer."""
+import pickle
+import threading
+from collections import defaultdict
+
+
+class MiniStore:
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({"_jobs", "_tags", "_usage", "_counts"})
+    _CANONICAL = {"_counts": "_counts_add"}
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs = {}
+        self._tags = set()
+        self._weights = set()
+        self._usage = defaultdict(dict)
+        self._counts = {}
+
+    def _counts_add(self, key, delta):
+        total = self._counts.get(key, 0) + delta
+        if total:
+            self._counts[key] = total
+        else:
+            self._counts.pop(key, None)
+
+
+class MiniFSM:
+    def __init__(self, store: MiniStore):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        if msg_type == "job":
+            self._apply_job(index, payload)
+
+    def _apply_job(self, index, payload):
+        job = payload["job"]
+        s = self.store
+        s._jobs[job["id"]] = job
+        s._tags.add(job["tag"])
+        job["weight"] = sum(sorted(s._weights))
+        s._counts_add(job["id"], 1)
+
+    def snapshot(self):
+        s = self.store
+        return pickle.dumps({
+            "jobs": dict(s._jobs),
+            "tags": sorted(s._tags),
+        })
+
+    def restore(self, blob):
+        data = pickle.loads(blob)
+        s = self.store
+        s._jobs = dict(data["jobs"])
+        s._tags = set(data["tags"])
+
+    def usage_for(self, namespace):
+        return self.store._usage.get(namespace, {})

@@ -1,9 +1,9 @@
 """Registry with a dead point and an unmet required site, each
 suppressed at its declaration line."""
 
-FAULT_POINTS = ("rpc.drop", "plan.crash", "dead.point", "node.churn_kill")   # analysis: allow(chaos-coverage)
+FAULT_POINTS = ("rpc.drop", "plan.crash", "dead.point", "node.churn_kill")   # analysis: allow(chaos-coverage) — fixture: exercises the suppression path
 
-REQUIRED_SITES = {"plan.crash": ("apply_plan",), "node.churn_kill": ("heartbeat",)}   # analysis: allow(chaos-coverage)
+REQUIRED_SITES = {"plan.crash": ("apply_plan",), "node.churn_kill": ("heartbeat",)}   # analysis: allow(chaos-coverage) — fixture: exercises the suppression path
 
 
 class ChaosRegistry:

@@ -14,12 +14,12 @@ class MiniFSM:
             self._apply_job(index, payload)
 
     def _apply_job(self, index, payload):
-        payload["submit_time"] = _time.time()        # analysis: allow(fsm-determinism)
-        payload["id"] = str(uuid.uuid4())            # analysis: allow(fsm-determinism)
+        payload["submit_time"] = _time.time()        # analysis: allow(fsm-determinism) — fixture: exercises the suppression path
+        payload["id"] = str(uuid.uuid4())            # analysis: allow(fsm-determinism) — fixture: exercises the suppression path
         doomed = set(payload.get("doomed", ()))
-        for d in doomed:                             # analysis: allow(fsm-determinism)
+        for d in doomed:                             # analysis: allow(fsm-determinism) — fixture: exercises the suppression path
             self.store.pop(d, None)
-        self._stamp(payload)                         # analysis: allow(fsm-determinism)
+        self._stamp(payload)                         # analysis: allow(fsm-determinism) — fixture: exercises the suppression path
 
     def _stamp(self, payload):
         payload["nonce"] = uuid.uuid4().hex          # reached only via the allowed edge

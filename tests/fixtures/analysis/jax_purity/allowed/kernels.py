@@ -8,20 +8,20 @@ import numpy as np
 
 @jax.jit
 def bad_norm(x):
-    total = float(x.sum())                   # analysis: allow(jax-purity)
-    arr = np.asarray(x)                      # analysis: allow(jax-purity)
+    total = float(x.sum())                   # analysis: allow(jax-purity) — fixture: exercises the suppression path
+    arr = np.asarray(x)                      # analysis: allow(jax-purity) — fixture: exercises the suppression path
     return x / (total + arr.shape[0])
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
 def bad_gate(scores, k):
-    if scores > 0:                           # analysis: allow(jax-purity)
+    if scores > 0:                           # analysis: allow(jax-purity) — fixture: exercises the suppression path
         return scores * k
     return scores
 
 
 def _pull(x):
-    return x.item()                          # analysis: allow(jax-purity)
+    return x.item()                          # analysis: allow(jax-purity) — fixture: exercises the suppression path
 
 
 def body(x):

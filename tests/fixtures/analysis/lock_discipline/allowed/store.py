@@ -11,4 +11,4 @@ class Store:
         self._jobs = {}
 
     def put(self, job_id, job):
-        self._jobs[job_id] = job                     # analysis: allow(lock-discipline)
+        self._jobs[job_id] = job                     # analysis: allow(lock-discipline) — fixture: exercises the suppression path

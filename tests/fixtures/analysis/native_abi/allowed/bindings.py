@@ -7,10 +7,10 @@ import numpy as np
 _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 
 lib = ctypes.CDLL("libfixture.so")
-if lib.nomad_native_abi_version() != 1:                # analysis: allow(native-abi)
+if lib.nomad_native_abi_version() != 1:                # analysis: allow(native-abi) — fixture: exercises the suppression path
     raise RuntimeError("abi mismatch")
 
-lib.scale_rows.argtypes = [_f32p, ctypes.c_int]        # analysis: allow(native-abi)
-lib.sum_ids.argtypes = [_f32p, ctypes.c_int]           # analysis: allow(native-abi)
+lib.scale_rows.argtypes = [_f32p, ctypes.c_int]        # analysis: allow(native-abi) — fixture: exercises the suppression path
+lib.sum_ids.argtypes = [_f32p, ctypes.c_int]           # analysis: allow(native-abi) — fixture: exercises the suppression path
 lib.sum_ids.restype = ctypes.c_int
-lib.old_fn.argtypes = [ctypes.c_int]                   # analysis: allow(native-abi)
+lib.old_fn.argtypes = [ctypes.c_int]                   # analysis: allow(native-abi) — fixture: exercises the suppression path

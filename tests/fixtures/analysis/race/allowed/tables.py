@@ -5,14 +5,14 @@ from nomad_tpu.analysis import race
 
 
 class BadDecl:
-    _RACE_TRACED = ["_ring"]                # analysis: allow(happens-before)
+    _RACE_TRACED = ["_ring"]                # analysis: allow(happens-before) — fixture: exercises the suppression path
 
     def __init__(self):
         self._ring = []
 
 
 class Store:
-    _RACE_TRACED = {"_ring": "_lock", "_ghost": "_lock2"}   # analysis: allow(happens-before)
+    _RACE_TRACED = {"_ring": "_lock", "_ghost": "_lock2"}   # analysis: allow(happens-before) — fixture: exercises the suppression path
 
     def __init__(self):
         self._ring = []
@@ -25,4 +25,4 @@ class Store:
 
 
 def rogue(obj):
-    race.read("Phantom._tbl", obj)          # analysis: allow(happens-before)
+    race.read("Phantom._tbl", obj)          # analysis: allow(happens-before) — fixture: exercises the suppression path

@@ -5,8 +5,8 @@ _RECOMPILE_TRACKED = True
 
 
 @jax.jit
-def scan_kernel(x):                         # analysis: allow(recompile-budget)
+def scan_kernel(x):                         # analysis: allow(recompile-budget) — fixture: exercises the suppression path
     return x * 2
 
 
-bulk_kernel = jax.jit(lambda x: x + 1)      # analysis: allow(recompile-budget)
+bulk_kernel = jax.jit(lambda x: x + 1)      # analysis: allow(recompile-budget) — fixture: exercises the suppression path

@@ -1,0 +1,57 @@
+"""The same seeded drift, every finding suppressed with a reasoned
+allow — on the finding's line or its enclosing def line."""
+import pickle
+import threading
+
+
+class MiniStore:
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({
+        "_jobs", "_orphans", "_ghost", "_phantom", "_by_job"})
+    _SNAPSHOT_DERIVED = {   # analysis: allow(snapshot-completeness) — fixture models a half-migrated declaration
+        "_by_job": "_index_job_locked",
+        "_absent": "_no_such_builder",
+    }
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs = {}
+        self._orphans = {}
+        self._ghost = {}
+        self._phantom = {}
+        self._by_job = {}
+
+    def _index_job_locked(self, job):   # analysis: allow(snapshot-completeness) — builder kept for the next migration step
+        self._by_job[job["id"]] = job["name"]
+
+
+class MiniFSM:
+    def __init__(self, store: MiniStore):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        if msg_type == "job":
+            self._apply_job(index, payload)
+
+    def _apply_job(self, index, payload):
+        job = payload["job"]
+        self.store._jobs[job["id"]] = job
+        self.store._orphans[job["id"]] = index   # analysis: allow(snapshot-completeness) — debug counter, deliberately process-local
+
+    def snapshot(self):   # analysis: allow(snapshot-completeness) — legacy record shape frozen until the format version bump
+        s = self.store
+        return pickle.dumps({
+            "jobs": dict(s._jobs),
+            "ghost": dict(s._ghost),
+            "legacy": 1,
+        })
+
+    def restore(self, blob):   # analysis: allow(snapshot-completeness) — restore still speaks the pre-migration record
+        data = pickle.loads(blob)
+        s = self.store
+        s._jobs = dict(data["jobs"])
+        s._phantom = {"seen": True}
+        if data.get("missing"):
+            s._jobs.clear()
+        for job in s._jobs.values():
+            s._by_job[job["id"]] = job["name"]

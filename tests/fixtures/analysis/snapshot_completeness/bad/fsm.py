@@ -1,0 +1,59 @@
+"""Seeded snapshot-completeness violations: a write-only table, a
+persist-only table, a restore-only table, record-key drift both ways, an
+inline derived-index rebuild, and builder-declaration drift (missing
+method, unreachable from restore, incremental yet unshared with apply)."""
+import pickle
+import threading
+
+
+class MiniStore:
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({
+        "_jobs", "_orphans", "_ghost", "_phantom", "_by_job"})
+    _SNAPSHOT_DERIVED = {
+        "_by_job": "_index_job_locked",
+        "_absent": "_no_such_builder",
+    }
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs = {}
+        self._orphans = {}
+        self._ghost = {}
+        self._phantom = {}
+        self._by_job = {}
+
+    def _index_job_locked(self, job):
+        self._by_job[job["id"]] = job["name"]
+
+
+class MiniFSM:
+    def __init__(self, store: MiniStore):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        if msg_type == "job":
+            self._apply_job(index, payload)
+
+    def _apply_job(self, index, payload):
+        job = payload["job"]
+        self.store._jobs[job["id"]] = job
+        self.store._orphans[job["id"]] = index       # write-only table
+
+    def snapshot(self):
+        s = self.store
+        return pickle.dumps({
+            "jobs": dict(s._jobs),
+            "ghost": dict(s._ghost),                 # persist-only table
+            "legacy": 1,                             # key never read back
+        })
+
+    def restore(self, blob):
+        data = pickle.loads(blob)
+        s = self.store
+        s._jobs = dict(data["jobs"])
+        s._phantom = {"seen": True}                  # restore-only table
+        if data.get("missing"):                      # key never written
+            s._jobs.clear()
+        for job in s._jobs.values():
+            s._by_job[job["id"]] = job["name"]       # inline rebuild

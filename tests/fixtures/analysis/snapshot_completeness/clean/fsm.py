@@ -1,0 +1,48 @@
+"""A complete snapshot round trip: every mutated table is persisted and
+restored, derived indexes rebuild through the one shared builder the
+apply path also uses, and the ephemeral cache is declared."""
+import pickle
+import threading
+
+
+class MiniStore:
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({"_jobs", "_by_job", "_cache"})
+    _SNAPSHOT_DERIVED = {"_by_job": "_index_job_locked"}
+    _SNAPSHOT_EPHEMERAL = frozenset({"_cache"})
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._jobs = {}
+        self._by_job = {}
+        self._cache = None
+
+    def _index_job_locked(self, job):
+        self._by_job[job["id"]] = job["name"]
+
+
+class MiniFSM:
+    def __init__(self, store: MiniStore):
+        self.store = store
+
+    def apply(self, index, msg_type, payload):
+        if msg_type == "job":
+            self._apply_job(index, payload)
+
+    def _apply_job(self, index, payload):
+        job = payload["job"]
+        self.store._jobs[job["id"]] = job
+        self.store._index_job_locked(job)
+        self.store._cache = None
+
+    def snapshot(self):
+        s = self.store
+        return pickle.dumps({"jobs": dict(s._jobs)})
+
+    def restore(self, blob):
+        data = pickle.loads(blob)
+        s = self.store
+        s._jobs = dict(data["jobs"])
+        s._by_job = {}
+        for job in s._jobs.values():
+            s._index_job_locked(job)

@@ -11,18 +11,18 @@ def scatter_kernel(basis, rows):
 
 
 def upload(basis):
-    return jax.device_put(basis)            # analysis: allow(transfer-purity)
+    return jax.device_put(basis)            # analysis: allow(transfer-purity) — fixture: exercises the suppression path
 
 
 def drain(out_dev):
-    total = float(out_dev)                  # analysis: allow(transfer-purity)
-    first = out_dev.item()                  # analysis: allow(transfer-purity)
-    host = np.asarray(out_dev)              # analysis: allow(transfer-purity)
-    if out_dev:                             # analysis: allow(transfer-purity)
+    total = float(out_dev)                  # analysis: allow(transfer-purity) — fixture: exercises the suppression path
+    first = out_dev.item()                  # analysis: allow(transfer-purity) — fixture: exercises the suppression path
+    host = np.asarray(out_dev)              # analysis: allow(transfer-purity) — fixture: exercises the suppression path
+    if out_dev:                             # analysis: allow(transfer-purity) — fixture: exercises the suppression path
         total += 1
     return total, first, host
 
 
 def dispatch(basis_dev):
     rows = np.zeros((4, 2), np.float32)
-    return scatter_kernel(basis_dev, rows)  # analysis: allow(transfer-purity)
+    return scatter_kernel(basis_dev, rows)  # analysis: allow(transfer-purity) — fixture: exercises the suppression path

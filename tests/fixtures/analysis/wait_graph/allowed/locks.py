@@ -1,0 +1,45 @@
+"""The same hazards, suppressed: the reverse-order acquire and the
+held-blocking sites carry reasoned allows, and the WAL lock declares why
+it may span its fsync."""
+import os
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def ab(self):
+        with self._la:
+            with self._lb:
+                pass
+
+    def ba(self):
+        with self._lb:
+            with self._la:   # analysis: allow(wait-graph) — shutdown-only path, never concurrent with ab (guarded by the stopped flag)
+                pass
+
+    def flush(self, fd):
+        with self._la:   # analysis: allow(wait-graph) — flush is the lock's purpose; contenders need the fsync ordering
+            os.fsync(fd)
+
+    def drain(self, fd):
+        with self._lb:   # analysis: allow(wait-graph) — drain serializes the final sync on shutdown
+            self._sync(fd)
+
+    def _sync(self, fd):
+        os.fsync(fd)
+
+
+class Wal:
+    _LOCK_BLOCKING_OK = {
+        "_lock": "append+fsync must stay atomic per record",
+    }
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def append(self, fd):
+        with self._lock:
+            os.fsync(fd)

@@ -1,0 +1,39 @@
+"""Seeded wait-graph violations: an opposite-order nesting cycle, locks
+held across blocking calls (directly and through a callee), and a
+reasonless _LOCK_BLOCKING_OK declaration."""
+import os
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def ab(self):
+        with self._la:
+            with self._lb:
+                pass
+
+    def ba(self):
+        with self._lb:
+            with self._la:               # opposite order -> cycle
+                pass
+
+    def flush(self, fd):
+        with self._la:
+            os.fsync(fd)                 # held across fsync
+
+    def drain(self, fd):
+        with self._lb:
+            self._sync(fd)               # held across callee's fsync
+
+    def _sync(self, fd):
+        os.fsync(fd)
+
+
+class Wal:
+    _LOCK_BLOCKING_OK = {"_lock": ""}    # reasonless declaration
+
+    def __init__(self):
+        self._lock = threading.Lock()

@@ -1,0 +1,47 @@
+"""Disciplined locking: one global nesting order, blocking I/O only
+under a lock declared (with reason) to serialize it, and condition
+waits only on the condition wrapping the held lock."""
+import os
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._la = threading.Lock()
+        self._lb = threading.Lock()
+
+    def ab(self):
+        with self._la:
+            with self._lb:
+                pass
+
+    def also_ab(self):
+        with self._la:
+            with self._lb:
+                pass
+
+
+class Wal:
+    _LOCK_BLOCKING_OK = {
+        "_lock": "append+fsync must stay atomic per record",
+    }
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def append(self, fd):
+        with self._lock:
+            os.fsync(fd)
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._items = []
+
+    def get(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait()          # waits on the held lock's cv
+            return self._items.pop(0)

@@ -30,6 +30,10 @@ CASES = [
     ("transfer_purity", "transfer-purity", 6),
     ("recompile", "recompile-budget", 2),
     ("race", "happens-before", 5),
+    ("snapshot_completeness", "snapshot-completeness", 10),
+    ("canonical_form", "canonical-form", 6),
+    ("wait_graph", "wait-graph", 4),
+    ("allow_audit", "allow-audit", 3),
 ]
 
 
@@ -57,6 +61,16 @@ def test_clean_tree_passes(fixture, checker, n_bad):
     assert run_all(FIXTURES / fixture / "clean", checkers=[checker]) == []
 
 
+@pytest.mark.parametrize("fixture,checker,n_bad", CASES,
+                         ids=[c[1] for c in CASES])
+def test_allowed_corpus_is_audit_clean(fixture, checker, n_bad):
+    """Every allowed-corpus suppression carries a reason and is consulted
+    by the checker it names: run_all runs the whole suite before the
+    audit, so a dead or reasonless allow would surface here."""
+    assert run_all(FIXTURES / fixture / "allowed",
+                   checkers=[checker, "allow-audit"]) == []
+
+
 def test_transitive_findings_carry_call_chain():
     findings = run_all(FIXTURES / "fsm_determinism" / "bad",
                        checkers=["fsm-determinism"])
@@ -73,6 +87,38 @@ def test_repo_tree_is_clean():
 def test_unknown_checker_rejected():
     with pytest.raises(ValueError, match="unknown checker"):
         run_all(FIXTURES / "fsm_determinism" / "clean", checkers=["nope"])
+
+
+def test_wait_graph_merges_runtime_corpus_into_cycle():
+    """A runtime-observed edge opposite to a static one must close a
+    cycle — the merged graph is the whole point of the shared corpus."""
+    from nomad_tpu.analysis import wait_graph
+    from nomad_tpu.analysis.common import load_corpus, lock_alloc_sites
+
+    root = FIXTURES / "wait_graph" / "clean"
+    corpus = load_corpus(root)
+    sites = lock_alloc_sites(corpus.py)
+    la, lb = sites[("Pair", "_la")], sites[("Pair", "_lb")]
+    corpus.lock_corpus = {
+        "format": "nomad-tpu-lock-order/1",
+        "edges": [{"a": lb, "b": la, "thread": "t9", "held": [lb]}],
+    }
+    findings = wait_graph.run(corpus)
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "lock-order cycle" in msg
+    assert "[runtime: thread t9]" in msg and "[static:" in msg
+
+
+def test_wait_graph_rejects_foreign_corpus_format():
+    from nomad_tpu.analysis import wait_graph
+    from nomad_tpu.analysis.common import load_corpus
+
+    corpus = load_corpus(FIXTURES / "wait_graph" / "clean")
+    corpus.lock_corpus = {"format": "bogus/9", "edges": []}
+    findings = wait_graph.run(corpus)
+    assert len(findings) == 1
+    assert "format" in findings[0].message
 
 
 # ------------------------------------------------------------------ the CLI
@@ -105,6 +151,50 @@ def test_cli_json_output():
     assert len(doc["findings"]) == 5
     assert {f["checker"] for f in doc["findings"]} == {"native-abi"}
     assert all({"path", "line", "message"} <= set(f) for f in doc["findings"])
+
+
+def test_cli_list_checkers():
+    res = _cli("--list-checkers")
+    assert res.returncode == 0
+    assert res.stdout.split() == list(CHECKERS)
+    assert len(CHECKERS) == 12
+
+
+def test_cli_checkers_csv_and_json_counts():
+    res = _cli("--root", str(FIXTURES / "wait_graph" / "bad"),
+               "--checkers", "wait-graph,allow-audit", "--json")
+    assert res.returncode == 1
+    doc = json.loads(res.stdout)
+    assert doc["checkers"] == ["wait-graph", "allow-audit"]
+    assert doc["counts"]["wait-graph"] == 4
+    assert doc["counts"]["allow-audit"] == 0
+    assert len(doc["findings"]) == 4
+
+
+def test_cli_lock_corpus_flag(tmp_path):
+    from nomad_tpu.analysis.common import load_corpus, lock_alloc_sites
+
+    root = FIXTURES / "wait_graph" / "clean"
+    sites = lock_alloc_sites(load_corpus(root).py)
+    corpus = {"format": "nomad-tpu-lock-order/1",
+              "edges": [{"a": sites[("Pair", "_lb")],
+                         "b": sites[("Pair", "_la")],
+                         "thread": "t1", "held": []}]}
+    p = tmp_path / "corpus.json"
+    p.write_text(json.dumps(corpus))
+    res = _cli("--root", str(root), "--checker", "wait-graph",
+               "--lock-corpus", str(p))
+    assert res.returncode == 1
+    assert "lock-order cycle" in res.stdout
+
+
+def test_cli_rejects_foreign_lock_corpus(tmp_path):
+    p = tmp_path / "bogus.json"
+    p.write_text('{"format": "other/1"}')
+    res = _cli("--root", str(FIXTURES / "wait_graph" / "clean"),
+               "--lock-corpus", str(p))
+    assert res.returncode == 2
+    assert "lock-order corpus" in res.stderr
 
 
 def test_cli_runs_without_jax():
@@ -193,6 +283,34 @@ def test_lock_order_recorder_wraps_condition():
             cv.notify_all()
         t.join()
     assert rec.cycles() == []
+
+
+def test_lock_order_dump_load_roundtrip(tmp_path):
+    """dump() writes the shared corpus format wait-graph consumes."""
+    from nomad_tpu.analysis import load_lock_corpus
+    from nomad_tpu.analysis.lock_order import LOCK_ORDER_FORMAT
+
+    rec = LockOrderRecorder()
+    a = _wrapped(rec, "store.py:10")
+    b = _wrapped(rec, "wal.py:20")
+    _nest(a, b)
+    path = tmp_path / "corpus.json"
+    rec.dump(path)
+    data = load_lock_corpus(path)
+    assert data["format"] == LOCK_ORDER_FORMAT
+    assert len(data["edges"]) == 1
+    edge = data["edges"][0]
+    assert edge["a"] == "store.py:10" and edge["b"] == "wal.py:20"
+    assert edge["thread"] and edge["held"] == ["store.py:10"]
+
+
+def test_load_lock_corpus_rejects_foreign_json(tmp_path):
+    from nomad_tpu.analysis import load_lock_corpus
+
+    p = tmp_path / "x.json"
+    p.write_text('{"what": 1}')
+    with pytest.raises(ValueError, match="lock-order corpus"):
+        load_lock_corpus(p)
 
 
 def test_lock_order_recorder_uninstall_restores_factories():
@@ -345,6 +463,47 @@ def _replay(log):
 def test_fsm_replay_is_byte_identical():
     log = _fsm_log()
     assert _replay(log) == _replay(log)
+
+
+def test_snapshot_derived_builders_are_real_methods():
+    """The _SNAPSHOT_DERIVED contract the snapshot-completeness checker
+    enforces statically, asserted live: every declared builder exists
+    and every derived table is in the replicated universe."""
+    for table, builder in StateStore._SNAPSHOT_DERIVED.items():
+        assert callable(getattr(StateStore, builder)), (table, builder)
+        assert table in StateStore._LOCK_PROTECTED, table
+
+
+def test_restore_rebuilds_derived_indexes_like_a_live_store():
+    """A restored follower's derived indexes must equal a live
+    survivor's — including the liveness index, which must NOT contain
+    terminal allocs.  Apply and restore share the _index_*_locked
+    builders, so the two paths cannot drift."""
+    from nomad_tpu.structs import AllocClientStatus
+
+    node = mock.node()
+    job = mock.job(submit_time=1.0)
+    live_a = mock.alloc_for(job, node.id)
+    dead_a = mock.alloc_for(job, node.id, index=1,
+                            client_status=AllocClientStatus.COMPLETE)
+    log = [
+        (1, MessageType.NODE_REGISTER, {"node": node}),
+        (2, MessageType.JOB_REGISTER, {"job": job}),
+        (3, MessageType.ALLOC_UPDATE, {"allocs": [live_a, dead_a]}),
+    ]
+    live = NomadFSM(StateStore())
+    for index, msg_type, payload in copy.deepcopy(log):
+        live.apply(index, msg_type, payload)
+    restored = NomadFSM(StateStore())
+    restored.restore(live.snapshot())
+    ls, rs = live.store, restored.store
+    for table in ("_allocs_by_job", "_allocs_by_node", "_allocs_by_eval",
+                  "_evals_by_job", "_services_by_alloc"):
+        assert dict(getattr(ls, table)) == dict(getattr(rs, table)), table
+    assert ls._live_names == rs._live_names
+    assert all(dead_a.id not in ids for ids in rs._live_names.values())
+    assert set(ls._acl_by_secret) == set(rs._acl_by_secret)
+    assert ls._applied_plan_ids_set == rs._applied_plan_ids_set
 
 
 def test_fsm_replay_matches_snapshot_restore_roundtrip():
