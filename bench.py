@@ -48,6 +48,11 @@ _STEADY_STATE: dict = {}
 # folded into the BENCH JSON so BENCH_r06 names the stage to fuse first
 _DEVICE_STAGES: dict = {}
 
+# per-scenario engine-stats snapshot taken before the server stops; the
+# --smoke fused-path gate reads it after the run (fused dispatch means
+# one device dispatch per wave group: bulk_parts == bulk_groups)
+_ENGINE_SNAP: dict = {}
+
 
 class _SteadyGate:
     """Arms the steady-state dispatch discipline around a measured
@@ -395,16 +400,29 @@ def bench_c2m_1m(n_nodes=10000, n_jobs=10000, groups_per_job=10,
         eng = get_engine()
         if eng:
             log(f"{scenario} engine stats: {eng.stats}")
+            _ENGINE_SNAP[scenario] = dict(eng.stats)
             # stage attribution runs strictly AFTER the steady gate has
             # exited: the probe compiles its own kernels and moves data,
             # which must not count against the gate's purity budgets
             try:
+                from nomad_tpu.ops.place import fill_grid_for
                 from nomad_tpu.parallel import stage_probe
-                ds = stage_probe.device_stages(eng.stats, n_nodes)
+                # device time the commit pipeline hid under raft
+                # append + fsync: engine device-blocked windows against
+                # the applier's commit windows
+                overlap = stage_probe.interval_overlap_s(
+                    list(eng.device_windows),
+                    list(s.applier.commit_windows))
+                ds = stage_probe.device_stages(
+                    eng.stats, n_nodes,
+                    fill_grid=fill_grid_for(group_count),
+                    pipeline_overlap_s=overlap)
                 if ds is not None:
                     _DEVICE_STAGES[scenario] = ds
                     log(f"{scenario} device stages: dominant="
-                        f"{ds['dominant_stage']} {ds['stages_s']}")
+                        f"{ds['dominant_stage']} {ds['stages_s']} "
+                        f"overlap={ds['pipeline_overlap_s']}s "
+                        f"fused={ds['fused']}")
             except Exception as e:  # noqa: BLE001
                 log(f"{scenario} stage probe failed: {e}")
         _log_plan_submit(scenario)
@@ -977,6 +995,41 @@ def main():
                 scenario_violations.append(
                     f"{name}: plan.submit p99 {p99} ms > "
                     f"cap {p99_cap_ms} ms")
+        # fused-path leg (r15): the smoke spine must have run every bulk
+        # wave group as ONE device dispatch (NOMAD_TPU_FUSE default),
+        # and the fused kernel must be registered with the recompile
+        # budget and warm before the gate (its cache populated by
+        # warmup, not the measured window).  The sharded twin is only
+        # checkable on a multi-device host.
+        fused_violations = []
+        snap = _ENGINE_SNAP.get("smoke", {})
+        groups = snap.get("bulk_groups", 0)
+        parts = snap.get("bulk_parts", 0)
+        if os.environ.get("NOMAD_TPU_FUSE", "1") != "0":
+            if groups <= 0:
+                fused_violations.append(
+                    "no bulk wave groups dispatched (fused path unused)")
+            elif parts != groups:
+                fused_violations.append(
+                    f"fused path inactive: {parts} device dispatches for "
+                    f"{groups} wave groups (expected 1 per wave)")
+        from nomad_tpu.analysis import recompile as _recompile
+        kernel_sizes = _recompile.cache_sizes()
+        want_kernels = ["place.bulk_batch"]
+        try:
+            import jax
+            if jax.device_count() > 1:
+                want_kernels.append("sharded.bulk")
+        except Exception:   # noqa: BLE001
+            pass
+        for k in want_kernels:
+            if kernel_sizes.get(k) is None:
+                fused_violations.append(
+                    f"kernel {k!r} missing a recompile.register entry")
+            elif kernel_sizes[k] < 1:
+                fused_violations.append(
+                    f"kernel {k!r} registered but never warmed "
+                    f"(cache empty after the run)")
         # tracing leg: disabled guards must be free, sampled run must
         # export a well-formed Perfetto file (r12)
         trace_checks = _smoke_trace_checks()
@@ -991,10 +1044,18 @@ def main():
             "steady_state": steady,
             "serving_plane": serving,
             "device_stages": _DEVICE_STAGES.get("smoke"),
+            "fused": {"bulk_groups": groups, "bulk_parts": parts,
+                      "kernels": {k: kernel_sizes.get(k)
+                                  for k in want_kernels},
+                      "violations": fused_violations},
             "tracing": trace_checks,
         }), flush=True)
         if steady.get("violations"):
             log("steady-state violations:", steady["violations"])
+            sys.exit(1)
+        if fused_violations:
+            for v in fused_violations:
+                log("fused gate:", v)
             sys.exit(1)
         if trace_checks["violations"]:
             for v in trace_checks["violations"]:

@@ -121,6 +121,18 @@ Fault points and their injection sites:
                               if a burst slipped past the stride
                               accounting; the starvation bound must hold
                               regardless
+    plan.commit_stall         core/plan_apply.py — the raft append +
+                              fsync of a commit batch stalls `delay_ms`
+                              while the pipelined next wave evaluates
+                              against the optimistic overlay, widening
+                              the speculative window the double-buffer
+                              invariants must survive
+    worker.settle_drop        core/worker.py — a pipelined worker's
+                              deferred eval settlement (status update +
+                              broker ack after the commit future lands)
+                              is dropped, as if the worker died between
+                              commit and ack: the lease must expire and
+                              redelivery must no-op via plan dedup
 
 `REQUIRED_SITES` pins points to the hot-path functions that must carry
 them; the chaos-coverage linter fails if a refactor drops one.
@@ -166,6 +178,8 @@ FAULT_POINTS = (
     "region.partition",
     "quota.apply_stall",
     "broker.unfair_burst",
+    "plan.commit_stall",
+    "worker.settle_drop",
 )
 
 # Points that must be injected in these specific functions (enforced by
@@ -186,7 +200,9 @@ REQUIRED_SITES = {
     "transfer.timeout": ("RaftNode.transfer_leadership",),
     "region.partition": ("RegionRouter.route",),
     "quota.apply_stall": ("PlanApplier._evaluate",),
-    "broker.unfair_burst": ("EvalBroker.dequeue",),
+    "broker.unfair_burst": ("EvalBroker._pick_locked",),
+    "plan.commit_stall": ("PlanApplier._commit_batch_and_resolve",),
+    "worker.settle_drop": ("Worker._settle_eval",),
 }
 
 

@@ -213,6 +213,75 @@ class EvalBroker:
             lease = self._unack.pop(token)
             self._nack_locked(lease.eval, requeue_now=True)
 
+    @requires_lock("_lock")
+    def _pick_locked(self, schedulers: List[str]
+                     ) -> Optional[Tuple[Evaluation, str]]:
+        """One fair pick + lease mint, or None when nothing is ready.
+        Shared by dequeue (one pick per lock pass) and dequeue_batch
+        (repeated picks draining a wave in one pass)."""
+        # fair pick: the runnable namespace with the minimum
+        # stride pass (ties broken by the global head order so
+        # equal-pass namespaces keep FIFO-within-priority);
+        # fairness off -> pure global (-priority, seq) order
+        fair = self._fair_enabled
+        if fair and chaos.active is not None and \
+                chaos.active.should("broker.unfair_burst"):
+            # one dequeue slips past the stride accounting, as
+            # if a burst raced the pick; the pass charge below
+            # still lands, so the debt is repaid on the next
+            # picks and the starvation bound must still hold
+            fair = False
+            self.stats["fair_bypassed"] += 1
+        best_q, best_ns, best_key = None, None, None
+        for s in schedulers:
+            for ns in self._ns_nonempty.get(s, ()):
+                head = self._ns_ready[s][ns][0]
+                key = (self._fair_pass.get(ns, 0.0),
+                       head[0], head[1]) if fair \
+                    else (head[0], head[1])
+                if best_key is None or key < best_key:
+                    best_q, best_ns, best_key = s, ns, key
+        if best_ns is None:
+            return None
+        heap = self._ns_ready[best_q][best_ns]
+        best = heapq.heappop(heap)
+        if not heap:
+            del self._ns_ready[best_q][best_ns]
+            self._ns_nonempty[best_q].discard(best_ns)
+        if self._fair_enabled:
+            self._fair_pass[best_ns] = \
+                self._fair_pass.get(best_ns, 0.0) + \
+                self._stride(best_ns)
+            self.stats["fair_picks"] += 1
+        ev = best[2]
+        token = str(uuid.uuid4())
+        expires = _time.time() + self.nack_timeout
+        if chaos.active is not None and \
+                chaos.active.should("broker.lease_expire"):
+            # hand out an already-expired lease: the next timer
+            # poll auto-nacks it, so the worker's eventual ack
+            # or plan submit sees a stale token
+            expires = _time.time()
+            self.stats["chaos_lease_expired"] += 1
+        race.write("EvalBroker._unack", self)
+        self._unack[token] = _Lease(ev, token, expires)
+        self.stats["dequeued"] += 1
+        tracer = tracing.active
+        if tracer is not None:
+            # queue-wait span, stitched from the propose-time
+            # note (the FSM's leader hook enqueues inside the
+            # apply cone, so nothing is stamped there); the
+            # context is re-noted for the dequeuing worker
+            note = tracer.take_eval_note(ev.id)
+            if note is not None:
+                ctx, enq_ts = note
+                tracer.emit(
+                    ctx, "broker.wait", enq_ts, _time.time(),
+                    node=getattr(self, "node_name", ""),
+                    eval_id=ev.id, sched=ev.type)
+                tracer.note_eval(ev.id, ctx)
+        return ev, token
+
     def dequeue(self, schedulers: List[str], timeout: float = 0.0
                 ) -> Tuple[Optional[Evaluation], str]:
         """-> (eval, token) or (None, '')."""
@@ -220,73 +289,41 @@ class EvalBroker:
         with self._lock:
             while True:
                 self._poll_timers_locked()
-                # fair pick: the runnable namespace with the minimum
-                # stride pass (ties broken by the global head order so
-                # equal-pass namespaces keep FIFO-within-priority);
-                # fairness off -> pure global (-priority, seq) order
-                fair = self._fair_enabled
-                if fair and chaos.active is not None and \
-                        chaos.active.should("broker.unfair_burst"):
-                    # one dequeue slips past the stride accounting, as
-                    # if a burst raced the pick; the pass charge below
-                    # still lands, so the debt is repaid on the next
-                    # picks and the starvation bound must still hold
-                    fair = False
-                    self.stats["fair_bypassed"] += 1
-                best_q, best_ns, best_key = None, None, None
-                for s in schedulers:
-                    for ns in self._ns_nonempty.get(s, ()):
-                        head = self._ns_ready[s][ns][0]
-                        key = (self._fair_pass.get(ns, 0.0),
-                               head[0], head[1]) if fair \
-                            else (head[0], head[1])
-                        if best_key is None or key < best_key:
-                            best_q, best_ns, best_key = s, ns, key
-                if best_ns is not None:
-                    heap = self._ns_ready[best_q][best_ns]
-                    best = heapq.heappop(heap)
-                    if not heap:
-                        del self._ns_ready[best_q][best_ns]
-                        self._ns_nonempty[best_q].discard(best_ns)
-                    if self._fair_enabled:
-                        self._fair_pass[best_ns] = \
-                            self._fair_pass.get(best_ns, 0.0) + \
-                            self._stride(best_ns)
-                        self.stats["fair_picks"] += 1
-                    ev = best[2]
-                    token = str(uuid.uuid4())
-                    expires = _time.time() + self.nack_timeout
-                    if chaos.active is not None and \
-                            chaos.active.should("broker.lease_expire"):
-                        # hand out an already-expired lease: the next timer
-                        # poll auto-nacks it, so the worker's eventual ack
-                        # or plan submit sees a stale token
-                        expires = _time.time()
-                        self.stats["chaos_lease_expired"] += 1
-                    race.write("EvalBroker._unack", self)
-                    self._unack[token] = _Lease(ev, token, expires)
-                    self.stats["dequeued"] += 1
-                    tracer = tracing.active
-                    if tracer is not None:
-                        # queue-wait span, stitched from the propose-time
-                        # note (the FSM's leader hook enqueues inside the
-                        # apply cone, so nothing is stamped there); the
-                        # context is re-noted for the dequeuing worker
-                        note = tracer.take_eval_note(ev.id)
-                        if note is not None:
-                            ctx, enq_ts = note
-                            tracer.emit(
-                                ctx, "broker.wait", enq_ts, _time.time(),
-                                node=getattr(self, "node_name", ""),
-                                eval_id=ev.id, sched=ev.type)
-                            tracer.note_eval(ev.id, ctx)
-                    return ev, token
+                got = self._pick_locked(schedulers)
+                if got is not None:
+                    return got
                 remaining = deadline - _time.time()
                 if remaining <= 0:
                     return None, ""
                 # wake early enough to serve delay heaps
                 wake = min(remaining, 0.05)
                 self._lock.wait(wake)
+
+    def dequeue_batch(self, schedulers: List[str], max_n: int,
+                      timeout: float = 0.0
+                      ) -> List[Tuple[Evaluation, str]]:
+        """Wave dequeue: block up to `timeout` for the FIRST ready eval,
+        then drain up to max_n in the SAME lock pass — one fair pick and
+        one lease per eval, so fairness accounting and job dedup are
+        byte-identical to max_n sequential dequeues.  Never waits for
+        the batch to fill: a shallow queue returns what exists so wave
+        batching can't add latency when traffic is light."""
+        deadline = _time.time() + timeout
+        out: List[Tuple[Evaluation, str]] = []
+        with self._lock:
+            while True:
+                self._poll_timers_locked()
+                while len(out) < max_n:
+                    got = self._pick_locked(schedulers)
+                    if got is None:
+                        break
+                    out.append(got)
+                if out:
+                    return out
+                remaining = deadline - _time.time()
+                if remaining <= 0:
+                    return out
+                self._lock.wait(min(remaining, 0.05))
 
     # ------------------------------------------------------------- ack/nack
 
@@ -404,3 +441,76 @@ class EvalBroker:
                 "picks": self.stats["fair_picks"],
                 "bypassed": self.stats["fair_bypassed"],
             }
+
+
+class EvalWaveFeeder:
+    """Wave-aligned front of `EvalBroker.dequeue` for a local worker
+    pool.
+
+    Whichever worker finds the shared buffer empty becomes the filler
+    and drains a whole ready wave in ONE broker lock pass
+    (`dequeue_batch`); its peers take from the buffered wave without
+    touching the broker at all.  A burst of ready evals therefore
+    reaches every scheduler at the same instant — instead of
+    arrival-jittered single dequeues — so the PlacementEngine's
+    dispatch coalescing sees full-wave batches end to end (broker wave
+    -> scheduler pool -> one fused device dispatch).
+
+    Buffered entries already hold their lease: the filler hands them to
+    peers within one scheduling pass (the wave is bounded by the pool
+    size), far inside the nack timeout, and `close()` nacks anything
+    still buffered at teardown so shutdown never strands a lease.
+    """
+
+    def __init__(self, broker: EvalBroker, max_n: int = 48):
+        self.broker = broker
+        self.max_n = max(1, max_n)
+        self._lock = threading.Condition()
+        self._buf: Dict[tuple, deque] = {}
+        self._filling: Set[tuple] = set()
+        self.stats = {"waves": 0, "wave_evals": 0, "max_wave": 0}
+
+    def get(self, schedulers: List[str], timeout: float = 0.1
+            ) -> Optional[Tuple[Evaluation, str]]:
+        key = tuple(schedulers)
+        deadline = _time.time() + timeout
+        with self._lock:
+            while True:
+                buf = self._buf.get(key)
+                if buf:
+                    return buf.popleft()
+                if key not in self._filling:
+                    self._filling.add(key)
+                    break
+                remaining = deadline - _time.time()
+                if remaining <= 0:
+                    return None
+                self._lock.wait(min(remaining, 0.05))
+        wave: List[Tuple[Evaluation, str]] = []
+        try:
+            wave = self.broker.dequeue_batch(
+                list(key), self.max_n,
+                timeout=max(0.0, deadline - _time.time()))
+        finally:
+            with self._lock:
+                self._filling.discard(key)
+                if len(wave) > 1:
+                    self._buf.setdefault(key, deque()).extend(wave[1:])
+                if wave:
+                    self.stats["waves"] += 1
+                    self.stats["wave_evals"] += len(wave)
+                    self.stats["max_wave"] = max(self.stats["max_wave"],
+                                                 len(wave))
+                self._lock.notify_all()
+        return wave[0] if wave else None
+
+    def close(self) -> None:
+        """Nack every still-buffered lease (leadership loss / stop)."""
+        with self._lock:
+            bufs, self._buf = self._buf, {}
+        for buf in bufs.values():
+            for ev, token in buf:
+                try:
+                    self.broker.nack(ev.id, token)
+                except Exception:                   # noqa: BLE001
+                    pass

@@ -28,6 +28,7 @@ from __future__ import annotations
 import os
 import threading
 import time as _time
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -66,9 +67,12 @@ class PlanApplier:
         self._lock = threading.Lock()
         self._commit_lock = threading.Lock()
         # plans coalesced per commit (one indexed write for the whole
-        # batch); the 48-worker C2M legs drive queue depth well past 1
+        # batch); the 48-worker C2M legs drive queue depth well past 1,
+        # and the wave-aligned dequeue front (EvalWaveFeeder) lands a
+        # whole worker pool's plans nearly at once — size the commit
+        # batch to swallow a full wave in one raft apply
         self.batch_n = max(1, int(os.environ.get(
-            "NOMAD_TPU_PLAN_BATCH", "16")))
+            "NOMAD_TPU_PLAN_BATCH", "64")))
         # pipelining overlay: accepted-but-not-yet-committed plan effects,
         # keyed by plan eval token/id (reference plan_apply.go:71-178
         # evaluates plan N+1 against a snapshot with plan N applied while
@@ -76,6 +80,12 @@ class PlanApplier:
         self._overlay_lock = threading.Lock()
         self._overlay: Dict[int, tuple] = {}
         self._overlay_seq = 0
+        # (t0, t1) wall windows where the commit thread held the raft
+        # append + fsync in flight; bench intersects these with the
+        # engine's device-blocked windows to report pipeline_overlap_s
+        # (device time hidden under durability waits).  Appends happen
+        # only on the single commit thread; readers tolerate staleness.
+        self.commit_windows = deque(maxlen=8192)
         self.stats = {"applied": 0, "rejected_nodes": 0, "partial": 0,
                       "pipelined": 0}
 
@@ -120,9 +130,15 @@ class PlanApplier:
                         tracer.emit(tnote[0], "plan.queue_wait",
                                     tnote[1], t0,
                                     node=getattr(self, "node_name", ""))
+                    # snapshot BEFORE evaluating: if the commit finishes
+                    # while _evaluate reads the double-counted window, an
+                    # after-the-fact is_alive() check would skip the
+                    # second look and let the stale rejection stand
+                    commit_in_flight = (commit_t is not None
+                                        and commit_t.is_alive())
                     result = self._evaluate(pending.plan)
                     global_metrics.measure_since("nomad.plan.evaluate", t0)
-                    if commit_t is not None and commit_t.is_alive() and \
+                    if commit_in_flight and \
                             self._result_rejected_something(pending.plan,
                                                             result):
                         # the in-flight commit's usage is counted twice
@@ -144,8 +160,17 @@ class PlanApplier:
                                     node=getattr(self, "node_name", ""))
                 except Exception as e:            # noqa: BLE001
                     pending.future.set_exception(e)
+                    if not pending.evaluated.done():
+                        pending.evaluated.set_exception(e)
                     continue
                 staged.append((pending, result, token))
+                # the plan is validated and its overlay registered: a
+                # pipelined submitter may continue scheduling off this
+                # result while the durable commit is still in flight
+                # (plan_apply.go:71-178's optimistic snapshot, extended
+                # to the worker side)
+                if not pending.evaluated.done():
+                    pending.evaluated.set_result(result)
             if not staged:
                 continue
             if commit_t is not None:
@@ -192,6 +217,11 @@ class PlanApplier:
                             tprev = tracing.bind(pending.trace[0])
                             tbound = True
                             break
+                t0c = _time.time()
+                if chaos.active is not None:
+                    # slow fsync: stretch the durability wait the next
+                    # wave is evaluating (and dispatching) under
+                    chaos.maybe_delay("plan.commit_stall")
                 try:
                     with self._commit_lock:
                         if self._commit_fn is not None:
@@ -205,6 +235,7 @@ class PlanApplier:
                 finally:
                     if tbound:
                         tracing.bind(tprev)
+                self.commit_windows.append((t0c, _time.time()))
                 if chaos.active is not None:
                     # the write landed but futures have not resolved: the
                     # submitter sees an error, retries, and the plan-id
@@ -217,9 +248,19 @@ class PlanApplier:
                 except Exception as e:            # noqa: BLE001
                     pending.future.set_exception(e)
         except Exception as e:                    # noqa: BLE001
+            from nomad_tpu.parallel.engine import get_engine
+            eng = get_engine()
             for pending, _result, _token in staged:
-                if not pending.future.done():
-                    pending.future.set_exception(e)
+                if pending.future.done():
+                    continue
+                # a pipelined submitter continued off `evaluated` and
+                # skipped its early ticket release — free the engine
+                # overlay here so a failed commit never leaks phantom
+                # usage (plans that reached _post_commit released theirs
+                # already; complete_many is idempotent regardless)
+                if eng is not None and pending.plan.engine_tickets:
+                    eng.complete_many(pending.plan.engine_tickets)
+                pending.future.set_exception(e)
         finally:
             with self._overlay_lock:
                 race.write("PlanApplier._overlay", self)

@@ -25,11 +25,19 @@ class LeadershipLostError(Exception):
 class PendingPlan:
     # trace: (ctx, enqueue_ts) for a sampled submission, else None —
     # the applier stitches queue-wait/evaluate/raft spans from it
-    __slots__ = ("plan", "future", "trace")
+    #
+    # `evaluated` resolves with the PlanResult as soon as the applier has
+    # validated the plan and registered its overlay — before the raft
+    # append + fsync lands.  A pipelined worker continues scheduling off
+    # this future while `future` (the durable commit) is still in
+    # flight; if the commit later fails, `future` carries the error and
+    # the worker discards the speculative continuation.
+    __slots__ = ("plan", "future", "evaluated", "trace")
 
     def __init__(self, plan: Plan):
         self.plan = plan
         self.future: Future = Future()
+        self.evaluated: Future = Future()
         self.trace = None
         if tracing.active is not None:
             ctx = tracing.current()
@@ -50,7 +58,9 @@ class PlanQueue:
             self.enabled = enabled
             if not enabled:
                 for _, _, p in self._heap:
-                    p.future.set_exception(LeadershipLostError("plan queue disabled"))
+                    err = LeadershipLostError("plan queue disabled")
+                    p.future.set_exception(err)
+                    p.evaluated.set_exception(err)
                 self._heap = []
             self._lock.notify_all()
 

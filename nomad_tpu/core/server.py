@@ -112,6 +112,8 @@ class Server:
         self.applier.on_preempted = self._create_preemption_evals
         self.workers: List[Worker] = []
         self.remote_workers: List[Worker] = []
+        # dev-mode wave-aligned dequeue front (set at leadership)
+        self.eval_feeder = None
         self._raft_lock = threading.Lock()     # serializes indexed writes
         self._stop = threading.Event()
         self._leader_stop = threading.Event()
@@ -389,7 +391,14 @@ class Server:
             self._plan_thread.start()
             if self.raft is None:
                 # dev mode: local workers; in cluster mode RemoteWorkers
-                # already run on every member (started in start())
+                # already run on every member (started in start()).  The
+                # wave feeder aligns the pool's dequeues: one broker lock
+                # pass drains a whole ready wave so the engine coalesces
+                # full-wave dispatch batches (NOMAD_TPU_WAVE caps it).
+                from nomad_tpu.core.broker import EvalWaveFeeder
+                wave_n = int(os.environ.get(
+                    "NOMAD_TPU_WAVE", str(self.config.num_schedulers)))
+                self.eval_feeder = EvalWaveFeeder(self.broker, wave_n)
                 for i in range(self.config.num_schedulers):
                     w = Worker(self, i, self.config.enabled_schedulers)
                     w.start()
@@ -514,6 +523,9 @@ class Server:
             for w in self.workers:
                 w.join(1.0)
             self.workers = []
+            if self.eval_feeder is not None:
+                self.eval_feeder.close()
+                self.eval_feeder = None
             self.plan_queue.set_enabled(False)
             self.broker.set_enabled(False)
             self.blocked_evals.set_enabled(False)

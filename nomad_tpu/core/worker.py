@@ -11,12 +11,14 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import os
 import random
 import threading
 import time
+from collections import deque
 from typing import List, Optional
 
-from nomad_tpu import tracing
+from nomad_tpu import chaos, tracing
 from nomad_tpu.core.plan_queue import LeadershipLostError
 from nomad_tpu.raft import NotLeaderError
 from nomad_tpu.raft.transport import Unreachable
@@ -51,7 +53,21 @@ class Worker:
         # store index the scheduling snapshot must reach before this
         # worker's current eval may be processed (set at dequeue)
         self._wait_index = 0
-        self.stats = {"processed": 0, "failed": 0}
+        # double-buffered commit pipeline (plan_apply.go:71-178 carried
+        # to the worker side): with depth > 0, submit_plan returns at
+        # applier-EVALUATE time (the PlanResult is final then; only
+        # alloc_index lands later) and the eval's COMPLETE/ack settle is
+        # deferred until the raft append + fsync finishes — so wave N+1
+        # schedules and dispatches on-device while commit(N) is durably
+        # landing.  Depth bounds how many evals may be settle-deferred
+        # at once; 0 restores strict blocking submits.
+        self.pipeline_depth = max(0, int(os.environ.get(
+            "NOMAD_TPU_PIPELINE_DEPTH", "2")))
+        # (ev, token, [PendingPlan]) awaiting durable commit, oldest first
+        self._deferred = deque()
+        self._eval_pendings: List = []
+        self.stats = {"processed": 0, "failed": 0,
+                      "pipelined_evals": 0, "pipeline_discards": 0}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -70,6 +86,7 @@ class Worker:
     def run(self) -> None:
         while not self._stop.is_set():
             got = self._dequeue()
+            self._drain_deferred()
             if got is None:
                 continue
             ev, token = got
@@ -102,14 +119,75 @@ class Worker:
                     self._nack(ev.id, token)
                 except TRANSIENT_ERRORS:
                     pass
+        # settle every still-deferred eval before the thread exits —
+        # a clean stop must not leave acked-nowhere leases to time out
+        while self._deferred:
+            self._settle_eval(*self._deferred.popleft())
+
+    # ------------------------------------------------------ pipelined settle
+
+    def _drain_deferred(self) -> None:
+        """Settle deferred evals: everything whose commits already landed
+        settles for free; beyond `pipeline_depth` outstanding, block on
+        the oldest so the pipeline stays bounded."""
+        while self._deferred:
+            ev, token, pendings = self._deferred[0]
+            if len(self._deferred) <= self.pipeline_depth and \
+                    not all(p.future.done() for p in pendings):
+                return
+            self._deferred.popleft()
+            self._settle_eval(ev, token, pendings)
+
+    def _settle_eval(self, ev: Evaluation, token: str,
+                     pendings: List) -> None:
+        """Deferred tail of process_eval: wait for the durable commits
+        backing this eval's plans, then publish COMPLETE and ack.  If a
+        commit failed mid-flight, the speculative result is discarded —
+        the eval is nacked for redelivery and the re-process snapshots
+        past whatever DID commit (`_wait_index`), so a partial landing
+        never double-places (same contract as crash-after-commit)."""
+        try:
+            for p in pendings:
+                p.future.result(timeout=600.0)
+        except Exception:                           # noqa: BLE001
+            # transient or real commit failure: identical discard path
+            self.stats["pipeline_discards"] += 1
+            try:
+                self._nack(ev.id, token)
+            except TRANSIENT_ERRORS:
+                pass
+            return
+        if chaos.active is not None and chaos.should("worker.settle_drop"):
+            # worker dies between commit and ack: the lease expires and
+            # the redelivered eval no-ops via plan dedup
+            return
+        try:
+            self.server.update_eval(ev)
+            if self._ack(ev.id, token):
+                self.stats["processed"] += 1
+                self.stats["pipelined_evals"] += 1
+        except TRANSIENT_ERRORS:
+            try:
+                self._nack(ev.id, token)
+            except TRANSIENT_ERRORS:
+                pass
 
     # -- broker ops, overridable for the RPC path (RemoteWorker)
 
     def _dequeue(self):
-        ev, token = self.server.broker.dequeue(
-            self.enabled_schedulers, timeout=0.1)
-        if ev is None:
-            return None
+        feeder = getattr(self.server, "eval_feeder", None)
+        if feeder is not None:
+            # wave-aligned path: one pool member drains a whole ready
+            # wave in one broker pass; the rest pick from the buffer
+            got = feeder.get(self.enabled_schedulers, timeout=0.1)
+            if got is None:
+                return None
+            ev, token = got
+        else:
+            ev, token = self.server.broker.dequeue(
+                self.enabled_schedulers, timeout=0.1)
+            if ev is None:
+                return None
         self._wait_index = self.server.store.latest_index
         self._trace_ctx = None
         tracer = tracing.active
@@ -140,6 +218,7 @@ class Worker:
             return
         self._snapshot = snap
         self._token = token
+        self._eval_pendings = []
         ev = ev.copy()
         # sampled eval: the scheduler invocation is a span, and the trace
         # context stays bound for its duration so plan submission (and
@@ -174,6 +253,13 @@ class Worker:
                 tracer.finish(tspan)
                 tracing.bind(tprev)
         ev.status = EvalStatus.COMPLETE
+        pendings, self._eval_pendings = self._eval_pendings, []
+        if pendings:
+            # pipelined submits are still committing: defer the
+            # COMPLETE/ack settle and move on to the next eval now
+            self._deferred.append((ev, token, pendings))
+            self._drain_deferred()
+            return
         server.update_eval(ev)
         if self._ack(ev.id, token):
             self.stats["processed"] += 1
@@ -191,11 +277,24 @@ class Worker:
             tprev = tracing.bind(tracer.child_ctx(tctx, tspan))
         try:
             pending = self.server.enqueue_plan(plan)
-            # generous: under full-cluster bursts (the 1M-alloc C2M) the
-            # serialized applier legitimately backs up for minutes; an
-            # eval failed on a timed-out future gets retried from scratch
-            # even though its plan still commits — pure wasted recompute
-            res = pending.future.result(timeout=600.0)
+            if self.pipeline_depth > 0:
+                # pipelined: return as soon as the applier has validated
+                # the plan and registered its overlay — the PlanResult's
+                # content is final at evaluate time (only alloc_index
+                # lands post-commit, and the scheduler never reads it).
+                # The durable commit settles later in _settle_eval; the
+                # applier owns the engine-ticket release either way, so
+                # the scheduler must skip its early free.
+                res = pending.evaluated.result(timeout=600.0)
+                plan.commit_inflight = True
+                self._eval_pendings.append(pending)
+            else:
+                # generous: under full-cluster bursts (the 1M-alloc C2M)
+                # the serialized applier legitimately backs up for
+                # minutes; an eval failed on a timed-out future gets
+                # retried from scratch even though its plan still
+                # commits — pure wasted recompute
+                res = pending.future.result(timeout=600.0)
         finally:
             if tspan is not None:
                 tracer.finish(tspan)

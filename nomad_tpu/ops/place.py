@@ -46,6 +46,24 @@ TOP_K = 5  # score_meta entries kept per placement (structs.go:10341 kheap)
 # dominant op in a wave's body)
 _FILL_GRID = 64
 
+# The grid width is bucketed: a wave whose largest eval places count
+# instances never fills a run past count, so the [N, M] grid beyond
+# M = count is pure wasted compute — at the C2M-1M shape (count = 10)
+# the full 64-wide grid does 4x the work of the 16-wide one for
+# identical placements (runs longer than M continue next wave; the
+# wavefront is M-invariant).  Two buckets keep the compile-variant
+# count at 2x, covered by warmup.
+FILL_GRID_BUCKETS = (16, _FILL_GRID)
+
+
+def fill_grid_for(max_count: int) -> int:
+    """Smallest fill-grid bucket that lets the wave's longest possible
+    run complete in one wave (capped at _FILL_GRID)."""
+    for m in FILL_GRID_BUCKETS:
+        if max_count <= m:
+            return m
+    return _FILL_GRID
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -429,7 +447,7 @@ def place_batch_packed_jit(capacity: jax.Array,     # f32[N, R]
 
 def bulk_wave_grid(capacity, used, demand, feasible, affinity,
                    has_affinity, desired_f, penalty, coll,
-                   spread_algorithm: bool):
+                   spread_algorithm: bool, fill_grid: int = _FILL_GRID):
     """The [N, M] per-wave fill/scoring grid shared by the single-device
     (`_bulk_loop`) and node-sharded (parallel.sharded) bulk kernels —
     column m is every node's score/fitness with m more instances placed
@@ -437,7 +455,7 @@ def bulk_wave_grid(capacity, used, demand, feasible, affinity,
     Operates on whatever node slice it is given (a shard passes its
     local rows); MUST stay the single source of truth for the bulk
     scoring stack or sharded/single-device placement parity breaks."""
-    M = _FILL_GRID
+    M = fill_grid
     ms = jnp.arange(1, M + 1, dtype=jnp.float32)
     util_m = used[:, None, :] + ms[None, :, None] * demand    # [N, M, R]
     fits_m = (jnp.all(util_m <= capacity[:, None, :], axis=-1)
@@ -495,7 +513,8 @@ def _bulk_scores(capacity, used, demand, feasible, affinity, has_affinity,
 
 def _bulk_loop(capacity, used0, feasible, affinity, has_affinity, desired,
                penalty, coll0, demand, count,
-               spread_algorithm: bool, max_waves: int):
+               spread_algorithm: bool, max_waves: int,
+               fill_grid: int = _FILL_GRID):
     """The wavefront placement loop shared by the single-eval
     (`place_bulk_jit`) and batched (`place_bulk_batch_jit`) kernels.
     Places `count` IDENTICAL slots of one task group (spreads inactive)
@@ -551,7 +570,7 @@ def _bulk_loop(capacity, used0, feasible, affinity, has_affinity, desired,
         # fill runs.
         ms, fits_m, score_m = bulk_wave_grid(
             capacity, used, demand, feasible, affinity, has_affinity,
-            desired_f, penalty, coll, spread_algorithm)
+            desired_f, penalty, coll, spread_algorithm, fill_grid)
 
         fits = fits_m[:, 0]
         cur = jnp.where(fits, score_m[:, 0], -jnp.inf)
@@ -602,7 +621,8 @@ def _bulk_tail(capacity, used_f, coll_f, feasible, affinity, has_affinity,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("spread_algorithm", "max_waves"))
+                   static_argnames=("spread_algorithm", "max_waves",
+                                    "fill_grid"))
 def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
                    used0: jax.Array,       # f32[N, R]
                    feasible: jax.Array,    # bool[N]
@@ -614,7 +634,8 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
                    demand: jax.Array,      # f32[R]
                    count: jax.Array,       # i32 scalar: instances to place
                    spread_algorithm: bool = False,
-                   max_waves: int = 65536):
+                   max_waves: int = 65536,
+                   fill_grid: int = _FILL_GRID):
     """Single-eval wavefront placement (see `_bulk_loop` for semantics).
 
     Returns one packed f32[N, R+3] leaf (one D2H round trip): cols [0,R)
@@ -623,7 +644,8 @@ def place_bulk_jit(capacity: jax.Array,    # f32[N, R]
     become denormals that TPU hardware flushes to zero."""
     used_f, coll_f, assign, placed, waves = _bulk_loop(
         capacity, used0, feasible, affinity, has_affinity, desired,
-        penalty, coll0, demand, count, spread_algorithm, max_waves)
+        penalty, coll0, demand, count, spread_algorithm, max_waves,
+        fill_grid)
     final_scores, n_eval, n_exh = _bulk_tail(
         capacity, used_f, coll_f, feasible, affinity, has_affinity,
         desired, penalty, demand, spread_algorithm)
@@ -706,7 +728,7 @@ SPARSE_CAP = 128
 
 @functools.partial(jax.jit,
                    static_argnames=("D", "sparse_out", "spread_algorithm",
-                                    "max_waves"))
+                                    "max_waves", "fill_grid"))
 def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
                          used0: jax.Array,      # f32[N, R] (device basis)
                          heavy: jax.Array,      # f32[E, 4N] (device, stacked
@@ -716,7 +738,8 @@ def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
                          D: int,
                          sparse_out: bool = False,
                          spread_algorithm: bool = False,
-                         max_waves: int = 65536):
+                         max_waves: int = 65536,
+                         fill_grid: int = _FILL_GRID):
     """Chained batch of E wavefront bulk evals in ONE dispatch: a
     `lax.scan` over the eval axis carries the usage matrix, each step
     runs `_bulk_loop` (the O(waves) wavefront placement), so eval e+1
@@ -756,7 +779,7 @@ def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
         used_f, coll_f, assign, placed, waves = _bulk_loop(
             capacity, used + delta_mat, feasible, affinity, has_aff,
             desired, penalty, coll0, demand, count, spread_algorithm,
-            max_waves)
+            max_waves, fill_grid)
         scores, n_eval, n_exh = _bulk_tail(
             capacity, used_f, coll_f, feasible, affinity, has_aff,
             desired, penalty, demand, spread_algorithm)

@@ -300,6 +300,18 @@ class PlacementEngine:
         # (100K-node worlds at the 512-eval bucket would be ~1 GB)
         self.bulk_bytes_budget = int(os.environ.get(
             "NOMAD_TPU_BULK_BYTES", str(1 << 28)))
+        # fused wave dispatch (NOMAD_TPU_FUSE=0 restores the 3-way
+        # sparse/delta/dense format split): one device call per bulk
+        # wave — the format split paid ~1.5-2 dispatch+D2H round trips
+        # per wave on mixed serving traffic for transfer savings that
+        # stopped mattering once the heavy blocks went device-resident
+        self.fuse = os.environ.get("NOMAD_TPU_FUSE", "1") != "0"
+        # (t0, t1) wall windows where the engine thread was blocked on
+        # device results — intersected with the applier's commit-fsync
+        # windows to surface pipeline_overlap_s (device time hidden
+        # under commit I/O) in the bench device_stages block
+        from collections import deque
+        self.device_windows = deque(maxlen=8192)
         self._serving_mesh = None
         self._mesh_checked = False
         self._queue: List[_Request] = []
@@ -323,7 +335,12 @@ class PlacementEngine:
                       "max_batch_seen": 0, "tickets_open": 0,
                       "stack_s": 0.0, "put_s": 0.0, "device_s": 0.0,
                       "resolve_s": 0.0, "cache_hits": 0, "cache_misses": 0,
-                      "bulk_evals": 0, "waves": 0, "max_waves_seen": 0}
+                      "bulk_evals": 0, "waves": 0, "max_waves_seen": 0,
+                      # fused-path health: bulk_groups counts bulk wave
+                      # groups, bulk_parts the device calls they took —
+                      # fused steady state holds parts == groups, and
+                      # bench --smoke gates on the ratio
+                      "bulk_groups": 0, "bulk_parts": 0}
         self._cache = _DeviceCache()
         # device-resident worlds: (id(cm), N, mesh identity) ->
         # DeviceWorld (epoch-uploaded capacity/basis, scatter deltas);
@@ -459,9 +476,16 @@ class PlacementEngine:
         def bulk_variant(E):
             # separate compiles serving mixes: sparse vs dense output
             # (count <=/> SPARSE_CAP) x delta-free (D=0) vs delta-
-            # carrying (D=_DELTA_BUCKET) light blocks
+            # carrying (D=_DELTA_BUCKET) light blocks x the fill-grid
+            # buckets (the dispatch derives fill_grid from the part's
+            # max count, so the three warm counts induce the reachable
+            # static combos: sparse x {16, 64} and dense x {64} —
+            # retry evals place shrinking remainders, so a small-grid
+            # sparse variant is reachable whatever the job's count)
+            from nomad_tpu.ops.place import FILL_GRID_BUCKETS
             dummy_delta = [(0, np.zeros(NUM_RESOURCE_DIMS, np.float32))]
-            for count in {min(bulk["count"], SPARSE_CAP),
+            for count in {min(bulk["count"], FILL_GRID_BUCKETS[0]),
+                          SPARSE_CAP,
                           max(bulk["count"], SPARSE_CAP + 1)}:
                 for deltas in ([], dummy_delta):
                     spec = dict(bulk, count=count)
@@ -808,7 +832,9 @@ class PlacementEngine:
 
         if isinstance(reqs[0], _BulkRequest):
             mesh = self._mesh_for(reqs[0].feasible.shape[0])
+            parts = 0
             for part in self._split_bulk(reqs, sharded=mesh is not None):
+                parts += 1
                 if mesh is not None:
                     packed, world, dper = \
                         self._dispatch_bulk_group_sharded(part, mesh)
@@ -816,12 +842,20 @@ class PlacementEngine:
                     packed, world, dper = self._dispatch_bulk_group(part)
                 t0 = _time.time()
                 fetched = jax.device_get(packed)
-                dev_s = _time.time() - t0
+                t1 = _time.time()
+                dev_s = t1 - t0
                 self.stats["device_s"] += dev_s
+                self.device_windows.append((t0, t1))
                 t0 = _time.time()
                 self._resolve_bulk(part, fetched, world, dper)
                 self.stats["resolve_s"] += _time.time() - t0
                 self._emit_dispatch_spans(part, dev_s, "bulk")
+                if len(part) > 1:
+                    self.stats["batched_evals"] += len(part)
+                else:
+                    self.stats["single_evals"] += 1
+            self.stats["bulk_groups"] += 1
+            self.stats["bulk_parts"] += parts
             self.stats["bulk_evals"] += len(reqs)
             return
 
@@ -871,8 +905,10 @@ class PlacementEngine:
 
         t0 = _time.time()
         fetched = jax.device_get(packed)
-        dev_s = _time.time() - t0
+        t1 = _time.time()
+        dev_s = t1 - t0
         self.stats["device_s"] += dev_s
+        self.device_windows.append((t0, t1))
         t0 = _time.time()
         node, score, fit_s, n_eval, n_exh, top_n, top_s = \
             unpack_outputs(np.asarray(fetched))
@@ -1067,10 +1103,12 @@ class PlacementEngine:
         self.stats["put_basis_s"] = self.stats.get("put_basis_s", 0.0) \
             + (_time.time() - t1)
         t1 = _time.time()
+        from nomad_tpu.ops.place import fill_grid_for
         out = place_bulk_batch_sharded(
             mesh, cap_dev, basis_dev,
             feas, aff, hasa, des, pen, coll, dem, cnt,
-            drows, dvals, spread_algorithm=reqs[0].spread_algorithm)
+            drows, dvals, spread_algorithm=reqs[0].spread_algorithm,
+            fill_grid=fill_grid_for(max(r.count for r in reqs)))
         assign, scores, placed, n_eval, n_exh, waves, _used = out
         self.stats["put_kernel_s"] = self.stats.get("put_kernel_s", 0.0) \
             + (_time.time() - t1)
@@ -1083,27 +1121,40 @@ class PlacementEngine:
     # ---------------------------------------------------------- bulk path
 
     def _split_bulk(self, reqs: List[_BulkRequest], sharded: bool = False):
-        # oversized-delta requests go alone so their deltas can fold into
-        # the part's private basis copy (fixed delta bucket, no compile);
-        # small-count (sparse-output) and large-count (dense) requests
-        # split so a part compiles one output format and small evals
-        # never pay the dense [2N] D2H row
-        # ...and delta-free requests (the fresh-placement common case)
-        # split from delta-carrying ones: their D=0 light block is ~50x
-        # smaller, which matters at 512-eval chains on a slow link.
-        # The sharded kernel has ONE (dense, fixed-D) format — splitting
-        # there would only multiply mesh round trips.
-        fits_s0, fits_s, fits_d, overflow = [], [], [], []
-        for r in reqs:
-            if len(r.deltas) > _DELTA_BUCKET:
-                overflow.append(r)
-            elif not sharded and r.count <= SPARSE_CAP:
-                (fits_s0 if not r.deltas else fits_s).append(r)
-            else:
-                fits_d.append(r)
+        # oversized-delta requests always go alone so their deltas can
+        # fold into the part's private basis copy (fixed delta bucket,
+        # no compile variant forked)
+        overflow = [r for r in reqs if len(r.deltas) > _DELTA_BUCKET]
+        rest = [r for r in reqs if len(r.deltas) <= _DELTA_BUCKET]
         for r in overflow:
             yield [r]
         chunk = self._bulk_chunk(reqs[0].feasible.shape[0])
+        if self.fuse or sharded:
+            # FUSED wave dispatch: the whole wave is ONE device call
+            # (modulo the byte-budget chunk).  The dispatch picks the
+            # output format (sparse iff every count fits) and delta
+            # bucket (D=0 iff nothing ships deltas) for the mixed part —
+            # all combinations are warmed compile variants.  The old
+            # 3-way sparse/delta/dense split bought smaller D2H rows at
+            # the price of ~1.5-2 dispatch round trips per wave; with
+            # device-resident heavy blocks the extra round trips
+            # dominate.  The sharded kernel has ONE (dense, fixed-D)
+            # format, so it always dispatched fused.
+            for i in range(0, len(rest), chunk):
+                yield rest[i:i + chunk]
+            return
+        # NOMAD_TPU_FUSE=0: the pre-fusion format split — small-count
+        # (sparse-output) and large-count (dense) requests split so a
+        # part compiles one output format and small evals never pay the
+        # dense [2N] D2H row; delta-free requests (the fresh-placement
+        # common case) split from delta-carrying ones (their D=0 light
+        # block is ~50x smaller, which mattered on slow links)
+        fits_s0, fits_s, fits_d = [], [], []
+        for r in rest:
+            if r.count <= SPARSE_CAP:
+                (fits_s0 if not r.deltas else fits_s).append(r)
+            else:
+                fits_d.append(r)
         for fits in (fits_s0, fits_s, fits_d):
             for i in range(0, len(fits), chunk):
                 yield fits[i:i + chunk]
@@ -1172,10 +1223,12 @@ class PlacementEngine:
         t1 = _time.time()
         dyn_dev = jax.device_put(dyn)  # analysis: allow(transfer-purity) — per-dispatch dynamic leaf, shipped explicitly
         sparse = all(r.count <= SPARSE_CAP for r in reqs)
+        from nomad_tpu.ops.place import fill_grid_for
         packed, _used_final = place_bulk_batch_jit(
             cap_dev, used_dev, hstack, dyn_dev, D,
             sparse_out=sparse,
-            spread_algorithm=reqs[0].spread_algorithm)
+            spread_algorithm=reqs[0].spread_algorithm,
+            fill_grid=fill_grid_for(max(r.count for r in reqs)))
         self.stats["put_kernel_s"] = self.stats.get("put_kernel_s", 0.0) \
             + (_time.time() - t1)
         self.stats["put_s"] += _time.time() - t0

@@ -395,7 +395,8 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
                              penalty, coll0, demand, count,
                              delta_rows, delta_vals,
                              spread_algorithm: bool = False,
-                             max_waves: int = 65536):
+                             max_waves: int = 65536,
+                             fill_grid: int = 64):
     """Chained bulk wavefront batch (engine place_bulk) over a ('nodes',)
     mesh — the C2M-scale multi-chip path.  Per-eval node-axis fields
     carry a leading E axis; scalars (has_affinity/desired/count) are
@@ -438,7 +439,8 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
                 # the reductions/selection go through collectives
                 ms, fits_m, score_m = _bulk_wave_grid(
                     cap, u, demand, feasible, affinity, has_aff,
-                    desired_f, penalty, coll, spread_algorithm)
+                    desired_f, penalty, coll, spread_algorithm,
+                    fill_grid)
 
                 fits = fits_m[:, 0]
                 cur = jnp.where(fits, score_m[:, 0], -jnp.inf)
@@ -512,7 +514,7 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
                 P(None, "nodes"), P(None, "nodes"), P(None), P(None),
                 P(None, "nodes"), P(None, "nodes"), P(None, None),
                 P(None), P(None, None), P(None, None, None))
-    key = ("bulk", mesh, spread_algorithm, max_waves)
+    key = ("bulk", mesh, spread_algorithm, max_waves, fill_grid)
     fn = _SERVING_FN_CACHE.get(key)
     if fn is None:
         out_specs = (P(None, "nodes"), P(None, "nodes"), P(None), P(None),

@@ -31,6 +31,26 @@ from nomad_tpu.encode.matrixizer import NUM_RESOURCE_DIMS
 STAGES = ("feasibility", "fit", "score", "argmax", "scatter")
 
 
+def interval_overlap_s(a, b) -> float:
+    """Total seconds where two sets of (t0, t1) wall windows intersect.
+    Used for `pipeline_overlap_s`: the engine's device-blocked windows
+    against the applier's commit-fsync windows — device time the wave
+    pipeline hid under durability waits."""
+    a, b = sorted(a), sorted(b)
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
 def _stage_fns():
     """One small jit per pipeline stage, mirroring bulk_wave_grid /
     _bulk_loop exactly (ops/place.py) so the relative costs transfer."""
@@ -97,17 +117,19 @@ def _stage_fns():
 
 
 def probe(n_nodes: int, r_dims: int = NUM_RESOURCE_DIMS,
-          iters: int = 10, warmup: int = 2) -> Dict[str, float]:
+          iters: int = 10, warmup: int = 2,
+          fill_grid: Optional[int] = None) -> Dict[str, float]:
     """Raw per-stage wall seconds (best-of-`iters` after `warmup`) at
-    shape [n_nodes, _FILL_GRID, r_dims].  Best-of is deliberate — it
-    strips dispatch jitter, which is exactly what fractions must not
-    carry."""
+    shape [n_nodes, fill_grid, r_dims] (default the full _FILL_GRID
+    wave width).  Best-of is deliberate — it strips dispatch jitter,
+    which is exactly what fractions must not carry."""
     import jax
 
     from nomad_tpu.ops.place import _FILL_GRID
 
     rng = np.random.default_rng(0)
-    N, M, R = int(n_nodes), int(_FILL_GRID), int(r_dims)
+    N, R = int(n_nodes), int(r_dims)
+    M = int(fill_grid) if fill_grid else int(_FILL_GRID)
     dev = lambda a: jax.device_put(a)   # noqa: E731
     capacity = dev(rng.uniform(100.0, 1000.0,
                                (N, R)).astype(np.float32))
@@ -152,23 +174,96 @@ def probe(n_nodes: int, r_dims: int = NUM_RESOURCE_DIMS,
     return out
 
 
+def probe_fused(n_nodes: int, r_dims: int = NUM_RESOURCE_DIMS,
+                iters: int = 10, warmup: int = 2,
+                fill_grid: Optional[int] = None) -> float:
+    """Best-of wall seconds for ONE fused wave — the real production
+    composition (`bulk_wave_grid` + run-length argmax + scatter) traced
+    as a single jit, at the same shapes the per-phase `probe` uses.
+    Comparing against the per-phase sum measures what fusing the five
+    dispatches into one program actually buys at this shape."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from nomad_tpu.ops.place import _FILL_GRID, bulk_run_lengths, \
+        bulk_wave_grid
+
+    @functools.partial(jax.jit, static_argnames=("fill_grid",))
+    def fused_wave(capacity, used, demand, feasible, affinity,
+                   has_affinity, desired_f, penalty, coll, count,
+                   fill_grid):
+        ms, fits_m, score_m = bulk_wave_grid(
+            capacity, used, demand, feasible, affinity, has_affinity,
+            desired_f, penalty, coll, False, fill_grid)
+        fits = fits_m[:, 0]
+        cur = jnp.where(fits, score_m[:, 0], -jnp.inf)
+        top2 = jax.lax.top_k(cur, 2)[0]
+        second = jnp.where(cur == top2[0], top2[1], top2[0])
+        run = bulk_run_lengths(ms, fits_m, score_m, second)
+        wave = fits & (cur == top2[0])
+        order = jnp.argsort(jnp.where(wave, -cur, jnp.inf))
+        base_sorted = run[order]
+        prefix = jnp.cumsum(base_sorted) - base_sorted
+        alloc_sorted = jnp.clip(count - prefix, 0, base_sorted)
+        per_node = jnp.zeros(run.shape[0],
+                             jnp.int32).at[order].set(alloc_sorted)
+        used2 = used + per_node[:, None].astype(jnp.float32) * demand
+        return used2, coll + per_node, jnp.sum(per_node)
+
+    rng = np.random.default_rng(0)
+    N, R = int(n_nodes), int(r_dims)
+    M = int(fill_grid) if fill_grid else int(_FILL_GRID)
+    dev = lambda a: jax.device_put(a)   # noqa: E731
+    capacity = dev(rng.uniform(100.0, 1000.0, (N, R)).astype(np.float32))
+    used = dev(rng.uniform(0.0, 50.0, (N, R)).astype(np.float32))
+    demand = dev(rng.uniform(1.0, 10.0, R).astype(np.float32))
+    feasible = dev(rng.random(N) < 0.9)
+    coll = dev(rng.integers(0, 3, N).astype(np.int32))
+    penalty = dev((rng.random(N) < 0.05).astype(np.float32))
+    affinity = dev(rng.uniform(-1.0, 1.0, N).astype(np.float32))
+
+    call = lambda: fused_wave(                       # noqa: E731
+        capacity, used, demand, feasible, affinity, np.bool_(True),
+        np.float32(8.0), penalty, coll, np.int32(256), fill_grid=M)
+    for _ in range(warmup):
+        jax.block_until_ready(call())
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(call())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def device_stages(engine_stats: dict, n_nodes: int,
                   r_dims: int = NUM_RESOURCE_DIMS,
-                  iters: int = 10) -> Optional[dict]:
+                  iters: int = 10, fill_grid: Optional[int] = None,
+                  pipeline_overlap_s: Optional[float] = None
+                  ) -> Optional[dict]:
     """The BENCH JSON `"device_stages"` section: the run's measured
     `device_s` attributed across the wave pipeline by probed per-stage
     fractions (stage sum == device_s by construction), plus the
-    dirty-row upload time the engine already measures directly.  Returns
-    None when the run recorded no device time.  When a tracer is
-    installed the probe timings are also recorded as child spans of a
-    `device.stage_probe` trace (Perfetto-exportable like any other)."""
+    dirty-row upload time the engine already measures directly.  The
+    fused production kernel is probed as one more unit (`fused`): its
+    single-dispatch wave time against the five-dispatch phase sum, at
+    the same [N, fill_grid] shape the run used.  `pipeline_overlap_s`
+    (device time the commit pipeline hid under raft append + fsync —
+    see `interval_overlap_s`) passes straight through into the section.
+    Returns None when the run recorded no device time.  When a tracer
+    is installed the probe timings are also recorded as child spans of
+    a `device.stage_probe` trace (Perfetto-exportable like any other)."""
     device_s = float(engine_stats.get("device_s", 0.0))
     if device_s <= 0.0:
         return None
-    raw = probe(n_nodes, r_dims=r_dims, iters=iters)
+    raw = probe(n_nodes, r_dims=r_dims, iters=iters, fill_grid=fill_grid)
     total = sum(raw.values()) or 1.0
+    fused_s = probe_fused(n_nodes, r_dims=r_dims, iters=iters,
+                          fill_grid=fill_grid)
     stages = {name: device_s * (raw[name] / total) for name in STAGES}
     dominant = max(stages, key=stages.get)
+    from nomad_tpu.ops.place import _FILL_GRID
     section = {
         "stages_s": {k: round(v, 6) for k, v in stages.items()},
         "fractions": {k: round(raw[k] / total, 4) for k in STAGES},
@@ -178,6 +273,14 @@ def device_stages(engine_stats: dict, n_nodes: int,
             float(engine_stats.get("put_basis_s", 0.0)), 6),
         "dominant_stage": dominant,
         "n_nodes": int(n_nodes),
+        "fill_grid": int(fill_grid) if fill_grid else int(_FILL_GRID),
+        "fused": {
+            "wave_s": round(fused_s, 6),
+            "phase_sum_s": round(total, 6),
+            "fusion_speedup": round(total / fused_s, 3)
+            if fused_s > 0 else None,
+        },
+        "pipeline_overlap_s": round(float(pipeline_overlap_s or 0.0), 6),
     }
     tracer = tracing.active
     if tracer is not None:

@@ -206,17 +206,28 @@ class GenericScheduler:
             self.plan_result = self.planner.submit_plan(self.plan)
         finally:
             # release the in-flight usage overlay: the plan is now either
-            # committed into the cluster matrix or abandoned
-            if getattr(self, "_stack", None) is not None:
-                self._stack.release()
-                self._stack = None
-            if self._ext_tickets:
-                from nomad_tpu.parallel.engine import get_engine
-                eng = get_engine()
-                if eng is not None:
-                    for t in self._ext_tickets:
-                        eng.complete(t)
+            # committed into the cluster matrix or abandoned.  Exception:
+            # a pipelined submit returned at evaluate time with the
+            # durable commit still in flight — there the applier owns the
+            # release (success: _post_commit; failure: the commit
+            # thread's error path), and freeing here would show phantom
+            # capacity to concurrent kernels before the write lands.
+            if getattr(self.plan, "commit_inflight", False):
+                if getattr(self, "_stack", None) is not None:
+                    self._stack.last_ticket = None
+                    self._stack = None
                 self._ext_tickets = []
+            else:
+                if getattr(self, "_stack", None) is not None:
+                    self._stack.release()
+                    self._stack = None
+                if self._ext_tickets:
+                    from nomad_tpu.parallel.engine import get_engine
+                    eng = get_engine()
+                    if eng is not None:
+                        for t in self._ext_tickets:
+                            eng.complete(t)
+                    self._ext_tickets = []
         adjust_queued_allocations(self.plan_result, self.queued_allocs)
 
         full, expected, actual = self.plan_result.full_commit(self.plan)
