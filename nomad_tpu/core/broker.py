@@ -468,7 +468,11 @@ class EvalWaveFeeder:
         self._lock = threading.Condition()
         self._buf: Dict[tuple, deque] = {}
         self._filling: Set[tuple] = set()
-        self.stats = {"waves": 0, "wave_evals": 0, "max_wave": 0}
+        # wave_ns_max: peak count of DISTINCT namespaces in one wave —
+        # the 2-D mesh's wave-lane parallelism feeds on exactly this
+        # diversity (engine lane binning keys on the eval's namespace)
+        self.stats = {"waves": 0, "wave_evals": 0, "max_wave": 0,
+                      "wave_ns_max": 0}
 
     def get(self, schedulers: List[str], timeout: float = 0.1
             ) -> Optional[Tuple[Evaluation, str]]:
@@ -501,6 +505,9 @@ class EvalWaveFeeder:
                     self.stats["wave_evals"] += len(wave)
                     self.stats["max_wave"] = max(self.stats["max_wave"],
                                                  len(wave))
+                    self.stats["wave_ns_max"] = max(
+                        self.stats["wave_ns_max"],
+                        len({ev.namespace for ev, _ in wave}))
                 self._lock.notify_all()
         return wave[0] if wave else None
 

@@ -67,7 +67,11 @@ class Worker:
         self._deferred = deque()
         self._eval_pendings: List = []
         self.stats = {"processed": 0, "failed": 0,
-                      "pipelined_evals": 0, "pipeline_discards": 0}
+                      "pipelined_evals": 0, "pipeline_discards": 0,
+                      # dequeues served off the wave-aligned feeder
+                      # buffer (vs direct broker passes): the supply
+                      # side of the engine's wave-lane batching
+                      "wave_dequeues": 0}
 
     # ------------------------------------------------------------- lifecycle
 
@@ -183,6 +187,7 @@ class Worker:
             if got is None:
                 return None
             ev, token = got
+            self.stats["wave_dequeues"] += 1
         else:
             ev, token = self.server.broker.dequeue(
                 self.enabled_schedulers, timeout=0.1)

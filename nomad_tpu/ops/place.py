@@ -726,20 +726,18 @@ def pack_bulk_light(has_affinity, desired, count, demand, deltas,
 SPARSE_CAP = 128
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("D", "sparse_out", "spread_algorithm",
-                                    "max_waves", "fill_grid"))
-def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
-                         used0: jax.Array,      # f32[N, R] (device basis)
-                         heavy: jax.Array,      # f32[E, 4N] (device, stacked
-                         #   OUTSIDE jit: a 128-element tuple argument
-                         #   costs ~0.4s/call in pjit arg processing)
-                         dyn: jax.Array,         # f32[E*Ll] light blocks
-                         D: int,
-                         sparse_out: bool = False,
-                         spread_algorithm: bool = False,
-                         max_waves: int = 65536,
-                         fill_grid: int = _FILL_GRID):
+def _place_bulk_batch(capacity: jax.Array,      # f32[N, R]
+                      used0: jax.Array,         # f32[N, R] (device basis)
+                      heavy: jax.Array,         # f32[E, 4N] (device, stacked
+                      #   OUTSIDE jit: a 128-element tuple argument
+                      #   costs ~0.4s/call in pjit arg processing)
+                      dyn: jax.Array,           # f32[E*Ll] light blocks
+                      D: int,
+                      sparse_out: bool = False,
+                      spread_algorithm: bool = False,
+                      max_waves: int = 65536,
+                      fill_grid: int = _FILL_GRID,
+                      exact_out: bool = False):
     """Chained batch of E wavefront bulk evals in ONE dispatch: a
     `lax.scan` over the eval axis carries the usage matrix, each step
     runs `_bulk_loop` (the O(waves) wavefront placement), so eval e+1
@@ -756,13 +754,31 @@ def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
     Returns (packed, used_final device-resident).  packed per eval:
     dense [2N+4] (assign[N], scores[N], placed/n_eval/n_exh/waves) or,
     with sparse_out, [3*SPARSE_CAP+4] (rows, counts, row_scores,
-    scalars) — for count <= SPARSE_CAP only."""
+    scalars) — for count <= SPARSE_CAP only.
+
+    Jitted twice below: `place_bulk_batch_jit` (plain) and
+    `place_bulk_batch_donate_jit` (donate_argnums=(1,): the `used0`
+    carry buffer is donated and the caller adopts the carry output as
+    the new resident basis via world.loan_basis/adopt_basis — the carry
+    never re-uploads).
+
+    `exact_out` (the donation path) additionally threads an EXACT
+    rank-1 reconstruction of the basis — `used0 + sum_e assign_e *
+    demand_e`, one fused multiply-add per eval, the same op sequence as
+    world.apply_rank1's host/device scatters — and returns (packed,
+    used_final, used_exact).  The scan's own carry accumulates per-wave
+    partial placements (multiple f32 adds per node), which drifts
+    bitwise from the rank-1 form; scoring must keep the drifted chain
+    carry (placement parity with the non-donated path), while the
+    ADOPTED basis must stay bitwise in lockstep with the host snapshot
+    that apply_rank1_host maintains — hence two carries."""
     N, R = capacity.shape
     E = heavy.shape[0]
     hstack = heavy
     light = dyn.reshape(E, -1)
 
-    def eval_step(used, hl):
+    def eval_step(carry, hl):
+        used, exact = carry if exact_out else (carry, None)
         h, l = hl
         feasible = h[:N] > 0.5
         affinity = h[N:2 * N]
@@ -809,10 +825,26 @@ def place_bulk_batch_jit(capacity: jax.Array,   # f32[N, R]
                 scores_o[:SPARSE_CAP], scalars])
         else:
             out = jnp.concatenate([as_f(assign), scores, scalars])
-        return used_f - delta_mat, out
+        new_used = used_f - delta_mat
+        if exact_out:
+            return (new_used, exact + as_f(assign)[:, None] * demand), out
+        return new_used, out
 
-    used_final, packed = jax.lax.scan(eval_step, used0, (hstack, light))
-    return packed, used_final
+    carry0 = (used0, used0) if exact_out else used0
+    carry_f, packed = jax.lax.scan(eval_step, carry0, (hstack, light))
+    if exact_out:
+        used_final, used_exact = carry_f
+        return packed, used_final, used_exact
+    return packed, carry_f
+
+
+_BULK_BATCH_STATICS = ("D", "sparse_out", "spread_algorithm",
+                       "max_waves", "fill_grid", "exact_out")
+place_bulk_batch_jit = jax.jit(
+    _place_bulk_batch, static_argnames=_BULK_BATCH_STATICS)
+place_bulk_batch_donate_jit = jax.jit(
+    _place_bulk_batch, static_argnames=_BULK_BATCH_STATICS,
+    donate_argnums=(1,))
 
 
 def unpack_bulk_batch(packed: np.ndarray, n_rows: int,
@@ -882,3 +914,4 @@ recompile.register("place.eval", place_eval_jit)
 recompile.register("place.batch_packed", place_batch_packed_jit)
 recompile.register("place.bulk", place_bulk_jit)
 recompile.register("place.bulk_batch", place_bulk_batch_jit)
+recompile.register("place.bulk_batch_donate", place_bulk_batch_donate_jit)

@@ -2,18 +2,23 @@
 eval parallelism via scheduler workers and EvaluatePool fan-out).
 
 On TPU the two parallel axes are:
-- the **eval batch**: independent evaluations scheduled concurrently
-  (Nomad's optimistic worker concurrency) -> sharded over the 'evals'
-  mesh axis,
-- the **node axis**: the 10K-100K node matrix of one eval -> sharded over
-  the 'nodes' mesh axis with pmax/pmin collectives for the global argmax
-  (the ICI all-gather top-k of SURVEY.md section 5).
+- the **wave batch**: independent ready waves (distinct namespaces from
+  the broker's wave dequeue) scored concurrently (Nomad's optimistic
+  worker concurrency) -> sharded over the 'wave' mesh axis,
+- the **node axis**: the 10K-100K node matrix of one eval -> sharded
+  over the 'node_shard' mesh axis with pmax/pmin collectives for the
+  global argmax (the ICI all-gather top-k of SURVEY.md section 5).
+
+`wave_mesh_shape` factors a device count into the (node_shard, wave)
+grid; NOMAD_TPU_WAVE_SHARDS pins the wave extent.
 """
 
 from nomad_tpu.parallel.sharded import (
     make_mesh,
     place_eval_batch_sharded,
     stack_inputs,
+    wave_mesh_shape,
 )
 
-__all__ = ["make_mesh", "place_eval_batch_sharded", "stack_inputs"]
+__all__ = ["make_mesh", "place_eval_batch_sharded", "stack_inputs",
+           "wave_mesh_shape"]

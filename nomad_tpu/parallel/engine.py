@@ -53,6 +53,7 @@ from nomad_tpu.ops.place import (
     pack_heavy,
     pack_light,
     place_batch_packed_jit,
+    place_bulk_batch_donate_jit,
     place_bulk_batch_jit,
     unpack_bulk_batch,
     unpack_outputs,
@@ -240,10 +241,28 @@ class _BulkRequest:
     spread_algorithm: bool
     future: Future
     trace: object = None            # (ctx, submit_ts) for sampled evals
+    # lane affinity on the 2-D mesh: requests sharing a wave_key (the
+    # eval's namespace) chain in ONE lane; distinct keys spread across
+    # the mesh's 'wave' columns and score concurrently
+    wave_key: str = ""
 
     def shape_key(self):
         return ("bulk", id(self.cm), self.spread_algorithm,
                 self.feasible.shape[0])
+
+
+@dataclass
+class _PendingBulk:
+    """One in-flight bulk dispatch (donated-carry pipeline): the device
+    computes while the engine preps + dispatches the next part against
+    the adopted carry; _drain_record fetches and resolves it."""
+    reqs: List
+    out: object                     # device outputs (packed or tuple)
+    world: object                   # DeviceWorld the dispatch scored on
+    deltas_per: List
+    mapping: object                 # sharded lane mapping or None
+    donated: bool
+    t_dispatch: float
 
 
 class PlacementEngine:
@@ -306,12 +325,28 @@ class PlacementEngine:
         # per wave on mixed serving traffic for transfer savings that
         # stopped mattering once the heavy blocks went device-resident
         self.fuse = os.environ.get("NOMAD_TPU_FUSE", "1") != "0"
-        # (t0, t1) wall windows where the engine thread was blocked on
-        # device results — intersected with the applier's commit-fsync
-        # windows to surface pipeline_overlap_s (device time hidden
-        # under commit I/O) in the bench device_stages block
+        # donated-carry bulk dispatch (NOMAD_TPU_DONATE=0 restores the
+        # copy-on-dispatch carry): the usage-basis buffer is donated to
+        # the kernel and its carry output adopted as the new resident
+        # basis (world.loan_basis/adopt_basis) — the put_basis re-upload
+        # per wave (BENCH_r05: 0.37 s) drops to zero bytes
+        self.donate = os.environ.get("NOMAD_TPU_DONATE", "1") != "0"
+        # upload/compute overlap (NOMAD_TPU_OVERLAP=0 disables): hold
+        # ONE bulk dispatch in flight and prep + dispatch the next part
+        # against the adopted carry while the device computes — requires
+        # donation (the carry is what makes the in-flight placements
+        # visible to the chained dispatch without a resolve barrier)
+        self.overlap = self.donate and \
+            os.environ.get("NOMAD_TPU_OVERLAP", "1") != "0"
+        self._pending: Optional[_PendingBulk] = None
+        # (t0, t1) wall windows of in-flight device compute (bulk:
+        # dispatch -> fetch complete) — intersected with upload_windows
+        # (host-side stack/update/dispatch prep) for the bench's
+        # pipeline_overlap_s, and with the applier's commit-fsync
+        # windows for commit_overlap_s, in the device_stages block
         from collections import deque
         self.device_windows = deque(maxlen=8192)
+        self.upload_windows = deque(maxlen=8192)
         self._serving_mesh = None
         self._mesh_checked = False
         self._queue: List[_Request] = []
@@ -340,7 +375,19 @@ class PlacementEngine:
                       # groups, bulk_parts the device calls they took —
                       # fused steady state holds parts == groups, and
                       # bench --smoke gates on the ratio
-                      "bulk_groups": 0, "bulk_parts": 0}
+                      "bulk_groups": 0, "bulk_parts": 0,
+                      # donated-carry / 2-D-mesh health: donated_carries
+                      # counts dispatches whose basis was donated (the
+                      # steady state holds this == bulk_parts when
+                      # NOMAD_TPU_DONATE=1), wave_lanes the peak count
+                      # of concurrently-scoring mesh lanes, lane_evals /
+                      # lane_slots the laned occupancy (evals shipped vs
+                      # W x E slots compiled), overlap_chained the bulk
+                      # dispatches issued while the previous one was
+                      # still in flight on device
+                      "donated_carries": 0, "wave_lanes": 0,
+                      "lane_evals": 0, "lane_slots": 0,
+                      "overlap_chained": 0}
         self._cache = _DeviceCache()
         # device-resident worlds: (id(cm), N, mesh identity) ->
         # DeviceWorld (epoch-uploaded capacity/basis, scatter deltas);
@@ -382,7 +429,8 @@ class PlacementEngine:
                          desired, penalty, coll0, demand, count,
                          deltas: Optional[Sequence[Tuple[int, np.ndarray]]]
                          = None,
-                         spread_algorithm: bool = False) -> Future:
+                         spread_algorithm: bool = False,
+                         wave_key: str = "") -> Future:
         """Enqueue a bulk wavefront placement and return its Future
         (result tuple = place_bulk's).  Lets a multi-group eval submit
         EVERY eligible group before waiting: the engine chains them (and
@@ -390,7 +438,9 @@ class PlacementEngine:
         blocking round trip per group — the C2M-1M path, where jobs are
         many small groups.  FIFO order + the engine thread's resolve-
         before-next-dispatch discipline preserve exact chained
-        semantics."""
+        semantics.  `wave_key` (the eval's namespace) steers 2-D-mesh
+        lane binning: requests sharing a key chain in one lane, distinct
+        keys score concurrently across the mesh's wave columns."""
         req = _BulkRequest(
             cm=cm, feasible=np.asarray(feasible, bool),
             affinity=np.asarray(affinity, np.float32),
@@ -399,7 +449,7 @@ class PlacementEngine:
             coll0=np.asarray(coll0, np.int32),
             demand=np.asarray(demand, np.float32), count=int(count),
             deltas=list(deltas or ()), spread_algorithm=spread_algorithm,
-            future=Future())
+            future=Future(), wave_key=str(wave_key))
         if tracing.active is not None:
             ctx = tracing.current()
             if ctx is not None:
@@ -414,7 +464,7 @@ class PlacementEngine:
     def place_bulk(self, cm, *, feasible, affinity, has_affinity, desired,
                    penalty, coll0, demand, count,
                    deltas: Optional[Sequence[Tuple[int, np.ndarray]]] = None,
-                   spread_algorithm: bool = False):
+                   spread_algorithm: bool = False, wave_key: str = ""):
         """Wavefront bulk placement of `count` identical slots, batched
         with concurrent bulk evals into one chained device dispatch
         (ops.place.place_bulk_batch_jit).  Blocks; returns (assign i32[N],
@@ -426,7 +476,8 @@ class PlacementEngine:
             cm, feasible=feasible, affinity=affinity,
             has_affinity=has_affinity, desired=desired, penalty=penalty,
             coll0=coll0, demand=demand, count=count, deltas=deltas,
-            spread_algorithm=spread_algorithm).result()
+            spread_algorithm=spread_algorithm,
+            wave_key=wave_key).result()
 
     def warmup(self, cm, inputs: Optional[PlaceInputs] = None,
                bulk: Optional[dict] = None) -> None:
@@ -493,13 +544,18 @@ class PlacementEngine:
                                           spread_algorithm=False,
                                           future=Future(), **spec)
                              for _ in range(E)]
+                    # THROWAWAY world per thunk: the warmed variants
+                    # include the donated-carry kernels, and donating /
+                    # adopting against the real resident world would
+                    # install a basis holding warmup placements the
+                    # host snapshot never saw
                     if mesh is not None:
-                        out, _b, _d = self._dispatch_bulk_group_sharded(
-                            breqs, mesh)
+                        out = self._dispatch_bulk_group_sharded(
+                            breqs, mesh, world=DeviceWorld(mesh))[0]
                         jax.block_until_ready(out)
                     else:
-                        packed, _basis, _d = \
-                            self._dispatch_bulk_group(breqs)
+                        packed = self._dispatch_bulk_group(
+                            breqs, world=DeviceWorld())[0]
                         jax.block_until_ready(packed)
 
         # XLA compiles release the GIL and run concurrently per variant,
@@ -534,6 +590,13 @@ class PlacementEngine:
         warm_scatter(cap.shape, mesh)
         if mesh is not None:
             warm_scatter(cap.shape)
+        if bulk is not None:
+            # bulk warmup ran against throwaway worlds: pre-upload the
+            # REAL world's epoch so the measured window's first dispatch
+            # pays a dirty-row diff, not the epoch's full upload
+            N = cm.n_rows
+            self._world(cm, N, mesh).update(
+                np.asarray(cm.capacity)[:N], self._basis_for(cm)[:N])
         self.stats.update(stats_before)
         self._cache.hits, self._cache.misses = cache_before
 
@@ -789,18 +852,27 @@ class PlacementEngine:
     def _run(self) -> None:
         while True:
             with self._cv:
-                while not self._queue and not self._stop:
+                while not self._queue and not self._stop \
+                        and self._pending is None:
                     self._cv.wait()
                 if self._stop and not self._queue:
-                    return
+                    break
                 batch, self._queue = (self._queue[:self.max_batch],
                                       self._queue[self.max_batch:])
+            if not batch:
+                # idle with a bulk dispatch in flight: nothing arrived
+                # to chain behind it, so fetch + resolve it now
+                self._drain_pending()
+                continue
             try:
                 self._dispatch(batch)
             except Exception as e:              # noqa: BLE001
+                self._drain_pending()
                 for r in batch:
                     if not r.future.done():
                         r.future.set_exception(e)
+        # stop: settle any in-flight dispatch so its futures resolve
+        self._drain_pending()
 
     # ------------------------------------------------------------- dispatch
 
@@ -828,37 +900,65 @@ class PlacementEngine:
                         r.future.set_exception(e)
 
     def _dispatch_one_group(self, reqs: List) -> None:
-        import jax
-
         if isinstance(reqs[0], _BulkRequest):
-            mesh = self._mesh_for(reqs[0].feasible.shape[0])
+            cm = reqs[0].cm
+            N = reqs[0].feasible.shape[0]
+            mesh = self._mesh_for(N)
+            world = self._world(cm, N, mesh)
+            lanes = mesh.shape.get("wave", 1) if mesh is not None else 1
+            expected_shape = ((N, cm.capacity.shape[1]),
+                              (N, cm.used.shape[1]))
             parts = 0
-            for part in self._split_bulk(reqs, sharded=mesh is not None):
+            for part in self._split_bulk(reqs, sharded=mesh is not None,
+                                         lanes=lanes):
                 parts += 1
+                # upload/compute overlap: the previous bulk dispatch may
+                # still be computing.  Chaining behind it is sound ONLY
+                # when this part scores against the same world via the
+                # adopted donated carry (which already holds the
+                # in-flight placements) and update() can proceed by
+                # dirty-row scatter — a full upload from the host
+                # snapshot would erase those placements, and chaos
+                # injection may force exactly that, so both bail to a
+                # drain-first barrier.
+                chained = (self.overlap and self.donate
+                           and chaos.active is None
+                           and self._pending is not None
+                           and self._pending.world is world
+                           and self._pending.donated
+                           and world.shape == expected_shape)
+                if self._pending is not None and not chained:
+                    self._drain_pending()
+                tp0 = _time.time()
                 if mesh is not None:
-                    packed, world, dper = \
-                        self._dispatch_bulk_group_sharded(part, mesh)
+                    out, _w, dper, mapping, donated = \
+                        self._dispatch_bulk_group_sharded(
+                            part, mesh, world=world,
+                            force_scatter=chained)
                 else:
-                    packed, world, dper = self._dispatch_bulk_group(part)
-                t0 = _time.time()
-                fetched = jax.device_get(packed)
-                t1 = _time.time()
-                dev_s = t1 - t0
-                self.stats["device_s"] += dev_s
-                self.device_windows.append((t0, t1))
-                t0 = _time.time()
-                self._resolve_bulk(part, fetched, world, dper)
-                self.stats["resolve_s"] += _time.time() - t0
-                self._emit_dispatch_spans(part, dev_s, "bulk")
-                if len(part) > 1:
-                    self.stats["batched_evals"] += len(part)
-                else:
-                    self.stats["single_evals"] += 1
+                    out, _w, dper, donated = self._dispatch_bulk_group(
+                        part, world=world, force_scatter=chained)
+                    mapping = None
+                tp1 = _time.time()
+                self.upload_windows.append((tp0, tp1))
+                if chained:
+                    self.stats["overlap_chained"] += 1
+                prev, self._pending = self._pending, _PendingBulk(
+                    reqs=part, out=out, world=world, deltas_per=dper,
+                    mapping=mapping, donated=donated, t_dispatch=tp1)
+                if prev is not None:
+                    self._drain_record(prev)
+                if not (self.overlap and donated):
+                    self._drain_pending()
             self.stats["bulk_groups"] += 1
             self.stats["bulk_parts"] += parts
             self.stats["bulk_evals"] += len(reqs)
             return
 
+        # scan-path groups resolve against the overlay basis: an
+        # in-flight bulk dispatch's placements are not registered yet,
+        # so a pending dispatch must land before this group's basis read
+        self._drain_pending()
         rebucketed = (reqs[0].cm.capacity.shape[0]
                       != reqs[0].inputs.capacity.shape[0])
         mesh = None if rebucketed else \
@@ -899,6 +999,50 @@ class PlacementEngine:
                 packed = self._dispatch_group(chunk)
             self.stats["batched_evals"] += len(chunk)
             self._fetch_resolve_scan(chunk, packed)
+
+    def _drain_pending(self) -> None:
+        """Fetch + resolve the in-flight bulk dispatch, if any.  Called
+        wherever the overlap pipeline must barrier: before any dispatch
+        that cannot chain (different world, scan path, chaos active),
+        when the queue idles with work in flight, and at stop."""
+        p, self._pending = self._pending, None
+        if p is not None:
+            self._drain_record(p)
+
+    def _drain_record(self, p: _PendingBulk) -> None:
+        import jax
+
+        t0 = _time.time()
+        try:
+            fetched = jax.device_get(p.out)
+        except Exception as e:                  # noqa: BLE001
+            if p.donated and p.world is not None:
+                # the adopted carry is suspect (failed dispatch): the
+                # next update() re-uploads from the host snapshot
+                p.world.invalidate_basis()
+            for r in p.reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        t1 = _time.time()
+        dev_s = t1 - t0
+        self.stats["device_s"] += dev_s
+        self.device_windows.append((p.t_dispatch, t1))
+        t0 = _time.time()
+        try:
+            self._resolve_bulk(p.reqs, fetched, p.world, p.deltas_per,
+                               mapping=p.mapping, donated=p.donated)
+        except Exception as e:                  # noqa: BLE001
+            for r in p.reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        self.stats["resolve_s"] += _time.time() - t0
+        self._emit_dispatch_spans(p.reqs, dev_s, "bulk")
+        if len(p.reqs) > 1:
+            self.stats["batched_evals"] += len(p.reqs)
+        else:
+            self.stats["single_evals"] += 1
 
     def _fetch_resolve_scan(self, reqs: List[_Request], packed) -> None:
         import jax
@@ -941,8 +1085,8 @@ class PlacementEngine:
     # ------------------------------------------------------- sharded path
 
     def _mesh_for(self, N: int):
-        """The ('nodes',) serving mesh when sharding applies to this node
-        axis, else None."""
+        """The ('node_shard','wave') serving mesh when sharding applies
+        to this node axis, else None."""
         if os.environ.get("NOMAD_TPU_SHARD", "1") == "0":
             return None
         if not self._mesh_checked:
@@ -955,8 +1099,11 @@ class PlacementEngine:
         mesh = self._serving_mesh
         if mesh is None or N < self.shard_min_nodes:
             return None
-        # shards need >= 2 local rows (the wave's top-2 reduction)
-        if N % mesh.devices.size != 0 or N < 2 * mesh.devices.size:
+        # the node axis splits over 'node_shard' only (wave columns hold
+        # replicas); shards need >= 2 local rows (the wave's top-2
+        # reduction)
+        n_shard = mesh.shape.get("node_shard", mesh.devices.size)
+        if N % n_shard != 0 or N < 2 * n_shard:
             return None
         return mesh
 
@@ -1038,68 +1185,143 @@ class PlacementEngine:
             self.stats.get("sharded_evals", 0) + len(reqs))
         return packed
 
+    @staticmethod
+    def _lane_bins(reqs: List[_BulkRequest], W: int):
+        """Deterministic wave-lane binning: distinct wave_keys (eval
+        namespaces) spread round-robin over the mesh's W wave columns in
+        sorted-key order; requests sharing a key stay in ONE lane so
+        their chained semantics are untouched.  Returns (bins — per-lane
+        request lists, ALWAYS W of them so the stacks match the mesh's
+        wave extent — and mapping[i] = (lane, slot) per input order).
+        A single distinct key (or W == 1) degenerates to one active lane
+        (padded lanes carry count=0 evals that exit immediately) —
+        placement-identical to the pre-laned dispatch."""
+        keys = sorted({r.wave_key for r in reqs})
+        if W <= 1 or len(keys) <= 1:
+            bins = [list(reqs)] + [[] for _ in range(max(0, W - 1))]
+            return bins, [(0, i) for i in range(len(reqs))]
+        lane_of = {k: i % W for i, k in enumerate(keys)}
+        bins: List[List[_BulkRequest]] = [[] for _ in range(W)]
+        mapping = []
+        for r in reqs:
+            lane = lane_of[r.wave_key]
+            mapping.append((lane, len(bins[lane])))
+            bins[lane].append(r)
+        return bins, mapping
+
     def _dispatch_bulk_group_sharded(self, reqs: List[_BulkRequest],
-                                     mesh):
-        from nomad_tpu.parallel.sharded import place_bulk_batch_sharded
+                                     mesh, world=None, donate=None,
+                                     force_scatter: bool = False):
+        from nomad_tpu.parallel.sharded import (
+            NODE_AXIS_NAME,
+            WAVE_AXIS_NAME,
+            place_bulk_batch_sharded,
+        )
 
         cm = reqs[0].cm
         N = reqs[0].feasible.shape[0]
-        E = next(b for b in self.BULK_E_BUCKETS if b >= len(reqs))
+        donate = self.donate if donate is None else donate
+        W = mesh.shape.get(WAVE_AXIS_NAME, 1)
         capacity = cm.capacity[:N]
         basis = self._basis_for(cm)[:N]
         deltas_per = [r.deltas for r in reqs]
         if len(reqs) == 1 and len(reqs[0].deltas) > _DELTA_BUCKET:
             deltas_per = [_fold_overflow(basis, reqs[0].deltas)]
+            reqs = list(reqs)
+            bins = [[reqs[0]]] + [[] for _ in range(max(0, W - 1))]
+            mapping = [(0, 0)]
+            deltas_bins = [deltas_per] + [[] for _ in range(max(0, W - 1))]
+        else:
+            bins, mapping = self._lane_bins(reqs, W)
+            dp = {id(r): d for r, d in zip(reqs, deltas_per)}
+            deltas_bins = [[dp[id(r)] for r in b] for b in bins]
+        # lane eval extent: one compile bucket covering the fullest lane
+        fullest = max(len(b) for b in bins)
+        E = next(b for b in self.BULK_E_BUCKETS if b >= fullest)
+        self.stats["wave_lanes"] = max(
+            self.stats["wave_lanes"], sum(1 for b in bins if b))
+        self.stats["lane_evals"] += len(reqs)
+        self.stats["lane_slots"] += W * E
 
         t0 = _time.time()
-        pad = E - len(reqs)
         # content key from per-request digests (packbits + zero-marker
-        # fast paths) — cheaper than hashing the stacked [E, N] tensors,
-        # and a hit skips even BUILDING the host stacks
-        digs = tuple(bulk_heavy_digest(r.feasible, r.affinity, r.penalty,
-                                       r.coll0) for r in reqs)
-        meta = tuple((np.asarray(r.demand, np.float32).tobytes(),
-                      bool(r.has_affinity), int(r.desired))
-                     for r in reqs)
+        # fast paths) — cheaper than hashing the stacked [W, E, N]
+        # tensors, and a hit skips even BUILDING the host stacks.  The
+        # per-lane tuples make the key sensitive to lane layout.
+        r00 = reqs[0]
+        digs = tuple(tuple(
+            bulk_heavy_digest(r.feasible, r.affinity, r.penalty, r.coll0)
+            for r in b) for b in bins)
+        meta = tuple(tuple(
+            (np.asarray(r.demand, np.float32).tobytes(),
+             bool(r.has_affinity), int(r.desired)) for r in b)
+            for b in bins)
 
         def build_stacks():
-            stack1 = lambda get, dt: np.stack(         # noqa: E731
-                [np.asarray(get(r), dt) for r in reqs]
-                + [np.asarray(get(reqs[0]), dt)] * pad)
-            feas = stack1(lambda r: r.feasible, bool)
-            aff = stack1(lambda r: r.affinity, np.float32)
-            pen = stack1(lambda r: r.penalty, bool)
-            coll = stack1(lambda r: r.coll0, np.int32)
-            dem = stack1(lambda r: r.demand, np.float32)
-            hasa = np.array([r.has_affinity for r in reqs]
-                            + [False] * pad, bool)
-            des = np.array([r.desired for r in reqs] + [1] * pad,
-                           np.int32)
+            def lane_stack(get, dt, pad_val=None):
+                rows = []
+                for b in bins:
+                    fill = b[0] if b else r00
+                    lane = [np.asarray(get(r), dt) for r in b]
+                    pad_a = np.asarray(get(fill), dt) \
+                        if pad_val is None else pad_val
+                    lane += [pad_a] * (E - len(b))
+                    rows.append(np.stack(lane) if lane[0].ndim
+                                else np.array(lane, dt))
+                return np.stack(rows)
+            feas = lane_stack(lambda r: r.feasible, bool)
+            aff = lane_stack(lambda r: r.affinity, np.float32)
+            pen = lane_stack(lambda r: r.penalty, bool)
+            coll = lane_stack(lambda r: r.coll0, np.int32)
+            dem = lane_stack(lambda r: r.demand, np.float32)
+            hasa = np.stack([np.array(
+                [r.has_affinity for r in b] + [False] * (E - len(b)),
+                bool) for b in bins])
+            des = np.stack([np.array(
+                [r.desired for r in b] + [1] * (E - len(b)), np.int32)
+                for b in bins])
             return feas, aff, pen, coll, dem, hasa, des
 
         # padded evals have count=0: the wavefront exits immediately
-        cnt = np.array([r.count for r in reqs] + [0] * pad, np.int32)
-        drows, dvals = self._stack_deltas(
-            deltas_per + [[]] * pad, E, N)
+        cnt = np.stack([np.array(
+            [r.count for r in b] + [0] * (E - len(b)), np.int32)
+            for b in bins])
+        lane_drows, lane_dvals = [], []
+        for db in deltas_bins:
+            dr, dv = self._stack_deltas(
+                list(db) + [[]] * (E - len(db)), E, N)
+            lane_drows.append(dr)
+            lane_dvals.append(dv)
+        drows = np.stack(lane_drows)
+        dvals = np.stack(lane_dvals)
         self.stats["stack_s"] += _time.time() - t0
         t0 = _time.time()
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as _P
-        node2 = NamedSharding(mesh, _P(None, "nodes"))
-        rep1 = NamedSharding(mesh, _P(None))
-        rep2 = NamedSharding(mesh, _P(None, None))
+        lane3 = NamedSharding(
+            mesh, _P(WAVE_AXIS_NAME, None, NODE_AXIS_NAME))
+        lane2 = NamedSharding(mesh, _P(WAVE_AXIS_NAME, None))
+        lane2r = NamedSharding(mesh, _P(WAVE_AXIS_NAME, None, None))
         feas, aff, pen, coll, dem, hasa, des = self._cache.sharded(
             "bulk", mesh, build_stacks,
-            (node2, node2, node2, node2, rep2, rep1, rep1),
-            key=("bulkstack", N, E, digs, meta))
+            (lane3, lane3, lane3, lane3, lane2r, lane2, lane2),
+            key=("bulkstack", N, W, E, digs, meta))
         self.stats["put_heavy_s"] = self.stats.get("put_heavy_s", 0.0) \
             + (_time.time() - t0)
         t1 = _time.time()
         # device-resident world: one full upload per cluster epoch, then
         # dirty-row scatters; steady state ships zero basis bytes because
-        # _resolve_bulk pre-applied the placements via apply_rank1
-        world = self._world(cm, N, mesh)
-        cap_dev, basis_dev = world.update(capacity, basis)
+        # _resolve_bulk pre-applied the placements (apply_rank1, or the
+        # donated carry + apply_rank1_host)
+        world = world if world is not None else self._world(cm, N, mesh)
+        cap_dev, basis_dev = world.update(capacity, basis,
+                                          force_scatter=force_scatter)
+        if donate:
+            loaned = world.loan_basis()
+            if loaned is not None:
+                basis_dev = loaned
+            else:
+                donate = False
         self.stats["put_basis_s"] = self.stats.get("put_basis_s", 0.0) \
             + (_time.time() - t1)
         t1 = _time.time()
@@ -1108,19 +1330,24 @@ class PlacementEngine:
             mesh, cap_dev, basis_dev,
             feas, aff, hasa, des, pen, coll, dem, cnt,
             drows, dvals, spread_algorithm=reqs[0].spread_algorithm,
-            fill_grid=fill_grid_for(max(r.count for r in reqs)))
-        assign, scores, placed, n_eval, n_exh, waves, _used = out
+            fill_grid=fill_grid_for(max(r.count for r in reqs)),
+            donate=donate)
+        assign, scores, placed, n_eval, n_exh, waves, used_tot = out
+        if donate:
+            world.adopt_basis(used_tot)
+            self.stats["donated_carries"] += 1
         self.stats["put_kernel_s"] = self.stats.get("put_kernel_s", 0.0) \
             + (_time.time() - t1)
         self.stats["put_s"] += _time.time() - t0
         self.stats["sharded_evals"] = (
             self.stats.get("sharded_evals", 0) + len(reqs))
         return (assign, scores, placed, n_eval, n_exh, waves), \
-            world, deltas_per
+            world, deltas_per, mapping, donate
 
     # ---------------------------------------------------------- bulk path
 
-    def _split_bulk(self, reqs: List[_BulkRequest], sharded: bool = False):
+    def _split_bulk(self, reqs: List[_BulkRequest], sharded: bool = False,
+                    lanes: int = 1):
         # oversized-delta requests always go alone so their deltas can
         # fold into the part's private basis copy (fixed delta bucket,
         # no compile variant forked)
@@ -1128,7 +1355,7 @@ class PlacementEngine:
         rest = [r for r in reqs if len(r.deltas) <= _DELTA_BUCKET]
         for r in overflow:
             yield [r]
-        chunk = self._bulk_chunk(reqs[0].feasible.shape[0])
+        chunk = self._bulk_chunk(reqs[0].feasible.shape[0], lanes)
         if self.fuse or sharded:
             # FUSED wave dispatch: the whole wave is ONE device call
             # (modulo the byte-budget chunk).  The dispatch picks the
@@ -1159,19 +1386,24 @@ class PlacementEngine:
             for i in range(0, len(fits), chunk):
                 yield fits[i:i + chunk]
 
-    def _bulk_chunk(self, N: int) -> int:
+    def _bulk_chunk(self, N: int, lanes: int = 1) -> int:
         """Largest bulk E bucket whose stacked per-eval heavy blocks
         (f32[4N] each) fit the NOMAD_TPU_BULK_BYTES budget — 100K-node
-        worlds cap their chains instead of staging ~1 GB stacks."""
-        cap = max(1, self.bulk_bytes_budget // (4 * N * 4))
+        worlds cap their chains instead of staging ~1 GB stacks.  On a
+        laned mesh the stacks carry [W, E, ...] so the budget divides by
+        the wave extent."""
+        cap = max(1, self.bulk_bytes_budget
+                  // (4 * N * 4 * max(1, lanes)))
         allowed = [b for b in self.BULK_E_BUCKETS if b <= cap]
         return min(self.max_batch, allowed[-1] if allowed else 1)
 
-    def _dispatch_bulk_group(self, reqs: List[_BulkRequest]):
+    def _dispatch_bulk_group(self, reqs: List[_BulkRequest], world=None,
+                             donate=None, force_scatter: bool = False):
         import jax
 
         cm = reqs[0].cm
         N = reqs[0].feasible.shape[0]
+        donate = self.donate if donate is None else donate
         E = next(b for b in self.BULK_E_BUCKETS if b >= len(reqs))
         # rows are stable across matrix re-bucketing (growth only pads
         # the node axis), so the enqueue-time world is the prefix slice
@@ -1199,9 +1431,18 @@ class PlacementEngine:
         t0 = _time.time()
         # device-resident world: epoch upload once, dirty-row scatters
         # after; steady state ships zero basis bytes (apply_rank1 in
-        # _resolve_bulk keeps device and host snapshot in lockstep)
-        world = self._world(cm, N)
-        cap_dev, used_dev = world.update(capacity, basis)
+        # _resolve_bulk keeps device and host snapshot in lockstep; on
+        # the donated path the kernel's exact carry IS the new resident
+        # basis and only the host snapshot catches up)
+        world = world if world is not None else self._world(cm, N)
+        cap_dev, used_dev = world.update(capacity, basis,
+                                         force_scatter=force_scatter)
+        if donate:
+            loaned = world.loan_basis()
+            if loaned is not None:
+                used_dev = loaned
+            else:
+                donate = False
         self.stats["put_basis_s"] = self.stats.get("put_basis_s", 0.0) \
             + (_time.time() - t0)
         t1 = _time.time()
@@ -1224,18 +1465,32 @@ class PlacementEngine:
         dyn_dev = jax.device_put(dyn)  # analysis: allow(transfer-purity) — per-dispatch dynamic leaf, shipped explicitly
         sparse = all(r.count <= SPARSE_CAP for r in reqs)
         from nomad_tpu.ops.place import fill_grid_for
-        packed, _used_final = place_bulk_batch_jit(
-            cap_dev, used_dev, hstack, dyn_dev, D,
-            sparse_out=sparse,
-            spread_algorithm=reqs[0].spread_algorithm,
-            fill_grid=fill_grid_for(max(r.count for r in reqs)))
+        fill_grid = fill_grid_for(max(r.count for r in reqs))
+        if donate:
+            # exact_out: the adopted basis is the rank-1 reconstruction
+            # (bitwise what apply_rank1 would have scattered), while the
+            # scan's own carry keeps chain-scoring parity
+            packed, _used_final, used_exact = place_bulk_batch_donate_jit(
+                cap_dev, used_dev, hstack, dyn_dev, D,
+                sparse_out=sparse,
+                spread_algorithm=reqs[0].spread_algorithm,
+                fill_grid=fill_grid, exact_out=True)
+            world.adopt_basis(used_exact)
+            self.stats["donated_carries"] += 1
+        else:
+            packed, _used_final = place_bulk_batch_jit(
+                cap_dev, used_dev, hstack, dyn_dev, D,
+                sparse_out=sparse,
+                spread_algorithm=reqs[0].spread_algorithm,
+                fill_grid=fill_grid)
         self.stats["put_kernel_s"] = self.stats.get("put_kernel_s", 0.0) \
             + (_time.time() - t1)
         self.stats["put_s"] += _time.time() - t0
-        return packed, world, deltas_per
+        return packed, world, deltas_per, donate
 
     def _resolve_bulk(self, reqs: List[_BulkRequest], packed: np.ndarray,
-                      world, deltas_per) -> None:
+                      world, deltas_per, mapping=None,
+                      donated: bool = False) -> None:
         """Mirror the kernel's chained usage host-side so every caller
         gets the exact used matrix its placements produced: each eval
         sees basis + prior evals' PLACEMENTS + its own private deltas;
@@ -1247,7 +1502,11 @@ class PlacementEngine:
         `world` is the DeviceWorld this dispatch scored against: each
         eval's placements scatter onto it (host snapshot + device in
         lockstep) so the NEXT dispatch's update() diff is already clean
-        and ships zero basis rows in steady state."""
+        and ships zero basis rows in steady state.  `mapping` (laned
+        sharded dispatches) gives each request's (lane, slot) in the
+        [W, E, ...] outputs; `donated` routes the world hand-off through
+        apply_rank1_host — the adopted carry already holds the
+        placements on device, only the host snapshot catches up."""
         import jax
 
         N = reqs[0].feasible.shape[0]
@@ -1258,6 +1517,12 @@ class PlacementEngine:
             assign, scores, placed, n_eval, n_exh, waves = \
                 [np.asarray(x) for x in jax.device_get(packed)]
             assign = assign.astype(np.int32)
+            if mapping is not None:
+                idx = (np.array([ln for ln, _ in mapping]),
+                       np.array([s for _, s in mapping]))
+                assign, scores, placed, n_eval, n_exh, waves = (
+                    assign[idx], scores[idx], placed[idx], n_eval[idx],
+                    n_exh[idx], waves[idx])
         else:
             sparse = all(r.count <= SPARSE_CAP for r in reqs)
             assign, scores, placed, n_eval, n_exh, waves = \
@@ -1278,7 +1543,11 @@ class PlacementEngine:
                 r.cm, rows, assign[i][rows], r.demand) \
                 if rows.size else None
             if ticket is not None and world is not None:
-                world.apply_rank1(rows, assign[i][rows], r.demand)
+                if donated:
+                    world.apply_rank1_host(rows, assign[i][rows],
+                                           r.demand)
+                else:
+                    world.apply_rank1(rows, assign[i][rows], r.demand)
             r.future.set_result(
                 (assign[i], int(placed[i]), int(n_eval[i]),
                  int(n_exh[i]), scores[i], ticket))

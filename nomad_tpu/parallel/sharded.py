@@ -1,17 +1,32 @@
-"""Sharded placement: the dense engine over a ('evals', 'nodes') mesh.
+"""Sharded placement: the dense engine over a ('node_shard', 'wave') mesh.
 
-Each device owns a node shard of a subset of the eval batch.  Inside one
-scan step every shard scores its local nodes, the global best node is
-found with pmax (max score) + pmin (lowest global row among ties, matching
-the single-chip argmax tie-break), and each shard applies the carry update
-only to rows it owns.  Cross-shard information (the selected node's spread
-value indices) moves via psum of a masked gather — an ICI-friendly scalar
-collective rather than an all-gather of the whole matrix.
+The serving mesh is 2-D.  Along `node_shard` each device owns a
+contiguous row shard of the [N, R] world: inside one scan step every
+shard scores its local nodes, the global best node is found with pmax
+(max score) + pmin (lowest global row among ties, matching the
+single-chip argmax tie-break), and each shard applies the carry update
+only to rows it owns.  Cross-shard information (the selected node's
+spread value indices) moves via psum of a masked gather — an
+ICI-friendly scalar collective rather than an all-gather of the whole
+matrix.
+
+Along `wave`, INDEPENDENT ready waves (bulk evals from different
+namespaces, binned by the engine's wave_key) score concurrently on
+disjoint device columns: each lane runs its own chained eval scan
+against the shared usage basis, and the merged basis is the psum of the
+lane deltas.  Lanes are blind to each other within one dispatch — the
+plan applier's overlay/commit validation remains the capacity authority,
+exactly as it is for evals split across dispatches.
+
+`wave_mesh_shape` factors a device count into the (node_shard, wave)
+grid; `NOMAD_TPU_WAVE_SHARDS` pins the wave extent.
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional
+import math
+import os
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +43,26 @@ _TRANSFER_HOT_PATH = True
 _RECOMPILE_TRACKED = True
 
 BIG = jnp.int32(2**31 - 1)
+
+# mesh axis names: rows of the world along NODE_AXIS, independent eval
+# waves along WAVE_AXIS
+NODE_AXIS_NAME = "node_shard"
+WAVE_AXIS_NAME = "wave"
+
+
+def mesh_key(mesh) -> Optional[tuple]:
+    """Stable identity of a device mesh: axis layout + device ids.
+
+    `id(mesh)` is NOT a mesh identity — a re-created Mesh object can
+    reuse the id of a dead one and resurrect its cache entries with
+    stale shardings; conversely two distinct but equal Mesh objects must
+    hit the same kernel cache entry (re-creating the mesh must not
+    recompile).  Two meshes with the same axes over the same devices are
+    interchangeable for sharding purposes."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat))
 
 
 def _put_host(mesh, spec, x):  # analysis: allow(transfer-purity) — per-wave delta/field operands are payload, shipped explicitly with their mesh sharding so the runtime guard stays "disallow"
@@ -55,14 +90,47 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
                check_rep=check_vma)
 
 
-def make_mesh(n_eval_shards: int = 1, n_node_shards: Optional[int] = None,
-              devices=None) -> Mesh:
-    devices = devices if devices is not None else jax.devices()
-    if n_node_shards is None:
-        n_node_shards = len(devices) // n_eval_shards
-    dev = np.array(devices[:n_eval_shards * n_node_shards]).reshape(
-        n_eval_shards, n_node_shards)
-    return Mesh(dev, ("evals", "nodes"))
+def wave_mesh_shape(n_devices: int,
+                    wave_shards: Optional[int] = None) -> Tuple[int, int]:
+    """Factor a device count into the (node_shard, wave) grid.
+
+    Node sharding is the always-profitable axis (it divides the [N, M]
+    scoring grids, where the FLOPs live), so the wave extent is the
+    LARGEST divisor of `n_devices` that is <= sqrt(n_devices): 1 -> 1x1,
+    2 -> 2x1, 4 -> 2x2, 8 -> 4x2.  `wave_shards` (or the
+    NOMAD_TPU_WAVE_SHARDS env knob) pins the wave extent instead; a
+    value that does not divide the device count falls back to 1 rather
+    than dropping devices from the mesh."""
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    if wave_shards is None:
+        env = os.environ.get("NOMAD_TPU_WAVE_SHARDS", "")
+        wave_shards = int(env) if env else None
+    if wave_shards is not None:
+        w = max(1, int(wave_shards))
+        if n_devices % w != 0:
+            w = 1
+        return n_devices // w, w
+    w = max(d for d in range(1, math.isqrt(n_devices) + 1)
+            if n_devices % d == 0)
+    return n_devices // w, w
+
+
+def make_mesh(n_wave_shards: Optional[int] = None,
+              n_node_shards: Optional[int] = None, devices=None) -> Mesh:
+    """Named 2-D ('node_shard', 'wave') device mesh.  With no explicit
+    shape, `wave_mesh_shape` picks the factorization for the full
+    device set."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_wave_shards is None and n_node_shards is None:
+        n_node_shards, n_wave_shards = wave_mesh_shape(len(devices))
+    elif n_node_shards is None:
+        n_node_shards = len(devices) // n_wave_shards
+    elif n_wave_shards is None:
+        n_wave_shards = len(devices) // n_node_shards
+    dev = np.array(devices[:n_wave_shards * n_node_shards]).reshape(
+        n_node_shards, n_wave_shards)
+    return Mesh(dev, (NODE_AXIS_NAME, WAVE_AXIS_NAME))
 
 
 def stack_inputs(inputs) -> PlaceInputs:
@@ -72,7 +140,7 @@ def stack_inputs(inputs) -> PlaceInputs:
 
 
 # PartitionSpecs for one eval's PlaceInputs, node axis sharded.  A leading
-# 'evals' batch axis is prepended by place_eval_batch_sharded.
+# 'wave' batch axis is prepended by place_eval_batch_sharded.
 _NODE_AXIS = {
     "capacity": 0, "used": 0,
     "feasible": 1, "affinity": 1, "penalty": 1, "tg_count": 1,
@@ -96,9 +164,9 @@ def _input_specs(batched: bool) -> PlaceInputs:
                 "slot_active": 1}[name]
         parts = [None] * ndim
         if axis is not None:
-            parts[axis] = "nodes"
+            parts[axis] = NODE_AXIS_NAME
         if batched:
-            parts = ["evals"] + parts
+            parts = [WAVE_AXIS_NAME] + parts
         specs[name] = P(*parts)
     return PlaceInputs(**specs)
 
@@ -106,7 +174,8 @@ def _input_specs(batched: bool) -> PlaceInputs:
 def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
                         shard_offset: jax.Array, carry, slot):
     """One placement step on a node shard (mirrors ops.place._place_step;
-    the selection and carry updates go through 'nodes' collectives)."""
+    the selection and carry updates go through 'node_shard'
+    collectives)."""
     used, tg_count, spread_counts, place_cap = carry
     g = inp.slot_tg[slot]
     d = inp.demand[slot]
@@ -147,13 +216,13 @@ def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
     final = total / n_scorers
     masked = jnp.where(fits & active, final, -jnp.inf)
 
-    # --- global argmax over 'nodes': pmax score, pmin row among ties
+    # --- global argmax over 'node_shard': pmax score, pmin row among ties
     local_best = jnp.max(masked)
-    global_best = jax.lax.pmax(local_best, "nodes")
+    global_best = jax.lax.pmax(local_best, NODE_AXIS_NAME)
     local_idx = jnp.argmax(masked)
     cand = jnp.where((local_best == global_best) & (global_best > -jnp.inf),
                      global_rows[local_idx], BIG)
-    sel = jax.lax.pmin(cand, "nodes")
+    sel = jax.lax.pmin(cand, NODE_AXIS_NAME)
     ok = sel < BIG
 
     # --- carry updates: only the owning shard touches its rows
@@ -169,20 +238,21 @@ def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
     K = inp.spread_vidx.shape[1]
     Vp1 = spread_counts.shape[-1]
     v_local = jnp.sum(jnp.where(sel_local[None, :], inp.spread_vidx[g], 0), axis=1)
-    v = jax.lax.psum(v_local, "nodes")                     # i32[K]
+    v = jax.lax.psum(v_local, NODE_AXIS_NAME)             # i32[K]
     upd = jax.nn.one_hot(jnp.minimum(v, Vp1 - 1), Vp1, dtype=spread_counts.dtype)
     upd = upd * (inp.spread_active[g] & (v < Vp1 - 1))[:, None] * ok
     spread_counts = spread_counts.at[g].add(upd)
 
     # per-slot metrics (global)
     fit_sel = jax.lax.psum(
-        jnp.sum(jnp.where(sel_local, fit_score, 0.0)), "nodes")
-    n_eval = jax.lax.psum(jnp.sum(feas & active), "nodes")
-    n_exh = jax.lax.psum(jnp.sum(feas & ~fits & active), "nodes")
+        jnp.sum(jnp.where(sel_local, fit_score, 0.0)), NODE_AXIS_NAME)
+    n_eval = jax.lax.psum(jnp.sum(feas & active), NODE_AXIS_NAME)
+    n_exh = jax.lax.psum(jnp.sum(feas & ~fits & active), NODE_AXIS_NAME)
     k_local = min(TOP_K, masked.shape[0])
     top_s_l, top_i_l = jax.lax.top_k(masked, k_local)
-    top_s = jax.lax.all_gather(top_s_l, "nodes", tiled=True)
-    top_i = jax.lax.all_gather(global_rows[top_i_l], "nodes", tiled=True)
+    top_s = jax.lax.all_gather(top_s_l, NODE_AXIS_NAME, tiled=True)
+    top_i = jax.lax.all_gather(global_rows[top_i_l], NODE_AXIS_NAME,
+                               tiled=True)
     order = jnp.argsort(-top_s)[:TOP_K]
 
     out = (
@@ -199,7 +269,7 @@ def _place_step_sharded(inp: PlaceInputs, spread_algorithm: bool,
 
 def _shard_body(inp: PlaceInputs, spread_algorithm: bool):
     """Runs inside shard_map for one eval: scan over slots."""
-    idx = jax.lax.axis_index("nodes")
+    idx = jax.lax.axis_index(NODE_AXIS_NAME)
     n_local = inp.used.shape[0]
     shard_offset = idx * n_local
     S = inp.demand.shape[0]
@@ -213,12 +283,12 @@ def _shard_body(inp: PlaceInputs, spread_algorithm: bool):
 
 def place_eval_batch_sharded(mesh: Mesh, stacked: PlaceInputs,
                              spread_algorithm: bool = False):
-    """Place a batch of evals over the ('evals','nodes') mesh.
+    """Place a batch of evals over the ('node_shard','wave') mesh.
 
     `stacked` has a leading eval-batch axis on every field (see
-    stack_inputs); the batch is sharded over 'evals' and the node axis over
-    'nodes'.  Returns per-eval (node, score, fit_score, nodes_evaluated,
-    nodes_exhausted, top_nodes, top_scores, used_final).
+    stack_inputs); the batch is sharded over 'wave' and the node axis
+    over 'node_shard'.  Returns per-eval (node, score, fit_score,
+    nodes_evaluated, nodes_exhausted, top_nodes, top_scores, used_final).
     """
     in_specs = _input_specs(batched=True)
 
@@ -227,12 +297,13 @@ def place_eval_batch_sharded(mesh: Mesh, stacked: PlaceInputs,
         # batch; vmap over it (collectives batch across the vmapped axis)
         return jax.vmap(lambda one: _shard_body(one, spread_algorithm))(inp)
 
+    W, NS = WAVE_AXIS_NAME, NODE_AXIS_NAME
     out_specs = (
-        P("evals", None), P("evals", None), P("evals", None),
-        P("evals", None), P("evals", None), P("evals", None, None),
-        P("evals", None, None), P("evals", "nodes", None),
+        P(W, None), P(W, None), P(W, None),
+        P(W, None), P(W, None), P(W, None, None),
+        P(W, None, None), P(W, NS, None),
     )
-    key = ("eval_batch", mesh, spread_algorithm)
+    key = ("eval_batch", mesh_key(mesh), spread_algorithm)
     fn = _SERVING_FN_CACHE.get(key)
     if fn is None:
         fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(in_specs,),
@@ -244,13 +315,13 @@ def place_eval_batch_sharded(mesh: Mesh, stacked: PlaceInputs,
 
 # --------------------------------------------------------------------------
 # Serving-path kernels: the PlacementEngine's chained batch semantics over
-# a 1-D ('nodes',) mesh.  The eval axis stays a lax.scan (eval e+1 scores
-# against usage including eval e's placements — identical placements to
-# the single-device engine, the property the conflict-free design relies
-# on); the node axis, where the FLOPs live, shards across devices.
-# Selection/ordering runs on [N]-vector collectives (all_gather/pmax/psum
-# over ICI), which are KBs per wave — the scoring stacks and the [N, M]
-# fill grid never leave their shard.
+# the 2-D serving mesh.  Within a wave lane the eval axis stays a lax.scan
+# (eval e+1 scores against usage including eval e's placements — identical
+# placements to the single-device engine, the property the conflict-free
+# design relies on); the node axis, where the FLOPs live, shards across
+# 'node_shard'.  Selection/ordering runs on [N]-vector collectives
+# (all_gather/pmax/psum over ICI), which are KBs per wave — the scoring
+# stacks and the [N, M] fill grid never leave their shard.
 # --------------------------------------------------------------------------
 
 
@@ -265,10 +336,16 @@ def _apply_deltas_local(used, delta_rows, delta_vals, shard_offset):
         jnp.where(ok[:, None], delta_vals, 0.0), mode="drop")
 
 
-def make_serving_mesh(devices=None) -> Mesh:
-    """1-D ('nodes',) mesh over all devices — the engine's serving mesh."""
-    devices = devices if devices is not None else jax.devices()
-    return Mesh(np.array(devices), ("nodes",))
+def make_serving_mesh(devices=None,
+                      wave_shards: Optional[int] = None) -> Mesh:
+    """The engine's serving mesh: the 2-D ('node_shard','wave')
+    factorization over all devices.  Basis/capacity shard over
+    'node_shard' (replicated across wave columns); only the laned bulk
+    kernel populates the 'wave' axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    n_node, n_wave = wave_mesh_shape(len(devices), wave_shards)
+    dev = np.array(devices[:n_node * n_wave]).reshape(n_node, n_wave)
+    return Mesh(dev, (NODE_AXIS_NAME, WAVE_AXIS_NAME))
 
 
 def _set_rows_local(dev, rows, vals):
@@ -276,7 +353,7 @@ def _set_rows_local(dev, rows, vals):
     local indices; rows outside the shard (and the row==N pad slots)
     drop, so each device writes only rows it owns."""
     n_local = dev.shape[0]
-    lrows = rows - jax.lax.axis_index("nodes") * n_local
+    lrows = rows - jax.lax.axis_index(NODE_AXIS_NAME) * n_local
     ok = (lrows >= 0) & (lrows < n_local)
     lrows = jnp.where(ok, lrows, n_local)
     return dev.at[lrows].set(vals, mode="drop")
@@ -286,7 +363,7 @@ def _add_rank1_local(dev, rows, counts, demand):
     """Shard-local twin of the native scatter_add_rank1 export:
     dev[rows[k]] += counts[k] * demand, rows translated per shard."""
     n_local = dev.shape[0]
-    lrows = rows - jax.lax.axis_index("nodes") * n_local
+    lrows = rows - jax.lax.axis_index(NODE_AXIS_NAME) * n_local
     ok = (lrows >= 0) & (lrows < n_local)
     lrows = jnp.where(ok, lrows, n_local)
     vals = counts[:, None].astype(jnp.float32) * demand
@@ -294,21 +371,22 @@ def _add_rank1_local(dev, rows, counts, demand):
 
 
 def serving_update_fns(mesh: Mesh):
-    """Jitted (set_rows, add_rank1) scatter pair for a ('nodes',)-sharded
+    """Jitted (set_rows, add_rank1) scatter pair for a node-sharded
     [N, R] resident matrix (parallel.world.DeviceWorld).  Rows/values are
     replicated operands (KBs); the sharded matrix never moves — each
     shard scatters its own rows, no cross-device gather of the operand."""
-    key = ("update", mesh)
+    key = ("update", mesh_key(mesh))
     fns = _SERVING_FN_CACHE.get(key)
     if fns is None:
+        NS = NODE_AXIS_NAME
         set_fn = jax.jit(shard_map(
             _set_rows_local, mesh=mesh,
-            in_specs=(P("nodes", None), P(None), P(None, None)),
-            out_specs=P("nodes", None), check_vma=False))
+            in_specs=(P(NS, None), P(None), P(None, None)),
+            out_specs=P(NS, None), check_vma=False))
         add_fn = jax.jit(shard_map(
             _add_rank1_local, mesh=mesh,
-            in_specs=(P("nodes", None), P(None), P(None), P(None)),
-            out_specs=P("nodes", None), check_vma=False))
+            in_specs=(P(NS, None), P(None), P(None), P(None)),
+            out_specs=P(NS, None), check_vma=False))
         recompile.register("sharded.serving_set", set_fn)
         recompile.register("sharded.serving_add", add_fn)
         fns = (set_fn, add_fn)
@@ -318,7 +396,7 @@ def serving_update_fns(mesh: Mesh):
 
 def _field_specs_batched() -> dict:
     """PartitionSpecs for the per-eval field dict (PlaceInputs minus the
-    shared capacity/used basis), leading 'evals' batch axis unsharded on
+    shared capacity/used basis), leading eval batch axis unsharded on
     the serving mesh (the eval axis is a chained scan)."""
     specs = {}
     for name, axis in _NODE_AXIS.items():
@@ -332,7 +410,7 @@ def _field_specs_batched() -> dict:
                 "slot_tg": 1, "slot_active": 1}[name]
         parts = [None] * ndim
         if axis is not None:
-            parts[axis] = "nodes"
+            parts[axis] = NODE_AXIS_NAME
         specs[name] = P(*([None] + parts))
     return specs
 
@@ -343,7 +421,7 @@ _SERVING_FN_CACHE: dict = {}
 def place_batch_sharded(mesh: Mesh, capacity, used0, fields: dict,
                         delta_rows, delta_vals,
                         spread_algorithm: bool = False):
-    """Chained scan-path batch (engine _dispatch_group) over a ('nodes',)
+    """Chained scan-path batch (engine _dispatch_group) over the serving
     mesh.  `fields`: per-eval PlaceInputs fields (minus capacity/used,
     which ride separately as the batch-shared basis), each with a leading
     E axis; `delta_rows` i32[E, D] / `delta_vals` f32[E, D, R] are each
@@ -353,7 +431,7 @@ def place_batch_sharded(mesh: Mesh, capacity, used0, fields: dict,
     from nomad_tpu.ops.place import _pack_outputs
 
     def body(cap, u0, flds, drows, dvals):
-        idx = jax.lax.axis_index("nodes")
+        idx = jax.lax.axis_index(NODE_AXIS_NAME)
         n_local = cap.shape[0]
         shard_offset = idx * n_local
 
@@ -374,13 +452,14 @@ def place_batch_sharded(mesh: Mesh, capacity, used0, fields: dict,
                                           (flds, drows, dvals))
         return packed, used_final
 
-    key = ("scan", mesh, spread_algorithm)
+    NS = NODE_AXIS_NAME
+    key = ("scan", mesh_key(mesh), spread_algorithm)
     fn = _SERVING_FN_CACHE.get(key)
     if fn is None:
-        in_specs = (P("nodes", None), P("nodes", None),
+        in_specs = (P(NS, None), P(NS, None),
                     _field_specs_batched(), P(None, None),
                     P(None, None, None))
-        out_specs = (P(None, None, None), P("nodes", None))
+        out_specs = (P(None, None, None), P(NS, None))
         fn = jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
                                    out_specs=out_specs, check_vma=False))
         recompile.register("sharded.scan", fn)
@@ -396,29 +475,48 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
                              delta_rows, delta_vals,
                              spread_algorithm: bool = False,
                              max_waves: int = 65536,
-                             fill_grid: int = 64):
-    """Chained bulk wavefront batch (engine place_bulk) over a ('nodes',)
-    mesh — the C2M-scale multi-chip path.  Per-eval node-axis fields
-    carry a leading E axis; scalars (has_affinity/desired/count) are
-    f32[E].  Each wave computes its [N_local, M] scoring/fill grid on the
-    shard, then resolves the global greedy order from two all_gathered
-    [N] vectors (wave-start score + per-node run), every device deriving
-    the identical per-node placement so only its own rows mutate.
-    Returns (assign i32[E, N], scores f32[E, N], placed/n_eval/n_exh/
-    waves i32[E] each, used_final sharded)."""
+                             fill_grid: int = 64,
+                             donate: bool = False):
+    """Laned chained bulk wavefront batch (engine place_bulk) over the
+    2-D ('node_shard','wave') mesh — the C2M-scale multi-chip path.
+
+    Every per-eval input carries leading [W, E] axes, W the mesh's wave
+    extent: lane w holds its own chained eval sequence (the engine bins
+    requests into lanes by wave_key; pad slots ride with count == 0).
+    Node-axis fields are [W, E, N] sharded P('wave', None, 'node_shard');
+    scalars [W, E].  Within a lane each wave computes its [N_local, M]
+    scoring/fill grid on the shard, then resolves the global greedy
+    order from two all_gathered [N] vectors (wave-start score +
+    per-node run), every device in the lane's column deriving the
+    identical per-node placement so only its own rows mutate.  Lanes
+    never communicate until the final basis merge:
+    `used_final = u0 + psum_over_wave(lane_delta)`.
+
+    Returns (assign i32[W, E, N], scores f32[W, E, N], placed/n_eval/
+    n_exh/waves i32[W, E] each, used_final node-sharded).  With
+    `donate=True` the `used0` buffer is donated to the kernel — the
+    caller hands over its resident basis and adopts `used_final` in its
+    place (world.loan_basis / adopt_basis), so the steady state ships
+    zero basis bytes."""
     from nomad_tpu.ops.place import (
         _bulk_scores,
         bulk_run_lengths as _bulk_run_lengths,
         bulk_wave_grid as _bulk_wave_grid,
     )
 
-    def body(cap, u0, feas_e, aff_e, hasa_e, des_e, pen_e, coll_e,
-             dem_e, cnt_e, drows, dvals):
-        idx = jax.lax.axis_index("nodes")
+    def body(cap, u0, feas_l, aff_l, hasa_l, des_l, pen_l, coll_l,
+             dem_l, cnt_l, drows_l, dvals_l):
+        idx = jax.lax.axis_index(NODE_AXIS_NAME)
         n_local = cap.shape[0]
         shard_offset = idx * n_local
+        # lane-local blocks arrive [1, E, ...]: drop the unit wave axis
+        feas_e, aff_e, pen_e, coll_e = (
+            feas_l[0], aff_l[0], pen_l[0], coll_l[0])
+        hasa_e, des_e, cnt_e = hasa_l[0], des_l[0], cnt_l[0]
+        dem_e, drows, dvals = dem_l[0], drows_l[0], dvals_l[0]
 
-        def eval_step(used_in, ev):
+        def eval_step(carry, ev):
+            used_in, exact = carry
             feasible, affinity, has_aff, desired, penalty, coll0, \
                 demand, count, dr, dv = ev
             # deltas are scoped to THIS eval (backed out of the carry
@@ -445,18 +543,19 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
                 fits = fits_m[:, 0]
                 cur = jnp.where(fits, score_m[:, 0], -jnp.inf)
                 any_fit = jax.lax.pmax(
-                    jnp.any(fits).astype(jnp.int32), "nodes") > 0
+                    jnp.any(fits).astype(jnp.int32), NODE_AXIS_NAME) > 0
                 s_star = jax.lax.pmax(
                     jnp.max(jnp.where(fits_m[:, 1], score_m[:, 1],
-                                      -jnp.inf)), "nodes")
+                                      -jnp.inf)), NODE_AXIS_NAME)
                 # global top-2 of cur: local top-2, gathered
                 l2 = jax.lax.top_k(cur, 2)[0]
                 g2 = jax.lax.top_k(
-                    jax.lax.all_gather(l2, "nodes", tiled=True), 2)[0]
+                    jax.lax.all_gather(l2, NODE_AXIS_NAME, tiled=True),
+                    2)[0]
                 gmax, gsecond = g2[0], g2[1]
                 strict = fits & (cur > s_star)
                 use_strict = jax.lax.pmax(
-                    jnp.any(strict).astype(jnp.int32), "nodes") > 0
+                    jnp.any(strict).astype(jnp.int32), NODE_AXIS_NAME) > 0
                 tie = fits & (cur == gmax)
                 wv = jnp.where(use_strict, strict, tie)
                 second = jnp.where(cur == gmax, gsecond, gmax)
@@ -466,8 +565,10 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
                 # global greedy order from gathered [N] vectors; every
                 # shard computes the identical per-node allocation and
                 # slices out its own rows
-                cur_g = jax.lax.all_gather(cur, "nodes", tiled=True)
-                base_g = jax.lax.all_gather(base, "nodes", tiled=True)
+                cur_g = jax.lax.all_gather(cur, NODE_AXIS_NAME,
+                                           tiled=True)
+                base_g = jax.lax.all_gather(base, NODE_AXIS_NAME,
+                                            tiled=True)
                 wave_g = base_g > 0
                 order = jnp.argsort(jnp.where(wave_g, -cur_g, jnp.inf))
                 base_sorted = base_g[order]
@@ -497,30 +598,51 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
             scores, fits_f = _bulk_scores(
                 cap, used_f, demand, feasible, affinity, has_aff,
                 desired, penalty, coll_f, spread_algorithm)
-            n_eval = jax.lax.psum(jnp.sum(feasible), "nodes")
-            n_exh = jax.lax.psum(jnp.sum(feasible & ~fits_f), "nodes")
+            n_eval = jax.lax.psum(jnp.sum(feasible), NODE_AXIS_NAME)
+            n_exh = jax.lax.psum(jnp.sum(feasible & ~fits_f),
+                                 NODE_AXIS_NAME)
             out = (assign, scores, placed.astype(jnp.int32),
                    n_eval.astype(jnp.int32), n_exh.astype(jnp.int32),
                    waves.astype(jnp.int32))
-            return used_f - delta_local, out
+            # two carries (see ops.place._place_bulk_batch exact_out):
+            # the chain carry keeps the wavefront's incremental adds
+            # (scoring parity with the single-device kernel), the exact
+            # carry is the rank-1 reconstruction the adopted basis uses
+            exact = exact + assign[:, None].astype(jnp.float32) * demand
+            return (used_f - delta_local, exact), out
 
-        used_final, outs = jax.lax.scan(
-            eval_step, u0,
+        (used_final, exact_final), outs = jax.lax.scan(
+            eval_step, (u0, u0),
             (feas_e, aff_e, hasa_e, des_e, pen_e, coll_e, dem_e, cnt_e,
              drows, dvals))
-        return outs + (used_final,)
+        # merge lanes: each lane chained independently against the
+        # shared basis; the combined usage is the basis plus every
+        # lane's net rank-1 placement delta (the psum result is
+        # identical on all wave columns, satisfying the replicated
+        # out_spec; inactive lanes contribute exact zeros)
+        used_tot = u0 + jax.lax.psum(exact_final - u0, WAVE_AXIS_NAME)
+        assign, scores, placed, n_eval, n_exh, waves = outs
+        return (assign[None], scores[None], placed[None], n_eval[None],
+                n_exh[None], waves[None], used_tot)
 
-    in_specs = (P("nodes", None), P("nodes", None),
-                P(None, "nodes"), P(None, "nodes"), P(None), P(None),
-                P(None, "nodes"), P(None, "nodes"), P(None, None),
-                P(None), P(None, None), P(None, None, None))
-    key = ("bulk", mesh, spread_algorithm, max_waves, fill_grid)
+    NS, W = NODE_AXIS_NAME, WAVE_AXIS_NAME
+    in_specs = (P(NS, None), P(NS, None),
+                P(W, None, NS), P(W, None, NS), P(W, None), P(W, None),
+                P(W, None, NS), P(W, None, NS), P(W, None, None),
+                P(W, None), P(W, None, None), P(W, None, None, None))
+    key = ("bulk", mesh_key(mesh), spread_algorithm, max_waves,
+           fill_grid, donate)
     fn = _SERVING_FN_CACHE.get(key)
     if fn is None:
-        out_specs = (P(None, "nodes"), P(None, "nodes"), P(None), P(None),
-                     P(None), P(None), P("nodes", None))
-        fn = jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
-                                   out_specs=out_specs, check_vma=False))
+        out_specs = (P(W, None, NS), P(W, None, NS), P(W, None),
+                     P(W, None), P(W, None), P(W, None), P(NS, None))
+        mapped = shard_map(body, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+        # donate_argnums=(1,): used0 and used_final share shape [N, R]
+        # and sharding P('node_shard', None), so XLA aliases the carry
+        # in place of a fresh allocation + a host re-upload next wave
+        fn = jax.jit(mapped, donate_argnums=(1,)) if donate \
+            else jax.jit(mapped)
         recompile.register("sharded.bulk", fn)
         _SERVING_FN_CACHE[key] = fn
     args = [capacity, used0, feasible, affinity, has_affinity, desired,

@@ -33,9 +33,11 @@ STAGES = ("feasibility", "fit", "score", "argmax", "scatter")
 
 def interval_overlap_s(a, b) -> float:
     """Total seconds where two sets of (t0, t1) wall windows intersect.
-    Used for `pipeline_overlap_s`: the engine's device-blocked windows
-    against the applier's commit-fsync windows — device time the wave
-    pipeline hid under durability waits."""
+    Used for `pipeline_overlap_s` (the engine's host upload/dispatch
+    windows against in-flight device windows — host prep for wave N+1
+    hidden under wave N's compute) and for `commit_overlap_s` (device
+    windows against the applier's commit-fsync windows — device time
+    hidden under durability waits)."""
     a, b = sorted(a), sorted(b)
     i = j = 0
     total = 0.0
@@ -240,7 +242,9 @@ def probe_fused(n_nodes: int, r_dims: int = NUM_RESOURCE_DIMS,
 def device_stages(engine_stats: dict, n_nodes: int,
                   r_dims: int = NUM_RESOURCE_DIMS,
                   iters: int = 10, fill_grid: Optional[int] = None,
-                  pipeline_overlap_s: Optional[float] = None
+                  pipeline_overlap_s: Optional[float] = None,
+                  commit_overlap_s: Optional[float] = None,
+                  wave: Optional[dict] = None
                   ) -> Optional[dict]:
     """The BENCH JSON `"device_stages"` section: the run's measured
     `device_s` attributed across the wave pipeline by probed per-stage
@@ -248,12 +252,19 @@ def device_stages(engine_stats: dict, n_nodes: int,
     dirty-row upload time the engine already measures directly.  The
     fused production kernel is probed as one more unit (`fused`): its
     single-dispatch wave time against the five-dispatch phase sum, at
-    the same [N, fill_grid] shape the run used.  `pipeline_overlap_s`
-    (device time the commit pipeline hid under raft append + fsync —
-    see `interval_overlap_s`) passes straight through into the section.
-    Returns None when the run recorded no device time.  When a tracer
-    is installed the probe timings are also recorded as child spans of
-    a `device.stage_probe` trace (Perfetto-exportable like any other)."""
+    the same [N, fill_grid] shape the run used.
+
+    `pipeline_overlap_s` is the tentpole upload/compute overlap: host
+    prep windows (engine.upload_windows — stack + dirty-row update +
+    dispatch of wave N+1) intersected with in-flight device windows
+    (engine.device_windows of wave N) via `interval_overlap_s`.
+    `commit_overlap_s` is the older commit-pipeline metric (device time
+    hidden under raft append + fsync).  `wave` carries the 2-D-mesh
+    lane occupancy block (wave_lanes / lane_evals / lane_slots /
+    donated_carries / overlap_chained engine counters).  Returns None
+    when the run recorded no device time.  When a tracer is installed
+    the probe timings are also recorded as child spans of a
+    `device.stage_probe` trace (Perfetto-exportable like any other)."""
     device_s = float(engine_stats.get("device_s", 0.0))
     if device_s <= 0.0:
         return None
@@ -281,7 +292,20 @@ def device_stages(engine_stats: dict, n_nodes: int,
             if fused_s > 0 else None,
         },
         "pipeline_overlap_s": round(float(pipeline_overlap_s or 0.0), 6),
+        "commit_overlap_s": round(float(commit_overlap_s or 0.0), 6),
     }
+    if wave:
+        lanes = int(wave.get("wave_lanes", 0))
+        evals = int(wave.get("lane_evals", 0))
+        slots = int(wave.get("lane_slots", 0))
+        section["wave"] = {
+            "wave_lanes": lanes,
+            "lane_evals": evals,
+            "lane_slots": slots,
+            "lane_occupancy": round(evals / slots, 4) if slots else None,
+            "donated_carries": int(wave.get("donated_carries", 0)),
+            "overlap_chained": int(wave.get("overlap_chained", 0)),
+        }
     tracer = tracing.active
     if tracer is not None:
         ctx = tracer.new_context()

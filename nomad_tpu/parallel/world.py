@@ -27,18 +27,25 @@ dirty-row scatters:
   placements are already device-resident before the next dispatch
   diffs — the steady-state diff is empty and ships zero rows.
 
-On a multi-device mesh the buffers live sharded over the ('nodes',)
-serving mesh (`NamedSharding(mesh, P('nodes', None))`) and the scatters
-run through `sharded.serving_update_fns` — a shard_map twin that
-translates global rows to shard-local ones so each device only writes
-rows it owns (no cross-device gather of the operand).
+On a multi-device mesh the buffers live sharded over the serving
+mesh's 'node_shard' axis (`NamedSharding(mesh, P('node_shard', None))`,
+replicated across 'wave' columns) and the scatters run through
+`sharded.serving_update_fns` — a shard_map twin that translates global
+rows to shard-local ones so each device only writes rows it owns (no
+cross-device gather of the operand).
 
 Updates are functional (`at[...].set` under jit): in-flight consumers
 (a dispatched kernel, a concurrent warmup thread) keep the old buffer
 alive until they finish, then it frees — replacing the buffer under
-the lock while readers hold references is safe, which explicit buffer
-donation is not.  The transient second [N, R] buffer is ~2 MB at 100K
-nodes, noise next to the per-eval stacks.
+the lock while readers hold references is safe.  Explicit buffer
+donation IS safe, but only through the loan/adopt lifecycle below:
+`loan_basis()` transfers exclusive ownership of the resident basis to
+a donating kernel (the world forgets it, so no later scatter can touch
+a donated-away buffer), and `adopt_basis(used_final)` installs the
+kernel's carry output as the new resident basis.  Because the donated
+carry already contains the wave's placements, the resolve path pairs
+it with `apply_rank1_host` — the host-snapshot-only rank-1 twin —
+keeping host and device in lockstep with zero basis bytes shipped.
 """
 from __future__ import annotations
 
@@ -61,18 +68,8 @@ _RECOMPILE_TRACKED = True
 # dirty-row buckets: each size is one small compile of the row scatter
 ROW_BUCKETS = (64, 512, 4096)
 
-
-def mesh_key(mesh) -> Optional[tuple]:
-    """Stable identity of a device mesh: axis layout + device ids.
-
-    `id(mesh)` is NOT a mesh identity — a re-created Mesh object can
-    reuse the id of a dead one and resurrect its cache entries with
-    stale shardings.  Two meshes with the same axes over the same
-    devices are interchangeable for sharding purposes."""
-    if mesh is None:
-        return None
-    return (tuple(mesh.shape.items()),
-            tuple(d.id for d in mesh.devices.flat))
+# canonical mesh identity lives with the kernel caches it keys
+from nomad_tpu.parallel.sharded import mesh_key  # noqa: E402,F401
 
 
 _set_rows_fn = None
@@ -145,7 +142,9 @@ class DeviceWorld:
                       # full uploads AFTER the epoch's first (churn
                       # fallback or injected device loss): the bench's
                       # steady-state gate asserts this stays 0
-                      "steady_reuploads": 0}
+                      "steady_reuploads": 0,
+                      # donated-carry lifecycle (loan_basis/adopt_basis)
+                      "basis_loans": 0, "basis_adopts": 0}
 
     # ------------------------------------------------------------ helpers
 
@@ -153,7 +152,9 @@ class DeviceWorld:
         if self.mesh is None:
             return None
         from jax.sharding import NamedSharding, PartitionSpec as P
-        return NamedSharding(self.mesh, P("nodes", None))
+        axis = "node_shard" if "node_shard" in self.mesh.axis_names \
+            else self.mesh.axis_names[0]
+        return NamedSharding(self.mesh, P(axis, None))
 
     def _put_full(self, host: np.ndarray):
         import jax
@@ -191,9 +192,14 @@ class DeviceWorld:
         return fn(dev, rows_dev, vals_dev)
 
     def _update_one(self, host: np.ndarray, last: Optional[np.ndarray],
-                    dev) -> Tuple[np.ndarray, object, bool]:
+                    dev, force_scatter: bool = False
+                    ) -> Tuple[np.ndarray, object, bool]:
         """Sync one matrix; returns (new snapshot, new device array,
-        full-upload?).  Caller holds self.lock."""
+        full-upload?).  Caller holds self.lock.  `force_scatter` is the
+        chained-dispatch (donated-carry pipeline) discipline: the device
+        array holds in-flight placements the host snapshot lacks, so a
+        full upload would silently erase them — large churn scatters in
+        bucket-sized chunks instead of falling back."""
         if chaos.active is not None and \
                 chaos.active.should("world.scatter_fail"):
             # injected device loss: forget what shipped so this update
@@ -209,9 +215,27 @@ class DeviceWorld:
             if changed.size == 0:
                 self.stats["clean_hits"] += 1
                 return last, dev, False
-            if changed.size <= N // 4:
+            if changed.size <= N // 4 or force_scatter:
                 B = next((b for b in ROW_BUCKETS if b >= changed.size),
                          None)
+            if B is None and force_scatter:
+                # churn beyond the largest bucket: chunked bucket
+                # scatters (every chunk a warmed compile variant)
+                Bmax = ROW_BUCKETS[-1]
+                changed_vals = np.array(host[changed], dtype=np.float32)
+                snap = last.copy()
+                snap[changed] = changed_vals
+                for off in range(0, changed.size, Bmax):
+                    cr = changed[off:off + Bmax]
+                    cv = changed_vals[off:off + Bmax]
+                    b = next(b for b in ROW_BUCKETS if b >= cr.size)
+                    rows = np.full(b, N, np.int32)
+                    rows[:cr.size] = cr
+                    vals = np.zeros((b, host.shape[1]), np.float32)
+                    vals[:cr.size] = cv
+                    dev = self._set_rows(dev, rows, vals)
+                self.stats["rows_scattered"] += int(changed.size)
+                return snap, dev, False
         if B is None:
             snap = np.array(host, dtype=np.float32)
             return snap, self._put_full(snap), True
@@ -230,11 +254,15 @@ class DeviceWorld:
 
     # ------------------------------------------------------------- public
 
-    def update(self, capacity: np.ndarray, basis: np.ndarray):
+    def update(self, capacity: np.ndarray, basis: np.ndarray,
+               force_scatter: bool = False):
         """Bring the resident pair up to date with the host truth;
         returns (capacity_dev, basis_dev).  `capacity` may be the LIVE
         cm.capacity (it is snapshot-copied before any caching decision);
-        `basis` must already be a private copy (engine._basis_for)."""
+        `basis` must already be a private copy (engine._basis_for).
+        `force_scatter` (chained donated-carry dispatches only) forbids
+        the basis full-upload fallback: the resident basis carries
+        in-flight placements a host-snapshot upload would erase."""
         with self.lock:
             shape = (capacity.shape, basis.shape)
             if shape != self.shape:              # new cluster epoch
@@ -250,7 +278,8 @@ class DeviceWorld:
                 capacity, self._cap_last, self._cap_dev)
             race.write("DeviceWorld._basis_last", self)
             self._basis_last, self._basis_dev, full_b = self._update_one(
-                basis, self._basis_last, self._basis_dev)
+                basis, self._basis_last, self._basis_dev,
+                force_scatter=force_scatter)
             if full_c or full_b:
                 self.stats["full_uploads"] += 1
                 # a full ship after the epoch's first upload means the
@@ -259,6 +288,59 @@ class DeviceWorld:
                 self.stats["steady_reuploads"] += 1
             return self._cap_dev, self._basis_dev
 
+    def loan_basis(self):
+        """Transfer exclusive ownership of the resident basis buffer to
+        a donating kernel.  The world forgets the buffer (no later
+        scatter or update can alias a donated-away array); the caller
+        MUST follow the dispatch with `adopt_basis(used_final)` — or, on
+        a failed dispatch, leave the world invalidated so the next
+        update() re-uploads from the host snapshot.  Returns None if no
+        basis is resident."""
+        with self.lock:
+            dev, self._basis_dev = self._basis_dev, None
+            if dev is not None:
+                self.stats["basis_loans"] += 1
+            return dev
+
+    def adopt_basis(self, dev) -> None:
+        """Install a kernel's donated-carry output as the resident
+        basis.  The caller pairs this with `apply_rank1_host` at resolve
+        time: the adopted carry already holds the wave's placements on
+        device, so only the host snapshot needs the rank-1 update."""
+        with self.lock:
+            self._basis_dev = dev
+            if dev is not None:
+                self.stats["basis_adopts"] += 1
+
+    def invalidate_basis(self) -> None:
+        """Forget the resident basis (failed donated dispatch / poisoned
+        carry): the next update() ships a full upload from the host
+        snapshot instead of serving a suspect buffer."""
+        with self.lock:
+            self._basis_dev = None
+
+    def _rank1_host_locked(self, rows: np.ndarray, counts: np.ndarray,
+                           demand: np.ndarray) -> Optional[tuple]:
+        """Rank-1 update of the HOST snapshot (native scatter); caller
+        holds self.lock.  Returns the clipped (rows, counts, d) for the
+        device twin, or None if there is nothing to scatter."""
+        race.write("DeviceWorld._basis_last", self)
+        if self._basis_last is None:
+            return None                          # next update ships full
+        n, r = self._basis_last.shape
+        rows = np.ascontiguousarray(rows, np.int32)
+        counts = np.ascontiguousarray(counts, np.int32)
+        keep = rows < n
+        if not keep.all():
+            rows, counts = rows[keep], counts[keep]
+        if rows.size == 0:
+            return None
+        d = np.zeros(r, np.float32)
+        d[:min(len(demand), r)] = np.asarray(
+            demand, np.float32)[:r]
+        _native.scatter_add_rank1(self._basis_last, rows, counts, d)
+        return rows, counts, d
+
     def apply_rank1(self, rows: np.ndarray, counts: np.ndarray,
                     demand: np.ndarray) -> None:
         """Scatter `counts[k] * demand` into basis row `rows[k]` on BOTH
@@ -266,21 +348,10 @@ class DeviceWorld:
         jitted twin), keeping them in lockstep so the next update()'s
         diff sees those rows clean."""
         with self.lock:
-            race.write("DeviceWorld._basis_last", self)
-            if self._basis_last is None:
-                return                           # next update ships full
-            n, r = self._basis_last.shape
-            rows = np.ascontiguousarray(rows, np.int32)
-            counts = np.ascontiguousarray(counts, np.int32)
-            keep = rows < n
-            if not keep.all():
-                rows, counts = rows[keep], counts[keep]
-            if rows.size == 0:
+            clipped = self._rank1_host_locked(rows, counts, demand)
+            if clipped is None:
                 return
-            d = np.zeros(r, np.float32)
-            d[:min(len(demand), r)] = np.asarray(
-                demand, np.float32)[:r]
-            _native.scatter_add_rank1(self._basis_last, rows, counts, d)
+            rows, counts, d = clipped
             if chaos.active is not None and \
                     chaos.active.should("world.scatter_fail"):
                 # injected device loss of the scatter: the host snapshot
@@ -291,6 +362,8 @@ class DeviceWorld:
                 self.stats["chaos_invalidations"] = \
                     self.stats.get("chaos_invalidations", 0) + 1
                 return
+            if self._basis_dev is None:
+                return                   # loaned out: next update ships
             if self.mesh is None:
                 _, fn = _single_device_fns()
             else:
@@ -300,6 +373,26 @@ class DeviceWorld:
                 rows, counts, d)
             self._basis_dev = fn(self._basis_dev, rows_dev, counts_dev,
                                  d_dev)
+            self.stats["rank1_applies"] += 1
+
+    def apply_rank1_host(self, rows: np.ndarray, counts: np.ndarray,
+                         demand: np.ndarray) -> None:
+        """Host-snapshot-only rank-1 twin for the donated-carry path:
+        the adopted device basis ALREADY contains these placements (the
+        kernel's carry output), so scattering them on device would
+        double-count — only the host snapshot catches up, restoring
+        lockstep.  The chaos hook mirrors apply_rank1: an injected
+        device loss drops the adopted carry and the next update()
+        re-uploads from the (authoritative) host snapshot."""
+        with self.lock:
+            if self._rank1_host_locked(rows, counts, demand) is None:
+                return
+            if chaos.active is not None and \
+                    chaos.active.should("world.scatter_fail"):
+                self._basis_dev = None
+                self.stats["chaos_invalidations"] = \
+                    self.stats.get("chaos_invalidations", 0) + 1
+                return
             self.stats["rank1_applies"] += 1
 
     def host_basis(self) -> Optional[np.ndarray]:

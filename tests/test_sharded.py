@@ -29,7 +29,7 @@ def test_sharded_matches_single_chip():
     st, inp, count = build_inputs()
     single = place_eval(inp, st.spread_algorithm)
 
-    mesh = make_mesh(n_eval_shards=2, n_node_shards=4)
+    mesh = make_mesh(n_wave_shards=2, n_node_shards=4)
     batch = stack_inputs([inp, inp])
     node, score, fit_s, n_eval, n_exh, top_i, top_s, used = \
         place_eval_batch_sharded(mesh, batch)
@@ -60,7 +60,7 @@ def test_sharded_with_spread_and_affinity():
     inp = st.build_inputs(j, groups, [0] * 4, {})
     single = st.place(inp)
 
-    mesh = make_mesh(n_eval_shards=1, n_node_shards=8)
+    mesh = make_mesh(n_wave_shards=1, n_node_shards=8)
     batch = stack_inputs([inp])
     node, score, *_ = place_eval_batch_sharded(mesh, batch)
     # the engine pads the slot axis to a canonical bucket; compare the
@@ -109,7 +109,7 @@ def test_sharded_scale_10k_nodes_mixed():
 
     single = place_eval(inp, st.spread_algorithm)
 
-    mesh = make_mesh(n_eval_shards=1, n_node_shards=8)
+    mesh = make_mesh(n_wave_shards=1, n_node_shards=8)
     batch = stack_inputs([inp])
     node, score, fit_s, n_eval, n_exh, top_i, top_s, used = \
         place_eval_batch_sharded(mesh, batch, st.spread_algorithm)
@@ -437,9 +437,9 @@ def test_mesh_key_survives_mesh_recreation():
         arr = np.arange(16, dtype=np.float32).reshape(8, 2)
         from jax.sharding import NamedSharding, PartitionSpec as P
         a1 = eng._cache.sharded("t", m1, arr,
-                                NamedSharding(m1, P("nodes", None)))
+                                NamedSharding(m1, P("node_shard", None)))
         a2 = eng._cache.sharded("t", m2, arr,
-                                NamedSharding(m2, P("nodes", None)))
+                                NamedSharding(m2, P("node_shard", None)))
         assert a1 is a2                          # same content-address
     finally:
         eng.stop()

@@ -44,7 +44,9 @@ def test_engine_stats_shape_and_live_counters():
                     "max_batch_seen", "tickets_open", "stack_s", "put_s",
                     "device_s", "resolve_s", "cache_hits", "cache_misses",
                     "bulk_evals", "waves", "max_waves_seen",
-                    "bulk_groups", "bulk_parts"}
+                    "bulk_groups", "bulk_parts", "donated_carries",
+                    "wave_lanes", "lane_evals", "lane_slots",
+                    "overlap_chained"}
         assert expected <= set(eng.stats), \
             f"missing stats keys: {expected - set(eng.stats)}"
         for key in expected:
