@@ -956,6 +956,36 @@ def bench_kernel_100k_nodes(n_nodes=100_000, waves=12, per_wave=8,
 def main():
     target = 1_000_000 / 30.0       # north-star C2M rate (v5e-8)
 
+    if "--fleet-soak" in sys.argv:
+        # 10K-agent fleet cells (nomad_tpu/scenarios.py FleetSoakShape):
+        # batched heartbeats, drain/churn storms, and the blank-join
+        # gate with a leader hard-kill mid-snapshot-stream.  Minutes per
+        # cell at full size; the CI leg shrinks the fleet via
+        # NOMAD_TPU_FLEET_AGENTS.  A NOMAD_TPU_CHAOS env spec overrides
+        # the schedule (cells collapse to (fleet_soak, env)).
+        from nomad_tpu.scenarios import FLEET_CELLS, run_matrix
+        seed = 1
+        if "--seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        summary = run_matrix(FLEET_CELLS, seed=seed, log=log)
+        print(json.dumps({
+            "metric": "fleet_soak",
+            "seed": seed,
+            "agents": int(os.environ.get("NOMAD_TPU_FLEET_AGENTS",
+                                         "10000")),
+            "cells": len(summary["cells"]),
+            "passed": summary["passed"],
+            "failed": summary["failed"],
+            "per_cell": [{
+                "shape": t.get("shape"), "schedule": t.get("schedule"),
+                "converged": t["convergence"].get("converged"),
+                "convergence_time_s":
+                    t["convergence"].get("convergence_time_s"),
+                "notes": t.get("notes"),
+            } for t in summary["cells"]],
+        }), flush=True)
+        sys.exit(0 if summary["ok"] else 1)
+
     if "--matrix" in sys.argv:
         # chaos scenario matrix: workload shapes x phased chaos
         # schedules on a real 3-server cluster, each cell gated on

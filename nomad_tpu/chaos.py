@@ -133,6 +133,22 @@ Fault points and their injection sites:
                               is dropped, as if the worker died between
                               commit and ack: the lease must expire and
                               redelivery must no-op via plan dedup
+    snapshot.chunk_drop       raft/node.py — one frame of a chunked
+                              InstallSnapshot stream is lost in flight;
+                              the follower's next-expected-offset ack
+                              must re-synchronize the stream instead of
+                              restarting it from byte zero
+    snapshot.stream_abort     raft/node.py — the sending side of a
+                              snapshot stream dies mid-transfer (leader
+                              kill, stream teardown); the next
+                              replication tick restarts the stream,
+                              which must resume from the follower's
+                              acked offset
+    heartbeat.batch_stall     core/heartbeat.py — the leader's batched
+                              heartbeat/node-status FSM flush stalls
+                              `delay_ms` (or skips a round), widening
+                              the window where TTL expiry, revival and
+                              liveness stamps pile into one batch entry
 
 `REQUIRED_SITES` pins points to the hot-path functions that must carry
 them; the chaos-coverage linter fails if a refactor drops one.
@@ -180,6 +196,9 @@ FAULT_POINTS = (
     "broker.unfair_burst",
     "plan.commit_stall",
     "worker.settle_drop",
+    "snapshot.chunk_drop",
+    "snapshot.stream_abort",
+    "heartbeat.batch_stall",
 )
 
 # Points that must be injected in these specific functions (enforced by
@@ -203,6 +222,9 @@ REQUIRED_SITES = {
     "broker.unfair_burst": ("EvalBroker._pick_locked",),
     "plan.commit_stall": ("PlanApplier._commit_batch_and_resolve",),
     "worker.settle_drop": ("Worker._settle_eval",),
+    "snapshot.chunk_drop": ("RaftNode._send_snapshot",),
+    "snapshot.stream_abort": ("RaftNode._send_snapshot",),
+    "heartbeat.batch_stall": ("HeartbeatBatcher.flush",),
 }
 
 

@@ -4,16 +4,29 @@ resetHeartbeatTimer, invalidateHeartbeat:135, disconnectState:177).
 Each node has a TTL; a missed TTL transitions the node to `down` — or to
 `disconnected` when any alloc on it uses max_client_disconnect — and
 triggers evaluations for every affected job.
+
+Fleet scale: TTLs live in a hashed timing wheel (one bucket per tick,
+enough buckets that a full TTL fits in one rotation), so re-arming a
+node is O(1) remove+insert and a 10K-agent fleet heartbeating every
+interval never grows a stale-tuple backlog the way a lazy-deletion heap
+does.  The status/liveness writes those heartbeats imply coalesce
+through HeartbeatBatcher into ONE NodeHeartbeatBatch raft entry per
+flush tick — the node-plane analogue of the plan applier's
+APPLY_PLAN_RESULTS batching — so steady-state heartbeat cost is
+O(batches), not O(nodes), log entries.
 """
 from __future__ import annotations
 
-import heapq
+import logging
 import threading
 import time as _time
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional, Set, Tuple
 
 from nomad_tpu import chaos
 from nomad_tpu.structs.node import NodeStatus
+from nomad_tpu.telemetry import global_metrics
+
+log = logging.getLogger(__name__)
 
 
 class HeartbeatTracker:
@@ -22,12 +35,26 @@ class HeartbeatTracker:
         self.ttl = ttl
         self.tick = tick
         self._lock = threading.Lock()
-        self._deadlines: Dict[str, float] = {}
-        self._heap: list = []
+        # wheel geometry: one bucket per tick; a deadline at most
+        # ttl+retry ahead always lands within a single rotation
+        self._span = max(tick, 0.001)
+        self._nslots = max(8, int(ttl / self._span) + 4)
+        self._slots: list = [set() for _ in range(self._nslots)]
+        self._where: Dict[str, Tuple[int, float]] = {}
+        self._cursor = _time.time()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> None:
+        with self._lock:
+            # fresh per leadership tenure: deadlines armed under a
+            # PREVIOUS tenure must not expire nodes out of this one —
+            # the new leader re-arms every live node right after start()
+            # (initializeHeartbeatTimers), and anything it does not
+            # re-arm is by definition not its to expire
+            self._slots = [set() for _ in range(self._nslots)]
+            self._where.clear()
+            self._cursor = _time.time()
         self._stop = threading.Event()   # fresh per leadership tenure
         self._thread = threading.Thread(target=self._run, name="heartbeat",
                                         daemon=True)
@@ -47,42 +74,65 @@ class HeartbeatTracker:
             return self.ttl
         deadline = _time.time() + self.ttl
         with self._lock:
-            self._deadlines[node_id] = deadline
-            heapq.heappush(self._heap, (deadline, node_id))
+            self._arm_locked(node_id, deadline)
         return self.ttl
+
+    def _arm_locked(self, node_id: str, deadline: float) -> None:
+        old = self._where.get(node_id)
+        if old is not None:
+            self._slots[old[0]].discard(node_id)
+        slot = int(deadline / self._span) % self._nslots
+        self._slots[slot].add(node_id)
+        self._where[node_id] = (slot, deadline)
 
     def untrack(self, node_id: str) -> None:
         with self._lock:
-            self._deadlines.pop(node_id, None)
+            old = self._where.pop(node_id, None)
+            if old is not None:
+                self._slots[old[0]].discard(node_id)
+
+    def tracked(self) -> int:
+        """Number of armed TTLs (bench/telemetry)."""
+        with self._lock:
+            return len(self._where)
 
     def _run(self) -> None:
         while not self._stop.is_set():
             now = _time.time()
             expired = []
             with self._lock:
-                while self._heap and self._heap[0][0] <= now:
-                    deadline, node_id = heapq.heappop(self._heap)
-                    # stale entries: node re-heartbeated since
-                    if self._deadlines.get(node_id) == deadline:
-                        del self._deadlines[node_id]
-                        expired.append(node_id)
+                start = int(self._cursor / self._span)
+                end = int(now / self._span)
+                if end - start >= self._nslots:
+                    # clock jumped past a full rotation: one pass over
+                    # every physical bucket covers all of it
+                    start = end - self._nslots + 1
+                for b in range(start, end + 1):
+                    slot = self._slots[b % self._nslots]
+                    for node_id in list(slot):
+                        _, deadline = self._where[node_id]
+                        if deadline <= now:
+                            slot.discard(node_id)
+                            del self._where[node_id]
+                            expired.append(node_id)
+                        # else: re-armed into this bucket's next
+                        # rotation — its own turn will catch it
+                self._cursor = now
             for node_id in expired:
                 try:
                     self._invalidate(node_id)
                 except Exception:           # noqa: BLE001
                     # a failed write (e.g. lost quorum mid-invalidate) must
                     # not kill the heartbeat loop for the whole tenure
-                    import logging
-                    logging.getLogger(__name__).exception("invalidate")
-                    # the node was already popped from _deadlines; without
+                    log.exception("invalidate")
+                    # the node was already dropped from the wheel; without
                     # a retry deadline it would stay tracked-as-alive
                     # forever despite the missed TTL.  Re-arm a short one
                     # (unless the node re-heartbeated meanwhile).
                     retry = _time.time() + min(self.ttl, 1.0)
                     with self._lock:
-                        if node_id not in self._deadlines:
-                            self._deadlines[node_id] = retry
-                            heapq.heappush(self._heap, (retry, node_id))
+                        if node_id not in self._where:
+                            self._arm_locked(node_id, retry)
             self._stop.wait(self.tick)
 
     def _invalidate(self, node_id: str) -> None:
@@ -100,4 +150,111 @@ class HeartbeatTracker:
             if tg is not None and tg.max_client_disconnect_s is not None:
                 new_status = NodeStatus.DISCONNECTED
                 break
-        server.update_node_status(node_id, new_status)
+        # a churn storm expires nodes in waves: ride the batcher (one
+        # raft entry per flush) instead of one entry per expiry
+        batcher = getattr(server, "heartbeat_batch", None)
+        if batcher is not None and batcher.running:
+            batcher.note(node_id, new_status)
+        else:
+            server.update_node_status(node_id, new_status)
+
+
+class HeartbeatBatcher:
+    """Leader-side coalescer for heartbeat-driven FSM writes.
+
+    Revivals (down/disconnected node heartbeats again), TTL expirations
+    and periodic liveness stamps collect in a pending table keyed by
+    node and flush as ONE NodeHeartbeatBatch log entry per tick, with
+    node evals created only for real status transitions.  Liveness
+    stamps are rate-limited to one per node per half-TTL — fresh enough
+    that a failed-over leader re-arms timers off recent stamps, cheap
+    enough that a 10K-agent fleet costs O(batches) log entries per
+    tick.  `updated_at` is stamped here, at propose time: the FSM never
+    reads the clock."""
+
+    def __init__(self, server, interval: float = 0.05):
+        self.server = server
+        self.interval = interval
+        self._lock = threading.Lock()
+        self._pending: Dict[str, Tuple[str, float]] = {}
+        self._transitions: Set[str] = set()
+        self._last_stamp: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self._transitions.clear()
+            self._last_stamp.clear()
+        self._stop = threading.Event()   # fresh per leadership tenure
+        self._thread = threading.Thread(target=self._run,
+                                        name="heartbeat-batch", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(1.0)
+        with self._lock:
+            # a deposed leader's queued writes die with its tenure; the
+            # successor's own expiry/revival pass re-derives them
+            self._pending.clear()
+            self._transitions.clear()
+
+    @property
+    def running(self) -> bool:
+        return (self._thread is not None and self._thread.is_alive()
+                and not self._stop.is_set())
+
+    def note(self, node_id: str, status: str) -> None:
+        """Queue a status TRANSITION (revival, expiry) for the next
+        flush; the flush creates the node's evals."""
+        with self._lock:
+            self._pending[node_id] = (status, _time.time())
+            self._transitions.add(node_id)
+
+    def stamp(self, node_id: str, status: str) -> None:
+        """Queue a liveness stamp (same status, fresh updated_at), at
+        most one per node per half-TTL."""
+        now = _time.time()
+        half = self.server.config.heartbeat_ttl / 2.0
+        with self._lock:
+            if now - self._last_stamp.get(node_id, 0.0) < half:
+                return
+            self._last_stamp[node_id] = now
+            if node_id not in self._pending:
+                self._pending[node_id] = (status, now)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.flush()
+            except Exception:               # noqa: BLE001
+                # deposed mid-flush (NotLeaderError) or a transient write
+                # failure: stop() clears the queue when the tenure ends
+                log.debug("heartbeat batch flush failed", exc_info=True)
+
+    def flush(self) -> None:
+        """Drain the pending table into one batched FSM entry."""
+        if chaos.active is not None:
+            if chaos.should("heartbeat.batch_stall"):
+                # flush skipped this round: the pending table keeps
+                # coalescing and the next tick carries the batch
+                return
+            chaos.maybe_delay("heartbeat.batch_stall")
+        with self._lock:
+            if not self._pending:
+                return
+            pending = self._pending
+            transitions = self._transitions
+            self._pending = {}
+            self._transitions = set()
+        from nomad_tpu.raft.fsm import MessageType
+        self.server.apply(MessageType.NODE_HEARTBEAT_BATCH, {
+            "updates": [{"node_id": nid, "status": st, "updated_at": ts}
+                        for nid, (st, ts) in pending.items()]})
+        global_metrics.incr("heartbeat.batch_flush")
+        global_metrics.incr("heartbeat.batch_nodes", float(len(pending)))
+        for nid in transitions:
+            self.server.create_node_evals(nid)

@@ -29,7 +29,7 @@ from nomad_tpu.core.core_gc import CoreScheduler
 from nomad_tpu.core.deployments import DeploymentWatcher
 from nomad_tpu.core.drainer import NodeDrainer
 from nomad_tpu.core.events import EventBroker
-from nomad_tpu.core.heartbeat import HeartbeatTracker
+from nomad_tpu.core.heartbeat import HeartbeatBatcher, HeartbeatTracker
 from nomad_tpu.core.periodic import PeriodicDispatcher
 from nomad_tpu.core.plan_apply import PlanApplier
 from nomad_tpu.core.plan_queue import PlanQueue
@@ -63,6 +63,7 @@ class ServerConfig:
     def __init__(self, num_schedulers: int = 4,
                  enabled_schedulers: Optional[List[str]] = None,
                  heartbeat_ttl: float = 10.0,
+                 heartbeat_batch_interval: float = 0.05,
                  gc_interval: float = 300.0,
                  data_dir: Optional[str] = None,
                  region: str = "global",
@@ -71,6 +72,12 @@ class ServerConfig:
         self.enabled_schedulers = enabled_schedulers or \
             ["service", "batch", "system", "sysbatch"]
         self.heartbeat_ttl = heartbeat_ttl
+        # flush cadence of the leader's heartbeat/node-status coalescer
+        # (one NodeHeartbeatBatch raft entry per flush);
+        # NOMAD_TPU_HEARTBEAT_BATCH_MS overrides
+        self.heartbeat_batch_interval = float(os.environ.get(
+            "NOMAD_TPU_HEARTBEAT_BATCH_MS",
+            heartbeat_batch_interval * 1000.0)) / 1000.0
         self.gc_interval = gc_interval
         self.data_dir = data_dir
         self.region = region
@@ -122,6 +129,8 @@ class Server:
         self._threads: List[threading.Thread] = []
         self.event_broker = EventBroker()
         self.heartbeats = HeartbeatTracker(self, ttl=self.config.heartbeat_ttl)
+        self.heartbeat_batch = HeartbeatBatcher(
+            self, interval=self.config.heartbeat_batch_interval)
         self.deployment_watcher = DeploymentWatcher(self)
         from nomad_tpu.core.volumes import VolumeWatcher
         self.volume_watcher = VolumeWatcher(self)
@@ -418,6 +427,7 @@ class Server:
                                      daemon=True)
             dup_t.start()
             self._threads.append(dup_t)
+            self.heartbeat_batch.start()
             self.heartbeats.start()
             # initializeHeartbeatTimers (leader.go:347): nodes registered
             # under a previous leader get timers on the new one, so a node
@@ -514,6 +524,7 @@ class Server:
                 self.wan_pool.set_leader(False)
             self._leader_stop.set()
             self.heartbeats.stop()
+            self.heartbeat_batch.stop()
             self.deployment_watcher.stop()
             self.volume_watcher.stop()
             self.drainer.stop()
@@ -985,9 +996,33 @@ class Server:
                                    {"node_id": node_id, "heartbeat": True})
             return resp["heartbeat_ttl"]
         node = self.store.node_by_id(node_id)
-        if node is not None and node.status in ("down", "disconnected"):
-            self.update_node_status(node_id, "ready")
+        if node is not None:
+            if node.status in ("down", "disconnected"):
+                # revival rides the heartbeat batch when it runs: one
+                # coalesced FSM entry per flush tick, not one per node
+                if self.heartbeat_batch.running:
+                    self.heartbeat_batch.note(node_id, "ready")
+                else:
+                    self.update_node_status(node_id, "ready")
+            elif self.heartbeat_batch.running:
+                # periodic liveness stamp (rate-limited to half-TTL per
+                # node inside the batcher) so a failed-over leader sees
+                # reasonably fresh status_updated_at values
+                self.heartbeat_batch.stamp(node_id, node.status)
         return self.heartbeats.heartbeat(node_id)
+
+    def node_heartbeats(self, node_ids: List[str]) -> float:
+        """Batched heartbeat for fleet-scale agent drivers: one
+        forwarded RPC re-arms many TTLs; each node still takes the real
+        node_heartbeat path (revival, liveness stamp, TTL wheel)."""
+        if self.raft is not None and not self.raft.is_leader:
+            resp = self.rpc_leader("Node.BatchHeartbeat",
+                                   {"node_ids": list(node_ids)})
+            return resp["heartbeat_ttl"]
+        ttl = self.config.heartbeat_ttl
+        for nid in node_ids:
+            ttl = self.node_heartbeat(nid)
+        return ttl
 
     def update_node_status(self, node_id: str, status: str) -> List[Evaluation]:
         """Node.UpdateStatus: transition + evals for affected jobs."""

@@ -26,6 +26,7 @@ class MessageType:
     NODE_REGISTER = "NodeRegisterRequest"
     NODE_DEREGISTER = "NodeDeregisterRequest"
     NODE_UPDATE_STATUS = "NodeUpdateStatusRequest"
+    NODE_HEARTBEAT_BATCH = "NodeHeartbeatBatchRequest"
     NODE_UPDATE_DRAIN = "NodeUpdateDrainRequest"
     NODE_UPDATE_ELIGIBILITY = "NodeUpdateEligibilityRequest"
     JOB_REGISTER = "JobRegisterRequest"
@@ -72,6 +73,8 @@ class NomadFSM:
             MessageType.NODE_REGISTER: self._apply_node_register,
             MessageType.NODE_DEREGISTER: self._apply_node_deregister,
             MessageType.NODE_UPDATE_STATUS: self._apply_node_update_status,
+            MessageType.NODE_HEARTBEAT_BATCH:
+                self._apply_node_heartbeat_batch,
             MessageType.NODE_UPDATE_DRAIN: self._apply_node_update_drain,
             MessageType.NODE_UPDATE_ELIGIBILITY: self._apply_node_eligibility,
             MessageType.JOB_REGISTER: self._apply_job_register,
@@ -141,6 +144,13 @@ class NomadFSM:
     def _apply_node_update_status(self, index, p):
         self.store.update_node_status(
             index, p["node_id"], p["status"], p.get("updated_at", 0.0))
+
+    def _apply_node_heartbeat_batch(self, index, p):
+        # the heartbeat coalescer flushes one entry per tick: revivals,
+        # expiries and liveness stamps for a whole fleet batch land in a
+        # single store write (updated_at was stamped at propose time —
+        # the FSM never reads the clock)
+        self.store.update_node_statuses_many(index, p["updates"])
 
     def _apply_node_update_drain(self, index, p):
         self.store.update_node_drain(

@@ -4,8 +4,11 @@ install, log compaction).
 
 A compact, threaded Raft: follower/candidate/leader states with randomized
 election timeouts, AppendEntries consistency checks, majority commit, an
-apply loop feeding the NomadFSM, and InstallSnapshot for followers that
-fell behind a compaction.  Designed for in-process clusters over
+apply loop feeding the NomadFSM, and a streamed, resumable, CRC-framed
+InstallSnapshot (dissertation §7 offset/done framing) for followers that
+fell behind a compaction — chunk transfers run on their own threads, off
+the replication tick, and resume from the follower's acked offset across
+drops, restarts and leader changes.  Designed for in-process clusters over
 InMemTransport (the reference's raftInmem test mode) — the production
 transport boundary is the same `call(dst, method, args)` surface.
 
@@ -28,24 +31,31 @@ from __future__ import annotations
 
 import concurrent.futures
 import logging
+import os
 import pickle
 import queue
 import random
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
 
 from nomad_tpu import chaos, tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.raft.log import LogEntry, LogStore
 from nomad_tpu.raft.meta import DurableMeta, MetaPersistError
-from nomad_tpu.raft.snapshot import FileSnapshotStore
+from nomad_tpu.raft.snapshot import ChunkSink, FileSnapshotStore
 from nomad_tpu.raft.transport import InMemTransport, Unreachable
+from nomad_tpu.telemetry import global_metrics
 from nomad_tpu.utils import requires_lock
 
 log = logging.getLogger(__name__)
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+# InstallSnapshot stream frame size (Raft dissertation §7 offset/done
+# framing); NOMAD_TPU_SNAP_CHUNK overrides
+SNAP_CHUNK_DEFAULT = 256 * 1024
 
 # log entry type carrying a full cluster configuration (Raft §4.1);
 # dispatched as a no-op by the FSM — the raft layer consumes it on append
@@ -159,6 +169,17 @@ class RaftNode:
         self.commit_index = 0
         self.last_applied = 0
         self._last_snapshot_index = 0
+        self._last_snap_term = 0
+        # outbound snapshot streams (leader): peer -> worker thread, so
+        # the chunk loop runs OFF the replication tick and heartbeats to
+        # healthy peers never queue behind a catch-up transfer; plus a
+        # bounded-backoff table for peers whose installs keep failing
+        self._snap_streams: Dict[str, threading.Thread] = {}
+        self._snap_backoff: Dict[str, Tuple[int, float]] = {}
+        # inbound chunk stream (follower): at most one partial sink at a
+        # time, keyed by snapshot identity so a new leader resuming the
+        # SAME snapshot continues where the dead one stopped
+        self._snap_rx: Optional[ChunkSink] = None
         self._next_index: Dict[str, int] = {}
         self._match_index: Dict[str, int] = {}
         self._futures: Dict[int, concurrent.futures.Future] = {}
@@ -1001,7 +1022,7 @@ class RaftNode:
             term = self.term
             nxt = self._next_index.get(peer, self.log.last_index + 1)
             if nxt < self.log.first_index and self.snapshots is not None:
-                self._send_snapshot(peer)
+                self._spawn_snapshot_stream(peer)
                 return
             prev_index = nxt - 1
             prev_term = self.log.term_at(prev_index)
@@ -1040,28 +1061,137 @@ class RaftNode:
                 self._next_index[peer] = max(
                     1, min(nxt - 1, resp.get("last_index", nxt - 1) + 1))
 
-    _last_snap_term = 0
+    @requires_lock("_lock")
+    def _spawn_snapshot_stream(self, peer: str) -> None:
+        """Kick off (or leave running) the chunked snapshot transfer to a
+        lagging peer.  Called from the replication tick under `_lock`;
+        only spawns the worker thread, so heartbeats to the remaining
+        peers proceed immediately."""
+        t = self._snap_streams.get(peer)
+        if t is not None and t.is_alive():
+            return
+        _, next_ok = self._snap_backoff.get(peer, (0, 0.0))
+        if time.monotonic() < next_ok:
+            return      # bounded backoff after repeated install failures
+        t = threading.Thread(target=self._send_snapshot, args=(peer,),
+                             name=f"raft-snap-{self.name}-{peer}",
+                             daemon=True)
+        self._snap_streams[peer] = t
+        t.start()
+
+    def _note_snap_failure(self, peer: str) -> None:
+        """A snapshot stream attempt failed: count it and arm bounded
+        exponential backoff so a follower that persistently fails to
+        persist is not re-streamed the full blob every tick forever."""
+        global_metrics.incr("raft.snapshot.send_fail")
+        with self._lock:
+            fails, _ = self._snap_backoff.get(peer, (0, 0.0))
+            fails = min(fails + 1, 6)
+            delay = min(2.0, 0.05 * (2 ** fails))
+            self._snap_backoff[peer] = (fails, time.monotonic() + delay)
 
     def _send_snapshot(self, peer: str) -> None:
-        rec = self.snapshots.latest_full() if self.snapshots else None
-        if rec is None:
-            return
-        s_idx, s_term = rec["index"], rec["term"]
-        resp = self.transport.call(self.name, peer, "install_snapshot", {
-            "term": self.term, "leader": self.name,
-            "last_index": s_idx, "last_term": s_term, "data": rec["data"],
-            # the snapshot carries the configuration as of its index so a
-            # blank joiner learns the membership without any log prefix
-            "config": rec.get("config")})
-        with self._lock:
-            if resp["term"] > self.term:
-                self._step_down(resp["term"])
+        """Streamed, resumable InstallSnapshot (dissertation §7).
+
+        Runs on its own thread, off the replication tick.  The blob goes
+        out in `NOMAD_TPU_SNAP_CHUNK`-byte frames, each carrying
+        {offset, crc32, total, done, last_index, last_term, config};
+        every ack returns the follower's next expected offset, which is
+        the whole resume protocol — a dropped/duplicated/reordered frame
+        re-syncs to the ack, a restarted follower acks 0, and a NEW
+        leader streaming the same snapshot picks up at the offset the
+        dead leader's stream reached.  The `done` frame adds the
+        whole-stream CRC so the follower persists only a verified blob.
+        """
+        try:
+            rec = self.snapshots.latest_full() if self.snapshots else None
+            if rec is None:
                 return
-            if not resp.get("success"):
-                return   # follower could not persist it; retry next round
-            self._next_index[peer] = s_idx + 1
-            self._match_index[peer] = s_idx
-            self._peer_contact[peer] = time.monotonic()
+            blob, s_idx, s_term = rec["data"], rec["index"], rec["term"]
+            total = len(blob)
+            chunk = max(1, int(os.environ.get(
+                "NOMAD_TPU_SNAP_CHUNK", str(SNAP_CHUNK_DEFAULT))))
+            stream_crc = zlib.crc32(blob)
+            offset = 0
+            stalls = drops = 0
+            while True:
+                with self._lock:
+                    if self.state != LEADER or self._stop.is_set():
+                        return
+                    term = self.term
+                if chaos.active is not None \
+                        and chaos.should("snapshot.stream_abort"):
+                    # sender dies mid-transfer (leader kill / stream
+                    # teardown): the next replication tick restarts the
+                    # stream, which resumes from the follower's ack
+                    # rather than byte zero
+                    return
+                data = blob[offset:offset + chunk]
+                done = offset + len(data) >= total
+                frame = {
+                    "term": term, "leader": self.name,
+                    "last_index": s_idx, "last_term": s_term,
+                    "offset": offset, "total": total,
+                    "crc32": zlib.crc32(data), "data": data, "done": done,
+                    # configuration as of the snapshot index so a blank
+                    # joiner learns the membership without any log prefix
+                    "config": rec.get("config"),
+                }
+                if done:
+                    frame["stream_crc32"] = stream_crc
+                if chaos.active is not None \
+                        and chaos.should("snapshot.chunk_drop"):
+                    # frame lost in flight: re-probe the same offset — the
+                    # follower's ack re-synchronizes the stream
+                    drops += 1
+                    if drops > 64:      # chaos armed at rate ~1.0
+                        self._note_snap_failure(peer)
+                        return
+                    continue
+                resp = self.transport.call(self.name, peer,
+                                           "install_snapshot", frame)
+                with self._lock:
+                    if resp["term"] > self.term:
+                        self._step_down(resp["term"])
+                        return
+                    if self.state != LEADER or self.term != term:
+                        return
+                if not resp.get("success"):
+                    # follower could not persist/verify; back off instead
+                    # of hammering it with the full stream every tick
+                    self._note_snap_failure(peer)
+                    return
+                acked = resp.get("offset", offset + len(data))
+                if done and acked >= total:
+                    with self._lock:
+                        if self.state != LEADER or self.term != term:
+                            return
+                        self._next_index[peer] = s_idx + 1
+                        self._match_index[peer] = s_idx
+                        self._peer_contact[peer] = time.monotonic()
+                        self._snap_backoff.pop(peer, None)
+                    return
+                if acked == offset:
+                    # no progress (per-chunk CRC reject, or a done frame
+                    # whose stream CRC failed when total == acked): give
+                    # the link a rest after a few tries
+                    stalls += 1
+                    if stalls > 16:
+                        self._note_snap_failure(peer)
+                        return
+                else:
+                    stalls = 0
+                    with self._lock:
+                        # a moving stream is proof of contact: autopilot
+                        # must not reap a peer mid-catch-up
+                        self._peer_contact[peer] = time.monotonic()
+                offset = min(max(acked, 0), total)
+        except Unreachable:
+            self._note_snap_failure(peer)
+        except Exception:                           # noqa: BLE001
+            log.warning("raft: %s snapshot stream to %s failed",
+                        self.name, peer, exc_info=True)
+            self._note_snap_failure(peer)
 
     @requires_lock("_lock")
     def _advance_commit(self) -> None:
@@ -1323,16 +1453,101 @@ class RaftNode:
                 self._step_down(a["term"])   # single term-adoption path
             self.leader_id = a["leader"]
             self._last_contact = time.monotonic()
+        if "offset" not in a:
+            # monolithic install (seed protocol, kept for compatibility):
+            # the whole blob arrives in one frame
+            return self._install_snapshot_blob(a, a["data"])
+        return self._on_snapshot_chunk(a)
+
+    def _on_snapshot_chunk(self, a: dict) -> dict:
+        """One frame of a chunked InstallSnapshot stream.
+
+        Frames append to a temp file through a ChunkSink keyed by the
+        snapshot identity (last_index, last_term, total); every ack
+        carries our next expected offset, which is the whole resume
+        protocol — a duplicated or reordered frame acks the current
+        offset, a restarted follower (no sink) acks 0, and a restarted
+        leader re-syncs off the first ack.  The sink deliberately
+        survives leader/term changes: a new leader streaming the SAME
+        snapshot resumes where the dead one stopped, while a different
+        snapshot identity discards the partial sink cleanly.  On `done`
+        the whole-stream CRC gates persist-before-accept."""
+        key = (a["last_index"], a["last_term"], a["total"])
+        with self._lock:
+            if a["term"] < self.term:
+                return {"term": self.term, "success": False, "offset": 0}
+            sink = self._snap_rx
+            if sink is not None and sink.key != key:
+                # a different snapshot supersedes the partial stream
+                sink.abort()
+                sink = self._snap_rx = None
+            if sink is None:
+                if a["offset"] != 0:
+                    # mid-stream frame with no sink (we restarted):
+                    # tell the leader to resume from byte zero
+                    return {"term": self.term, "success": True,
+                            "offset": 0}
+                try:
+                    sink = self._snap_rx = ChunkSink(
+                        self.snapshots.dir if self.snapshots is not None
+                        else None, key)
+                except OSError:
+                    log.warning("raft: %s cannot open snapshot sink",
+                                self.name, exc_info=True)
+                    return {"term": self.term, "success": False,
+                            "offset": 0}
+            if a["offset"] != sink.offset:
+                # dropped/duplicated/reordered frame: re-sync the leader
+                # to where the stream actually is
+                return {"term": self.term, "success": True,
+                        "offset": sink.offset}
+            if zlib.crc32(a["data"]) != a["crc32"]:
+                # corrupt in flight: ask for the same offset again
+                return {"term": self.term, "success": True,
+                        "offset": sink.offset}
+            try:
+                sink.append(a["data"])
+            except OSError:
+                log.warning("raft: %s snapshot chunk append failed",
+                            self.name, exc_info=True)
+                self._snap_rx = None
+                sink.abort()
+                return {"term": self.term, "success": False, "offset": 0}
+            if not a.get("done"):
+                return {"term": self.term, "success": True,
+                        "offset": sink.offset}
+            # final frame: assemble + whole-stream verify, then hand the
+            # blob to the monolithic install tail below (outside _lock —
+            # it takes _fsm_lock first, same nesting as force_snapshot)
+            self._snap_rx = None
+            data = sink.finish()
+            if sink.offset != a["total"] \
+                    or sink.crc != a.get("stream_crc32", sink.crc):
+                # the assembled bytes are not the leader's blob (e.g. a
+                # resumed prefix from a dead leader whose snapshot bytes
+                # differ): discard and restart from zero
+                return {"term": self.term, "success": True, "offset": 0}
+        resp = self._install_snapshot_blob(a, data)
+        resp["offset"] = a["total"] if resp.get("success") else 0
+        return resp
+
+    def _install_snapshot_blob(self, a: dict, data: bytes) -> dict:
+        """Persist-before-accept + restore of a complete snapshot blob —
+        the tail of the install path, reached monolithically or when a
+        chunk stream's `done` frame verifies."""
+        with self._lock:
+            if a["term"] < self.term:
+                return {"term": self.term, "success": False}
             # Persist BEFORE accepting.  The snapshot stands in for log
             # entries the leader has already compacted away: if we restore
             # it in memory without a durable copy, later appends land past
             # a hole that exists only on disk, and the next restart replays
             # around the hole — committed state silently vanishes.  Reject
-            # instead; the leader keeps us behind and retries the install.
+            # instead; the leader backs off and retries the install.
             if self.snapshots is not None:
                 try:
                     self.snapshots.save(a["last_index"], a["last_term"],
-                                        a["data"], config=a.get("config"))
+                                        data, config=a.get("config"))
                 except Exception:                   # noqa: BLE001
                     log.warning("raft: %s could not persist installed "
                                 "snapshot; rejecting (leader retries)",
@@ -1347,7 +1562,15 @@ class RaftNode:
                 if a["last_index"] <= self._last_snapshot_index:
                     # duplicate/stale install: never regress the FSM
                     return {"term": self.term, "success": True}
-            self.fsm.restore(a["data"])
+                # §7: if the apply loop already covered the snapshot's
+                # prefix via AppendEntries while the stream was in flight,
+                # the state ALREADY includes it (committed entries at an
+                # index are unique) — restoring would rewind the FSM past
+                # entries that will never re-apply.  Retain the state,
+                # still compact the now-redundant log prefix below.
+                skip_restore = a["last_index"] <= self.last_applied
+            if not skip_restore:
+                self.fsm.restore(data)
             with self._lock:
                 self._last_snapshot_index = a["last_index"]
                 self._last_snap_term = a["last_term"]

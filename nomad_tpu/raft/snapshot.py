@@ -37,6 +37,53 @@ SNAP_MAGIC = b"NTPUSNP1"
 _HDR = struct.Struct("<II")
 
 
+class ChunkSink:
+    """Temp-file assembler for one inbound chunked InstallSnapshot
+    stream (dissertation §7).  Frames append sequentially: `offset` is
+    the next expected byte (the resume ack), `crc` the running
+    whole-stream CRC.  `finish()` flushes and returns the assembled
+    blob for the persist-before-accept path; `abort()` discards the
+    temp file.  The file lives beside the snapshot store (same
+    filesystem as the final record) or in the system temp dir for
+    storeless nodes — either way it is scratch state: the durable copy
+    is only ever written by FileSnapshotStore.save()."""
+
+    def __init__(self, directory: Optional[str], key: tuple):
+        self.key = key          # (last_index, last_term, total)
+        self.offset = 0
+        self.crc = 0
+        fd, self.path = tempfile.mkstemp(dir=directory,
+                                         prefix=".snap-rx-")
+        self._fh = os.fdopen(fd, "wb")
+
+    def append(self, data: bytes) -> None:
+        self._fh.write(data)
+        self.offset += len(data)
+        self.crc = zlib.crc32(data, self.crc)
+
+    def finish(self) -> bytes:
+        self._fh.flush()
+        self._fh.close()
+        try:
+            with open(self.path, "rb") as fh:
+                return fh.read()
+        finally:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def abort(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
 class FileSnapshotStore:
     # wait-graph (nomad_tpu.analysis)
     _LOCK_BLOCKING_OK = {
@@ -49,6 +96,15 @@ class FileSnapshotStore:
         self.retain = retain
         self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
+        # a crash mid-stream orphans the receiving ChunkSink's temp
+        # file; the restarted node acks offset 0 and re-streams, so the
+        # orphan is pure garbage — reap it here
+        for stale in os.listdir(directory):
+            if stale.startswith(".snap-rx-"):
+                try:
+                    os.unlink(os.path.join(directory, stale))
+                except OSError:
+                    pass
 
     def save(self, index: int, term: int, blob: bytes,
              config: Optional[dict] = None) -> str:

@@ -360,6 +360,14 @@ class Endpoints:
             if self.server.leader else self.server.config.heartbeat_ttl
         return {"eval_ids": [e.id for e in evals], "heartbeat_ttl": ttl}
 
+    def rpc_Node__BatchHeartbeat(self, args):
+        """Fleet-scale liveness: one RPC re-arms many node TTLs through
+        the real heartbeat path (the 10K-agent drivers' steady state —
+        the leader coalesces any implied status writes into one
+        NodeHeartbeatBatch entry per flush tick)."""
+        ttl = self.server.node_heartbeats(args["node_ids"])
+        return {"heartbeat_ttl": ttl}
+
     @staticmethod
     def _redact_node(node):
         """Strip the node secret before it leaves the servers (reference

@@ -77,6 +77,7 @@ from nomad_tpu.structs.job import (
     UpdateStrategy,
 )
 from nomad_tpu.structs.resources import DeviceRequest, NodeDevice
+from nomad_tpu.telemetry import global_metrics
 
 
 # ------------------------------------------------------------- utilities
@@ -271,6 +272,58 @@ class NodeKeeper(threading.Thread):
                     ld.node_heartbeat(nid)
             except Exception:           # noqa: BLE001 — chaos/no-leader
                 pass
+            self.stop_flag.wait(self.interval)
+
+
+class FleetDriver(threading.Thread):
+    """The 10K-agent client fleet: each driver thread owns a shard of
+    the registered nodes and heartbeats it through the BATCHED liveness
+    RPC path (Node.BatchHeartbeat -> Server.node_heartbeats), which
+    still runs every node through the real per-node heartbeat path —
+    TTL-wheel re-arm, down/disconnected revival, rate-limited liveness
+    stamp — so chaos `node.churn_kill` swallows individual re-arms and
+    storm expiry waves flow through the genuine TTL-miss path at fleet
+    scale.  `busy_s` accumulates wall time spent heartbeating, the
+    steady-state heartbeat cost the fleet cells gate on."""
+
+    def __init__(self, cluster: Cluster, node_ids: List[str],
+                 interval: float = 0.5, chunk: int = 1000,
+                 lock: Optional[threading.Lock] = None):
+        super().__init__(name="fleet-driver", daemon=True)
+        self.cluster = cluster
+        self.node_ids = node_ids
+        self.interval = interval
+        self.chunk = chunk
+        # the cold boot shares its (still-growing) id list so agents
+        # heartbeat from the moment they register — at fleet size the
+        # boot outlasts the TTL, and without early coverage the early
+        # registrants mass-expire into a down-status wavefront that
+        # races every plan apply
+        self._lock = lock
+        self.stop_flag = threading.Event()
+        self.busy_s = 0.0
+        self.rounds = 0
+
+    def reset_stats(self):
+        self.busy_s = 0.0
+        self.rounds = 0
+
+    def run(self):
+        while not self.stop_flag.is_set():
+            t0 = time.monotonic()
+            try:
+                if self._lock is not None:
+                    with self._lock:
+                        ids = list(self.node_ids)
+                else:
+                    ids = self.node_ids
+                ld = self.cluster.leader(timeout=1.0)
+                for i in range(0, len(ids), self.chunk):
+                    ld.node_heartbeats(ids[i:i + self.chunk])
+            except Exception:       # noqa: BLE001 — chaos/no-leader
+                pass
+            self.busy_s += time.monotonic() - t0
+            self.rounds += 1
             self.stop_flag.wait(self.interval)
 
 
@@ -545,6 +598,17 @@ class Shape:
 
     name = "shape"
     n_nodes = 8
+
+    def tune_config(self, cfg: ServerConfig) -> None:
+        """Adjust the cell ServerConfig before the cluster is built
+        (the fleet shape stretches heartbeat_ttl so a 10K-node expiry
+        wave is a storm, not an extinction)."""
+
+    def amend_spec(self, spec: str) -> str:
+        """Append shape-specific chaos points to the schedule spec
+        (only for curated schedules — an explicit NOMAD_TPU_CHAOS
+        override is never amended)."""
+        return spec
 
     def make_cluster(self, cfg: ServerConfig, raft_config: RaftConfig,
                      data_dir: str):
@@ -1266,6 +1330,328 @@ class MultiRegionShape(Shape):
         return merged
 
 
+def _counter(name: str) -> float:
+    for row in global_metrics.snapshot()["Counters"]:
+        if row["Name"] == name:
+            return float(row["Count"])
+    return 0.0
+
+
+class FleetSoakShape(Shape):
+    """Fleet scale on the real heartbeat path: NOMAD_TPU_FLEET_AGENTS
+    (default 10000) in-process client agents register against a 3-server
+    cluster and heartbeat through the batched liveness RPC, so the
+    steady-state write load is O(batches) NodeHeartbeatBatch entries per
+    flush tick, not O(nodes).  The cell gates the fleet-shaped numbers
+    the small shapes cannot see:
+
+        reg_ready_p99_ms   registration-to-ready p99 for the cold boot
+        hb_busy_frac       steady-state fleet heartbeat cost (driver
+                           wall-time fraction) + batch-flush counters
+        blank_join_s       a blank server joining at FULL state catches
+                           up via the chunked snapshot stream — with the
+                           leader HARD-KILLED mid-transfer, the stream
+                           must resume from the acked offset under the
+                           new leader (same ChunkSink, no restart) and
+                           the battery then proves FSM byte-identity
+
+    The storm rides the shared schedules with the snapshot-plane chaos
+    points amended in: chunked streams to restarted/replacement members
+    lose frames (snapshot.chunk_drop), abort mid-flight and resume next
+    tick (snapshot.stream_abort), and batch flushes stall
+    (heartbeat.batch_stall) while expiry waves keep coalescing."""
+
+    name = "fleet_soak"
+
+    def __init__(self):
+        self.n_agents = int(os.environ.get("NOMAD_TPU_FLEET_AGENTS",
+                                           "10000"))
+        self._driver: Optional[FleetDriver] = None
+        self._drain_wave_done = False
+        self._last_compact = 0.0
+        self._compact_rr = 0
+        self._counters0: Dict[str, float] = {}
+
+    def tune_config(self, cfg: ServerConfig) -> None:
+        # at 10K agents a churn_kill storm must thin the fleet, not
+        # extinguish it: stretch the TTL so expiry needs several
+        # consecutive swallowed heartbeats
+        cfg.heartbeat_ttl = 3.0
+
+    def amend_spec(self, spec: str) -> str:
+        return (spec + ";snapshot.chunk_drop=0.1@storm"
+                ";snapshot.stream_abort=0.05@storm"
+                ";heartbeat.batch_stall=0.15@storm")
+
+    def make_nodes(self, rng):
+        # the runner's serial registration loop (and NodeKeeper's
+        # per-node heartbeat RPC) would take minutes at fleet size; the
+        # shape boots its own fleet in setup() instead
+        return []
+
+    def setup(self, cluster, rng, ctx):
+        lat_ms: List[float] = []
+        ids: List[str] = []
+        lock = threading.Lock()
+        t_boot = time.monotonic()
+
+        # heartbeats must flow DURING the boot: each registrant arms a
+        # TTL deadline immediately, and a 10K boot outlasts the TTL by
+        # an order of magnitude — publish ids incrementally so the
+        # already-running driver covers them within one interval
+        def boot(count):
+            for _ in range(count):
+                n = mock.node()
+                t0 = time.monotonic()
+                _on_leader(cluster, lambda ld, n=n: ld.register_node(n),
+                           timeout=60.0)
+                ms = (time.monotonic() - t0) * 1000.0
+                with lock:
+                    ids.append(n.id)
+                    lat_ms.append(ms)
+
+        self._driver = FleetDriver(cluster, ids, lock=lock)
+        self._driver.start()
+        nthreads = 16
+        share, extra = divmod(self.n_agents, nthreads)
+        threads = [threading.Thread(
+            target=boot, args=(share + (1 if i < extra else 0),),
+            name=f"fleet-boot-{i}", daemon=True) for i in range(nthreads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300.0)
+        if len(ids) < self.n_agents:
+            raise RuntimeError(
+                f"fleet cold boot registered {len(ids)}/{self.n_agents}")
+        boot_s = time.monotonic() - t_boot
+        lat_ms.sort()
+        ctx.node_ids = ids              # NodeKeeper holds the old []
+        ctx.drain_candidates = list(ids)
+        ctx.notes["fleet_agents"] = self.n_agents
+        ctx.notes["cold_boot_s"] = round(boot_s, 2)
+        ctx.notes["reg_ready_p99_ms"] = round(
+            lat_ms[int(0.99 * (len(lat_ms) - 1))], 2)
+        ctx.notes["reg_per_sec"] = round(self.n_agents / boot_s, 1)
+
+        for _ in range(3):
+            j = _batch_job(8)
+            _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+            ctx.exact_jobs.append(j.id)
+            _wait_live(cluster, ctx, j.id, 8)
+
+        self._counters0 = {k: _counter(k) for k in
+                           ("heartbeat.batch_flush",
+                            "heartbeat.batch_nodes",
+                            "raft.snapshot.send_fail")}
+        # hb_busy_frac gates the STEADY-STATE heartbeat cost: drop the
+        # boot-era rounds (partial fleet, contended leader) from the
+        # sample before the chaos window opens
+        self._driver.reset_stats()
+
+    def during(self, cluster, rng, ctx, reg):
+        if not reg.phase_now():
+            return
+        # compact live members through the storm (not just once at the
+        # open): a server that dies keeps its WAL position, so only a
+        # compaction landing WHILE it is down forces its catch-up onto
+        # the chunked stream — which is where snapshot.chunk_drop and
+        # snapshot.stream_abort bite
+        # one member per call, round-robin: serializing a fleet-sized
+        # FSM three times per tick would stretch a single loop iteration
+        # past the whole storm window (and the ReplaceDriver only fires
+        # from an iteration that lands INSIDE the window)
+        now = time.monotonic()
+        if now - self._last_compact > 0.4:
+            self._last_compact = now
+            live = [s for s in cluster.servers
+                    if s.raft is not None and not s._stop.is_set()]
+            if live:
+                s = live[self._compact_rr % len(live)]
+                self._compact_rr += 1
+                try:
+                    s.raft.force_snapshot()
+                except Exception:       # noqa: BLE001 — dying member
+                    pass
+        if self._drain_wave_done:
+            return
+        self._drain_wave_done = True
+        # one drain STORM mid-window: a wave of alloc-bearing and
+        # empty nodes drain concurrently while expiry waves coalesce
+        busy = {a.node_id for a in _on_leader(
+            cluster, lambda ld: _live(ld.store.allocs()))}
+        victims = list(busy)[:8] + rng.sample(ctx.node_ids, k=8)
+        for nid in dict.fromkeys(victims):
+            try:
+                _on_leader(cluster, lambda ld, nid=nid:
+                           ld.drainer.drain_node(nid, deadline_s=1.0),
+                           timeout=5.0)
+                ctx.drained.append(nid)
+            except TRANSIENT_ERRORS + (TimeoutError,):
+                pass
+
+    def finish(self, cluster, ctx):
+        drv = self._driver
+        if drv is not None:
+            elapsed = max(1e-9, drv.rounds * drv.interval + drv.busy_s)
+            ctx.notes["hb_busy_frac"] = round(drv.busy_s / elapsed, 4)
+            ctx.notes["hb_rounds"] = drv.rounds
+        for k, v0 in self._counters0.items():
+            ctx.notes[k] = round(_counter(k) - v0, 1)
+        self._quiesce(cluster, ctx)
+        self._blank_join_drill(cluster, ctx)
+
+    def _blank_join_drill(self, cluster, ctx):
+        """The blank-join gate at full state: a blank server can only
+        catch up via the chunked snapshot stream, the leader is
+        HARD-KILLED provably mid-transfer, and the successor must drive
+        the SAME stream to completion from the follower's acked offset
+        (same ChunkSink, no restart from byte zero)."""
+        # every member must hold the IDENTICAL snapshot record: the
+        # leader snapshots once and bootstraps the followers through the
+        # real monolithic install path (persist + restore + compact), so
+        # whoever wins the post-kill election streams the same identity
+        # and the joiner's partial sink resumes instead of discarding.
+        # The quiesced control plane makes the applied-index barrier
+        # below converge.
+        rec = None
+        for attempt in range(12):
+            ld = cluster.leader(timeout=10.0)
+            # wait out the post-storm write tail (followup evals, plan
+            # results): the bootstrap below needs an instant where every
+            # member sits at the same applied index
+            _wait(lambda: ld.raft.state == "leader"
+                  and cluster.wait_replication(ld.raft.log.last_index,
+                                               timeout=0.5),
+                  timeout=5.0, interval=0.1)
+            ld.raft.force_snapshot()
+            rec = ld.raft.snapshots.latest_full()
+            peers = [s for s in cluster.servers
+                     if s is not ld and not s._stop.is_set()]
+            if not (_wait(lambda: all(p.raft.last_applied >= rec["index"]
+                                      for p in peers), timeout=10.0)
+                    and all(p.raft.last_applied == rec["index"]
+                            for p in peers)):
+                time.sleep(0.5)
+                continue
+            for p in peers:
+                if p.raft.last_applied == rec["index"] \
+                        and p.raft._last_snapshot_index < rec["index"]:
+                    p.raft._on_install_snapshot({
+                        "term": p.raft.term, "leader": ld.name,
+                        "last_index": rec["index"],
+                        "last_term": rec["term"],
+                        "data": rec["data"], "config": rec.get("config")})
+            live = [s for s in cluster.servers if not s._stop.is_set()]
+            if all(s.raft._last_snapshot_index == rec["index"]
+                   for s in live):
+                break
+            time.sleep(0.5)
+        else:
+            raise RuntimeError(
+                "could not align an identical snapshot record across "
+                "the cluster for the mid-stream kill drill")
+        snap_bytes = len(rec["data"])
+        ctx.notes["snapshot_bytes"] = snap_bytes
+        # carve the stream into many frames so "mid-transfer" exists
+        # even at the reduced CI fleet size
+        old_chunk = os.environ.get("NOMAD_TPU_SNAP_CHUNK")
+        os.environ["NOMAD_TPU_SNAP_CHUNK"] = str(
+            min(max(4096, snap_bytes // 64), 256 * 1024))
+        joiner = None
+        try:
+            # hold the stream in backoff until the chunk gate is
+            # installed on the joiner, then release it — on EVERY live
+            # member, since leadership may move before the gate is up
+            name = "fleet-joiner"
+            for s in cluster.servers:
+                if not s._stop.is_set():
+                    with s.raft._lock:
+                        s.raft._snap_backoff[name] = (
+                            0, time.monotonic() + 30.0)
+            t0 = time.monotonic()
+            joiner = cluster.add_server(name=name, timeout=60.0)
+            held = threading.Event()     # a mid-stream frame is parked
+            release = threading.Event()  # kill done: let frames flow
+            orig = joiner.raft._on_snapshot_chunk
+
+            def gated(a):
+                if a.get("offset", 0) > 0 and not release.is_set():
+                    held.set()
+                    release.wait(30.0)
+                return orig(a)
+
+            joiner.raft._on_snapshot_chunk = gated
+            for s in cluster.servers:
+                if s is not joiner and not s._stop.is_set():
+                    with s.raft._lock:
+                        s.raft._snap_backoff.pop(name, None)
+            if not _wait(held.is_set, timeout=30.0, interval=0.001):
+                raise RuntimeError("snapshot stream never reached the "
+                                   "joiner's chunk gate")
+            sink = joiner.raft._snap_rx
+            kill_offset = sink.offset if sink is not None else 0
+            ctx.notes["kill_offset"] = kill_offset
+            victim = cluster.leader(timeout=5.0)
+            cluster.hard_kill(victim)
+            release.set()
+            if not _wait(lambda: joiner.raft._last_snapshot_index > 0,
+                         timeout=90.0, interval=0.01):
+                raise RuntimeError("joiner never completed the snapshot "
+                                   "stream after the mid-transfer kill")
+            joiner.raft._on_snapshot_chunk = orig
+            ctx.notes["blank_join_s"] = round(time.monotonic() - t0, 2)
+            # resume, not restart: the sink the dead leader was filling
+            # was driven to completion by the successor
+            resumed = bool(sink is not None and kill_offset > 0
+                           and sink.offset >= snap_bytes)
+            ctx.notes["stream_resumed"] = resumed
+            if not resumed:
+                raise RuntimeError(
+                    f"stream restarted instead of resuming "
+                    f"(kill_offset={kill_offset}, "
+                    f"sink={sink.offset if sink else None})")
+            restored = cluster.restart(victim)
+            _tune(restored)
+            cluster.wait_voter(joiner.name, timeout=90.0)
+        finally:
+            if joiner is not None:
+                joiner.raft._on_snapshot_chunk = orig
+            if old_chunk is None:
+                os.environ.pop("NOMAD_TPU_SNAP_CHUNK", None)
+            else:
+                os.environ["NOMAD_TPU_SNAP_CHUNK"] = old_chunk
+
+    def check(self, cluster, ctx, timeout: float = 60.0) -> dict:
+        try:
+            return check_convergence(cluster, ctx,
+                                     timeout=max(timeout, 120.0))
+        finally:
+            if self._driver is not None:
+                self._driver.stop_flag.set()
+
+    def _quiesce(self, cluster, ctx):
+        """Freeze the liveness plane before the join drill and the
+        invariant audit: the batcher's steady-state stamps land
+        continuously at fleet scale and would race both the identical-
+        snapshot bootstrap and the battery's byte-identity captures.
+        Stop the fleet driver, stretch every tracker's TTL past the
+        audit, and run one final revival sweep so the whole fleet is
+        ready with no further heartbeat writes due."""
+        if self._driver is not None:
+            self._driver.stop_flag.set()
+            self._driver.join(5.0)
+        for s in cluster.servers:
+            s.config.heartbeat_ttl = 3600.0
+            if s.heartbeats is not None:
+                s.heartbeats.ttl = 3600.0
+        for i in range(0, len(ctx.node_ids), 1000):
+            _on_leader(cluster, lambda ld, c=ctx.node_ids[i:i + 1000]:
+                       ld.node_heartbeats(c), timeout=30.0)
+        # let the last revival batch flush before the quiet period
+        time.sleep(0.3)
+
+
 SHAPES: Dict[str, Callable[[], Shape]] = {
     "e2e_spine": E2ESpineShape,
     "scan_spread": ScanSpreadShape,
@@ -1276,6 +1662,7 @@ SHAPES: Dict[str, Callable[[], Shape]] = {
     "autoscale_ramp": AutoscaleRampShape,
     "multi_tenant": MultiTenantShape,
     "multi_region": MultiRegionShape,
+    "fleet_soak": FleetSoakShape,
 }
 
 
@@ -1517,7 +1904,7 @@ def run_cell(shape_name: str, schedule_name: str, seed: int = 1,
                          duration_s=4.0, server_churn=False)
     else:
         sched = SCHEDULES[schedule_name]
-        spec = sched.spec.format(seed=seed)
+        spec = shape.amend_spec(sched.spec.format(seed=seed))
     reg = ChaosRegistry.from_spec(spec)
     # crc32, not hash(): PYTHONHASHSEED randomizes hash() per process
     # and the cell rng must reproduce for a given --seed
@@ -1527,6 +1914,7 @@ def run_cell(shape_name: str, schedule_name: str, seed: int = 1,
     cfg = ServerConfig(num_schedulers=2, heartbeat_ttl=1.5,
                        gc_interval=3600.0,
                        failed_eval_followup_delay=0.3)
+    shape.tune_config(cfg)
     cluster = shape.make_cluster(
         cfg, RaftConfig(heartbeat_interval=0.02, election_timeout=0.1),
         data_dir)
@@ -1658,10 +2046,20 @@ SMOKE_CELLS = [
 # single-cluster cells don't already cover
 ALL_CELLS = [(shape, schedule)
              for shape in SHAPES
-             if shape not in ("multi_region", "multi_tenant")
+             if shape not in ("multi_region", "multi_tenant", "fleet_soak")
              for schedule in SCHEDULES if schedule != "region_partition"] \
     + [("multi_region", "storm"), ("multi_region", "region_partition")] \
     + [("multi_tenant", "storm"), ("multi_tenant", "lease_flap")]
+
+# the 10K-agent fleet cells are their own tier (minutes per cell at
+# full size): `bench.py --fleet-soak` runs them, the CI fleet-soak leg
+# runs them at a reduced NOMAD_TPU_FLEET_AGENTS, and lease_flap adds
+# nothing over storm for a shape whose whole point is churn + snapshot
+# streams
+FLEET_CELLS = [
+    ("fleet_soak", "storm"),
+    ("fleet_soak", "server_replace"),
+]
 
 
 def run_matrix(cells=None, seed: int = 1, out_dir: str = ".",

@@ -361,6 +361,31 @@ class StateStore:
             self._bump(index)
         self._notify("nodes", node)
 
+    def update_node_statuses_many(self, index: int, updates) -> None:
+        """Batched status/liveness transitions — one lock pass for a
+        whole heartbeat-coalescer flush (the node-plane analogue of
+        upsert_plan_results_many), so a 10K-agent fleet's steady-state
+        heartbeat writes cost O(batches), not O(nodes), store passes.
+        Each update dict carries node_id/status/updated_at with the
+        same per-node semantics as update_node_status."""
+        changed = []
+        with self._lock:
+            for u in updates:
+                old = self._nodes.get(u["node_id"])
+                if old is None:
+                    continue
+                node = _shallow_copy_node(old)
+                node.status = u["status"]
+                node.status_updated_at = u.get("updated_at", 0.0)
+                node.modify_index = index
+                self._nodes[u["node_id"]] = node
+                self.matrix.upsert_node(node)
+                changed.append(node)
+            if changed:
+                self._bump(index)
+        for node in changed:
+            self._notify("nodes", node)
+
     def update_node_drain(self, index: int, node_id: str, drain_strategy,
                           mark_eligible: bool = False) -> None:
         with self._lock:

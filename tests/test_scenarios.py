@@ -16,6 +16,7 @@ from nomad_tpu import chaos
 from nomad_tpu.chaos import FAULT_POINTS, ChaosRegistry
 from nomad_tpu.scenarios import (
     ALL_CELLS,
+    FLEET_CELLS,
     SCHEDULES,
     SHAPES,
     SMOKE_CELLS,
@@ -30,11 +31,13 @@ from nomad_tpu.scenarios import (
 
 def test_matrix_covers_every_shape_schedule_pair():
     # the core product: every single-cluster shape crossed with every
-    # single-cluster schedule; the federated and multi-tenant shapes
-    # ride exactly their first-class cells (region_partition is
-    # multi_region-only; multi_tenant gates storm + lease_flap)
+    # single-cluster schedule; the federated, multi-tenant, and fleet
+    # shapes ride exactly their first-class cells (region_partition is
+    # multi_region-only; multi_tenant gates storm + lease_flap; the
+    # 10K-agent fleet cells live in FLEET_CELLS, not ALL_CELLS)
     core_shapes = [sh for sh in SHAPES
-                   if sh not in ("multi_region", "multi_tenant")]
+                   if sh not in ("multi_region", "multi_tenant",
+                                 "fleet_soak")]
     core_scheds = [sc for sc in SCHEDULES if sc != "region_partition"]
     expected = {(sh, sc) for sh in core_shapes for sc in core_scheds}
     expected |= {("multi_region", "storm"),
@@ -45,6 +48,9 @@ def test_matrix_covers_every_shape_schedule_pair():
     assert len(ALL_CELLS) == len(expected) == 25
     # no duplicate cells
     assert len(ALL_CELLS) == len(set(ALL_CELLS))
+    assert set(FLEET_CELLS) == {("fleet_soak", "storm"),
+                                ("fleet_soak", "server_replace")}
+    assert not set(FLEET_CELLS) & set(ALL_CELLS)
 
 
 def test_matrix_batch_jobs_reschedule_unlimited():

@@ -1,0 +1,671 @@
+"""Streamed resumable InstallSnapshot (dissertation §7) + fleet-scale
+heartbeat batching.
+
+Covers the chunk frame protocol end to end — in-order streaming,
+resync on drop/duplicate/corruption, resume across leader changes and
+follower restarts, whole-stream CRC gating persist-before-accept — plus
+the install-ordering races against the apply loop and AppendEntries,
+the snapshot-send backoff satellite, and the HeartbeatTracker wheel /
+HeartbeatBatcher coalescing the 10K-agent soak rides on.
+"""
+import os
+import threading
+import time
+import zlib
+
+import pytest
+
+from nomad_tpu import chaos, mock
+from nomad_tpu.chaos import ChaosRegistry
+from nomad_tpu.core.heartbeat import HeartbeatBatcher, HeartbeatTracker
+from nomad_tpu.raft import (
+    FileSnapshotStore,
+    InMemTransport,
+    LogStore,
+    MessageType,
+    NomadFSM,
+    RaftConfig,
+    RaftNode,
+)
+from nomad_tpu.raft.node import LEADER
+from nomad_tpu.raft.snapshot import ChunkSink
+from nomad_tpu.state import StateStore
+from nomad_tpu.telemetry import global_metrics
+
+FAST = RaftConfig(heartbeat_interval=0.02, election_timeout=0.1)
+
+
+def _poll(cond, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _counter(name):
+    for c in global_metrics.snapshot()["Counters"]:
+        if c["Name"] == name:
+            return c["Count"]
+    return 0.0
+
+
+class CountingFSM(NomadFSM):
+    """Records every applied index so double-apply / gap assertions are
+    direct instead of inferred from store contents."""
+
+    def __init__(self, store):
+        super().__init__(store)
+        self.applied_indexes = []
+
+    def apply(self, index, msg_type, payload):
+        self.applied_indexes.append(index)
+        super().apply(index, msg_type, payload)
+
+
+def _source_state(n=6):
+    """An FSM with `n` registered nodes, its snapshot blob, and the log
+    payloads (1-based) that produce it entry by entry."""
+    fsm = NomadFSM(StateStore())
+    payloads = []
+    for i in range(1, n + 1):
+        p = {"node": mock.node()}
+        fsm.apply(i, MessageType.NODE_REGISTER, p)
+        payloads.append(p)
+    return fsm, fsm.snapshot(), payloads
+
+
+def _prefix_blob(payloads, k):
+    """Snapshot blob of the SAME history truncated at entry `k`."""
+    fsm = NomadFSM(StateStore())
+    for i, p in enumerate(payloads[:k]):
+        fsm.apply(i + 1, MessageType.NODE_REGISTER, p)
+    return fsm.snapshot()
+
+
+def _frames(blob, last_index, last_term, chunk, term=1, leader="ld",
+            config=None):
+    """The leader's frame sequence for `blob` (mirrors _send_snapshot)."""
+    total = len(blob)
+    out = []
+    offset = 0
+    while True:
+        data = blob[offset:offset + chunk]
+        done = offset + len(data) >= total
+        f = {"term": term, "leader": leader, "last_index": last_index,
+             "last_term": last_term, "offset": offset, "total": total,
+             "crc32": zlib.crc32(data), "data": data, "done": done,
+             "config": config}
+        if done:
+            f["stream_crc32"] = zlib.crc32(blob)
+        out.append(f)
+        offset += len(data)
+        if done:
+            return out
+
+
+def _follower(tmp_path, name="b", fsm=None):
+    """An unstarted follower: handlers are fully wired in __init__, so
+    tests drive frame interleavings deterministically — no threads."""
+    return RaftNode(name, ["a", name], InMemTransport(),
+                    fsm or NomadFSM(StateStore()), config=FAST,
+                    snapshots=FileSnapshotStore(str(tmp_path / name)))
+
+
+# ------------------------------------------------------------ ChunkSink
+
+
+def test_chunk_sink_append_tracks_offset_and_stream_crc(tmp_path):
+    sink = ChunkSink(str(tmp_path), key=(5, 1, 9))
+    blob = b"abc" + b"defg" + b"hi"
+    for piece in (b"abc", b"defg", b"hi"):
+        sink.append(piece)
+    assert sink.offset == len(blob)
+    assert sink.crc == zlib.crc32(blob)
+    path = sink.path
+    assert os.path.exists(path)
+    assert sink.finish() == blob
+    assert not os.path.exists(path)     # scratch file reclaimed
+
+
+def test_chunk_sink_abort_unlinks_temp_file(tmp_path):
+    sink = ChunkSink(str(tmp_path), key=(5, 1, 4))
+    sink.append(b"part")
+    sink.abort()
+    assert not os.path.exists(sink.path)
+
+
+def test_snapshot_store_reaps_orphaned_rx_files(tmp_path):
+    d = tmp_path / "snaps"
+    d.mkdir()
+    orphan = d / ".snap-rx-dead"
+    orphan.write_bytes(b"half a stream")
+    store = FileSnapshotStore(str(d))
+    assert not orphan.exists()
+    # real snapshots survive the reap
+    store.save(3, 1, b"blob")
+    FileSnapshotStore(str(d))
+    assert store.latest() == (3, 1, b"blob")
+
+
+# ------------------------------------------------- chunk frame protocol
+
+
+def test_chunk_stream_in_order_installs_and_persists(tmp_path):
+    src, blob, _ = _source_state()
+    b = _follower(tmp_path)
+    frames = _frames(blob, 6, 1, chunk=max(1, len(blob) // 5))
+    for f in frames:
+        resp = b._on_install_snapshot(f)
+        assert resp["success"]
+        assert resp["offset"] == min(f["offset"] + len(f["data"]),
+                                     len(blob))
+    assert b._last_snapshot_index == 6
+    assert b.last_applied == 6
+    assert b._snap_rx is None
+    # persist-before-accept: the durable record is already on disk
+    assert b.snapshots.latest() == (6, 1, blob)
+    assert {n.id for n in b.fsm.store.nodes()} == \
+        {n.id for n in src.store.nodes()}
+
+
+def test_chunk_stream_duplicate_and_future_frames_resync(tmp_path):
+    _, blob, _ = _source_state()
+    b = _follower(tmp_path)
+    chunk = max(1, len(blob) // 4)
+    frames = _frames(blob, 6, 1, chunk=chunk)
+    assert b._on_install_snapshot(frames[0])["offset"] == chunk
+    # duplicate: acked back to the real position, bytes not re-appended
+    resp = b._on_install_snapshot(frames[0])
+    assert resp["success"] and resp["offset"] == chunk
+    assert b._snap_rx.offset == chunk
+    # reordered/future frame: same resync ack, nothing appended
+    resp = b._on_install_snapshot(frames[2])
+    assert resp["success"] and resp["offset"] == chunk
+    for f in frames[1:]:
+        b._on_install_snapshot(f)
+    assert b._last_snapshot_index == 6
+
+
+def test_chunk_frame_crc_reject_asks_for_same_offset(tmp_path):
+    _, blob, _ = _source_state()
+    b = _follower(tmp_path)
+    chunk = max(1, len(blob) // 4)
+    frames = _frames(blob, 6, 1, chunk=chunk)
+    b._on_install_snapshot(frames[0])
+    corrupt = dict(frames[1])
+    corrupt["crc32"] = frames[1]["crc32"] ^ 0xDEAD
+    resp = b._on_install_snapshot(corrupt)
+    # ack the unchanged offset: the leader re-sends this frame
+    assert resp["success"] and resp["offset"] == chunk
+    assert b._snap_rx.offset == chunk
+    for f in frames[1:]:
+        assert b._on_install_snapshot(f)["success"]
+    assert b.snapshots.latest() == (6, 1, blob)
+
+
+def test_superseding_stream_discards_partial_sink(tmp_path):
+    _, blob_a, _ = _source_state(4)
+    src_b, blob_b, _ = _source_state(8)
+    b = _follower(tmp_path)
+    frames_a = _frames(blob_a, 4, 1, chunk=max(1, len(blob_a) // 3))
+    b._on_install_snapshot(frames_a[0])
+    old_path = b._snap_rx.path
+    # a NEWER snapshot stream starts: the stale partial is discarded
+    for f in _frames(blob_b, 8, 2, chunk=max(1, len(blob_b) // 3),
+                     term=2):
+        assert b._on_install_snapshot(f)["success"]
+    assert not os.path.exists(old_path)
+    assert b._last_snapshot_index == 8
+    assert {n.id for n in b.fsm.store.nodes()} == \
+        {n.id for n in src_b.store.nodes()}
+
+
+def test_restarted_follower_acks_zero_for_mid_stream_frame(tmp_path):
+    _, blob, _ = _source_state()
+    b = _follower(tmp_path)
+    chunk = max(1, len(blob) // 4)
+    frames = _frames(blob, 6, 1, chunk=chunk)
+    b._on_install_snapshot(frames[0])
+    b._on_install_snapshot(frames[1])
+    # crash + restart: same data dir, fresh node, sink gone (and the
+    # orphaned temp file reaped by the store constructor)
+    b2 = RaftNode("b2", ["a", "b2"], InMemTransport(),
+                  NomadFSM(StateStore()), config=FAST,
+                  snapshots=FileSnapshotStore(str(tmp_path / "b")))
+    resp = b2._on_install_snapshot(frames[2])
+    # stale-offset ack: tell the leader to restart from byte zero
+    assert resp["success"] and resp["offset"] == 0
+    assert not any(f.startswith(".snap-rx-")
+                   for f in os.listdir(str(tmp_path / "b")))
+    for f in frames:
+        assert b2._on_install_snapshot(f)["success"]
+    assert b2._last_snapshot_index == 6
+
+
+def test_whole_stream_crc_mismatch_restarts_from_zero(tmp_path):
+    _, blob, _ = _source_state()
+    b = _follower(tmp_path)
+    frames = _frames(blob, 6, 1, chunk=max(1, len(blob) // 3))
+    bad_done = dict(frames[-1])
+    bad_done["stream_crc32"] = frames[-1]["stream_crc32"] ^ 1
+    for f in frames[:-1]:
+        b._on_install_snapshot(f)
+    resp = b._on_install_snapshot(bad_done)
+    # assembled bytes are not the leader's blob: discard, ack zero
+    assert resp["success"] and resp["offset"] == 0
+    assert b._last_snapshot_index == 0
+    assert b._snap_rx is None
+    assert b.snapshots.latest() is None
+    # the re-stream from zero succeeds
+    for f in frames:
+        assert b._on_install_snapshot(f)["success"]
+    assert b._last_snapshot_index == 6
+
+
+def test_new_leader_resumes_same_snapshot_from_acked_offset(tmp_path):
+    """The sink survives a leader change: a new leader streaming the
+    SAME snapshot identity starts its probe at zero and is bounced
+    straight to the dead leader's high-water mark."""
+    _, blob, _ = _source_state()
+    b = _follower(tmp_path)
+    chunk = max(1, len(blob) // 8)
+    frames = _frames(blob, 6, 1, chunk=chunk)
+    for f in frames[:3]:
+        b._on_install_snapshot(f)
+    resume_at = b._snap_rx.offset
+    assert 0 < resume_at < len(blob)
+    # new leader, higher term, same (last_index, last_term, total)
+    frames2 = _frames(blob, 6, 1, chunk=chunk, term=2, leader="ld2")
+    resp = b._on_install_snapshot(frames2[0])
+    assert resp["success"] and resp["offset"] == resume_at
+    sent = 0
+    for f in frames2:
+        if f["offset"] < resume_at:
+            continue              # leader jumps to the acked offset
+        sent += len(f["data"])
+        assert b._on_install_snapshot(f)["success"]
+    assert sent < len(blob)       # resumed, not restarted
+    assert b._last_snapshot_index == 6
+    assert b.snapshots.latest() == (6, 1, blob)
+
+
+# -------------------------------------------- install-ordering races
+
+
+def test_install_then_apply_loop_continues_past_snapshot(tmp_path):
+    """Snapshot at 6 lands while entries 1..10 sit committed-unapplied:
+    the apply loop must resume at 7 — no entry below the snapshot
+    re-applies onto the restored state, no entry above it is lost."""
+    src, _, payloads = _source_state(10)
+    blob6 = _prefix_blob(payloads, 6)
+    fsm = CountingFSM(StateStore())
+    b = _follower(tmp_path, fsm=fsm)
+    b._on_append_entries({
+        "term": 1, "leader": "a", "prev_log_index": 0, "prev_log_term": 0,
+        "entries": [(i + 1, 1, MessageType.NODE_REGISTER, p)
+                    for i, p in enumerate(payloads)],
+        "leader_commit": 10})
+    assert b.commit_index == 10 and b.last_applied == 0
+    resp = b._on_install_snapshot({
+        "term": 1, "leader": "a", "last_index": 6, "last_term": 1,
+        "data": blob6, "config": None})
+    assert resp["success"]
+    assert b.last_applied == 6
+    assert b.log.first_index == 7          # prefix compacted
+    b.start()
+    try:
+        assert _poll(lambda: b.last_applied == 10)
+    finally:
+        b.stop()
+    # exactly 7..10 went through fsm.apply; 1..6 came from the blob
+    assert fsm.applied_indexes == [7, 8, 9, 10]
+    assert {n.id for n in b.fsm.store.nodes()} == \
+        {n.id for n in src.store.nodes()}
+
+
+def test_apply_loop_skips_compacted_gap(tmp_path):
+    """The _run_apply compacted-skip guard: entries below the snapshot
+    index that are no longer in the log advance last_applied without
+    touching the FSM."""
+    blob6 = _source_state(6)[1]
+    fsm = CountingFSM(StateStore())
+    b = _follower(tmp_path, fsm=fsm)   # empty log: 1..6 exist only in blob
+    with b._lock:
+        b.fsm.restore(blob6)
+        fsm.applied_indexes.clear()
+        b._last_snapshot_index = 6
+        b._last_snap_term = 1
+        b.commit_index = 6
+        # last_applied deliberately behind the snapshot: the loop must
+        # walk 1..6 as compacted skips, never as FSM applies
+        b.last_applied = 0
+    b.start()
+    try:
+        assert _poll(lambda: b.last_applied == 6)
+    finally:
+        b.stop()
+    assert fsm.applied_indexes == []
+
+
+def test_done_frame_after_append_entries_does_not_rewind_fsm(tmp_path):
+    """AppendEntries covered the stream's whole range while the chunk
+    stream was in flight: the late `done` frame must not restore the
+    older blob over state that already includes it (entries 7..10 would
+    never re-apply — a silent divergence)."""
+    src, _, payloads = _source_state(10)
+    blob6 = _prefix_blob(payloads, 6)
+    fsm = CountingFSM(StateStore())
+    b = _follower(tmp_path, fsm=fsm)
+    chunk = max(1, len(blob6) // 4)
+    frames = _frames(blob6, 6, 1, chunk=chunk)
+    for f in frames[:-1]:
+        assert b._on_install_snapshot(f)["success"]
+    # the leader catches the follower up over AppendEntries meanwhile
+    b._on_append_entries({
+        "term": 1, "leader": "a", "prev_log_index": 0, "prev_log_term": 0,
+        "entries": [(i + 1, 1, MessageType.NODE_REGISTER, p)
+                    for i, p in enumerate(payloads)],
+        "leader_commit": 10})
+    # drive the apply loop to completion deterministically
+    b.start()
+    try:
+        assert _poll(lambda: b.last_applied == 10)
+    finally:
+        b.stop()
+    assert len(b.fsm.store.nodes()) == 10
+    # ... and only now does the stream's done frame land
+    resp = b._on_install_snapshot(frames[-1])
+    assert resp["success"] and resp["offset"] == len(blob6)
+    # state retained (10 nodes), log prefix still compacted
+    assert {n.id for n in b.fsm.store.nodes()} == \
+        {n.id for n in src.store.nodes()}
+    assert b.last_applied == 10
+    assert b._last_snapshot_index == 6
+    assert b.log.first_index == 7
+    assert fsm.applied_indexes == list(range(1, 11))   # each exactly once
+
+
+# ------------------------------------- send-side backoff (satellite 2)
+
+
+def test_snapshot_send_failure_counter_and_bounded_backoff(tmp_path):
+    a = _follower(tmp_path, name="a")
+    before = _counter("raft.snapshot.send_fail")
+    for _ in range(10):
+        a._note_snap_failure("p")
+    assert _counter("raft.snapshot.send_fail") == before + 10
+    fails, until = a._snap_backoff["p"]
+    assert fails == 6                           # capped
+    assert 0 < until - time.monotonic() <= 2.0  # bounded delay
+    # the replication tick honors the backoff window: no stream spawned
+    with a._lock:
+        a._spawn_snapshot_stream("p")
+    assert "p" not in a._snap_streams
+
+
+def test_persist_failure_rejects_install_and_arms_backoff(tmp_path):
+    """A follower that cannot persist must reject (persist-before-
+    accept), and the leader must back off instead of re-streaming the
+    full blob every tick."""
+    _, blob, _ = _source_state()
+    tr = InMemTransport()
+    a = RaftNode("a", ["a", "b"], tr, NomadFSM(StateStore()), config=FAST,
+                 snapshots=FileSnapshotStore(str(tmp_path / "a")))
+    b = RaftNode("b", ["a", "b"], tr, NomadFSM(StateStore()), config=FAST,
+                 snapshots=FileSnapshotStore(str(tmp_path / "b")))
+    a.snapshots.save(6, 1, blob)
+    with a._lock:
+        a.state = LEADER
+        a.term = 1
+        a._last_snapshot_index = 6
+        a._last_snap_term = 1
+
+    def broken_save(*args, **kw):
+        raise OSError("disk full")
+
+    b.snapshots.save = broken_save
+    before = _counter("raft.snapshot.send_fail")
+    a._send_snapshot("b")       # synchronous: the whole chunk loop
+    assert b._last_snapshot_index == 0          # install rejected
+    assert _counter("raft.snapshot.send_fail") == before + 1
+    assert a._snap_backoff["b"][0] >= 1
+    # healthy retry after the window: restore save, clear backoff
+    b.snapshots.save = FileSnapshotStore(str(tmp_path / "b")).save
+    with a._lock:
+        a._snap_backoff.pop("b")
+    a._send_snapshot("b")
+    assert b._last_snapshot_index == 6
+    assert "b" not in a._snap_backoff           # cleared on success
+    assert a._next_index["b"] == 7 and a._match_index["b"] == 6
+
+
+def test_chunk_drop_chaos_stream_resyncs_to_completion(tmp_path):
+    _, blob, _ = _source_state()
+    tr = InMemTransport()
+    a = RaftNode("a", ["a", "b"], tr, NomadFSM(StateStore()), config=FAST,
+                 snapshots=FileSnapshotStore(str(tmp_path / "a")))
+    b = RaftNode("b", ["a", "b"], tr, NomadFSM(StateStore()), config=FAST,
+                 snapshots=FileSnapshotStore(str(tmp_path / "b")))
+    a.snapshots.save(6, 1, blob)
+    with a._lock:
+        a.state = LEADER
+        a.term = 1
+    os.environ["NOMAD_TPU_SNAP_CHUNK"] = str(max(1, len(blob) // 16))
+    reg = ChaosRegistry.from_spec("seed=7;snapshot.chunk_drop=0.3")
+    reg.arm(now=0.0)
+    chaos.install(reg)
+    try:
+        a._send_snapshot("b")
+    finally:
+        chaos.uninstall()
+        del os.environ["NOMAD_TPU_SNAP_CHUNK"]
+    assert b._last_snapshot_index == 6
+    assert b.snapshots.latest() == (6, 1, blob)
+
+
+def test_last_snap_term_is_instance_state_not_class_default():
+    # the dead class attribute is gone; the live field is per-instance
+    assert "_last_snap_term" not in vars(RaftNode)
+    n = RaftNode("solo", ["solo"], InMemTransport(),
+                 NomadFSM(StateStore()), config=FAST)
+    assert n._last_snap_term == 0
+
+
+# --------------------------------------------- blank join, end to end
+
+
+def test_blank_join_catches_up_via_chunked_stream(tmp_path):
+    """A joiner with no log or snapshot must catch up through the
+    chunked stream alone: leader compacted its log, so AppendEntries
+    cannot reach index 1."""
+    os.environ["NOMAD_TPU_SNAP_CHUNK"] = "512"
+    tr = InMemTransport()
+    names = ["a", "b", "c"]
+    nodes = [RaftNode(nm, names, tr, NomadFSM(StateStore()), config=FAST,
+                      log_store=LogStore(str(tmp_path / f"{nm}.log")),
+                      snapshots=FileSnapshotStore(str(tmp_path / nm)))
+             for nm in names]
+    joiner = RaftNode("d", [], tr, NomadFSM(StateStore()), config=FAST,
+                      log_store=LogStore(str(tmp_path / "d.log")),
+                      snapshots=FileSnapshotStore(str(tmp_path / "d")),
+                      join=True)
+    for n in nodes:
+        n.start()
+    joiner.start()
+    try:
+        assert _poll(lambda: any(n.is_leader for n in nodes), timeout=5)
+        leader = next(n for n in nodes if n.is_leader)
+        for _ in range(30):
+            leader.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+        leader.force_snapshot()
+        assert leader.log.first_index > 30      # prefix gone
+        leader.add_server("d")
+        assert _poll(lambda: joiner._last_snapshot_index >= 30,
+                     timeout=10), "joiner never installed the stream"
+        assert _poll(lambda: len(joiner.fsm.store.nodes()) == 30,
+                     timeout=5)
+        assert {n.id for n in joiner.fsm.store.nodes()} == \
+            {n.id for n in leader.fsm.store.nodes()}
+        # membership arrived with the snapshot's config
+        assert set(joiner._voters) == {"a", "b", "c"}
+    finally:
+        del os.environ["NOMAD_TPU_SNAP_CHUNK"]
+        for n in nodes + [joiner]:
+            n.stop()
+
+
+# ------------------------------------ heartbeat fleet path (tentpole c)
+
+
+class _StubServer:
+    """Just enough Server for the tracker/batcher: a real StateStore
+    plus recorders for the write paths."""
+
+    class _Cfg:
+        heartbeat_ttl = 10.0
+
+    def __init__(self):
+        self.store = StateStore()
+        self.config = self._Cfg()
+        self.status_writes = []
+        self.applies = []
+        self.evals_for = []
+        self.heartbeat_batch = None
+
+    def update_node_status(self, node_id, status):
+        self.status_writes.append((node_id, status))
+
+    def apply(self, msg_type, payload):
+        self.applies.append((msg_type, payload))
+
+    def create_node_evals(self, node_id):
+        self.evals_for.append(node_id)
+
+
+def _register(server, node_id="n1"):
+    n = mock.node()
+    n.id = node_id
+    NomadFSM(server.store).apply(1, MessageType.NODE_REGISTER, {"node": n})
+    return n
+
+
+def test_heartbeat_tracker_restart_clears_stale_deadlines():
+    """Satellite 1: deadlines armed under a previous tenure must not
+    survive start() — a leftover TTL would expire a live node out of a
+    tenure that never tracked it."""
+    srv = _StubServer()
+    _register(srv, "n1")
+    tracker = HeartbeatTracker(srv, ttl=0.15, tick=0.02)
+    tracker.heartbeat("n1")                 # armed pre-tenure
+    assert tracker.tracked() == 1
+    tracker.start()
+    try:
+        assert tracker.tracked() == 0       # wiped on start
+        time.sleep(0.4)                     # well past the stale TTL
+        assert srv.status_writes == []      # stale deadline never fired
+    finally:
+        tracker.stop()
+
+
+def test_heartbeat_wheel_expiry_rearm_untrack():
+    srv = _StubServer()
+    for nid in ("n1", "n2"):
+        _register(srv, nid)
+    tracker = HeartbeatTracker(srv, ttl=0.15, tick=0.02)
+    tracker.start()
+    try:
+        tracker.heartbeat("n1")
+        tracker.heartbeat("n2")
+        tracker.untrack("n2")               # deregistered: never expires
+        # keep n1 alive across several TTL windows: re-arm wins
+        for _ in range(6):
+            time.sleep(0.05)
+            tracker.heartbeat("n1")
+        assert srv.status_writes == []
+        assert _poll(lambda: ("n1", "down") in srv.status_writes,
+                     timeout=2.0), "n1 TTL never expired"
+        assert all(nid != "n2" for nid, _ in srv.status_writes)
+        assert tracker.tracked() == 0
+    finally:
+        tracker.stop()
+
+
+def test_heartbeat_batcher_coalesces_one_entry_per_flush():
+    srv = _StubServer()
+    b = HeartbeatBatcher(srv, interval=3600.0)   # manual flush only
+    b.note("n1", "down")
+    b.stamp("n2", "ready")
+    b.stamp("n2", "ready")      # rate-limited to one per half-TTL
+    b.stamp("n1", "ready")      # transition already pending: kept as-is
+    before = _counter("heartbeat.batch_flush")
+    b.flush()
+    assert len(srv.applies) == 1             # ONE raft entry for the batch
+    msg_type, payload = srv.applies[0]
+    assert msg_type == MessageType.NODE_HEARTBEAT_BATCH
+    assert {u["node_id"]: u["status"] for u in payload["updates"]} == \
+        {"n1": "down", "n2": "ready"}
+    assert all(u["updated_at"] > 0 for u in payload["updates"])
+    assert srv.evals_for == ["n1"]           # evals only for transitions
+    assert _counter("heartbeat.batch_flush") == before + 1
+    b.flush()                                # nothing pending: no entry
+    assert len(srv.applies) == 1
+
+
+def test_heartbeat_batch_stall_chaos_defers_the_flush():
+    srv = _StubServer()
+    b = HeartbeatBatcher(srv, interval=3600.0)
+    b.note("n1", "down")
+    reg = ChaosRegistry.from_spec("seed=1;heartbeat.batch_stall=1.0")
+    reg.arm(now=0.0)
+    chaos.install(reg)
+    try:
+        b.flush()
+        assert srv.applies == []             # stalled: batch keeps pending
+    finally:
+        chaos.uninstall()
+    b.flush()
+    assert len(srv.applies) == 1             # next tick carries the batch
+    assert srv.applies[0][1]["updates"][0]["node_id"] == "n1"
+
+
+def test_fsm_applies_heartbeat_batch_in_one_store_write():
+    store = StateStore()
+    fsm = NomadFSM(store)
+    nodes = [mock.node() for _ in range(3)]
+    for i, n in enumerate(nodes):
+        fsm.apply(i + 1, MessageType.NODE_REGISTER, {"node": n})
+    ts = time.time()
+    fsm.apply(10, MessageType.NODE_HEARTBEAT_BATCH, {"updates": [
+        {"node_id": nodes[0].id, "status": "down", "updated_at": ts},
+        {"node_id": nodes[1].id, "status": "disconnected",
+         "updated_at": ts},
+        {"node_id": "ghost", "status": "down", "updated_at": ts},
+    ]})
+    assert store.node_by_id(nodes[0].id).status == "down"
+    assert store.node_by_id(nodes[1].id).status == "disconnected"
+    assert store.node_by_id(nodes[2].id).status != "down"
+    assert store.latest_index == 10          # unknown ids are ignored
+
+
+def test_tracker_expiry_rides_batcher_when_running():
+    """At fleet scale a churn wave must coalesce: expiries go through
+    HeartbeatBatcher.note, not one update_node_status entry each."""
+    srv = _StubServer()
+    _register(srv, "n1")
+    srv.heartbeat_batch = HeartbeatBatcher(srv, interval=3600.0)
+    srv.heartbeat_batch.start()
+    tracker = HeartbeatTracker(srv, ttl=0.1, tick=0.02)
+    tracker.start()
+    try:
+        tracker.heartbeat("n1")
+        assert _poll(
+            lambda: "n1" in srv.heartbeat_batch._pending
+            or any(u["node_id"] == "n1"
+                   for _, p in srv.applies for u in p["updates"]),
+            timeout=2.0)
+        assert srv.status_writes == []       # never the per-node path
+    finally:
+        tracker.stop()
+        srv.heartbeat_batch.stop()
