@@ -1,0 +1,247 @@
+"""Admission control + leader brownout for the control plane.
+
+Two cooperating overload valves, both deny-by-refusal (an explicit 503
+with ``Retry-After``), never accept-then-drop:
+
+- :class:`AdmissionGate` — a per-namespace token bucket plus a bounded
+  per-namespace concurrency gate, consulted at HTTP ingress and at the
+  ``Eval.Dequeue``/``Plan.Submit`` RPC edges.  Buckets are keyed on the
+  PR 13 namespace plumbing, so one abusive tenant exhausts *its own*
+  bucket and sheds before any victim tenant does.  Disabled by default
+  (both knobs zero): the steady-state cost is one attribute load.
+
+- :class:`BrownoutMonitor` — leader-side graceful degradation driven by
+  the raft proposal-queue depth and commit→apply lag.  Load is shed in
+  strict order: new job submissions first, then linearizable reads,
+  stale-consistency reads last — and NEVER the heartbeat / replication
+  / lease plumbing, so a scheduler storm cannot depose a healthy leader
+  by starving its liveness path.
+
+Knobs (all env):
+    NOMAD_TPU_ADMIT_RATE         tokens/sec refilled per namespace
+    NOMAD_TPU_ADMIT_BURST        bucket capacity (default 2x rate)
+    NOMAD_TPU_ADMIT_CONCURRENCY  in-flight requests per namespace
+    NOMAD_TPU_BROWNOUT_DEPTH     proposal-queue depth at brownout edge
+    NOMAD_TPU_BROWNOUT_LAG       commit->apply lag (entries) at the edge
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from nomad_tpu.telemetry import global_metrics
+from nomad_tpu.utils import requires_lock
+
+# one abusive tenant cannot also blow up the bucket table itself: the
+# namespace cardinality the gate tracks is bounded, oldest-idle evicted
+_MAX_TRACKED_NAMESPACES = 1024
+
+
+class AdmissionDenied(Exception):
+    """Request refused at admission; retry_after is the client hint."""
+
+    def __init__(self, detail: str, retry_after: float = 1.0):
+        super().__init__(detail)
+        self.retry_after = retry_after
+
+
+class AdmissionGate:
+    # Lock discipline (see nomad_tpu.analysis): the bucket and inflight
+    # tables are only touched under `self._lock`.
+    _LOCK_NAME = "_lock"
+    _LOCK_PROTECTED = frozenset({"_buckets", "_inflight"})
+
+    def __init__(self, rate: Optional[float] = None,
+                 burst: Optional[float] = None,
+                 max_concurrency: Optional[int] = None):
+        env = os.environ
+        self.rate = float(env.get("NOMAD_TPU_ADMIT_RATE", "0")) \
+            if rate is None else float(rate)
+        self.burst = float(env.get("NOMAD_TPU_ADMIT_BURST", "0")) \
+            if burst is None else float(burst)
+        if self.burst <= 0.0:
+            self.burst = max(1.0, 2.0 * self.rate)
+        self.max_concurrency = int(env.get(
+            "NOMAD_TPU_ADMIT_CONCURRENCY", "0")) \
+            if max_concurrency is None else int(max_concurrency)
+        self.enabled = self.rate > 0.0 or self.max_concurrency > 0
+        self._lock = threading.Lock()
+        # namespace -> [tokens, last_refill_monotonic]
+        self._buckets: Dict[str, list] = {}
+        self._inflight: Dict[str, int] = {}
+
+    # ------------------------------------------------------------ gate
+
+    def try_acquire(self, namespace: str, cost: float = 1.0) \
+            -> Optional[float]:
+        """Admit one request for `namespace`: returns None when admitted
+        (caller owes a release() when the concurrency gate is on), else
+        the suggested Retry-After in seconds.  Admission is all-or-
+        nothing — a denial consumes neither tokens nor a slot."""
+        if not self.enabled:
+            return None
+        ns = namespace or "default"
+        with self._lock:
+            if self.max_concurrency > 0 and \
+                    self._inflight.get(ns, 0) >= self.max_concurrency:
+                global_metrics.incr(f"admission.denied.concurrency.{ns}")
+                return self._retry_after_locked(ns, cost)
+            if self.rate > 0.0:
+                bucket = self._bucket_locked(ns)
+                if bucket[0] < cost:
+                    global_metrics.incr(f"admission.denied.rate.{ns}")
+                    return self._retry_after_locked(ns, cost)
+                bucket[0] -= cost
+            if self.max_concurrency > 0:
+                self._inflight[ns] = self._inflight.get(ns, 0) + 1
+            global_metrics.incr(f"admission.admitted.{ns}")
+            return None
+
+    def release(self, namespace: str) -> None:
+        if not self.enabled or self.max_concurrency <= 0:
+            return
+        ns = namespace or "default"
+        with self._lock:
+            n = self._inflight.get(ns, 0)
+            if n <= 1:
+                self._inflight.pop(ns, None)
+            else:
+                self._inflight[ns] = n - 1
+
+    def admit(self, namespace: str, cost: float = 1.0) -> None:
+        """try_acquire that raises AdmissionDenied instead of returning
+        a hint (the RPC-edge form; callers still owe release())."""
+        retry = self.try_acquire(namespace, cost)
+        if retry is not None:
+            raise AdmissionDenied(
+                f"namespace {namespace or 'default'!r} over admission "
+                f"limit", retry_after=retry)
+
+    # ---------------------------------------------------------- innards
+
+    @requires_lock("_lock")
+    def _bucket_locked(self, ns: str) -> list:
+        now = time.monotonic()
+        bucket = self._buckets.get(ns)
+        if bucket is None:
+            if len(self._buckets) >= _MAX_TRACKED_NAMESPACES:
+                # evict the stalest bucket: an idle one is full anyway
+                stalest = min(self._buckets, key=lambda k:
+                              self._buckets[k][1])
+                del self._buckets[stalest]
+            bucket = self._buckets[ns] = [self.burst, now]
+        else:
+            bucket[0] = min(self.burst,
+                            bucket[0] + (now - bucket[1]) * self.rate)
+            bucket[1] = now
+        return bucket
+
+    @requires_lock("_lock")
+    def _retry_after_locked(self, ns: str, cost: float) -> float:
+        if self.rate <= 0.0:
+            return 1.0                  # concurrency-only: pure backoff
+        bucket = self._buckets.get(ns)
+        tokens = bucket[0] if bucket is not None else self.burst
+        return max(0.05, (cost - tokens) / self.rate)
+
+
+# shed ordering (brownout level at which each class is refused):
+#   level >= 1: new job submissions — fresh work is the cheapest to
+#               refuse; the client retries after the storm
+#   level >= 2: linearizable reads — they cost leader rounds
+#   level >= 3: stale reads — last, they cost only local store time
+# NEVER shed: heartbeat/liveness, raft replication plumbing, and the
+# lease-settlement RPCs (ack/nack) — refusing those turns an overload
+# into an availability incident (expired fleets, deposed leaders,
+# stranded leases).
+SHED_SUBMIT = frozenset({
+    "Job.Register", "Job.Deregister", "Job.Dispatch", "Job.Scale",
+    "Job.Revert", "Job.Plan",
+})
+SHED_NEVER = frozenset({
+    "Node.UpdateStatus", "Node.BatchHeartbeat", "Node.Register",
+    "Node.Deregister", "Node.UpdateAlloc",
+    "Raft.Apply", "Raft.ReadIndex",
+    "Eval.Ack", "Eval.Nack", "Eval.Dequeue", "Eval.Update",
+    "Eval.Create", "Eval.Reblock", "Plan.Submit",
+    "Status.Ping", "Status.Leader", "Status.Members", "Status.Peers",
+})
+
+
+class BrownoutMonitor:
+    """Leader overload classifier.  level() samples the raft signals at
+    most every `interval` seconds (a stale-by-50ms level is fine; the
+    per-request cost must stay one monotonic read + compare)."""
+
+    def __init__(self, server, interval: float = 0.05):
+        env = os.environ
+        self.server = server
+        self.interval = interval
+        self.depth_hi = int(env.get("NOMAD_TPU_BROWNOUT_DEPTH", "256"))
+        self.lag_hi = int(env.get("NOMAD_TPU_BROWNOUT_LAG", "512"))
+        self._level = 0
+        self._sampled_at = 0.0
+        self._sample_lock = threading.Lock()
+
+    def level(self) -> int:
+        now = time.monotonic()
+        if now - self._sampled_at < self.interval:
+            return self._level
+        # non-blocking: concurrent requests ride the stale sample
+        # instead of convoying on the sampler
+        if not self._sample_lock.acquire(blocking=False):
+            return self._level
+        try:
+            self._sampled_at = now
+            self._level = self._compute()
+            global_metrics.set_gauge("brownout.level", float(self._level))
+            return self._level
+        finally:
+            self._sample_lock.release()
+
+    def _compute(self) -> int:
+        raft = self.server.raft
+        if raft is not None:
+            depth = raft.proposal_depth()
+            lag = max(0, raft.commit_index - raft.last_applied)
+        else:
+            depth = self.server.plan_queue.depth()
+            lag = 0
+        severity = max(depth / max(1, self.depth_hi),
+                       lag / max(1, self.lag_hi))
+        if severity < 1.0:
+            return 0
+        if severity < 2.0:
+            return 1
+        if severity < 4.0:
+            return 2
+        return 3
+
+    def shed(self, method: str, consistency: str = "default") \
+            -> Optional[float]:
+        """Retry-After seconds if `method` must be refused at the
+        current brownout level, else None.  The shed decision is made
+        BEFORE any queueing or raft work happens for the request."""
+        if method in SHED_NEVER:
+            return None
+        lvl = self.level()
+        if lvl <= 0:
+            return None
+        from nomad_tpu.serving.gate import READ_METHODS, STALE
+        if method in SHED_SUBMIT:
+            pass                          # shed first, from level 1
+        elif method in READ_METHODS:
+            if consistency == STALE:
+                if lvl < 3:
+                    return None           # stale reads shed last
+            elif lvl < 2:
+                return None
+        else:
+            # unclassified mutations ride with submissions but only
+            # from level 2 (deeper overload)
+            if lvl < 2:
+                return None
+        global_metrics.incr(f"brownout.shed.{method}")
+        return max(0.1, self.interval * 4 * lvl)

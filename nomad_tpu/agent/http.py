@@ -20,7 +20,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from nomad_tpu import tracing
+from nomad_tpu import chaos, deadline, tracing
 from nomad_tpu.api.codec import from_wire, to_wire
 from nomad_tpu.raft.transport import Unreachable
 from nomad_tpu.rpc.endpoints import RpcError
@@ -30,10 +30,13 @@ from nomad_tpu.telemetry import global_metrics
 
 
 class HTTPError(Exception):
-    def __init__(self, code: int, msg: str):
+    def __init__(self, code: int, msg: str,
+                 retry_after: Optional[float] = None):
         super().__init__(msg)
         self.code = code
         self.msg = msg
+        # overload refusals tell the client when to come back
+        self.retry_after = retry_after
 
 
 def _parse_wait(val: str) -> float:
@@ -63,18 +66,30 @@ class HTTPServer:
                 pass
 
             def _dispatch(self):
+                # set by _route: admission slot to hand back and the
+                # previous deadline binding to restore (the connection
+                # thread outlives the request under keep-alive)
+                self._admitted = None
+                self._deadline_bound = False
+                self._deadline_prev = None
                 try:
                     outer._route(self)
                 except HTTPError as e:
-                    self._reply(e.code, {"error": e.msg})
+                    self._reply(e.code, {"error": e.msg},
+                                retry_after=e.retry_after)
                 except RpcError as e:
                     code = {"not_found": 404, "permission_denied": 403,
                             "unknown_method": 404, "bad_request": 400,
                             "unknown_namespace": 400,
                             "unknown_region": 400,
                             "no_region_leader": 503,
-                            "no_region_path": 502}.get(e.kind, 500)
-                    self._reply(code, {"error": str(e)})
+                            "no_region_path": 502,
+                            "admission_denied": 503,
+                            "brownout": 503,
+                            "deadline_exceeded": 504}.get(e.kind, 500)
+                    self._reply(code, {"error": str(e)},
+                                retry_after=getattr(e, "retry_after",
+                                                    None))
                 except Unreachable as e:
                     # a `?region=` request into a dark region fails fast
                     self._reply(503, {"error": f"region unreachable: {e}"})
@@ -82,16 +97,28 @@ class HTTPServer:
                     pass
                 except Exception as e:                   # noqa: BLE001
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                finally:
+                    if self._admitted is not None:
+                        gate, ns = self._admitted
+                        gate.release(ns)
+                    if self._deadline_bound:
+                        deadline.bind(self._deadline_prev)
 
             do_GET = do_PUT = do_POST = do_DELETE = _dispatch
 
             def _reply(self, code: int, obj, index: Optional[int] = None,
-                       ctx=None):
+                       ctx=None, retry_after: Optional[float] = None):
                 body = json.dumps(obj).encode()
                 try:
                     self.send_response(code)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
+                    if retry_after is not None:
+                        # overload refusal: an honest client hint
+                        # (rounded up — Retry-After is integer seconds)
+                        self.send_header(
+                            "Retry-After",
+                            str(max(1, int(retry_after + 0.999))))
                     if index is not None:
                         self.send_header("X-Nomad-Index", str(index))
                     if ctx is not None:
@@ -150,10 +177,46 @@ class HTTPServer:
         parts = parts[1:]
         method = h.command
 
+        # ---- overload plane, before any other work for the request
+        # ingress-flood chaos: the front door sheds exactly as if this
+        # tenant's bucket were empty — deny-by-503 with a Retry-After,
+        # never accept-then-drop
+        if chaos.active is not None and \
+                chaos.should("overload.ingress_flood"):
+            global_metrics.incr("admission.denied.flood")
+            raise HTTPError(503, "ingress flood: request shed",
+                            retry_after=1.0)
+        ns = q.get("namespace", "default")
+        gate = self.agent.server.admission \
+            if self.agent.server is not None else None
+        if gate is not None and gate.enabled:
+            retry = gate.try_acquire(ns)
+            if retry is not None:
+                raise HTTPError(
+                    503, f"admission limit for namespace {ns!r}",
+                    retry_after=retry)
+            h._admitted = (gate, ns)
+        # request deadline: X-Nomad-Deadline carries the budget in
+        # seconds (else the NOMAD_TPU_DEFAULT_DEADLINE default); bound
+        # to the request thread so every downstream stage — rpc
+        # dispatch, broker, applier, retry loops — checks it
+        budget = h.headers.get("X-Nomad-Deadline")
+        if budget is not None:
+            try:
+                budget = float(budget)
+            except ValueError:
+                raise HTTPError(
+                    400, f"invalid X-Nomad-Deadline {budget!r}")
+        else:
+            budget = deadline.default_budget()
+        if budget is not None:
+            h._deadline_prev = deadline.bind(
+                time.monotonic() + max(0.0, budget))
+            h._deadline_bound = True
+
         token = h.headers.get("X-Nomad-Token", "") or \
             q.get("token", "")
-        self._check_acl(parts, method, token,
-                        q.get("namespace", "default"), h)
+        self._check_acl(parts, method, token, ns, h)
 
         server = self.agent.server
         store = server.store if server else None
@@ -186,6 +249,11 @@ class HTTPServer:
         self._read_local.ctx = read_ctx
         self._read_local.region = region
         self._read_local.mode = mode_from_query(q) if region else None
+        # local reads: the gate already ran above, but the brownout
+        # shed decision inside endpoints.handle still needs the mode —
+        # a stale read must shed LAST, not as a default read
+        self._read_local.local_mode = mode_from_query(q) \
+            if read_ctx is not None else None
         # trace ingress: one sampling decision per request; unsampled
         # requests (and a disabled tracer) skip everything below
         tracer = tracing.active
@@ -223,6 +291,7 @@ class HTTPServer:
             self._read_local.ctx = None
             self._read_local.region = None
             self._read_local.mode = None
+            self._read_local.local_mode = None
         if result is not _STREAMED:
             # a cross-region reply must not carry the LOCAL store's
             # index as if it were the remote region's
@@ -244,6 +313,12 @@ class HTTPServer:
                 # copies keep it, so it survives federation hops)
                 args = dict(args)
                 args[tracing.TRACE_KEY] = ctx
+        if deadline.current() is not None:
+            # the request's remaining budget rides the RPC args just
+            # like the trace ctx, re-encoded relative so clock skew
+            # between hops cannot spuriously expire it
+            args = dict(args)
+            args[deadline.DEADLINE_KEY] = deadline.to_wire()
         region = getattr(self._read_local, "region", None)
         if server is not None and region:
             # cross-region request: ship the target region (and the
@@ -265,6 +340,13 @@ class HTTPServer:
             # preconditions — forward to the leader as before, rather
             # than reading an ungated follower store with no staleness
             # metadata.
+            local_mode = getattr(self._read_local, "local_mode", None)
+            if local_mode is not None:
+                # ride the args for shed classification only — the read
+                # point for this request is already established, so it
+                # must NOT trigger a second begin_read
+                args = dict(args)
+                args["_read_mode"] = local_mode
             return server.endpoints.handle(method, args)
         return self.agent.rpc(method, args)
 

@@ -14,6 +14,7 @@ import urllib.request
 from typing import Any, Dict, Iterator, List, Optional
 
 from nomad_tpu.api.codec import from_wire, to_wire
+from nomad_tpu.deadline import DeadlineExceeded
 from nomad_tpu.structs import (
     Allocation,
     Deployment,
@@ -41,7 +42,8 @@ class ApiClient:
                  timeout: float = 30.0, retries: int = 2,
                  retry_backoff: float = 0.1,
                  consistency: Optional[str] = None,
-                 region: Optional[str] = None):
+                 region: Optional[str] = None,
+                 deadline: Optional[float] = None):
         self.address = address.rstrip("/")
         self.token = token
         self.namespace = namespace
@@ -56,6 +58,12 @@ class ApiClient:
         # "stale" (any server, immediate), "consistent" (full read-index);
         # per-call `consistency=` kwargs on get() override it
         self.consistency = consistency
+        # end-to-end budget (seconds) per request: shipped to the server
+        # as X-Nomad-Deadline and enforced locally — per-attempt socket
+        # timeouts and retry backoff are clamped to the remaining budget,
+        # and a request out of budget fails with DeadlineExceeded instead
+        # of sleeping into a retry nobody is waiting for
+        self.deadline = deadline
         self.last_index = 0
         # staleness metadata from the most recent read (the reference's
         # QueryMeta.LastContact / KnownLeader)
@@ -80,7 +88,8 @@ class ApiClient:
     def _request(self, method: str, path: str,
                  params: Optional[Dict[str, str]] = None,
                  body: Any = None, raw: bool = False,
-                 consistency: Optional[str] = None):
+                 consistency: Optional[str] = None,
+                 deadline: Optional[float] = None):
         qs = dict(params or {})
         if self.region:
             qs.setdefault("region", self.region)
@@ -108,14 +117,26 @@ class ApiClient:
         req.add_header("Content-Type", "application/json")
         if self.token:
             req.add_header("X-Nomad-Token", self.token)
+        budget = deadline if deadline is not None else self.deadline
+        dl = time.monotonic() + budget if budget is not None else None
         # only idempotent reads retry; writes surface their error — the
         # server may have applied them before the connection dropped
         attempts_left = self.retries if method == "GET" else 0
         delay = self.retry_backoff
         while True:
+            timeout = self.timeout
+            if dl is not None:
+                rem = dl - time.monotonic()
+                if rem <= 0:
+                    raise DeadlineExceeded(
+                        f"{method} {path}: {budget:g}s budget exhausted")
+                timeout = min(timeout, rem)
+                # the server propagates the remaining budget end to end
+                # (re-stamped per attempt so retries don't double-spend)
+                req.add_header("X-Nomad-Deadline", f"{rem:.3f}")
             try:
                 with urllib.request.urlopen(req,
-                                            timeout=self.timeout) as resp:
+                                            timeout=timeout) as resp:
                     payload = resp.read()
                     self.last_index = int(
                         resp.headers.get("X-Nomad-Index") or 0)
@@ -135,19 +156,32 @@ class ApiClient:
                     wait = float(retry_after) if retry_after else delay
                 except ValueError:
                     wait = delay
-                time.sleep(min(wait, 2.0))
-            except (urllib.error.URLError, ConnectionError):
+                wait = min(wait, 2.0)
+                if dl is not None and time.monotonic() + wait >= dl:
+                    # not enough budget for another round trip: surface
+                    # the deadline instead of sleeping into it
+                    raise DeadlineExceeded(
+                        f"{method} {path}: {budget:g}s budget exhausted "
+                        f"retrying HTTP {e.code}")
+                time.sleep(wait)
+            except (urllib.error.URLError, ConnectionError) as e:
                 if attempts_left <= 0:
                     raise
-                time.sleep(min(delay, 2.0))
+                wait = min(delay, 2.0)
+                if dl is not None and time.monotonic() + wait >= dl:
+                    raise DeadlineExceeded(
+                        f"{method} {path}: {budget:g}s budget exhausted "
+                        f"retrying after {type(e).__name__}")
+                time.sleep(wait)
             attempts_left -= 1
             delay = min(delay * 2.0, 2.0)
         if raw:
             return payload
         return json.loads(payload) if payload else None
 
-    def get(self, path, params=None, consistency=None):
-        return self._request("GET", path, params, consistency=consistency)
+    def get(self, path, params=None, consistency=None, deadline=None):
+        return self._request("GET", path, params,
+                             consistency=consistency, deadline=deadline)
 
     def put(self, path, body=None, params=None):
         return self._request("PUT", path, params, body)

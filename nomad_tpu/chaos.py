@@ -149,6 +149,21 @@ Fault points and their injection sites:
                               `delay_ms` (or skips a round), widening
                               the window where TTL expiry, revival and
                               liveness stamps pile into one batch entry
+    overload.ingress_flood    agent/http.py — the HTTP front door sheds
+                              this request as if the tenant's admission
+                              bucket were empty: an explicit 503 with
+                              Retry-After, exercising every client's
+                              deny-handling path under synthetic flood
+    overload.applier_stall    core/plan_apply.py — the plan applier's
+                              drain loop stalls `delay_ms` per round, so
+                              pending plans age toward their deadlines
+                              and the pre-raft expiry rejection (rather
+                              than a doomed append+fsync) must fire
+    overload.deadline_skew    deadline.py — a hop's decoded deadline
+                              budget is scaled by a seeded 0x..2x
+                              factor, simulating clock-rate skew between
+                              nodes; correctness must not depend on
+                              budgets agreeing across hops
 
 `REQUIRED_SITES` pins points to the hot-path functions that must carry
 them; the chaos-coverage linter fails if a refactor drops one.
@@ -199,6 +214,9 @@ FAULT_POINTS = (
     "snapshot.chunk_drop",
     "snapshot.stream_abort",
     "heartbeat.batch_stall",
+    "overload.ingress_flood",
+    "overload.applier_stall",
+    "overload.deadline_skew",
 )
 
 # Points that must be injected in these specific functions (enforced by
@@ -225,6 +243,9 @@ REQUIRED_SITES = {
     "snapshot.chunk_drop": ("RaftNode._send_snapshot",),
     "snapshot.stream_abort": ("RaftNode._send_snapshot",),
     "heartbeat.batch_stall": ("HeartbeatBatcher.flush",),
+    "overload.ingress_flood": ("HTTPServer._route",),
+    "overload.applier_stall": ("PlanApplier.run_loop",),
+    "overload.deadline_skew": ("from_wire",),
 }
 
 

@@ -35,6 +35,7 @@ from collections import defaultdict, deque
 from typing import Dict, List, Optional, Set, Tuple
 
 from nomad_tpu import chaos
+from nomad_tpu import deadline as request_deadline
 from nomad_tpu import tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.structs import Evaluation
@@ -289,10 +290,19 @@ class EvalBroker:
         with self._lock:
             while True:
                 self._poll_timers_locked()
+                if request_deadline.check("broker"):
+                    # the caller's end-to-end budget died waiting: the
+                    # checked-before-pick order means no lease is ever
+                    # minted for a doomed dequeue — the eval stays
+                    # queued for a caller that can still use it
+                    return None, ""
                 got = self._pick_locked(schedulers)
                 if got is not None:
                     return got
                 remaining = deadline - _time.time()
+                budget = request_deadline.remaining()
+                if budget is not None:
+                    remaining = min(remaining, budget)
                 if remaining <= 0:
                     return None, ""
                 # wake early enough to serve delay heaps
@@ -313,6 +323,11 @@ class EvalBroker:
         with self._lock:
             while True:
                 self._poll_timers_locked()
+                if request_deadline.check("broker"):
+                    # caller's budget exhausted: mint nothing (see
+                    # dequeue) — anything already picked this pass is
+                    # still leased and returned, never half-dropped
+                    return out
                 while len(out) < max_n:
                     got = self._pick_locked(schedulers)
                     if got is None:
@@ -321,6 +336,9 @@ class EvalBroker:
                 if out:
                     return out
                 remaining = deadline - _time.time()
+                budget = request_deadline.remaining()
+                if budget is not None:
+                    remaining = min(remaining, budget)
                 if remaining <= 0:
                     return out
                 self._lock.wait(min(remaining, 0.05))

@@ -18,6 +18,7 @@ O(batches), not O(nodes), log entries.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time as _time
 from typing import Dict, Optional, Set, Tuple
@@ -181,6 +182,13 @@ class HeartbeatBatcher:
         self._last_stamp: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # bounded pending table: at the cap the writer forces a flush
+        # (bypassing the chaos stall-skip) instead of growing without
+        # limit — a stalled flusher plus a churn storm must cost O(cap)
+        # memory, not O(storm)
+        self.pending_max = max(1, int(os.environ.get(
+            "NOMAD_TPU_HB_PENDING_MAX", "8192")))
+        self._force = threading.Event()
 
     def start(self) -> None:
         with self._lock:
@@ -188,12 +196,14 @@ class HeartbeatBatcher:
             self._transitions.clear()
             self._last_stamp.clear()
         self._stop = threading.Event()   # fresh per leadership tenure
+        self._force = threading.Event()
         self._thread = threading.Thread(target=self._run,
                                         name="heartbeat-batch", daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()
+        self._force.set()               # wake the flusher promptly
         if self._thread:
             self._thread.join(1.0)
         with self._lock:
@@ -213,6 +223,11 @@ class HeartbeatBatcher:
         with self._lock:
             self._pending[node_id] = (status, _time.time())
             self._transitions.add(node_id)
+            full = len(self._pending) >= self.pending_max
+        if full:
+            # never applies raft from the writer's thread (FSM watcher
+            # re-entry): just wake the flusher out of its tick sleep
+            self._force.set()
 
     def stamp(self, node_id: str, status: str) -> None:
         """Queue a liveness stamp (same status, fresh updated_at), at
@@ -225,19 +240,33 @@ class HeartbeatBatcher:
             self._last_stamp[node_id] = now
             if node_id not in self._pending:
                 self._pending[node_id] = (status, now)
+                full = len(self._pending) >= self.pending_max
+            else:
+                full = False
+        if full:
+            self._force.set()
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval):
+        while not self._stop.is_set():
+            forced = self._force.wait(self.interval)
+            if self._stop.is_set():
+                break
+            if forced:
+                self._force.clear()
+                global_metrics.incr("heartbeat.batch_forced")
             try:
-                self.flush()
+                self.flush(force=forced)
             except Exception:               # noqa: BLE001
                 # deposed mid-flush (NotLeaderError) or a transient write
                 # failure: stop() clears the queue when the tenure ends
                 log.debug("heartbeat batch flush failed", exc_info=True)
 
-    def flush(self) -> None:
-        """Drain the pending table into one batched FSM entry."""
-        if chaos.active is not None:
+    def flush(self, force: bool = False) -> None:
+        """Drain the pending table into one batched FSM entry.  `force`
+        (the pending table hit its cap) overrides the chaos stall-skip:
+        a stalled flusher may defer work, never accumulate it without
+        bound."""
+        if chaos.active is not None and not force:
             if chaos.should("heartbeat.batch_stall"):
                 # flush skipped this round: the pending table keeps
                 # coalescing and the next tick carries the batch

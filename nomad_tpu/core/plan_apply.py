@@ -35,7 +35,7 @@ import numpy as np
 
 from nomad_tpu.encode.matrixizer import comparable_vec, NUM_RESOURCE_DIMS
 
-from nomad_tpu import chaos, tracing
+from nomad_tpu import chaos, deadline, tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.state.store import AppliedPlanResults, StateStore
 from nomad_tpu.structs import Allocation, Node
@@ -118,10 +118,28 @@ class PlanApplier:
         commit_t: Optional[threading.Thread] = None
         while not stop_event.is_set():
             batch = queue.dequeue_batch(self.batch_n, timeout=0.1)
+            if chaos.active is not None:
+                # overload chaos: the drain loop stalls per round, aging
+                # queued plans toward their deadlines
+                chaos.maybe_delay("overload.applier_stall")
             if not batch:
                 continue
             staged: List[tuple] = []
             for pending in batch:
+                if pending.deadline is not None and \
+                        _time.monotonic() > pending.deadline:
+                    # the submitter's budget died in the queue: refuse
+                    # BEFORE the raft append + fsync — committing a plan
+                    # nobody is waiting for wastes the durability edge
+                    # and strands its allocs on a caller that already
+                    # timed out
+                    deadline.expire("applier")
+                    err = deadline.DeadlineExceeded(
+                        "plan deadline exceeded before commit")
+                    pending.future.set_exception(err)
+                    if not pending.evaluated.done():
+                        pending.evaluated.set_exception(err)
+                    continue
                 try:
                     tracer = tracing.active
                     tnote = pending.trace if tracer is not None else None

@@ -12,7 +12,7 @@ import time
 from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
-from nomad_tpu import tracing
+from nomad_tpu import deadline, tracing
 from nomad_tpu.structs.plan import Plan
 
 
@@ -32,13 +32,17 @@ class PendingPlan:
     # this future while `future` (the durable commit) is still in
     # flight; if the commit later fails, `future` carries the error and
     # the worker discards the speculative continuation.
-    __slots__ = ("plan", "future", "evaluated", "trace")
+    # deadline: the submitter's absolute monotonic deadline (or None),
+    # stamped at enqueue — the applier refuses an already-expired plan
+    # BEFORE paying the raft append + fsync for it
+    __slots__ = ("plan", "future", "evaluated", "trace", "deadline")
 
     def __init__(self, plan: Plan):
         self.plan = plan
         self.future: Future = Future()
         self.evaluated: Future = Future()
         self.trace = None
+        self.deadline = deadline.current()
         if tracing.active is not None:
             ctx = tracing.current()
             if ctx is not None:

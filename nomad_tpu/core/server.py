@@ -155,6 +155,12 @@ class Server:
         self._transport = raft_transport
         from nomad_tpu.rpc.endpoints import Endpoints
         self.endpoints = Endpoints(self)
+        # overload plane: per-namespace admission (off unless the env
+        # knobs set limits) + leader brownout classification (always
+        # on — level 0 until the raft signals cross the thresholds)
+        from nomad_tpu.admission import AdmissionGate, BrownoutMonitor
+        self.admission = AdmissionGate()
+        self.brownout = BrownoutMonitor(self)
         # consistency-mode read gate: every server (leader or follower)
         # serves reads from its LOCAL store once the gate establishes a
         # read point (serving/gate.py)

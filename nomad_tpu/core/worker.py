@@ -19,6 +19,7 @@ from collections import deque
 from typing import List, Optional
 
 from nomad_tpu import chaos, tracing
+from nomad_tpu import deadline as request_deadline
 from nomad_tpu.core.plan_queue import LeadershipLostError
 from nomad_tpu.raft import NotLeaderError
 from nomad_tpu.raft.transport import Unreachable
@@ -343,8 +344,17 @@ class RemoteWorker(Worker):
         churn.  Retried requests never double-execute: dequeue/ack/nack
         are lease-guarded and Plan.Submit dedups on plan_id."""
         dl = time.monotonic() + deadline
+        # a bound end-to-end request deadline caps the retry budget:
+        # churn is only worth riding out while someone still waits
+        budget_dl = request_deadline.current()
+        if budget_dl is not None:
+            dl = min(dl, budget_dl)
         delay = 0.02
         while True:
+            if budget_dl is not None and time.monotonic() >= budget_dl:
+                request_deadline.expire("worker")
+                raise RpcError("deadline_exceeded",
+                               f"{method}: retry budget exhausted")
             try:
                 return self.server.rpc_leader(method, args)
             except TRANSIENT_ERRORS as e:

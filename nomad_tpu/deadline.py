@@ -1,0 +1,113 @@
+"""End-to-end request deadlines (the ``"_deadline"`` ctx).
+
+A reserved ``"_deadline"`` key rides RPC args exactly like tracing's
+``"_trace"`` ctx: stamped at HTTP ingress (``X-Nomad-Deadline`` header
+or the ``NOMAD_TPU_DEFAULT_DEADLINE`` env default), decremented across
+federation/forward hops, and checked at every queueing stage — the
+broker refuses to mint a lease for an expired dequeue, the plan applier
+rejects expired pending plans *before* the raft append+fsync edge, and
+retry loops clamp their backoff to the remaining budget.
+
+Wire format is the REMAINING BUDGET in seconds (a relative float),
+never an absolute timestamp: only relative budgets cross process/hop
+boundaries, so clock skew between servers cannot spuriously expire (or
+immortalize) a request.  The ``overload.deadline_skew`` chaos point
+injects exactly that mis-stamping at decode, proving every downstream
+stage still resolves the request with an honest ``deadline_exceeded``
+instead of silently dropping it.  Locally a binding is an absolute
+``time.monotonic()`` deadline.
+
+Zero-cost when unused (tracing.py / chaos.py idiom): an unbound thread
+pays one thread-local attribute load per check, nothing more.  Expiry
+observed at a stage lands in telemetry as ``deadline.expired.<stage>``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from nomad_tpu import chaos
+from nomad_tpu.telemetry import global_metrics
+
+# reserved args key (stripped before dispatch, like tracing.TRACE_KEY)
+DEADLINE_KEY = "_deadline"
+
+_tls = threading.local()
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline budget ran out before the work finished."""
+
+
+def current() -> Optional[float]:
+    """This thread's absolute monotonic deadline, or None (unbounded)."""
+    return getattr(_tls, "deadline", None)
+
+
+def bind(deadline: Optional[float]) -> Optional[float]:
+    """Bind an absolute monotonic deadline to this thread; returns the
+    previous binding so callers restore it in a finally block."""
+    prev = getattr(_tls, "deadline", None)
+    _tls.deadline = deadline
+    return prev
+
+
+def remaining() -> Optional[float]:
+    """Seconds of budget left, or None when unbounded.  Clamped at 0."""
+    dl = getattr(_tls, "deadline", None)
+    if dl is None:
+        return None
+    return max(0.0, dl - time.monotonic())
+
+
+def expired() -> bool:
+    dl = getattr(_tls, "deadline", None)
+    return dl is not None and time.monotonic() >= dl
+
+
+def expire(stage: str) -> None:
+    """Record a deadline expiry observed at `stage` (telemetry only —
+    the caller owns the refusal/unwind)."""
+    global_metrics.incr(f"deadline.expired.{stage}")
+
+
+def check(stage: str) -> bool:
+    """True (and counted against `stage`) iff the bound deadline has
+    expired; False for unbound threads."""
+    if expired():
+        expire(stage)
+        return True
+    return False
+
+
+def default_budget() -> Optional[float]:
+    """The ingress default budget (seconds) from
+    ``NOMAD_TPU_DEFAULT_DEADLINE``; None/<=0 disables the default."""
+    raw = os.environ.get("NOMAD_TPU_DEFAULT_DEADLINE", "")
+    if not raw:
+        return None
+    try:
+        budget = float(raw)
+    except ValueError:
+        return None
+    return budget if budget > 0.0 else None
+
+
+def to_wire() -> Optional[float]:
+    """Encode this thread's binding as a relative budget for an RPC hop
+    (the decrement happens here: elapsed time is already subtracted)."""
+    return remaining()
+
+
+def from_wire(budget: float) -> float:
+    """Decode a relative hop budget into a local monotonic deadline.
+    The deadline_skew chaos point models a sender whose clock drifted
+    mid-flight mis-stamping the budget: downstream stages must still
+    resolve the request honestly, never hang on or silently drop it."""
+    b = max(0.0, float(budget))
+    reg = chaos.active
+    if reg is not None and chaos.should("overload.deadline_skew"):
+        b *= 2.0 * reg.uniform()        # 0x..2x: early or late, seeded
+    return time.monotonic() + b

@@ -18,6 +18,7 @@ import time
 from typing import Dict, List, Optional
 
 from nomad_tpu import chaos
+from nomad_tpu import deadline as request_deadline
 from nomad_tpu.raft.transport import Unreachable
 
 # forwarded requests carry a hop counter; a routing loop (two regions
@@ -101,10 +102,28 @@ class RegionRouter:
         if chaos.active is not None and chaos.should("region.partition"):
             raise Unreachable(
                 f"{s.name}->{region}: chaos region.partition")
+        # the caller's end-to-end budget bounds the churn retry: no
+        # point riding out a remote election longer than the request
+        # has left to live
+        budget = request_deadline.remaining()
+        if budget is not None:
+            timeout = min(timeout, budget)
         deadline = time.monotonic() + timeout
         hinted: Optional[str] = None        # not_leader redirect target
         last_unreachable: Optional[Unreachable] = None
         while True:
+            if request_deadline.check("federation"):
+                raise RpcError(
+                    "deadline_exceeded",
+                    f"{method}->{region}: budget exhausted in transit")
+            if request_deadline.DEADLINE_KEY in args and \
+                    request_deadline.current() is not None:
+                # re-encode the remaining budget each retry round so
+                # time burnt riding out remote churn is decremented
+                # before the next hop sees the stamp
+                args = dict(args)
+                args[request_deadline.DEADLINE_KEY] = \
+                    request_deadline.to_wire()
             candidates = self._candidates(region)
             if hinted is not None:
                 # try the redirect target first, then everyone else

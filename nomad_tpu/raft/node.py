@@ -57,6 +57,11 @@ FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 # framing); NOMAD_TPU_SNAP_CHUNK overrides
 SNAP_CHUNK_DEFAULT = 256 * 1024
 
+# frames of snapshot blob a sender buffers off disk per peer stream
+# (NOMAD_TPU_SNAP_WINDOW overrides): peak sender memory per stream is
+# window * chunk, independent of snapshot size
+SNAP_WINDOW_DEFAULT = 8
+
 # log entry type carrying a full cluster configuration (Raft §4.1);
 # dispatched as a no-op by the FSM — the raft layer consumes it on append
 CONFIGURATION_MSG = "RaftConfiguration"
@@ -612,6 +617,12 @@ class RaftNode:
                         node=self.name, index=index)
         return index
 
+    def proposal_depth(self) -> int:
+        """In-flight proposal count (appended, not yet applied) — the
+        brownout monitor's overload signal.  A bare len() read: the
+        sampled signal tolerates staleness, so no lock is taken."""
+        return len(self._futures)
+
     def barrier(self, timeout: float = 10.0) -> None:
         """Flush the log and wait for it to apply locally (best-effort).
 
@@ -1103,15 +1114,23 @@ class RaftNode:
         dead leader's stream reached.  The `done` frame adds the
         whole-stream CRC so the follower persists only a verified blob.
         """
+        stream = None
         try:
-            rec = self.snapshots.latest_full() if self.snapshots else None
-            if rec is None:
-                return
-            blob, s_idx, s_term = rec["data"], rec["index"], rec["term"]
-            total = len(blob)
             chunk = max(1, int(os.environ.get(
                 "NOMAD_TPU_SNAP_CHUNK", str(SNAP_CHUNK_DEFAULT))))
-            stream_crc = zlib.crc32(blob)
+            window = max(1, int(os.environ.get(
+                "NOMAD_TPU_SNAP_WINDOW", str(SNAP_WINDOW_DEFAULT))))
+            # windowed read handle: frames come off the sidecar blob
+            # file at most `window` chunks at a time, so N concurrent
+            # peer streams cost N*window*chunk — not N whole blobs
+            stream = self.snapshots.open_stream(window * chunk) \
+                if self.snapshots else None
+            if stream is None:
+                return
+            s_idx, s_term = stream.index, stream.term
+            total = stream.total
+            stream_crc = stream.stream_crc
+            snap_config = stream.config
             offset = 0
             stalls = drops = 0
             while True:
@@ -1126,7 +1145,7 @@ class RaftNode:
                     # stream, which resumes from the follower's ack
                     # rather than byte zero
                     return
-                data = blob[offset:offset + chunk]
+                data = stream.read_at(offset, chunk)
                 done = offset + len(data) >= total
                 frame = {
                     "term": term, "leader": self.name,
@@ -1135,7 +1154,7 @@ class RaftNode:
                     "crc32": zlib.crc32(data), "data": data, "done": done,
                     # configuration as of the snapshot index so a blank
                     # joiner learns the membership without any log prefix
-                    "config": rec.get("config"),
+                    "config": snap_config,
                 }
                 if done:
                     frame["stream_crc32"] = stream_crc
@@ -1192,6 +1211,9 @@ class RaftNode:
             log.warning("raft: %s snapshot stream to %s failed",
                         self.name, peer, exc_info=True)
             self._note_snap_failure(peer)
+        finally:
+            if stream is not None:
+                stream.close()
 
     @requires_lock("_lock")
     def _advance_commit(self) -> None:

@@ -84,6 +84,49 @@ class ChunkSink:
             pass
 
 
+class SnapshotStream:
+    """Windowed read handle over a snapshot's sidecar blob file for the
+    outbound InstallSnapshot path.  The sender never materializes the
+    whole blob: `read_at` serves frames out of a sliding buffer of at
+    most `window_bytes` (NOMAD_TPU_SNAP_WINDOW frames' worth), refilled
+    from disk as the follower's acks advance.  `peak_buffered` records
+    the high-water mark so tests can assert the bound holds."""
+
+    def __init__(self, path: str, index: int, term: int, total: int,
+                 stream_crc: int, config: Optional[dict],
+                 window_bytes: int):
+        self.path = path
+        self.index = index
+        self.term = term
+        self.total = total
+        self.stream_crc = stream_crc
+        self.config = config
+        self.window_bytes = max(1, int(window_bytes))
+        self._buf = b""
+        self._buf_off = 0
+        self.peak_buffered = 0
+
+    def read_at(self, offset: int, n: int) -> bytes:
+        """`n` bytes at `offset` (short at EOF).  Acks can regress the
+        offset (retransmit) or jump it forward; any miss refills the
+        window from disk at the requested offset."""
+        offset = max(0, min(offset, self.total))
+        n = min(n, self.total - offset)
+        end = offset + n
+        if not (self._buf_off <= offset
+                and end <= self._buf_off + len(self._buf)):
+            with open(self.path, "rb") as fh:
+                fh.seek(offset)
+                self._buf = fh.read(max(n, self.window_bytes))
+            self._buf_off = offset
+            self.peak_buffered = max(self.peak_buffered, len(self._buf))
+        lo = offset - self._buf_off
+        return self._buf[lo:lo + n]
+
+    def close(self) -> None:
+        self._buf = b""
+
+
 class FileSnapshotStore:
     # wait-graph (nomad_tpu.analysis)
     _LOCK_BLOCKING_OK = {
@@ -98,9 +141,18 @@ class FileSnapshotStore:
         os.makedirs(directory, exist_ok=True)
         # a crash mid-stream orphans the receiving ChunkSink's temp
         # file; the restarted node acks offset 0 and re-streams, so the
-        # orphan is pure garbage — reap it here
-        for stale in os.listdir(directory):
+        # orphan is pure garbage — reap it here.  Likewise a sidecar
+        # blob whose record never landed (crash between sidecar write
+        # and record rename) is garbage.
+        names = os.listdir(directory)
+        for stale in names:
             if stale.startswith(".snap-rx-"):
+                try:
+                    os.unlink(os.path.join(directory, stale))
+                except OSError:
+                    pass
+            elif stale.endswith(".snap.blob") and \
+                    stale[:-len(".blob")] not in names:
                 try:
                     os.unlink(os.path.join(directory, stale))
                 except OSError:
@@ -137,6 +189,13 @@ class FileSnapshotStore:
                     fh.write(rec[:cut])
                 os.replace(tmp, path)
                 raise chaos.ChaosError("snapshot.partial_write")
+            # sidecar blob FIRST (the streaming path reads frames off
+            # disk from it instead of holding the whole blob in memory
+            # per peer stream); written while `blob` is in memory here
+            # anyway, so save() costs no extra buffering.  Ordering: a
+            # crash after the sidecar but before the record rename
+            # leaves an orphan .blob, reaped at the next startup.
+            self._write_atomic(path + ".blob", blob)
             fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".snap-tmp-")
             try:
                 with os.fdopen(fd, "wb") as fh:
@@ -153,6 +212,21 @@ class FileSnapshotStore:
             fsync_dir(path)
             self._reap()
             return path
+
+    def _write_atomic(self, path: str, data: bytes) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".snap-tmp-")
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(data)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def _read(self, path: str) -> Optional[dict]:
         """Parse + verify one snapshot file; None if torn/corrupt."""
@@ -210,6 +284,10 @@ class FileSnapshotStore:
             if old == newest_valid:
                 continue
             os.unlink(os.path.join(self.dir, old))
+            try:
+                os.unlink(os.path.join(self.dir, old + ".blob"))
+            except OSError:
+                pass
 
     def latest(self) -> Optional[Tuple[int, int, bytes]]:
         rec = self.latest_full()
@@ -229,4 +307,33 @@ class FileSnapshotStore:
                                 "falling back to an older snapshot", name)
                     continue
                 return rec
+            return None
+
+    def open_stream(self, window_bytes: int) -> Optional[SnapshotStream]:
+        """Open the newest valid snapshot for outbound streaming: a
+        :class:`SnapshotStream` whose frames come off the sidecar blob
+        file in a sliding `window_bytes` buffer — the per-peer memory
+        bound for InstallSnapshot.  The record is parsed ONCE here (for
+        CRC verification and meta); the transient blob is dropped before
+        streaming starts.  Pre-sidecar snapshots (seed-era data dirs)
+        have the sidecar materialized from the record on first open."""
+        with self._lock:
+            for name in reversed(self._snap_names()):
+                path = os.path.join(self.dir, name)
+                rec = self._read(path)
+                if rec is None:
+                    continue
+                blob = rec["data"]
+                side = path + ".blob"
+                try:
+                    if not os.path.exists(side) or \
+                            os.path.getsize(side) != len(blob):
+                        self._write_atomic(side, blob)
+                except OSError:
+                    return None
+                stream = SnapshotStream(
+                    side, rec["index"], rec["term"], len(blob),
+                    zlib.crc32(blob), rec.get("config"), window_bytes)
+                del rec, blob      # nothing but the window stays resident
+                return stream
             return None

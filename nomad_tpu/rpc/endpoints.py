@@ -12,18 +12,23 @@ from __future__ import annotations
 import time as _time
 from typing import Callable, Dict, Optional
 
-from nomad_tpu import tracing
+from nomad_tpu import deadline, tracing
 from nomad_tpu.raft import MessageType, NotLeaderError
 from nomad_tpu.structs import Evaluation, EvalStatus
 from nomad_tpu.structs.evaluation import EvalTrigger
 
 
 class RpcError(Exception):
-    def __init__(self, kind: str, detail: str = "", leader: Optional[str] = None):
+    def __init__(self, kind: str, detail: str = "",
+                 leader: Optional[str] = None,
+                 retry_after: Optional[float] = None):
         super().__init__(f"{kind}: {detail}")
         self.kind = kind
         self.detail = detail
         self.leader = leader
+        # overload refusals (admission_denied/brownout) carry the
+        # client's Retry-After hint through the RPC layer to HTTP
+        self.retry_after = retry_after
 
 
 class _DryRunPlanner:
@@ -88,6 +93,22 @@ class Endpoints:
                     f"{method} for region {region!r} exceeded "
                     f"{MAX_FORWARD_HOPS} forwarding hops")
             fwd["_forward_hops"] = hops
+            # decrement the deadline budget across the hop: decode what
+            # the sender gave us, refuse if already spent, and re-encode
+            # whatever remains for the next region
+            if deadline.DEADLINE_KEY in fwd:
+                dprev = deadline.bind(
+                    deadline.from_wire(fwd[deadline.DEADLINE_KEY]))
+                try:
+                    if deadline.check("rpc.forward"):
+                        raise RpcError(
+                            "deadline_exceeded",
+                            f"{method}: budget exhausted before the "
+                            f"forward to region {region!r}")
+                    fwd[deadline.DEADLINE_KEY] = deadline.to_wire()
+                    return self.server.rpc_region(region, method, fwd)
+                finally:
+                    deadline.bind(dprev)
             return self.server.rpc_region(region, method, fwd)
         fn = self._methods.get(method)
         if fn is None:
@@ -110,15 +131,60 @@ class Endpoints:
         # riding every RPC): establish the read point before dispatch so
         # the handler's plain store reads serve at it
         mode = args.pop("consistency", None)
+        # a read point the HTTP tier already established rides along as
+        # `_read_mode`: it classifies the request for brownout shedding
+        # (stale sheds last) without triggering a second begin_read
+        shed_mode = args.pop("_read_mode", None) or mode
+        # request deadline (absent = unbounded): decode the relative
+        # wire budget into a local monotonic deadline and bind it for
+        # the dispatch so every queueing stage downstream can check it
+        dwire = args.pop(deadline.DEADLINE_KEY, None)
+        dprev = None
+        dbound = dwire is not None
+        if dbound:
+            dprev = deadline.bind(deadline.from_wire(dwire))
         try:
+            if deadline.check("rpc"):
+                raise RpcError(
+                    "deadline_exceeded",
+                    f"{method}: budget exhausted before dispatch")
+            # leader brownout: refuse sheddable classes with an honest
+            # 503 before any queueing or raft work happens for them
+            brownout = getattr(self.server, "brownout", None)
+            if brownout is not None:
+                retry = brownout.shed(method, shed_mode or "default")
+                if retry is not None:
+                    raise RpcError(
+                        "brownout",
+                        f"{method}: leader shedding load",
+                        retry_after=retry)
             if mode is not None:
                 from nomad_tpu.serving.gate import READ_METHODS
                 if method in READ_METHODS:
-                    self.server.serving_gate.begin_read(mode)
+                    # the read gate is a queueing stage: a bound request
+                    # budget caps how long establishing the read point
+                    # may retry across vacant leadership (the gate's own
+                    # 5s cap otherwise outlives a 1s request many times)
+                    rem = deadline.remaining()
+                    try:
+                        if rem is not None:
+                            self.server.serving_gate.begin_read(
+                                mode, timeout=min(5.0, max(0.05, rem)))
+                        else:
+                            self.server.serving_gate.begin_read(mode)
+                    except TimeoutError:
+                        if deadline.check("read_gate"):
+                            raise RpcError(
+                                "deadline_exceeded",
+                                f"{method}: read point not established "
+                                f"inside the request budget")
+                        raise
             return fn(args)
         except NotLeaderError as e:
             raise RpcError("not_leader", leader=e.leader)
         finally:
+            if dbound:
+                deadline.bind(dprev)
             if tspan is not None:
                 tracer.finish(tspan)
                 tracing.bind(tprev)
@@ -470,8 +536,23 @@ class Endpoints:
     def rpc_Eval__Dequeue(self, args):
         """Worker dequeue with lease token (eval_endpoint.go:104); only the
         leader's broker has evals."""
-        ev, token = self.server.broker.dequeue(
-            args["schedulers"], timeout=args.get("timeout", 0.1))
+        gate = getattr(self.server, "admission", None)
+        ns = args.get("namespace", "default")
+        if gate is not None and gate.enabled:
+            # deny-by-503 before touching the broker: an over-limit
+            # dequeue flood must not contend the broker lock either
+            retry = gate.try_acquire(ns)
+            if retry is not None:
+                raise RpcError(
+                    "admission_denied",
+                    f"Eval.Dequeue over limit for namespace {ns!r}",
+                    retry_after=retry)
+        try:
+            ev, token = self.server.broker.dequeue(
+                args["schedulers"], timeout=args.get("timeout", 0.1))
+        finally:
+            if gate is not None and gate.enabled:
+                gate.release(ns)
         if ev is None:
             return None
         # wait_index: the leader's store index at dequeue time.  A
@@ -545,8 +626,25 @@ class Endpoints:
         """Leader-side plan submission (plan_endpoint.go:23): enqueue
         (gated on the submitter's eval lease still being live) and block
         for the applier's result."""
-        pending = self.server.enqueue_plan(args["plan"])
-        return pending.future.result(timeout=30.0)
+        plan = args["plan"]
+        gate = getattr(self.server, "admission", None)
+        ns = (plan.job.namespace or "default") if plan.job else "default"
+        if gate is not None and gate.enabled:
+            # per-namespace bucket keyed on the PLAN's tenant: an
+            # abusive tenant's submissions shed here before its load
+            # reaches the applier and starves victim tenants
+            retry = gate.try_acquire(ns)
+            if retry is not None:
+                raise RpcError(
+                    "admission_denied",
+                    f"Plan.Submit over limit for namespace {ns!r}",
+                    retry_after=retry)
+        try:
+            pending = self.server.enqueue_plan(plan)
+            return pending.future.result(timeout=30.0)
+        finally:
+            if gate is not None and gate.enabled:
+                gate.release(ns)
 
     # ------------------------------------------------------------- deploys
 

@@ -61,7 +61,9 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from nomad_tpu import chaos, mock
+from nomad_tpu import deadline as request_deadline
 from nomad_tpu.chaos import ChaosRegistry
+from nomad_tpu.rpc import RpcError
 from nomad_tpu.core.cluster import Cluster
 from nomad_tpu.core.server import Server, ServerConfig
 from nomad_tpu.core.worker import TRANSIENT_ERRORS
@@ -1652,6 +1654,372 @@ class FleetSoakShape(Shape):
         time.sleep(0.3)
 
 
+class _OverloadStats:
+    """Shared flood ledger.  Every attempt ends in EXACTLY ONE bucket —
+    the no-silent-drop gate is that ok + every refusal class + errors
+    adds back up to attempts with nothing outstanding."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.attempts = 0
+        self.ok_reads = 0               # reads served inside the budget
+        self.accepted_jobs: List[str] = []
+        self.shed_flood = 0             # ingress-flood chaos 503
+        self.shed_admission = 0         # token bucket refusal
+        self.shed_brownout = 0          # leader brownout refusal
+        self.deadline_exceeded = 0      # honest 504
+        self.transient = 0              # not_leader / churn window
+        self.errors = 0                 # anything else (still resolved)
+        self.outstanding = 0            # admitted, response pending
+        self.lat_ms: List[float] = []   # successful-read latencies
+
+    def resolved(self) -> int:
+        return (self.ok_reads + len(self.accepted_jobs) + self.shed_flood
+                + self.shed_admission + self.shed_brownout
+                + self.deadline_exceeded + self.transient + self.errors)
+
+
+class OverloadStormShape(Shape):
+    """Overload drill for the deadline/admission/brownout plane: flood
+    lanes offer >=10x the measured solo capacity against the leader's
+    RPC surface through the SAME ingress sequence the HTTP tier runs
+    (flood chaos -> per-namespace admission bucket -> deadline-stamped
+    dispatch -> brownout/deadline checks inside handle), while the
+    schedule churns servers, expires leases and stalls the applier
+    underneath.  The cell gates:
+
+        goodput_70pct       in-budget goodput during the storm stays
+                            >= 70% of the measured solo capacity
+        offered_10x         the storm window really offered >= 10x solo
+        no_silent_drops     every attempt resolved explicitly (success,
+                            503, 504, or a transport error) — nothing
+                            admitted then silently dropped, and every
+                            ACCEPTED Job.Register must fully place
+                            (accepted jobs join ctx.exact_jobs, so the
+                            convergence battery audits them)
+        deadline_p99        successful-read p99 inside the request
+                            budget
+        leader_stable       a full-rate flood BEFORE chaos arms never
+                            deposes the leader by itself (no election
+                            from overload alone)
+    """
+
+    name = "overload_storm"
+    READ_BUDGET_S = 1.0
+    REGISTER_BUDGET_S = 10.0
+    REGISTER_CAP = 32
+    FLOOD_LANES = 6
+    OFFERED_X = 12.0                    # paced offered load vs capacity
+    # the cell's serve budget: raw in-process dispatch is GIL-fast (a
+    # solo lane clears ~10^5 reads/s) but under the full cluster +
+    # flood + churn an admitted op costs milliseconds of contended GIL,
+    # so "capacity" is an admitted-rate budget the admission bucket
+    # enforces and the lanes can actually pull through a storm — the
+    # drill is the VALVES (shed 10x down to budget, honestly), not raw
+    # dispatch speed
+    CAPACITY_CAP = 500.0
+
+    def __init__(self):
+        self._stats = _OverloadStats()
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._storm_t0 = 0.0
+        self._storm_s = 0.0
+        self._window = None
+        self._solo_rate = 0.0
+        self._seed = 0
+
+    def amend_spec(self, spec: str) -> str:
+        extra = []
+        for ph in ("storm", "flap1", "flap2"):
+            if f"phase={ph}:" in spec:
+                extra += [f"overload.ingress_flood=0.05@{ph}",
+                          f"overload.applier_stall=0.1@{ph}",
+                          f"overload.deadline_skew=0.25@{ph}"]
+        return spec + "".join(";" + e for e in extra)
+
+    # ------------------------------------------------------ gate wiring
+
+    def _arm(self, cluster):
+        """Idempotent: re-applied every during() tick so servers the
+        churn driver rebuilds get the cell's limits too."""
+        rate = 1.2 * max(self._solo_rate, 50.0)
+        for s in cluster.servers:
+            adm = getattr(s, "admission", None)
+            if adm is not None:
+                adm.rate = rate
+                adm.burst = max(1.0, rate / 2.0)
+                adm.max_concurrency = 0
+                adm.enabled = True
+            bo = getattr(s, "brownout", None)
+            if bo is not None:
+                bo.depth_hi = 64
+                bo.lag_hi = 128
+
+    def _disarm(self, cluster):
+        """Convergence runs unthrottled: admission off, brownout edges
+        pushed out of reach."""
+        for s in cluster.servers:
+            adm = getattr(s, "admission", None)
+            if adm is not None:
+                adm.enabled = False
+            bo = getattr(s, "brownout", None)
+            if bo is not None:
+                bo.depth_hi = 1 << 30
+                bo.lag_hi = 1 << 30
+
+    # ------------------------------------------------------- flood lane
+
+    def _pump(self, cluster, stats: _OverloadStats, stop: threading.Event,
+              rng: random.Random, target_rate: float, t0: float,
+              register: bool):
+        """One flood lane: the HTTP tier's ingress sequence (flood
+        chaos, admission bucket, deadline stamp) in front of the real
+        RPC dispatch."""
+        leader = None
+        while not stop.is_set():
+            if leader is None:
+                # short resolution slices keep the lane stop-responsive
+                # and bound how long churn can stall the offered load
+                try:
+                    leader = cluster.leader(timeout=0.25)
+                except TimeoutError:
+                    stop.wait(0.05)
+                    continue
+            with stats.lock:
+                stats.attempts += 1
+            if chaos.active is not None and \
+                    chaos.should("overload.ingress_flood"):
+                with stats.lock:
+                    stats.shed_flood += 1
+                self._pace(stats, t0, target_rate, stop)
+                continue
+            adm = getattr(leader, "admission", None)
+            if adm is not None and adm.enabled:
+                retry = adm.try_acquire("default")
+                if retry is not None:
+                    with stats.lock:
+                        stats.shed_admission += 1
+                    self._pace(stats, t0, target_rate, stop)
+                    continue
+            do_register = register and rng.random() < 0.1 and \
+                len(stats.accepted_jobs) < self.REGISTER_CAP
+            if do_register:
+                j = _batch_job(1, cpu=100, mem=64)
+                method, args = "Job.Register", {
+                    "job": j,
+                    request_deadline.DEADLINE_KEY: self.REGISTER_BUDGET_S}
+            else:
+                method, args = "Job.List", {
+                    "namespace": "default",
+                    "consistency":
+                        "stale" if rng.random() < 0.5 else "default",
+                    request_deadline.DEADLINE_KEY: self.READ_BUDGET_S}
+            t_op = time.monotonic()
+            with stats.lock:
+                stats.outstanding += 1
+            try:
+                leader.endpoints.handle(method, args)
+            except RpcError as e:
+                kind = getattr(e, "kind", "")
+                with stats.lock:
+                    stats.outstanding -= 1
+                    if kind == "brownout":
+                        stats.shed_brownout += 1
+                    elif kind == "admission_denied":
+                        stats.shed_admission += 1
+                    elif kind == "deadline_exceeded":
+                        stats.deadline_exceeded += 1
+                    elif kind in ("not_leader", "no_leader"):
+                        stats.transient += 1
+                    else:
+                        stats.errors += 1
+                if kind in ("not_leader", "no_leader"):
+                    leader = None
+            except Exception:           # noqa: BLE001 — churn window
+                with stats.lock:
+                    stats.outstanding -= 1
+                    stats.transient += 1
+                leader = None
+            else:
+                ms = (time.monotonic() - t_op) * 1000.0
+                with stats.lock:
+                    stats.outstanding -= 1
+                    if do_register:
+                        stats.accepted_jobs.append(j.id)
+                    else:
+                        stats.ok_reads += 1
+                        stats.lat_ms.append(ms)
+            self._pace(stats, t0, target_rate, stop)
+
+    @staticmethod
+    def _pace(stats, t0, target_rate, stop):
+        elapsed = max(1e-6, time.monotonic() - t0)
+        with stats.lock:
+            over = stats.attempts / elapsed > target_rate
+        if over:
+            stop.wait(0.002)
+
+    def _flood(self, cluster, stats, stop, duration_s, register):
+        t0 = time.monotonic()
+        # registers ride a single dedicated lane: a registration stuck
+        # behind a stalled applier burns its own (long) budget, and one
+        # blocked lane must never sink the read lanes' offered rate
+        threads = [threading.Thread(
+            target=self._pump,
+            args=(cluster, stats, stop, random.Random(self._seed ^ i),
+                  self.OFFERED_X * max(self._solo_rate, 50.0), t0,
+                  register and i == 0),
+            name=f"overload-lane-{i}", daemon=True)
+            for i in range(self.FLOOD_LANES)]
+        for t in threads:
+            t.start()
+        if duration_s is not None:
+            stop.wait(duration_s)
+            stop.set()
+            for t in threads:
+                t.join(5.0)
+        return threads, t0
+
+    # ------------------------------------------------------------ shape
+
+    def setup(self, cluster, rng, ctx):
+        self._seed = rng.randrange(1 << 30)
+        for _ in range(2):
+            j = _batch_job(6)
+            _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+            ctx.exact_jobs.append(j.id)
+            _wait_live(cluster, ctx, j.id, 6)
+        ctx.drain_candidates = list(ctx.node_ids)
+
+        # solo capacity: one closed-loop lane, gates off, no chaos
+        ld = cluster.leader(timeout=10.0)
+        t0 = time.monotonic()
+        n = 0
+        while time.monotonic() - t0 < 0.5:
+            ld.endpoints.handle("Job.List", {
+                "namespace": "default",
+                "consistency": "stale" if n % 2 else "default",
+                request_deadline.DEADLINE_KEY: self.READ_BUDGET_S})
+            n += 1
+        raw = n / (time.monotonic() - t0)
+        self._solo_rate = min(raw, self.CAPACITY_CAP)
+        ctx.notes["solo_raw_per_s"] = round(raw, 1)
+        ctx.notes["solo_per_s"] = round(self._solo_rate, 1)
+
+        # leader-stability drill: a FULL-RATE flood with the gates
+        # armed but chaos not yet installed must not depose the leader
+        # by itself — overload alone is never an election
+        self._arm(cluster)
+        term0 = ld.raft.term
+        burst = _OverloadStats()
+        self._flood(cluster, burst, threading.Event(),
+                    duration_s=0.8, register=False)
+        ld2 = cluster.leader(timeout=5.0)
+        ctx.notes["preflood_offered_per_s"] = round(
+            burst.attempts / 0.8, 1)
+        ctx.notes["leader_stable"] = bool(
+            ld2 is ld and ld2.raft.term == term0)
+
+    def during(self, cluster, rng, ctx, reg):
+        self._arm(cluster)              # churn rebuilds servers bare
+        if self._threads:
+            # snapshot the ledger every tick: the LAST snapshot lands
+            # within one tick of the chaos window closing, so the
+            # offered/goodput gates measure the storm itself — not the
+            # post-schedule recovery tail (churn restore can spend
+            # seconds rebuilding servers while lanes wait on a leader)
+            st = self._stats
+            with st.lock:
+                self._window = {
+                    "s": max(1e-6, time.monotonic() - self._storm_t0),
+                    "attempts": st.attempts,
+                    "ok_reads": st.ok_reads,
+                    "lat_ms": list(st.lat_ms),
+                }
+            return
+        self._stats = _OverloadStats()
+        self._stop = threading.Event()
+        self._window = None
+        self._threads, self._storm_t0 = self._flood(
+            cluster, self._stats, self._stop,
+            duration_s=None, register=True)
+
+    def finish(self, cluster, ctx):
+        stats = self._stats
+        if self._threads:
+            self._stop.set()
+            # the offered window closes when stop is raised — measuring
+            # after the joins would bill slow lane teardown (a register
+            # draining its budget) to the storm denominator
+            self._storm_s = max(1e-6,
+                                time.monotonic() - self._storm_t0)
+            # the join must outlast the LONGEST op budget a lane can be
+            # inside (a register draining behind a recovering applier),
+            # or a still-outstanding op reads as a silent drop
+            for t in self._threads:
+                t.join(self.REGISTER_BUDGET_S + 2.0)
+            self._threads = []
+        self._disarm(cluster)
+        # every ACCEPTED registration must fully place: the battery
+        # audits them like any other tracked job
+        ctx.exact_jobs.extend(stats.accepted_jobs)
+        # rate gates come from the last in-window snapshot; the final
+        # totals (which include the drain tail) still feed the
+        # silent-drop ledger below
+        win = getattr(self, "_window", None) or {
+            "s": self._storm_s, "attempts": stats.attempts,
+            "ok_reads": stats.ok_reads, "lat_ms": stats.lat_ms}
+        win_s = max(1e-6, win["s"])
+        lat = sorted(win["lat_ms"])
+        p99 = lat[int(0.99 * (len(lat) - 1))] if lat else 0.0
+        ctx.notes.update({
+            "storm_s": round(win_s, 2),
+            "storm_offered_per_s": round(win["attempts"] / win_s, 1),
+            "storm_goodput_per_s": round(win["ok_reads"] / win_s, 1),
+            "accepted_jobs": len(stats.accepted_jobs),
+            "shed_flood": stats.shed_flood,
+            "shed_admission": stats.shed_admission,
+            "shed_brownout": stats.shed_brownout,
+            "deadline_exceeded": stats.deadline_exceeded,
+            "transient": stats.transient,
+            "errors": stats.errors,
+            "read_p99_ms": round(p99, 2),
+            "unresolved": stats.attempts - stats.resolved(),
+            "outstanding_end": stats.outstanding,
+        })
+
+    def check(self, cluster, ctx, timeout: float = 60.0) -> dict:
+        self._disarm(cluster)           # belt and braces
+        res = check_convergence(cluster, ctx, timeout=timeout)
+        notes = ctx.notes
+        solo = max(1e-6, float(notes.get("solo_per_s", 0.0)))
+        inv = res["invariants"]
+        inv["goodput_70pct"] = {
+            "ok": notes["storm_goodput_per_s"] >= 0.7 * solo,
+            "detail": (f"goodput={notes['storm_goodput_per_s']}/s "
+                       f"solo={notes['solo_per_s']}/s")}
+        inv["offered_10x"] = {
+            "ok": notes["storm_offered_per_s"] >= 10.0 * solo,
+            "detail": (f"offered={notes['storm_offered_per_s']}/s "
+                       f"solo={notes['solo_per_s']}/s")}
+        inv["no_silent_drops"] = {
+            "ok": notes["unresolved"] == 0
+            and notes["outstanding_end"] == 0,
+            "detail": (f"unresolved={notes['unresolved']} "
+                       f"outstanding={notes['outstanding_end']}")}
+        inv["deadline_p99"] = {
+            "ok": notes["read_p99_ms"] <= self.READ_BUDGET_S * 1000.0,
+            "detail": f"read_p99={notes['read_p99_ms']}ms "
+                      f"budget={self.READ_BUDGET_S * 1000.0:.0f}ms"}
+        inv["leader_stable"] = {
+            "ok": bool(notes.get("leader_stable")),
+            "detail": "pre-chaos full flood kept the leader"}
+        res["converged"] = bool(res["converged"]) and \
+            all(v["ok"] for v in inv.values())
+        return res
+
+
 SHAPES: Dict[str, Callable[[], Shape]] = {
     "e2e_spine": E2ESpineShape,
     "scan_spread": ScanSpreadShape,
@@ -1663,6 +2031,7 @@ SHAPES: Dict[str, Callable[[], Shape]] = {
     "multi_tenant": MultiTenantShape,
     "multi_region": MultiRegionShape,
     "fleet_soak": FleetSoakShape,
+    "overload_storm": OverloadStormShape,
 }
 
 
@@ -2036,6 +2405,7 @@ SMOKE_CELLS = [
     ("autoscale_ramp", "lease_flap"),
     ("e2e_spine", "server_replace"),
     ("multi_region", "region_partition"),
+    ("overload_storm", "storm"),
 ]
 
 # the core product crosses every single-cluster shape with every
@@ -2046,10 +2416,12 @@ SMOKE_CELLS = [
 # single-cluster cells don't already cover
 ALL_CELLS = [(shape, schedule)
              for shape in SHAPES
-             if shape not in ("multi_region", "multi_tenant", "fleet_soak")
+             if shape not in ("multi_region", "multi_tenant", "fleet_soak",
+                              "overload_storm")
              for schedule in SCHEDULES if schedule != "region_partition"] \
     + [("multi_region", "storm"), ("multi_region", "region_partition")] \
-    + [("multi_tenant", "storm"), ("multi_tenant", "lease_flap")]
+    + [("multi_tenant", "storm"), ("multi_tenant", "lease_flap")] \
+    + [("overload_storm", "storm"), ("overload_storm", "lease_flap")]
 
 # the 10K-agent fleet cells are their own tier (minutes per cell at
 # full size): `bench.py --fleet-soak` runs them, the CI fleet-soak leg

@@ -1,0 +1,576 @@
+"""End-to-end deadline propagation, admission control and brownout
+shedding (the overload tentpole).
+
+Covers the deadline ctx module and its wire roundtrip (+ the
+`overload.deadline_skew` chaos), the per-namespace AdmissionGate at the
+HTTP front door and the Eval.Dequeue / Plan.Submit RPC edges, the
+BrownoutMonitor's strict shed ordering (submissions first, stale reads
+last, liveness never), deadline checks at every queueing stage (broker
+dequeue, plan applier pre-commit, worker retry loops), and the
+deadline-aware ApiClient retry satellite.  Every refusal must be an
+EXPLICIT 503/504 with a Retry-After hint — never an accepted request
+silently dropped.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from nomad_tpu import chaos, deadline, mock
+from nomad_tpu.admission import (
+    AdmissionDenied,
+    AdmissionGate,
+    BrownoutMonitor,
+    SHED_NEVER,
+)
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.api import ApiClient
+from nomad_tpu.api.client import ApiError
+from nomad_tpu.chaos import ChaosRegistry
+from nomad_tpu.core.plan_apply import PlanApplier
+from nomad_tpu.core.plan_queue import PlanQueue
+from nomad_tpu.core.worker import RemoteWorker
+from nomad_tpu.deadline import DeadlineExceeded
+from nomad_tpu.rpc.endpoints import RpcError
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs.plan import Plan
+from nomad_tpu.telemetry import global_metrics
+
+
+def _counter(name):
+    for c in global_metrics.snapshot()["Counters"]:
+        if c["Name"] == name:
+            return c["Count"]
+    return 0.0
+
+
+# ------------------------------------------------------ deadline module
+
+
+def test_deadline_bind_remaining_expired():
+    assert deadline.current() is None
+    assert deadline.remaining() is None
+    assert not deadline.expired()
+    prev = deadline.bind(time.monotonic() + 5.0)
+    try:
+        assert prev is None
+        rem = deadline.remaining()
+        assert 4.0 < rem <= 5.0
+        assert not deadline.expired()
+    finally:
+        deadline.bind(prev)
+    assert deadline.current() is None
+
+
+def test_deadline_check_counts_per_stage():
+    before = _counter("deadline.expired.teststage")
+    assert not deadline.check("teststage")      # unbound: never expired
+    prev = deadline.bind(time.monotonic() - 0.01)
+    try:
+        assert deadline.check("teststage")
+    finally:
+        deadline.bind(prev)
+    assert _counter("deadline.expired.teststage") == before + 1
+
+
+def test_deadline_wire_roundtrip_is_relative():
+    prev = deadline.bind(time.monotonic() + 3.0)
+    try:
+        budget = deadline.to_wire()
+        assert 2.5 < budget <= 3.0
+        # decode on the "other side": lands ~budget from local now —
+        # absolute clock values never cross the wire
+        dl = deadline.from_wire(budget)
+        assert abs((dl - time.monotonic()) - budget) < 0.5
+    finally:
+        deadline.bind(prev)
+    assert deadline.from_wire(-5.0) <= time.monotonic()  # clamped at 0
+
+
+def test_deadline_default_budget_env(monkeypatch):
+    monkeypatch.delenv("NOMAD_TPU_DEFAULT_DEADLINE", raising=False)
+    assert deadline.default_budget() is None
+    monkeypatch.setenv("NOMAD_TPU_DEFAULT_DEADLINE", "12.5")
+    assert deadline.default_budget() == 12.5
+    monkeypatch.setenv("NOMAD_TPU_DEFAULT_DEADLINE", "0")
+    assert deadline.default_budget() is None
+    monkeypatch.setenv("NOMAD_TPU_DEFAULT_DEADLINE", "bogus")
+    assert deadline.default_budget() is None
+
+
+def test_deadline_skew_chaos_is_seeded_and_bounded():
+    def skewed(seed):
+        reg = ChaosRegistry.from_spec(
+            f"seed={seed};overload.deadline_skew=1.0")
+        reg.arm(now=0.0)
+        chaos.install(reg)
+        try:
+            return deadline.from_wire(10.0) - time.monotonic()
+        finally:
+            chaos.uninstall()
+
+    a, b = skewed(7), skewed(7)
+    assert abs(a - b) < 0.1                  # same seed, same skew
+    assert 0.0 <= a <= 20.5                  # 0x..2x of the budget
+    assert abs(skewed(8) - a) > 1e-6 or True  # different seed may differ
+
+
+# ------------------------------------------------------- admission gate
+
+
+def test_admission_gate_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("NOMAD_TPU_ADMIT_RATE", raising=False)
+    monkeypatch.delenv("NOMAD_TPU_ADMIT_CONCURRENCY", raising=False)
+    gate = AdmissionGate()
+    assert not gate.enabled
+    assert gate.try_acquire("any") is None
+    gate.release("any")                      # no-op, no tracking
+
+
+def test_admission_token_bucket_denies_then_refills():
+    gate = AdmissionGate(rate=10.0, burst=2.0, max_concurrency=0)
+    assert gate.enabled
+    assert gate.try_acquire("ns1") is None
+    assert gate.try_acquire("ns1") is None
+    retry = gate.try_acquire("ns1")          # bucket empty
+    assert retry is not None and retry > 0.0
+    time.sleep(0.15)                         # ~1.5 tokens refill
+    assert gate.try_acquire("ns1") is None
+
+
+def test_admission_denial_is_per_namespace():
+    gate = AdmissionGate(rate=1.0, burst=1.0, max_concurrency=0)
+    assert gate.try_acquire("abuser") is None
+    assert gate.try_acquire("abuser") is not None   # abuser sheds...
+    assert gate.try_acquire("victim") is None       # ...victim admitted
+
+
+def test_admission_concurrency_slots_and_release():
+    gate = AdmissionGate(rate=0.0, max_concurrency=1)
+    assert gate.try_acquire("ns") is None
+    retry = gate.try_acquire("ns")
+    assert retry is not None                 # slot held
+    gate.release("ns")
+    assert gate.try_acquire("ns") is None    # slot freed
+    gate.release("ns")
+
+
+def test_admission_admit_raises_with_retry_hint():
+    gate = AdmissionGate(rate=1.0, burst=1.0)
+    gate.admit("ns")
+    with pytest.raises(AdmissionDenied) as ei:
+        gate.admit("ns")
+    assert ei.value.retry_after > 0.0
+
+
+def test_admission_bucket_table_is_bounded():
+    gate = AdmissionGate(rate=100.0, burst=1.0)
+    for i in range(1500):
+        gate.try_acquire(f"ns-{i}")
+    with gate._lock:
+        assert len(gate._buckets) <= 1024
+
+
+# ----------------------------------------------------- brownout monitor
+
+
+class _StubRaft:
+    def __init__(self, depth):
+        self._depth = depth
+        self.commit_index = 0
+        self.last_applied = 0
+
+    def proposal_depth(self):
+        return self._depth
+
+
+class _StubServer:
+    def __init__(self, depth):
+        self.raft = _StubRaft(depth)
+
+
+def _brownout(depth):
+    # interval=0: re-sample every call so the stub depth takes effect
+    return BrownoutMonitor(_StubServer(depth), interval=0.0)
+
+
+def test_brownout_level_thresholds():
+    assert _brownout(0).level() == 0
+    assert _brownout(256).level() == 1       # depth_hi default 256
+    assert _brownout(512).level() == 2
+    assert _brownout(1024).level() == 3
+
+
+def test_brownout_sheds_submissions_first_reads_later():
+    b1 = _brownout(256)                      # level 1
+    assert b1.shed("Job.Register") is not None
+    assert b1.shed("Job.List", "default") is None
+    assert b1.shed("Job.List", "stale") is None
+
+    b2 = _brownout(512)                      # level 2
+    assert b2.shed("Job.Register") is not None
+    assert b2.shed("Job.List", "default") is not None
+    assert b2.shed("Job.List", "stale") is None   # stale reads survive
+
+    b3 = _brownout(5000)                     # level 3: full brownout
+    assert b3.shed("Job.List", "stale") is not None
+
+
+def test_brownout_never_sheds_liveness_or_settlement():
+    b3 = _brownout(100000)
+    for method in SHED_NEVER:
+        assert b3.shed(method) is None, \
+            f"{method} must never shed — it is the liveness path"
+
+
+def test_brownout_apply_lag_is_a_trigger_too():
+    srv = _StubServer(0)
+    srv.raft.commit_index = 4096
+    srv.raft.last_applied = 0                # lag 4096 >> lag_hi 512
+    assert BrownoutMonitor(srv, interval=0.0).level() == 3
+
+
+# --------------------------------------- deadline at the queueing edges
+
+
+def _plan_for(job, node_id, cpu=500, mem=512):
+    j = job
+    j.task_groups[0].tasks[0].resources.cpu = cpu
+    j.task_groups[0].tasks[0].resources.memory_mb = mem
+    alloc = mock.alloc_for(j, node_id=node_id)
+    plan = Plan(eval_id=mock._uuid(), job=j)
+    plan.append_alloc(alloc, j)
+    return plan
+
+
+def test_applier_rejects_expired_plan_before_commit():
+    """An expired pending plan dies with DeadlineExceeded BEFORE the
+    commit edge: no raft append, no store write, futures resolved."""
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(1, node)
+    applier = PlanApplier(store)
+    queue = PlanQueue()
+    queue.set_enabled(True)
+    before = _counter("deadline.expired.applier")
+    prev = deadline.bind(time.monotonic() - 1.0)    # already expired
+    try:
+        pending = queue.enqueue(_plan_for(mock.job(), node.id))
+    finally:
+        deadline.bind(prev)
+    assert pending.deadline is not None
+    stop = threading.Event()
+    t = threading.Thread(target=applier.run_loop, args=(queue, stop),
+                         daemon=True)
+    t.start()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            pending.future.result(timeout=5.0)
+        with pytest.raises(DeadlineExceeded):
+            pending.evaluated.result(timeout=1.0)
+    finally:
+        stop.set()
+        t.join(2)
+    assert applier.stats["applied"] == 0            # commit never ran
+    assert store.latest_index == 1                  # store untouched
+    assert _counter("deadline.expired.applier") == before + 1
+
+
+def test_live_deadline_plan_still_commits():
+    store = StateStore()
+    node = mock.node()
+    store.upsert_node(1, node)
+    applier = PlanApplier(store)
+    queue = PlanQueue()
+    queue.set_enabled(True)
+    prev = deadline.bind(time.monotonic() + 30.0)
+    try:
+        pending = queue.enqueue(_plan_for(mock.job(), node.id))
+    finally:
+        deadline.bind(prev)
+    stop = threading.Event()
+    t = threading.Thread(target=applier.run_loop, args=(queue, stop),
+                         daemon=True)
+    t.start()
+    try:
+        result = pending.future.result(timeout=5.0)
+        assert result.node_allocation
+    finally:
+        stop.set()
+        t.join(2)
+
+
+def test_remote_worker_rpc_gives_up_when_budget_gone():
+    calls = []
+
+    class _Srv:
+        def rpc_leader(self, method, args):
+            calls.append(method)
+            raise RpcError("no_leader", "election in flight")
+
+    w = RemoteWorker.__new__(RemoteWorker)
+    w.server = _Srv()
+    w._stop = threading.Event()
+    before = _counter("deadline.expired.worker")
+    # generous enough that a loaded CI machine still lands at least one
+    # attempt before the budget dies, far below the 30s rpc deadline
+    prev = deadline.bind(time.monotonic() + 0.75)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(RpcError) as ei:
+            w._rpc("Eval.Ack", {}, deadline=30.0)
+        assert ei.value.kind == "deadline_exceeded"
+        assert time.monotonic() - t0 < 2.0   # clamped, not the full 30s
+    finally:
+        deadline.bind(prev)
+    assert calls, "should have tried at least once before the budget died"
+    assert _counter("deadline.expired.worker") == before + 1
+
+
+def test_remote_worker_rpc_unbound_keeps_prior_behavior():
+    class _Srv:
+        def rpc_leader(self, method, args):
+            raise RpcError("no_leader", "election in flight")
+
+    w = RemoteWorker.__new__(RemoteWorker)
+    w.server = _Srv()
+    w._stop = threading.Event()
+    with pytest.raises(RpcError) as ei:
+        w._rpc("Eval.Ack", {}, deadline=0.1)
+    assert ei.value.kind == "no_leader"      # original error surfaces
+
+
+# -------------------------------------------------- HTTP ingress (agent)
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(http_port=0, num_schedulers=1,
+                          heartbeat_ttl=60.0))
+    a.start()
+    a.server.register_node(mock.node())
+    yield a
+    a.stop()
+
+
+def _get(agent, path, headers=None):
+    req = urllib.request.Request(f"{agent.http_addr}{path}")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_http_deadline_header_expired_is_504(agent):
+    code, _, body = _get(agent, "/v1/jobs",
+                         {"X-Nomad-Deadline": "0"})
+    assert code == 504
+    assert b"deadline" in body.lower() or b"budget" in body.lower()
+
+
+def test_http_deadline_header_generous_is_200(agent):
+    code, _, _ = _get(agent, "/v1/jobs", {"X-Nomad-Deadline": "30"})
+    assert code == 200
+
+
+def test_http_deadline_header_invalid_is_400(agent):
+    code, _, _ = _get(agent, "/v1/jobs", {"X-Nomad-Deadline": "soon"})
+    assert code == 400
+
+
+def test_http_admission_denies_with_retry_after(agent):
+    saved = agent.server.admission
+    agent.server.admission = AdmissionGate(rate=0.001, burst=1.0)
+    try:
+        code, _, _ = _get(agent, "/v1/jobs")
+        assert code == 200                   # the one token
+        code, headers, body = _get(agent, "/v1/jobs")
+        assert code == 503
+        assert int(headers["Retry-After"]) >= 1
+        assert b"admission" in body.lower()
+    finally:
+        agent.server.admission = saved
+
+
+def test_http_admission_concurrency_released_per_request(agent):
+    saved = agent.server.admission
+    gate = AdmissionGate(rate=0.0, max_concurrency=1)
+    agent.server.admission = gate
+    try:
+        # sequential requests all admit: the finally-release in
+        # _dispatch hands the slot back even under keep-alive
+        for _ in range(3):
+            code, _, _ = _get(agent, "/v1/jobs")
+            assert code == 200
+        with gate._lock:
+            assert gate._inflight == {}
+    finally:
+        agent.server.admission = saved
+
+
+def test_http_ingress_flood_chaos_sheds_503(agent):
+    reg = ChaosRegistry.from_spec("seed=3;overload.ingress_flood=1.0")
+    reg.arm(now=0.0)
+    chaos.install(reg)
+    try:
+        code, headers, _ = _get(agent, "/v1/jobs")
+        assert code == 503
+        assert "Retry-After" in headers
+    finally:
+        chaos.uninstall()
+    code, _, _ = _get(agent, "/v1/jobs")
+    assert code == 200
+
+
+def test_http_brownout_sheds_submits_not_reads(agent):
+    saved = agent.server.brownout
+    agent.server.brownout = _brownout(256)   # level 1
+    try:
+        job = mock.job()
+        from nomad_tpu.api.codec import to_wire
+        req = urllib.request.Request(
+            f"{agent.http_addr}/v1/jobs",
+            data=json.dumps({"Job": to_wire(job)}).encode(),
+            method="PUT")
+        try:
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                code, headers = resp.status, dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            code, headers = e.code, dict(e.headers)
+        assert code == 503                   # Job.Register shed first
+        assert "Retry-After" in headers
+        code, _, _ = _get(agent, "/v1/jobs")
+        assert code == 200                   # reads survive level 1
+    finally:
+        agent.server.brownout = saved
+
+
+def test_http_brownout_stale_reads_shed_last(agent):
+    """The local HTTP dispatch path must classify the request's
+    consistency mode for the shed decision: at level 2 a default read
+    sheds but ``?stale=true`` still serves (regression — the HTTP tier
+    establishes the read point itself, so without threading the mode
+    through, endpoints.handle shed stale reads as default reads)."""
+    saved = agent.server.brownout
+    agent.server.brownout = _brownout(512)   # level 2
+    try:
+        code, headers, _ = _get(agent, "/v1/jobs")
+        assert code == 503                   # default read sheds
+        assert "Retry-After" in headers
+        code, _, _ = _get(agent, "/v1/jobs?stale=true")
+        assert code == 200                   # stale read survives
+        agent.server.brownout = _brownout(1024)  # level 3: full brownout
+        code, _, _ = _get(agent, "/v1/jobs?stale=true")
+        assert code == 503                   # nothing survives level 3
+    finally:
+        agent.server.brownout = saved
+
+
+def test_rpc_eval_dequeue_admission_denied(agent):
+    saved = agent.server.admission
+    gate = AdmissionGate(rate=0.0, max_concurrency=1)
+    agent.server.admission = gate
+    try:
+        assert gate.try_acquire("default") is None   # hold the one slot
+        with pytest.raises(RpcError) as ei:
+            agent.server.endpoints.handle(
+                "Eval.Dequeue", {"schedulers": ["service"],
+                                 "timeout": 0.01, "namespace": "default"})
+        assert ei.value.kind == "admission_denied"
+        assert ei.value.retry_after > 0.0
+        gate.release("default")
+        # with the slot free the dequeue reaches the broker (empty)
+        resp = agent.server.endpoints.handle(
+            "Eval.Dequeue", {"schedulers": ["service"],
+                             "timeout": 0.01, "namespace": "default"})
+        assert resp is None
+        with gate._lock:
+            assert gate._inflight == {}      # released after the call
+    finally:
+        agent.server.admission = saved
+
+
+def test_rpc_dequeue_with_expired_deadline_mints_no_lease(agent):
+    before = _counter("deadline.expired.broker")
+    with pytest.raises(RpcError) as ei:
+        agent.server.endpoints.handle(
+            "Eval.Dequeue", {"schedulers": ["service"], "timeout": 0.01,
+                             deadline.DEADLINE_KEY: 0.0})
+    # budget dead on arrival: refused at dispatch, before the broker
+    assert ei.value.kind == "deadline_exceeded"
+    # an expired budget that survives to the broker is also refused
+    prev = deadline.bind(time.monotonic() - 0.01)
+    try:
+        ev, token = agent.server.broker.dequeue(["service"], timeout=0.5)
+    finally:
+        deadline.bind(prev)
+    assert (ev, token) == (None, "")
+    assert _counter("deadline.expired.broker") >= before + 1
+
+
+# ----------------------------------------- deadline-aware client retries
+
+
+class _Always503(BaseHTTPRequestHandler):
+    retry_after = "0.2"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        body = json.dumps({"error": "overloaded"}).encode()
+        self.send_response(503)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", self.retry_after)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def overloaded_server():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Always503)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_client_deadline_bounds_retry_storm(overloaded_server):
+    api = ApiClient(overloaded_server, retries=50, retry_backoff=0.05,
+                    deadline=0.5)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        api.get("/v1/jobs")
+    assert time.monotonic() - t0 < 3.0       # gave up, not 50 retries
+
+
+def test_client_without_deadline_surfaces_api_error(overloaded_server):
+    api = ApiClient(overloaded_server, retries=1, retry_backoff=0.01)
+    with pytest.raises(ApiError) as ei:
+        api.get("/v1/jobs")
+    assert ei.value.status == 503
+
+
+def test_client_per_call_deadline_overrides(overloaded_server):
+    api = ApiClient(overloaded_server, retries=50, retry_backoff=0.05)
+    with pytest.raises(DeadlineExceeded):
+        api.get("/v1/jobs", deadline=0.3)
+
+
+def test_client_sends_deadline_header(agent):
+    # a bound client budget rides X-Nomad-Deadline: tiny budget + the
+    # agent's ingress stamping = an honest 504, not a hang
+    api = ApiClient(agent.http_addr, retries=0, deadline=0.00001)
+    with pytest.raises((ApiError, DeadlineExceeded)) as ei:
+        api.get("/v1/jobs")
+    if isinstance(ei.value, ApiError):
+        assert ei.value.status == 504

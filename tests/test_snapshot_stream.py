@@ -149,6 +149,68 @@ def test_snapshot_store_reaps_orphaned_rx_files(tmp_path):
     assert store.latest() == (3, 1, b"blob")
 
 
+def test_snapshot_stream_window_bounds_buffered_bytes(tmp_path):
+    """The outbound stream reads frames off the sidecar file in a
+    sliding window: peak buffered bytes stay <= window regardless of
+    blob size (the whole point of the flow-control satellite)."""
+    store = FileSnapshotStore(str(tmp_path))
+    blob = os.urandom(64 * 1024)
+    store.save(9, 2, blob, config={"voters": ["a"]})
+    window = 4096
+    stream = store.open_stream(window)
+    assert stream is not None
+    assert (stream.index, stream.term, stream.total) == (9, 2, len(blob))
+    assert stream.stream_crc == zlib.crc32(blob)
+    assert stream.config == {"voters": ["a"]}
+    got = bytearray()
+    chunk = 1024
+    off = 0
+    while off < stream.total:
+        data = stream.read_at(off, chunk)
+        assert data, "short read before EOF"
+        got += data
+        off += len(data)
+    assert bytes(got) == blob
+    assert stream.peak_buffered <= window
+    assert stream.total > window        # the bound actually bit
+    # retransmit: an ack can regress the offset; the window re-seeks
+    assert stream.read_at(0, chunk) == blob[:chunk]
+    assert stream.peak_buffered <= window
+    stream.close()
+
+
+def test_snapshot_stream_materializes_sidecar_for_legacy_snapshot(tmp_path):
+    """Pre-sidecar snapshots (seed-era data dirs) stream too: the first
+    open_stream materializes the .blob sidecar from the record."""
+    store = FileSnapshotStore(str(tmp_path))
+    blob = b"legacy " * 500
+    path = store.save(4, 1, blob)
+    os.unlink(path + ".blob")           # simulate a pre-sidecar data dir
+    stream = store.open_stream(256)
+    assert stream is not None
+    assert os.path.exists(path + ".blob")
+    # windowed reads still reassemble the exact blob
+    got = b"".join(stream.read_at(o, 256)
+                   for o in range(0, stream.total, 256))
+    assert got == blob
+    stream.close()
+
+
+def test_snapshot_reap_removes_sidecar_blobs(tmp_path):
+    store = FileSnapshotStore(str(tmp_path), retain=1)
+    p1 = store.save(1, 1, b"one")
+    p2 = store.save(2, 1, b"two")
+    assert not os.path.exists(p1) and not os.path.exists(p1 + ".blob")
+    assert os.path.exists(p2 + ".blob")
+    # an orphaned sidecar (crash between sidecar write and record
+    # rename) is reaped at startup
+    orphan = tmp_path / "snapshot-0000000001-000000000099.snap.blob"
+    orphan.write_bytes(b"orphan")
+    FileSnapshotStore(str(tmp_path))
+    assert not orphan.exists()
+    assert os.path.exists(p2 + ".blob")  # live sidecar survives
+
+
 # ------------------------------------------------- chunk frame protocol
 
 
@@ -628,6 +690,43 @@ def test_heartbeat_batch_stall_chaos_defers_the_flush():
     b.flush()
     assert len(srv.applies) == 1             # next tick carries the batch
     assert srv.applies[0][1]["updates"][0]["node_id"] == "n1"
+
+
+def test_heartbeat_batcher_cap_forces_flush_through_stall_chaos():
+    """Satellite: the pending table is bounded.  With heartbeat.batch_stall
+    chaos skipping every regular flush, a churn storm must hit the cap,
+    force a flush (which BYPASSES the stall-skip) and drain — memory
+    stays O(cap), never O(storm)."""
+    srv = _StubServer()
+    b = HeartbeatBatcher(srv, interval=0.01)
+    b.pending_max = 16
+    reg = ChaosRegistry.from_spec("seed=1;heartbeat.batch_stall=1.0")
+    reg.arm(now=0.0)
+    chaos.install(reg)
+    try:
+        b.start()
+        try:
+            peak = 0
+            for i in range(200):
+                b.note(f"n{i}", "down")
+                with b._lock:
+                    peak = max(peak, len(b._pending))
+                if i % 16 == 0:
+                    time.sleep(0.02)        # let forced flushes run
+            # the sub-cap tail stays pending under stall chaos (by
+            # design — only cap-hit forces a drain); flush it by hand
+            b.flush(force=True)
+            assert _poll(lambda: sum(
+                len(p["updates"]) for _, p in srv.applies) == 200,
+                timeout=5.0), "forced flushes never drained the storm"
+            # the cap held: the table never grew meaningfully past it
+            # (writers may land between cap-hit and the forced drain)
+            assert peak <= 2 * b.pending_max
+            assert _counter("heartbeat.batch_forced") > 0
+        finally:
+            b.stop()
+    finally:
+        chaos.uninstall()
 
 
 def test_fsm_applies_heartbeat_batch_in_one_store_write():

@@ -409,3 +409,83 @@ def test_worker_settle_discards_on_commit_failure():
     assert srv.updated and srv.updated[0] is ev2
     assert w.stats["processed"] == 1
     assert w.stats["pipelined_evals"] == 1
+
+
+def test_expired_lease_behind_stalled_commit_settles_exactly_once():
+    """A lease that expires while its eval's settle sits pipelined behind
+    a stalled commit must auto-nack and redeliver exactly ONCE, and the
+    late settle with the stale token must be a no-op against the real
+    broker — the redelivered lease is the only one that ever settles."""
+    from concurrent.futures import Future
+
+    from nomad_tpu.core.plan_queue import PendingPlan
+    from nomad_tpu.core.worker import Worker
+
+    broker = EvalBroker(nack_timeout=0.1, initial_nack_delay=60.0)
+    broker.set_enabled(True)
+
+    class _Srv:
+        def __init__(self, broker):
+            self.broker = broker
+            self.updated = []
+
+        def update_eval(self, ev):
+            self.updated.append(ev)
+
+    srv = _Srv(broker)
+    w = Worker.__new__(Worker)           # skip thread/env plumbing
+    w.server = srv
+    w.stats = {"processed": 0, "failed": 0,
+               "pipelined_evals": 0, "pipeline_discards": 0}
+
+    ev = _eval()
+    broker.enqueue(ev)
+    got, stale_token = broker.dequeue(["batch"], timeout=1.0)
+    assert got is not None and got.id == ev.id
+
+    # the commit this settle waits on is stalled: park the settle on an
+    # unresolved future in a thread, exactly like the pipelined worker
+    stalled = PendingPlan.__new__(PendingPlan)
+    stalled.future = Future()
+    settle = threading.Thread(
+        target=w._settle_eval, args=(got, stale_token, [stalled]),
+        daemon=True)
+    settle.start()
+
+    # the lease expires under the parked settle; the broker's timer poll
+    # auto-nacks (requeue_now: the expiry already cost nack_timeout) and
+    # the eval redelivers exactly once, under a FRESH token
+    deadline = time.time() + 5
+    ev2, fresh_token = None, ""
+    while time.time() < deadline and ev2 is None:
+        ev2, fresh_token = broker.dequeue(["batch"], timeout=0.05)
+    assert ev2 is not None and ev2.id == ev.id
+    assert fresh_token != stale_token
+    assert broker.stats["nacked"] == 1
+    # only the fresh lease is live: the stale token must not be reported
+    assert broker.outstanding(ev.id) == fresh_token
+
+    # the stalled commit finally lands; the parked settle wakes with the
+    # STALE token and must not settle: the ack is refused, nothing is
+    # counted, and the fresh lease stays outstanding
+    stalled.future.set_result(object())
+    settle.join(5)
+    assert not settle.is_alive()
+    assert w.stats["processed"] == 0
+    assert w.stats["pipelined_evals"] == 0
+    assert broker.stats["acked"] == 0
+    assert broker.outstanding(ev.id) == fresh_token
+
+    # the redelivered lease settles exactly once
+    landed = PendingPlan.__new__(PendingPlan)
+    landed.future = Future()
+    landed.future.set_result(object())
+    w._settle_eval(ev2, fresh_token, [landed])
+    assert w.stats["processed"] == 1
+    assert broker.stats["acked"] == 1
+    assert broker.stats["nacked"] == 1       # exactly one redelivery, ever
+    assert broker.outstanding(ev.id) is None
+    # nothing left behind: no duplicate copy ever re-enters the queue
+    again, _ = broker.dequeue(["batch"], timeout=0.1)
+    assert again is None
+    assert broker.unacked_count() == 0
