@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from nomad_tpu import knobs
 from nomad_tpu.telemetry import global_metrics
 from nomad_tpu.utils import requires_lock
 
@@ -56,15 +57,14 @@ class AdmissionGate:
     def __init__(self, rate: Optional[float] = None,
                  burst: Optional[float] = None,
                  max_concurrency: Optional[int] = None):
-        env = os.environ
-        self.rate = float(env.get("NOMAD_TPU_ADMIT_RATE", "0")) \
+        self.rate = knobs.get_float("NOMAD_TPU_ADMIT_RATE") \
             if rate is None else float(rate)
-        self.burst = float(env.get("NOMAD_TPU_ADMIT_BURST", "0")) \
+        self.burst = knobs.get_float("NOMAD_TPU_ADMIT_BURST") \
             if burst is None else float(burst)
         if self.burst <= 0.0:
             self.burst = max(1.0, 2.0 * self.rate)
-        self.max_concurrency = int(env.get(
-            "NOMAD_TPU_ADMIT_CONCURRENCY", "0")) \
+        self.max_concurrency = knobs.get_int(
+            "NOMAD_TPU_ADMIT_CONCURRENCY") \
             if max_concurrency is None else int(max_concurrency)
         self.enabled = self.rate > 0.0 or self.max_concurrency > 0
         self._lock = threading.Lock()
@@ -176,11 +176,10 @@ class BrownoutMonitor:
     per-request cost must stay one monotonic read + compare)."""
 
     def __init__(self, server, interval: float = 0.05):
-        env = os.environ
         self.server = server
         self.interval = interval
-        self.depth_hi = int(env.get("NOMAD_TPU_BROWNOUT_DEPTH", "256"))
-        self.lag_hi = int(env.get("NOMAD_TPU_BROWNOUT_LAG", "512"))
+        self.depth_hi = knobs.get_int("NOMAD_TPU_BROWNOUT_DEPTH")
+        self.lag_hi = knobs.get_int("NOMAD_TPU_BROWNOUT_LAG")
         self._level = 0
         self._sampled_at = 0.0
         self._sample_lock = threading.Lock()

@@ -269,6 +269,11 @@ class HTTPServer:
             if store is not None and "index" in q and region is None:
                 min_index = int(q["index"])
                 wait = _parse_wait(q.get("wait", "5s"))
+                # a deadline-bound blocking query parks for at most its
+                # remaining budget, then serves the current state
+                rem = deadline.remaining()
+                if rem is not None:
+                    wait = min(wait, rem)
                 store.wait_for_index(min_index + 1, timeout=min(wait, 600.0))
 
             m = method.lower()

@@ -1,8 +1,8 @@
 """nomad_tpu.analysis — static + runtime invariant analysis plane.
 
-Eleven invariant checkers plus the suppression audit, all over the repo
-tree (stdlib-only; never imports the code it analyzes, so this runs
-without jax/numpy installed):
+Fifteen invariant checkers plus the suppression audit, all over the
+repo tree (stdlib-only; never imports the code it analyzes, so this
+runs without jax/numpy installed):
 
     fsm-determinism        no wall-clock/entropy/set-iteration in the
                            raft FSM apply cone
@@ -36,6 +36,21 @@ without jax/numpy installed):
                            the runtime LockOrderRecorder corpus):
                            cycles, and locks held across blocking calls
                            not declared _LOCK_BLOCKING_OK
+    context-propagation    reserved RPC-args keys (rpc/reserved.py
+                           _RESERVED_KEYS) survive every declared
+                           forwarding site; strips are declared or
+                           re-stamped
+    deadline-coverage      blocking primitives reachable from the
+                           serving roots consult the request deadline;
+                           stage names form a closed declared set
+    donation-safety        every donate_argnums jit declares its
+                           loan/adopt protocol; loaned buffers are
+                           never read after dispatch or aliased into
+                           caches
+    knob-registry          every NOMAD_TPU_* env knob is declared in
+                           nomad_tpu/knobs.py and read through its
+                           typed accessors; dead and undocumented
+                           entries fail
     allow-audit            every `# analysis: allow(...)` carries a
                            stated reason and suppressed something this
                            run (dead suppressions are findings)
@@ -56,8 +71,9 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
 from nomad_tpu.analysis import (
-    allow_audit, canonical_form, chaos_coverage, fsm_determinism,
-    jax_purity, lock_discipline, native_abi, race, recompile,
+    allow_audit, canonical_form, chaos_coverage, context_propagation,
+    deadline_coverage, donation_safety, fsm_determinism, jax_purity,
+    knob_registry, lock_discipline, native_abi, race, recompile,
     snapshot_completeness, transfer_purity, wait_graph,
 )
 from nomad_tpu.analysis.common import Corpus, Finding, load_corpus
@@ -77,6 +93,10 @@ CHECKERS = {
     snapshot_completeness.CHECKER: snapshot_completeness.run,
     canonical_form.CHECKER: canonical_form.run,
     wait_graph.CHECKER: wait_graph.run,
+    context_propagation.CHECKER: context_propagation.run,
+    deadline_coverage.CHECKER: deadline_coverage.run,
+    donation_safety.CHECKER: donation_safety.run,
+    knob_registry.CHECKER: knob_registry.run,
     allow_audit.CHECKER: allow_audit.run,
 }
 
@@ -114,5 +134,7 @@ def run_all(root: Path, checkers: Optional[Sequence[str]] = None,
 
 
 __all__ = ["CHECKERS", "Corpus", "Finding", "LockOrderRecorder",
-           "load_corpus", "load_lock_corpus", "race", "recompile",
-           "run_all", "transfer_purity"]
+           "context_propagation", "deadline_coverage",
+           "donation_safety", "knob_registry", "load_corpus",
+           "load_lock_corpus", "race", "recompile", "run_all",
+           "transfer_purity"]

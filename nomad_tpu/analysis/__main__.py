@@ -33,6 +33,11 @@ def main(argv=None) -> int:
                          "(LockOrderRecorder.dump / "
                          "NOMAD_TPU_LOCK_ORDER=1) merged into the "
                          "wait-graph")
+    ap.add_argument("--baseline", type=Path, metavar="REPORT.json",
+                    help="a prior --json report: exit 0 when this run's "
+                         "findings are a subset of it, report only the "
+                         "NEW findings (ratchet mode — existing debt "
+                         "doesn't fail the build, new debt does)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -67,25 +72,49 @@ def main(argv=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
+    baselined = 0
+    if args.baseline is not None:
+        try:
+            base = json.loads(args.baseline.read_text())
+        except (OSError, ValueError) as e:
+            print(f"error: --baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+        # line numbers drift with unrelated edits; identity is
+        # (checker, path, message)
+        known = {(f.get("checker"), f.get("path"), f.get("message"))
+                 for f in base.get("findings", ())}
+        kept = [f for f in findings
+                if (f.checker, f.path, f.message) not in known]
+        baselined = len(findings) - len(kept)
+        findings = kept
+
     ran = checkers or list(CHECKERS)
     if args.json:
         counts = {name: 0 for name in ran}
         for f in findings:
             counts[f.checker] = counts.get(f.checker, 0) + 1
-        print(json.dumps({
+        report = {
             "root": str(root),
             "checkers": ran,
             "lock_corpus": (str(args.lock_corpus)
                             if args.lock_corpus else None),
             "counts": counts,
             "findings": [f.to_dict() for f in findings],
-        }, indent=2))
+        }
+        if args.baseline is not None:
+            report["baseline"] = str(args.baseline)
+            report["baselined"] = baselined
+        print(json.dumps(report, indent=2))
     else:
         for f in findings:
             print(f.render())
         n = len(findings)
-        print(f"nomad_tpu.analysis: {n} finding{'s' if n != 1 else ''}"
-              f" in {root} ({len(set(ran))} checkers)")
+        suffix = f" ({baselined} baselined)" if args.baseline else ""
+        print(f"nomad_tpu.analysis: {n} "
+              f"{'new ' if args.baseline else ''}finding"
+              f"{'s' if n != 1 else ''} in {root} "
+              f"({len(set(ran))} checkers){suffix}")
     return 1 if findings else 0
 
 

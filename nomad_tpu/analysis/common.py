@@ -678,6 +678,21 @@ def literal_strs(node: ast.AST) -> Set[str]:
     return out
 
 
+def module_decl(sf: "SourceFile", name: str) -> Optional[ast.AST]:
+    """The value expression of a module-level `name = <literal>`
+    declaration, else None (the module-scope twin of class_decl)."""
+    for item in sf.tree.body:
+        if isinstance(item, ast.Assign):
+            for tgt in item.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return item.value
+        elif isinstance(item, ast.AnnAssign):
+            if isinstance(item.target, ast.Name) and \
+                    item.target.id == name and item.value is not None:
+                return item.value
+    return None
+
+
 def class_decl(cls: ast.ClassDef, name: str) -> Optional[ast.AST]:
     """The value expression of a class-level `name = <literal>`
     declaration, else None."""

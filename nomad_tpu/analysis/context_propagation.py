@@ -1,0 +1,305 @@
+"""context-propagation: reserved RPC-args keys survive every forward.
+
+Request-scoped context rides RPC args dicts in underscore-prefixed
+reserved keys (trace context, deadline budget, read classification,
+hop guard).  The failure mode is silent: a site that re-constructs,
+copies, or filters an args dict on a forwarding path drops a key and
+the request runs untraced / unbounded / unclassified on the far side.
+
+`nomad_tpu/rpc/reserved.py` declares the contract as module-level
+literals this checker parses from the AST (never imported):
+
+    _RESERVED_KEYS      key -> one-line meaning (the key universe)
+    _THREAD_KEYS        keys `restamp()` recovers from thread-locals
+    _FORWARDING_SITES   qualname -> (kind, keys the site must stamp);
+                        "origin" sites build fresh args and must cover
+                        every thread-recoverable key
+    _ALLOWED_STRIPS     (site, key) pairs where a pop is deliberate
+                        consumption
+    _WIRE_HEADERS       HTTP header spelling -> key (stamping the
+                        header is stamping the key)
+
+Findings: a declared site that does not exist; a site missing a stamp
+of a declared key (a dict-store of the key or its module-level alias
+constant, a `restamp(...)` call for thread keys, or a wire-header
+stamp); an "origin" declaration not covering the thread keys; a
+pop/del of a reserved key at a site that is neither an allowed strip
+nor re-stamped later in the same function; an underscore-prefixed key
+stamped or popped at a site but absent from the registry; a filtered
+dict-comprehension rebuild inside a site; a pop/del of a reserved key
+elsewhere in a module that hosts a site; a registered key that never
+occurs outside the registry (dead key).
+
+Suppress with `# analysis: allow(context-propagation) — reason`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, FuncInfo, SourceFile, call_name, dotted,
+    enclosing_def_line, index_functions, literal_strs, module_decl,
+)
+
+CHECKER = "context-propagation"
+
+
+def _find_registry(corpus: Corpus) -> Optional[SourceFile]:
+    for sf in corpus.py:
+        if isinstance(module_decl(sf, "_RESERVED_KEYS"), ast.Dict):
+            return sf
+    return None
+
+
+def _reserved_keys(sf: SourceFile) -> Dict[str, int]:
+    """key -> declaration line from the _RESERVED_KEYS dict literal."""
+    out: Dict[str, int] = {}
+    decl = module_decl(sf, "_RESERVED_KEYS")
+    if isinstance(decl, ast.Dict):
+        for k in decl.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out[k.value] = k.lineno
+    return out
+
+
+def _sites(sf: SourceFile) -> Dict[str, Tuple[str, Tuple[str, ...], int]]:
+    """qualname -> (kind, required keys, declaration line)."""
+    out: Dict[str, Tuple[str, Tuple[str, ...], int]] = {}
+    decl = module_decl(sf, "_FORWARDING_SITES")
+    if not isinstance(decl, ast.Dict):
+        return out
+    for k, v in zip(decl.keys, decl.values):
+        if not (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                and isinstance(v, (ast.Tuple, ast.List)) and v.elts):
+            continue
+        kind = v.elts[0].value \
+            if isinstance(v.elts[0], ast.Constant) else "forward"
+        keys = tuple(sorted(literal_strs(v.elts[1]))) \
+            if len(v.elts) > 1 else ()
+        out[k.value] = (str(kind), keys, k.lineno)
+    return out
+
+
+def _strips(sf: SourceFile) -> Dict[str, Set[str]]:
+    out: Dict[str, Set[str]] = {}
+    decl = module_decl(sf, "_ALLOWED_STRIPS")
+    if isinstance(decl, ast.Dict):
+        for k, v in zip(decl.keys, decl.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out[k.value] = literal_strs(v)
+    return out
+
+
+def _wire_headers(sf: SourceFile) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    decl = module_decl(sf, "_WIRE_HEADERS")
+    if isinstance(decl, ast.Dict):
+        for k, v in zip(decl.keys, decl.values):
+            if isinstance(k, ast.Constant) and isinstance(v, ast.Constant):
+                out[str(k.value)] = str(v.value)
+    return out
+
+
+def _key_aliases(corpus: Corpus, reserved: Dict[str, int]) -> Dict[str, str]:
+    """Module-level `TRACE_KEY = "_trace"`-style constants, corpus-wide:
+    alias name -> reserved key.  Stamping `args[deadline.DEADLINE_KEY]`
+    is stamping `_deadline`."""
+    out: Dict[str, str] = {}
+    for sf in corpus.py:
+        for item in sf.tree.body:
+            if isinstance(item, ast.Assign) and \
+                    isinstance(item.value, ast.Constant) and \
+                    isinstance(item.value.value, str) and \
+                    item.value.value in reserved:
+                for t in item.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = item.value.value
+    return out
+
+
+def _key_of(expr: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """A dict key expression resolved to its string: a literal, or a
+    Name/Attribute whose last component is a known key constant."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    d = dotted(expr)
+    if d is not None:
+        return aliases.get(d.split(".")[-1])
+    return None
+
+
+def _scan_fn(fi: FuncInfo, aliases: Dict[str, str],
+             wire: Dict[str, str]):
+    """(stores, pops, restamp_lines, header_stamps, filtered_comps) —
+    each a list of (key, line) except restamp_lines/filtered_comps."""
+    stores: List[Tuple[str, int]] = []
+    pops: List[Tuple[str, int]] = []
+    restamps: List[int] = []
+    headers: List[Tuple[str, int]] = []
+    comps: List[int] = []
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    key = _key_of(tgt.slice, aliases)
+                    if key is not None:
+                        stores.append((key, node.lineno))
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    key = _key_of(tgt.slice, aliases)
+                    if key is not None:
+                        pops.append((key, node.lineno))
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "pop" \
+                    and node.args:
+                key = _key_of(node.args[0], aliases)
+                if key is not None:
+                    pops.append((key, node.lineno))
+            elif call_name(node) == "restamp":
+                restamps.append(node.lineno)
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and arg.value in wire:
+                    headers.append((wire[arg.value], node.lineno))
+        elif isinstance(node, ast.DictComp) and \
+                any(gen.ifs for gen in node.generators):
+            comps.append(node.lineno)
+    return stores, pops, restamps, headers, comps
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    reg_sf = _find_registry(corpus)
+    if reg_sf is None:
+        return findings
+    reserved = _reserved_keys(reg_sf)
+    thread_keys = literal_strs(module_decl(reg_sf, "_THREAD_KEYS") or
+                               ast.Tuple(elts=[], ctx=ast.Load()))
+    sites = _sites(reg_sf)
+    strips = _strips(reg_sf)
+    wire = _wire_headers(reg_sf)
+    aliases = _key_aliases(corpus, reserved)
+    index = index_functions(corpus.py)
+
+    site_files: Dict[str, List[Tuple[int, int]]] = {}  # rel -> fn spans
+
+    for qualname, (kind, req_keys, decl_line) in sorted(sites.items()):
+        bare = qualname.split(".")[-1]
+        matches = [fi for fi in index.get(bare, ())
+                   if fi.qualname == qualname]
+        if not matches:
+            if not reg_sf.allowed(CHECKER, decl_line):
+                findings.append(Finding(
+                    CHECKER, reg_sf.rel, decl_line,
+                    f"declared forwarding site `{qualname}` does not "
+                    f"exist in the corpus (dead declaration)"))
+            continue
+        if kind == "origin" and not set(req_keys) >= set(thread_keys):
+            if not reg_sf.allowed(CHECKER, decl_line):
+                undeclared = sorted(set(thread_keys) - set(req_keys))
+                findings.append(Finding(
+                    CHECKER, reg_sf.rel, decl_line,
+                    f"origin site `{qualname}` must declare every "
+                    f"thread-recoverable key; missing "
+                    f"{', '.join(undeclared)}"))
+        for fi in matches:
+            sf = fi.sf
+            end = getattr(fi.node, "end_lineno", fi.node.lineno)
+            site_files.setdefault(sf.rel, []).append(
+                (fi.node.lineno, end))
+            stores, pops, restamps, headers, comps = \
+                _scan_fn(fi, aliases, wire)
+            stamped = {k for k, _ in stores} | {k for k, _ in headers}
+            if restamps:
+                stamped |= set(thread_keys)
+            missing = [k for k in req_keys if k not in stamped]
+            if missing and not sf.allowed(CHECKER, fi.node.lineno):
+                findings.append(Finding(
+                    CHECKER, sf.rel, fi.node.lineno,
+                    f"forwarding site `{qualname}` never stamps "
+                    f"{', '.join(missing)} (declared in "
+                    f"{reg_sf.rel} _FORWARDING_SITES)"))
+            for key, line in pops:
+                if key not in reserved:
+                    continue
+                if key in strips.get(qualname, set()):
+                    continue
+                if any(k == key and ln > line for k, ln in stores):
+                    continue  # pop-then-restore (the hop counter)
+                if key in thread_keys and any(ln > line
+                                              for ln in restamps):
+                    continue
+                if sf.allowed(CHECKER, line,
+                              enclosing_def_line(sf, line)):
+                    continue
+                findings.append(Finding(
+                    CHECKER, sf.rel, line,
+                    f"site `{qualname}` strips reserved key `{key}` "
+                    f"without an _ALLOWED_STRIPS entry or a later "
+                    f"re-stamp"))
+            for key, line in stores + pops:
+                if key.startswith("_") and key not in reserved and \
+                        not sf.allowed(CHECKER, line,
+                                       enclosing_def_line(sf, line)):
+                    findings.append(Finding(
+                        CHECKER, sf.rel, line,
+                        f"site `{qualname}` handles underscore key "
+                        f"`{key}` that is not in _RESERVED_KEYS"))
+            for line in comps:
+                if not sf.allowed(CHECKER, line,
+                                  enclosing_def_line(sf, line)):
+                    findings.append(Finding(
+                        CHECKER, sf.rel, line,
+                        f"site `{qualname}` rebuilds a dict through a "
+                        f"filtered comprehension — reserved keys may "
+                        f"be dropped wholesale"))
+
+    # modules hosting a site: a reserved-key pop/del in ANY other
+    # function there is a propagation hazard (the site's contract can
+    # be bypassed by a helper that strips first)
+    for sf in corpus.py:
+        spans = site_files.get(sf.rel)
+        if not spans or sf is reg_sf:
+            continue
+        for node in ast.walk(sf.tree):
+            key = line = None
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "pop" and node.args:
+                key, line = _key_of(node.args[0], aliases), node.lineno
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        key = _key_of(tgt.slice, aliases)
+                        line = node.lineno
+            if key is None or key not in reserved or line is None:
+                continue
+            if any(lo <= line <= hi for lo, hi in spans):
+                continue  # inside a declared site: judged above
+            if sf.allowed(CHECKER, line, enclosing_def_line(sf, line)):
+                continue
+            findings.append(Finding(
+                CHECKER, sf.rel, line,
+                f"reserved key `{key}` stripped outside any declared "
+                f"forwarding site in a module that hosts one"))
+
+    # dead keys: registered but never spelled anywhere else
+    for key, line in sorted(reserved.items()):
+        alive = False
+        for sf in corpus.py:
+            if sf is reg_sf:
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Constant) and node.value == key:
+                    alive = True
+                    break
+            if alive:
+                break
+        if not alive and not reg_sf.allowed(CHECKER, line):
+            findings.append(Finding(
+                CHECKER, reg_sf.rel, line,
+                f"reserved key `{key}` is registered but never used "
+                f"outside the registry (dead key)"))
+    return findings

@@ -1,0 +1,204 @@
+"""deadline-coverage: every wait in the serving cone honors the budget.
+
+End-to-end deadlines only work if every queueing/parking stage between
+ingress and reply consults the bound budget: one timeout-less
+`future.result()` or `cv.wait()` on the request path and a 500ms
+deadline request can park for 30s.  The deadline module declares the
+contract as module-level literals this checker parses from the AST:
+
+    _DEADLINE_STAGES    the closed set of stage names; `check(stage)`
+                        counts `deadline.expired.<stage>`, so this
+                        tuple IS the telemetry namespace
+    _SERVING_ROOTS      request-ingress qualnames (fnmatch patterns)
+                        seeding the reachability cone
+    _SERVING_MODULES    modules whose reached functions are judged
+                        (the cone also crosses helper modules whose
+                        waits are not request-scoped; those stay out)
+
+Findings:
+
+    D1  `deadline.check/expire(<non-literal>)` — stages must be
+        spellable or the counter namespace drifts silently
+    D2  a stage literal not declared in _DEADLINE_STAGES
+    D3  a declared stage no call site ever checks/expires (dead stage:
+        its `deadline.expired.<stage>` counter can never fire)
+    D4  a blocking primitive (`.result()`, `.wait()`,
+        `.wait_for_index()`, `.wait_for()`, a timeout-less `.get()`,
+        `sleep` inside a retry loop) in a function reachable from a
+        serving root, inside a serving module, whose enclosing
+        function never consults the deadline (check/expire/remaining/
+        current/expired)
+
+The cone walk shares walk_cone's over-approximation (bare-name edges,
+import-filtered); handlers dispatched purely via getattr — the HTTP
+`_h_*` table — are reached through their dispatcher roots, not by
+name.  Suppress with `# analysis: allow(deadline-coverage) — reason`.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, List, Optional, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, SourceFile, dotted, enclosing_def_line,
+    index_functions, literal_strs, module_decl, walk_cone,
+)
+
+CHECKER = "deadline-coverage"
+
+_STAGE_CALLS = {"check", "expire"}
+_CONSULT_CALLS = {"check", "expire", "remaining", "current", "expired"}
+_BLOCKING_ATTRS = {"result", "wait", "wait_for_index", "wait_for"}
+
+
+def _find_decl(corpus: Corpus) -> Optional[SourceFile]:
+    for sf in corpus.py:
+        if module_decl(sf, "_DEADLINE_STAGES") is not None:
+            return sf
+    return None
+
+
+def _stage_entries(sf: SourceFile) -> List[Tuple[str, int]]:
+    """(stage, declaration line) in declaration order."""
+    decl = module_decl(sf, "_DEADLINE_STAGES")
+    out: List[Tuple[str, int]] = []
+    if isinstance(decl, (ast.Tuple, ast.List, ast.Set)):
+        for elt in decl.elts:
+            if isinstance(elt, ast.Constant) and \
+                    isinstance(elt.value, str):
+                out.append((elt.value, elt.lineno))
+    return out
+
+
+def _deadline_attr(node: ast.Call) -> Optional[str]:
+    """The method name when `node` is a call on a deadline-ish base
+    (`deadline.check(...)`, `request_deadline.remaining()`)."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        base = dotted(f.value)
+        if base is not None and base.split(".")[-1].endswith("deadline"):
+            return f.attr
+    return None
+
+
+def _loop_spans(fn_node: ast.AST) -> List[Tuple[int, int]]:
+    return [(n.lineno, getattr(n, "end_lineno", n.lineno))
+            for n in ast.walk(fn_node)
+            if isinstance(n, (ast.While, ast.For, ast.AsyncFor))]
+
+
+def _blocking_sites(fn_node: ast.AST) -> List[Tuple[int, str]]:
+    """(line, description) of every blocking primitive in the body."""
+    out: List[Tuple[int, str]] = []
+    loops = _loop_spans(fn_node)
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _BLOCKING_ATTRS and \
+                    _deadline_attr(node) is None:
+                out.append((node.lineno, f".{f.attr}(...)"))
+                continue
+            if f.attr == "get" and not node.args and not node.keywords:
+                out.append((node.lineno, "timeout-less .get()"))
+                continue
+        name = dotted(f)
+        if name is not None and name.split(".")[-1] == "sleep" and \
+                any(lo <= node.lineno <= hi for lo, hi in loops):
+            out.append((node.lineno, "sleep inside a retry loop"))
+    return out
+
+
+def _consults_deadline(fn_node: ast.AST) -> bool:
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call) and \
+                _deadline_attr(node) in _CONSULT_CALLS:
+            return True
+    return False
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    decl_sf = _find_decl(corpus)
+    if decl_sf is None:
+        return findings
+    stages = _stage_entries(decl_sf)
+    declared: Dict[str, int] = dict(stages)
+    roots = sorted(literal_strs(
+        module_decl(decl_sf, "_SERVING_ROOTS") or ast.Constant(value=0)))
+    modules = literal_strs(
+        module_decl(decl_sf, "_SERVING_MODULES") or ast.Constant(value=0))
+
+    # D1/D2 + stage usage, corpus-wide (the declaring module is the
+    # implementation — its internal forwarding of the `stage` argument
+    # is not a call site)
+    used: Set[str] = set()
+    for sf in corpus.py:
+        if sf is decl_sf:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call) or \
+                    _deadline_attr(node) not in _STAGE_CALLS:
+                continue
+            line = node.lineno
+            arg = node.args[0] if node.args else None
+            if not (isinstance(arg, ast.Constant) and
+                    isinstance(arg.value, str)):
+                if not sf.allowed(CHECKER, line,
+                                  enclosing_def_line(sf, line)):
+                    findings.append(Finding(
+                        CHECKER, sf.rel, line,
+                        "deadline stage must be a string literal (the "
+                        "deadline.expired.<stage> counter namespace "
+                        "is closed)"))
+                continue
+            used.add(arg.value)
+            if arg.value not in declared and \
+                    not sf.allowed(CHECKER, line,
+                                   enclosing_def_line(sf, line)):
+                findings.append(Finding(
+                    CHECKER, sf.rel, line,
+                    f"deadline stage `{arg.value}` is not declared in "
+                    f"{decl_sf.rel} _DEADLINE_STAGES"))
+
+    # D3: dead stages
+    for stage, line in stages:
+        if stage not in used and not decl_sf.allowed(CHECKER, line):
+            findings.append(Finding(
+                CHECKER, decl_sf.rel, line,
+                f"declared stage `{stage}` is never checked/expired "
+                f"anywhere (its deadline.expired.{stage} counter can "
+                f"never fire)"))
+
+    # D4: blocking primitives in the request-serving cone
+    if not roots or not modules:
+        return findings
+    index = index_functions(corpus.py)
+    seeds = []
+    seen_keys: Set[str] = set()
+    for infos in index.values():
+        for fi in infos:
+            if fi.key not in seen_keys and \
+                    any(fnmatch.fnmatchcase(fi.qualname, pat)
+                        for pat in roots):
+                seen_keys.add(fi.key)
+                seeds.append(fi)
+    for fi, chain in walk_cone(index, seeds, CHECKER):
+        if fi.sf.module not in modules:
+            continue
+        sites = _blocking_sites(fi.node)
+        if not sites or _consults_deadline(fi.node):
+            continue
+        sf = fi.sf
+        for line, desc in sites:
+            if sf.allowed(CHECKER, line, enclosing_def_line(sf, line),
+                          fi.node.lineno):
+                continue
+            findings.append(Finding(
+                CHECKER, sf.rel, line,
+                f"`{fi.qualname}` blocks on {desc} in the "
+                f"request-serving cone without ever consulting the "
+                f"deadline", chain=chain))
+    return findings

@@ -1,0 +1,217 @@
+"""donation-safety: donated device buffers are never touched again.
+
+`donate_argnums` aliases an input buffer into the kernel's output: the
+moment the dispatch is issued the Python reference is a dangling
+handle, and reading it raises (best case) or silently serves deleted
+memory on some backends (worst).  The repo's donated-carry protocol
+(`world.loan_basis()` -> dispatch -> `world.adopt_basis(carry)` /
+`world.invalidate_basis()` on failure) makes the ownership transfer
+explicit; this checker makes the protocol mechanical:
+
+    G1  every `donate_argnums` jit site (an `x = jax.jit(...,
+        donate_argnums=...)` assignment — possibly behind an IfExp
+        donate toggle — or a decorated def, incl.
+        `@partial(jax.jit, ..., donate_argnums=...)`) must be declared
+        in its module's `_DONATE_PROTOCOL` dict (name -> one-line
+        loan/adopt contract); a protocol entry naming no site is a
+        dead declaration
+    G2  after `x = <world>.loan_basis()` the loaned name (and local
+        aliases, `basis_dev = x`) must reach `adopt_basis(...)` or
+        `invalidate_basis()` in the same function, and must not be
+        READ between the donating dispatch (the first call taking the
+        loaned name as an argument) and that adopt/invalidate
+    G3  assigning a loaned name into a subscript/attribute target
+        (`cache[k] = loaned`, `self.basis = loaned`) aliases a
+        to-be-donated buffer into a longer-lived structure — a
+        use-after-donate waiting for the next dispatch
+
+All static, AST-only (the CI analysis leg runs before pip install).
+Suppress with `# analysis: allow(donation-safety) — reason`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, SourceFile, dotted, enclosing_def_line,
+    module_decl,
+)
+
+CHECKER = "donation-safety"
+
+_JIT = {"jax.jit", "jit"}
+_PARTIAL = {"functools.partial", "partial"}
+_ADOPT = {"adopt_basis", "invalidate_basis"}
+
+
+def _donating_jit(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and dotted(expr.func) in _JIT and \
+        any(kw.arg == "donate_argnums" for kw in expr.keywords)
+
+
+def _donate_sites(sf: SourceFile) -> List[Tuple[str, int]]:
+    """(bound name, line) of every donate_argnums jit site."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign):
+            v = node.value
+            cands = [v.body, v.orelse] if isinstance(v, ast.IfExp) else [v]
+            if any(_donating_jit(c) for c in cands):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.append((t.id, node.lineno))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                donating = _donating_jit(dec) or (
+                    dotted(dec.func) in _PARTIAL and dec.args and
+                    dotted(dec.args[0]) in _JIT and
+                    any(kw.arg == "donate_argnums"
+                        for kw in dec.keywords))
+                if donating:
+                    out.append((node.name, node.lineno))
+                    break
+    return out
+
+
+def _protocol_entries(sf: SourceFile) -> Dict[str, int]:
+    """declared site name -> declaration line from _DONATE_PROTOCOL."""
+    out: Dict[str, int] = {}
+    decl = module_decl(sf, "_DONATE_PROTOCOL")
+    if isinstance(decl, ast.Dict):
+        for k in decl.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out[k.value] = k.lineno
+    return out
+
+
+def _loan_flows(fn_node: ast.AST) -> List[Tuple[Set[str], int]]:
+    """(loaned names incl. aliases, loan line) per loan in the body."""
+    loans: List[Tuple[str, int]] = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call) and \
+                isinstance(node.value.func, ast.Attribute) and \
+                node.value.func.attr == "loan_basis":
+            loans.append((node.targets[0].id, node.lineno))
+    out: List[Tuple[Set[str], int]] = []
+    for name, line in loans:
+        names = {name}
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn_node):
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1 and \
+                        isinstance(node.targets[0], ast.Name) and \
+                        isinstance(node.value, ast.Name) and \
+                        node.value.id in names and \
+                        node.lineno > line and \
+                        node.targets[0].id not in names:
+                    names.add(node.targets[0].id)
+                    changed = True
+        out.append((names, line))
+    return out
+
+
+def _call_uses(call: ast.Call, names: Set[str]) -> bool:
+    for arg in call.args:
+        if isinstance(arg, ast.Name) and arg.id in names:
+            return True
+        if isinstance(arg, ast.Starred):
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name) and sub.id in names:
+                    return True
+    return False
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in corpus.py:
+        # ---- G1: declared protocol for every donate_argnums jit
+        sites = _donate_sites(sf)
+        protocol = _protocol_entries(sf)
+        site_names = {name for name, _ in sites}
+        for name, line in sites:
+            if name in protocol:
+                continue
+            if sf.allowed(CHECKER, line, enclosing_def_line(sf, line)):
+                continue
+            findings.append(Finding(
+                CHECKER, sf.rel, line,
+                f"donate_argnums jit `{name}` has no _DONATE_PROTOCOL "
+                f"entry declaring its loan/adopt contract"))
+        for name, line in sorted(protocol.items()):
+            if name not in site_names and not sf.allowed(CHECKER, line):
+                findings.append(Finding(
+                    CHECKER, sf.rel, line,
+                    f"_DONATE_PROTOCOL entry `{name}` names no "
+                    f"donate_argnums jit site in this module (dead "
+                    f"declaration)"))
+
+        # ---- G2/G3: loan dataflow per function
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for names, loan_line in _loan_flows(node):
+                calls = sorted(
+                    (c for c in ast.walk(node)
+                     if isinstance(c, ast.Call) and c.lineno > loan_line),
+                    key=lambda c: c.lineno)
+                adopt_line = None
+                dispatch_end = None
+                for c in calls:
+                    if isinstance(c.func, ast.Attribute) and \
+                            c.func.attr in _ADOPT:
+                        if adopt_line is None:
+                            adopt_line = c.lineno
+                    elif dispatch_end is None and _call_uses(c, names):
+                        dispatch_end = getattr(c, "end_lineno", c.lineno)
+                if adopt_line is None:
+                    if not sf.allowed(CHECKER, loan_line,
+                                      enclosing_def_line(sf, loan_line)):
+                        findings.append(Finding(
+                            CHECKER, sf.rel, loan_line,
+                            f"`{node.name}` takes loan_basis() but "
+                            f"never adopt_basis(...) or "
+                            f"invalidate_basis() — the resident basis "
+                            f"is left dangling after the donated "
+                            f"dispatch"))
+                    continue
+                if dispatch_end is not None:
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Name) and \
+                                isinstance(sub.ctx, ast.Load) and \
+                                sub.id in names and \
+                                dispatch_end < sub.lineno < adopt_line \
+                                and not sf.allowed(
+                                    CHECKER, sub.lineno,
+                                    enclosing_def_line(sf, sub.lineno)):
+                            findings.append(Finding(
+                                CHECKER, sf.rel, sub.lineno,
+                                f"`{sub.id}` read after the donating "
+                                f"dispatch and before "
+                                f"adopt/invalidate — the buffer was "
+                                f"donated and may already be deleted"))
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and \
+                            isinstance(sub.value, ast.Name) and \
+                            sub.value.id in names and \
+                            sub.lineno > loan_line and \
+                            any(isinstance(t, (ast.Subscript,
+                                               ast.Attribute))
+                                for t in sub.targets) and \
+                            not sf.allowed(
+                                CHECKER, sub.lineno,
+                                enclosing_def_line(sf, sub.lineno)):
+                        findings.append(Finding(
+                            CHECKER, sf.rel, sub.lineno,
+                            f"loaned buffer `{sub.value.id}` aliased "
+                            f"into a longer-lived structure — it will "
+                            f"dangle once the donating dispatch "
+                            f"consumes it"))
+    return findings

@@ -1,0 +1,187 @@
+"""knob-registry: every NOMAD_TPU_* env knob is declared exactly once.
+
+`nomad_tpu/knobs.py` (marked `_KNOB_REGISTRY = True`) is the single
+registry: name, default, type, one-line doc for every environment knob
+the runtime consults, read through the typed accessors
+(`knobs.get_str/get_int/get_float/get_bool/override`).  Scattered
+`os.environ.get("NOMAD_TPU_...")` reads are how knobs rot: defaults
+drift between call sites, dead knobs linger in READMEs, live knobs
+never make it in.
+
+Four rules, all static (this module never imports the registry — it
+parses the `KNOBS` dict literal from the AST, so the CI analysis leg
+lints before pip install):
+
+    R1  a direct environ read/write of a `NOMAD_TPU_*` literal outside
+        the registry file (environ.get/pop/setdefault, os.getenv,
+        subscripting os.environ or a local alias of it)
+    R2  an accessor call whose literal knob name is not registered
+        (it would KeyError at runtime; the finding is earlier)
+    R3  a registered knob never read through an accessor anywhere
+        outside the registry (dead entry)
+    R4  a registered knob missing from the root README.md (skipped
+        when the analyzed tree has no README, so fixture corpora and
+        bare package roots stay clean)
+
+Suppress with `# analysis: allow(knob-registry) — reason` on the
+finding line, the enclosing def line, or (for R3/R4) the registry
+entry's own line.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from nomad_tpu.analysis.common import (
+    Corpus, Finding, SourceFile, dotted, enclosing_def_line, module_decl,
+)
+
+CHECKER = "knob-registry"
+
+_PREFIX = "NOMAD_TPU_"
+_ACCESSORS = {"get_str", "get_int", "get_float", "get_bool", "override"}
+_ENV_METHODS = {"get", "pop", "setdefault"}
+
+
+def _find_registry(corpus: Corpus) -> Optional[SourceFile]:
+    for sf in corpus.py:
+        marker = module_decl(sf, "_KNOB_REGISTRY")
+        if isinstance(marker, ast.Constant) and marker.value is True:
+            return sf
+    return None
+
+
+def _registry_entries(sf: SourceFile) -> Dict[str, int]:
+    """knob name -> declaration line, from the KNOBS dict literal."""
+    out: Dict[str, int] = {}
+    decl = module_decl(sf, "KNOBS")
+    if isinstance(decl, ast.Dict):
+        for k in decl.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                out[k.value] = k.lineno
+    return out
+
+
+def _environ_aliases(sf: SourceFile) -> Set[str]:
+    """Local names bound to os.environ anywhere in the file
+    (`env = os.environ` makes `env.get(...)` an environ read)."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and \
+                dotted(node.value) == "os.environ":
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _is_environ(expr: ast.AST, aliases: Set[str]) -> bool:
+    d = dotted(expr)
+    if d is None:
+        return False
+    return d.split(".")[-1] == "environ" or d in aliases
+
+
+def _literal_arg(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _imports_knobs(sf: SourceFile) -> bool:
+    return any(imp == "nomad_tpu.knobs" or
+               imp.startswith("nomad_tpu.knobs.") for imp in sf.imports)
+
+
+def run(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    reg_sf = _find_registry(corpus)
+    entries = _registry_entries(reg_sf) if reg_sf is not None else {}
+    used: Set[str] = set()
+
+    for sf in corpus.py:
+        is_registry = sf is reg_sf
+        aliases = _environ_aliases(sf)
+        for node in ast.walk(sf.tree):
+            # ---- R1: raw environ access of a NOMAD_TPU_* literal
+            if not is_registry:
+                name = None
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if isinstance(f, ast.Attribute) and \
+                            f.attr in _ENV_METHODS and \
+                            _is_environ(f.value, aliases):
+                        name = _literal_arg(node)
+                    elif dotted(f) in ("os.getenv", "getenv"):
+                        name = _literal_arg(node)
+                elif isinstance(node, ast.Subscript) and \
+                        _is_environ(node.value, aliases) and \
+                        isinstance(node.slice, ast.Constant) and \
+                        isinstance(node.slice.value, str):
+                    name = node.slice.value
+                if name is not None and name.startswith(_PREFIX):
+                    line = node.lineno
+                    if not sf.allowed(CHECKER, line,
+                                      enclosing_def_line(sf, line)):
+                        where = "no knob registry module found" \
+                            if reg_sf is None else \
+                            f"the registry is {reg_sf.rel}"
+                        findings.append(Finding(
+                            CHECKER, sf.rel, line,
+                            f"raw environ access of `{name}` outside "
+                            f"the knob registry ({where}); read it "
+                            f"through nomad_tpu.knobs accessors"))
+            # ---- R2 + usage collection: typed accessor calls
+            if isinstance(node, ast.Call):
+                f = node.func
+                acc = None
+                if isinstance(f, ast.Attribute) and f.attr in _ACCESSORS \
+                        and (dotted(f.value) or
+                             "").split(".")[-1] == "knobs":
+                    acc = f.attr
+                elif isinstance(f, ast.Name) and f.id in _ACCESSORS and \
+                        _imports_knobs(sf):
+                    acc = f.id
+                if acc is None:
+                    continue
+                name = _literal_arg(node)
+                if name is None:
+                    continue
+                if reg_sf is not None and name not in entries:
+                    line = node.lineno
+                    if not sf.allowed(CHECKER, line,
+                                      enclosing_def_line(sf, line)):
+                        findings.append(Finding(
+                            CHECKER, sf.rel, line,
+                            f"knobs.{acc}({name!r}) reads an "
+                            f"unregistered knob (not declared in "
+                            f"{reg_sf.rel} KNOBS)"))
+                elif not is_registry:
+                    used.add(name)
+
+    if reg_sf is not None:
+        # ---- R3: dead registry entries
+        for name, line in sorted(entries.items()):
+            if name not in used and not reg_sf.allowed(CHECKER, line):
+                findings.append(Finding(
+                    CHECKER, reg_sf.rel, line,
+                    f"registered knob `{name}` is never read through "
+                    f"an accessor outside the registry (dead entry)"))
+        # ---- R4: README coverage
+        readme = corpus.root / "README.md"
+        if readme.is_file():
+            try:
+                text = readme.read_text()
+            except (OSError, UnicodeDecodeError):
+                text = None
+            if text is not None:
+                for name, line in sorted(entries.items()):
+                    if name not in text and \
+                            not reg_sf.allowed(CHECKER, line):
+                        findings.append(Finding(
+                            CHECKER, reg_sf.rel, line,
+                            f"registered knob `{name}` is not "
+                            f"documented in README.md (regenerate the "
+                            f"knob table: python -m nomad_tpu.knobs)"))
+    return findings

@@ -109,7 +109,7 @@ class ApiClient:
         if qs:
             # some section helpers bake a query string into `path`
             url += ("&" if "?" in path else "?") + urllib.parse.urlencode(
-                {k: v for k, v in qs.items() if v is not None})
+                {k: v for k, v in qs.items() if v is not None})  # analysis: allow(context-propagation) — qs is the URL query string, not an RPC args dict; the deadline rides X-Nomad-Deadline per attempt
         data = None
         if body is not None:
             data = json.dumps(body).encode()

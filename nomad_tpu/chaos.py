@@ -183,6 +183,8 @@ import time
 from collections import defaultdict
 from typing import Dict, Optional, Tuple
 
+from nomad_tpu import knobs
+
 FAULT_POINTS = (
     "rpc.drop",
     "rpc.delay",
@@ -463,6 +465,6 @@ def maybe_delay(point: str = "rpc.delay") -> None:
         time.sleep(reg.delay_ms / 1000.0)   # analysis: allow(wait-graph) — chaos fault injection sleeps on purpose
 
 
-_env_spec = os.environ.get("NOMAD_TPU_CHAOS", "")
+_env_spec = knobs.get_str("NOMAD_TPU_CHAOS")
 if _env_spec:
     active = ChaosRegistry.from_spec(_env_spec)

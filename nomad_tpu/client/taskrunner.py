@@ -21,6 +21,7 @@ from nomad_tpu.client.drivers import (
     ExitResult,
     TaskHandle,
 )
+from nomad_tpu import knobs
 from nomad_tpu.client.taskenv import build_task_env, interpolate
 from nomad_tpu.structs import RestartPolicy
 from nomad_tpu.structs.alloc import TaskState
@@ -509,7 +510,7 @@ class TaskRunner:
             self._tmpl_thread.start()
 
     def _template_watch_loop(self, task_dir: str) -> None:
-        poll = float(os.environ.get("NOMAD_TPU_TEMPLATE_POLL_S", "0.5"))
+        poll = knobs.get_float("NOMAD_TPU_TEMPLATE_POLL_S")
         while not self._kill.wait(poll):
             if self.state.state == "dead":
                 return                               # task is gone

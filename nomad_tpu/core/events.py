@@ -27,16 +27,17 @@ import time as _time
 from collections import deque
 from typing import Dict, List, Optional
 
+from nomad_tpu import knobs
 from nomad_tpu.analysis import race
 from nomad_tpu.telemetry import global_metrics
 
 
 def _default_sub_queue() -> int:
-    return max(2, int(os.environ.get("NOMAD_TPU_SUB_QUEUE", "1024")))
+    return max(2, knobs.get_int("NOMAD_TPU_SUB_QUEUE"))
 
 
 def _default_buffer() -> int:
-    return max(8, int(os.environ.get("NOMAD_TPU_EVENT_BUFFER", "256")))
+    return max(8, knobs.get_int("NOMAD_TPU_EVENT_BUFFER"))
 
 
 class Event:

@@ -23,7 +23,7 @@ import threading
 import time as _time
 from typing import Dict, Optional, Set, Tuple
 
-from nomad_tpu import chaos
+from nomad_tpu import chaos, knobs
 from nomad_tpu.structs.node import NodeStatus
 from nomad_tpu.telemetry import global_metrics
 
@@ -186,8 +186,8 @@ class HeartbeatBatcher:
         # (bypassing the chaos stall-skip) instead of growing without
         # limit — a stalled flusher plus a churn storm must cost O(cap)
         # memory, not O(storm)
-        self.pending_max = max(1, int(os.environ.get(
-            "NOMAD_TPU_HB_PENDING_MAX", "8192")))
+        self.pending_max = max(1, knobs.get_int(
+            "NOMAD_TPU_HB_PENDING_MAX"))
         self._force = threading.Event()
 
     def start(self) -> None:

@@ -35,7 +35,7 @@ import numpy as np
 
 from nomad_tpu.encode.matrixizer import comparable_vec, NUM_RESOURCE_DIMS
 
-from nomad_tpu import chaos, deadline, tracing
+from nomad_tpu import chaos, deadline, knobs, tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.state.store import AppliedPlanResults, StateStore
 from nomad_tpu.structs import Allocation, Node
@@ -71,8 +71,7 @@ class PlanApplier:
         # and the wave-aligned dequeue front (EvalWaveFeeder) lands a
         # whole worker pool's plans nearly at once — size the commit
         # batch to swallow a full wave in one raft apply
-        self.batch_n = max(1, int(os.environ.get(
-            "NOMAD_TPU_PLAN_BATCH", "64")))
+        self.batch_n = max(1, knobs.get_int("NOMAD_TPU_PLAN_BATCH"))
         # pipelining overlay: accepted-but-not-yet-committed plan effects,
         # keyed by plan eval token/id (reference plan_apply.go:71-178
         # evaluates plan N+1 against a snapshot with plan N applied while

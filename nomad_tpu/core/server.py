@@ -22,7 +22,7 @@ import time as _time
 import uuid
 from typing import Dict, List, Optional
 
-from nomad_tpu import tracing
+from nomad_tpu import knobs, tracing
 from nomad_tpu.core.blocked import BlockedEvals
 from nomad_tpu.core.broker import FAILED_QUEUE, EvalBroker
 from nomad_tpu.core.core_gc import CoreScheduler
@@ -75,9 +75,9 @@ class ServerConfig:
         # flush cadence of the leader's heartbeat/node-status coalescer
         # (one NodeHeartbeatBatch raft entry per flush);
         # NOMAD_TPU_HEARTBEAT_BATCH_MS overrides
-        self.heartbeat_batch_interval = float(os.environ.get(
+        self.heartbeat_batch_interval = knobs.get_float(
             "NOMAD_TPU_HEARTBEAT_BATCH_MS",
-            heartbeat_batch_interval * 1000.0)) / 1000.0
+            default=heartbeat_batch_interval * 1000.0) / 1000.0
         self.gc_interval = gc_interval
         self.data_dir = data_dir
         self.region = region
@@ -147,7 +147,7 @@ class Server:
         self._established = False
         # deny-by-default token enforcement on HTTP/RPC mutation paths
         # (reference: `acl { enabled = true }` agent config)
-        if os.environ.get("NOMAD_TPU_ACL") == "1":
+        if knobs.get_bool("NOMAD_TPU_ACL"):
             self.acl_enabled = True
 
         self.fsm = NomadFSM(self.store, hooks=self)
@@ -200,14 +200,13 @@ class Server:
         # caught-up non-voters after a stabilization window and, when
         # gossip runs, adds ALIVE members / removes LEFT ones / reaps
         # FAILED ones out of the raft configuration
-        self._autopilot_interval = float(os.environ.get(
-            "NOMAD_TPU_AUTOPILOT_INTERVAL", "0.05"))
-        self._autopilot_stabilization = float(os.environ.get(
-            "NOMAD_TPU_AUTOPILOT_STABILIZATION", "0.25"))
-        self._autopilot_lag = int(os.environ.get(
-            "NOMAD_TPU_AUTOPILOT_LAG", "16"))
-        self._autopilot_reap_after = float(os.environ.get(
-            "NOMAD_TPU_AUTOPILOT_REAP_AFTER", "1.0"))
+        self._autopilot_interval = knobs.get_float(
+            "NOMAD_TPU_AUTOPILOT_INTERVAL")
+        self._autopilot_stabilization = knobs.get_float(
+            "NOMAD_TPU_AUTOPILOT_STABILIZATION")
+        self._autopilot_lag = knobs.get_int("NOMAD_TPU_AUTOPILOT_LAG")
+        self._autopilot_reap_after = knobs.get_float(
+            "NOMAD_TPU_AUTOPILOT_REAP_AFTER")
         self._nonvoter_since: Dict[str, float] = {}
         self._failed_since: Dict[str, float] = {}
 
@@ -255,7 +254,12 @@ class Server:
             # during a transition; forwarding would recurse into ourselves
             from nomad_tpu.rpc.endpoints import RpcError
             raise RpcError("no_leader", "no cluster leader")
-        return self._transport.call(self.name, f"rpc:{leader}", method, args)
+        # the transport hop leaves this thread: re-attach the sampled
+        # trace context and re-encode the remaining deadline budget so
+        # the leader inherits both (reserved-key contract, rpc/reserved)
+        from nomad_tpu.rpc import reserved
+        return self._transport.call(self.name, f"rpc:{leader}", method,
+                                    reserved.restamp(args))
 
     # ------------------------------------------------------------- reads
 
@@ -307,14 +311,14 @@ class Server:
         hints, bounded retry over remote churn, Unreachable fail-fast
         when the region is dark)."""
         # app-level forwards (job.region routing, leader handoffs) build
-        # fresh args: re-attach this thread's sampled trace context so
-        # the trace survives the hop like it does the _forward_hops path
-        if tracing.active is not None and tracing.TRACE_KEY not in args:
-            ctx = tracing.current()
-            if ctx is not None:
-                args = dict(args)
-                args[tracing.TRACE_KEY] = ctx
-        return self.region_router.route(region, method, args)
+        # fresh args: re-attach this thread's sampled trace context AND
+        # re-encode the remaining deadline budget so both survive the
+        # hop like they do the _forward_hops path (before restamp() the
+        # budget silently vanished here and the remote region served
+        # the request unbounded)
+        from nomad_tpu.rpc import reserved
+        return self.region_router.route(region, method,
+                                        reserved.restamp(args))
 
     def enqueue_plan(self, plan):
         """Plan-queue enqueue gated on the submitting worker still holding
@@ -411,8 +415,9 @@ class Server:
                 # pass drains a whole ready wave so the engine coalesces
                 # full-wave dispatch batches (NOMAD_TPU_WAVE caps it).
                 from nomad_tpu.core.broker import EvalWaveFeeder
-                wave_n = int(os.environ.get(
-                    "NOMAD_TPU_WAVE", str(self.config.num_schedulers)))
+                wave_n = knobs.get_int(
+                    "NOMAD_TPU_WAVE",
+                    default=self.config.num_schedulers)
                 self.eval_feeder = EvalWaveFeeder(self.broker, wave_n)
                 for i in range(self.config.num_schedulers):
                     w = Worker(self, i, self.config.enabled_schedulers)

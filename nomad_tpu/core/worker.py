@@ -18,7 +18,7 @@ import time
 from collections import deque
 from typing import List, Optional
 
-from nomad_tpu import chaos, tracing
+from nomad_tpu import chaos, knobs, tracing
 from nomad_tpu import deadline as request_deadline
 from nomad_tpu.core.plan_queue import LeadershipLostError
 from nomad_tpu.raft import NotLeaderError
@@ -62,8 +62,8 @@ class Worker:
         # schedules and dispatches on-device while commit(N) is durably
         # landing.  Depth bounds how many evals may be settle-deferred
         # at once; 0 restores strict blocking submits.
-        self.pipeline_depth = max(0, int(os.environ.get(
-            "NOMAD_TPU_PIPELINE_DEPTH", "2")))
+        self.pipeline_depth = max(0, knobs.get_int(
+            "NOMAD_TPU_PIPELINE_DEPTH"))
         # (ev, token, [PendingPlan]) awaiting durable commit, oldest first
         self._deferred = deque()
         self._eval_pendings: List = []

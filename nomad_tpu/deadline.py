@@ -28,11 +28,46 @@ import threading
 import time
 from typing import Optional
 
-from nomad_tpu import chaos
+from nomad_tpu import chaos, knobs
 from nomad_tpu.telemetry import global_metrics
 
 # reserved args key (stripped before dispatch, like tracing.TRACE_KEY)
 DEADLINE_KEY = "_deadline"
+
+# Every stage name that may appear in a `check(stage)` / `expire(stage)`
+# call — and therefore in a `deadline.expired.<stage>` counter.  The
+# deadline-coverage checker cross-checks both directions: a stage
+# checked but not declared is a finding (dashboards would miss the
+# counter), and a declared stage nothing checks is a dead stage.
+_DEADLINE_STAGES = (
+    "rpc",           # endpoint dispatch gate (Endpoints.handle)
+    "rpc.forward",   # cross-region forward refusal (Endpoints.handle)
+    "read_gate",     # consistency-gate establishment (read path)
+    "federation",    # region-router retry loop
+    "worker",        # scheduler worker RPC retry backoff
+    "applier",       # plan applier pre-raft rejection
+    "broker",        # eval broker dequeue park
+    "plan.submit",   # Plan.Submit applier-result wait
+)
+
+# Roots of the request-serving cone ("*" globs endpoint handlers) and
+# the modules whose blocking primitives inside that cone must consult
+# the deadline (check/expire/remaining/current) or carry an allow.
+_SERVING_ROOTS = (
+    "Endpoints.handle",
+    "Endpoints.rpc_*",
+    "RegionRouter.route",
+    "HTTPServer._route",
+    "HTTPServer._rpc",
+)
+_SERVING_MODULES = (
+    "nomad_tpu.rpc.endpoints",
+    "nomad_tpu.agent.http",
+    "nomad_tpu.federation.router",
+    "nomad_tpu.core.broker",
+    "nomad_tpu.core.worker",
+    "nomad_tpu.core.plan_apply",
+)
 
 _tls = threading.local()
 
@@ -85,12 +120,11 @@ def check(stage: str) -> bool:
 def default_budget() -> Optional[float]:
     """The ingress default budget (seconds) from
     ``NOMAD_TPU_DEFAULT_DEADLINE``; None/<=0 disables the default."""
-    raw = os.environ.get("NOMAD_TPU_DEFAULT_DEADLINE", "")
-    if not raw:
-        return None
     try:
-        budget = float(raw)
+        budget = knobs.get_float("NOMAD_TPU_DEFAULT_DEADLINE")
     except ValueError:
+        return None
+    if budget is None:
         return None
     return budget if budget > 0.0 else None
 

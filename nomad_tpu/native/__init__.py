@@ -27,7 +27,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from nomad_tpu import chaos
+from nomad_tpu import chaos, knobs
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "native",
@@ -49,7 +49,7 @@ def _build() -> Optional[str]:
     mtime check could silently prefer a stale or foreign-toolchain binary
     after a checkout).  NOMAD_TPU_NATIVE_LIB overrides with a prebuilt
     .so (the sanitizer CI leg points this at an ASan/UBSan build)."""
-    override = os.environ.get("NOMAD_TPU_NATIVE_LIB")
+    override = knobs.get_str("NOMAD_TPU_NATIVE_LIB")
     if override:
         return override if os.path.exists(override) else None
     if not os.path.exists(_SRC):
@@ -169,8 +169,7 @@ class CircuitBreaker:
             self.open = False
 
 
-breaker = CircuitBreaker(
-    int(os.environ.get("NOMAD_TPU_NATIVE_BREAKER", "3")))
+breaker = CircuitBreaker(knobs.get_int("NOMAD_TPU_NATIVE_BREAKER"))
 
 
 def _native_lib() -> Optional[ctypes.CDLL]:

@@ -846,6 +846,16 @@ place_bulk_batch_donate_jit = jax.jit(
     _place_bulk_batch, static_argnames=_BULK_BATCH_STATICS,
     donate_argnums=(1,))
 
+# Loan/adopt protocol for every donate_argnums site in this module
+# (the donation-safety checker fails an undeclared donating jit).
+_DONATE_PROTOCOL = {
+    "place_bulk_batch_donate_jit":
+        "arg 1 (used0) is the loaned usage basis: the engine takes it "
+        "via world.loan_basis(), must not read it after dispatch, and "
+        "adopts the exact carry via world.adopt_basis() — or "
+        "invalidates the basis on a failed dispatch",
+}
+
 
 def unpack_bulk_batch(packed: np.ndarray, n_rows: int,
                       sparse: bool = False):

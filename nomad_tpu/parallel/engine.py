@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from nomad_tpu import chaos
+from nomad_tpu import chaos, knobs
 from nomad_tpu import native as _native
 from nomad_tpu import tracing
 from nomad_tpu.analysis import race
@@ -311,33 +311,31 @@ class PlacementEngine:
         # the scoring work (>=16 rows/shard on an 8-device mesh).
         # NOMAD_TPU_SHARD=0 disables; NOMAD_TPU_SHARD_MIN tunes.
         if shard_min_nodes is None:
-            shard_min_nodes = int(os.environ.get("NOMAD_TPU_SHARD_MIN",
-                                                 "128"))
+            shard_min_nodes = knobs.get_int("NOMAD_TPU_SHARD_MIN")
         self.shard_min_nodes = shard_min_nodes
         # per-eval bulk heavy block is f32[4N]: cap the eval-axis chain
         # so one dispatch's stacked tensors stay under this byte budget
         # (100K-node worlds at the 512-eval bucket would be ~1 GB)
-        self.bulk_bytes_budget = int(os.environ.get(
-            "NOMAD_TPU_BULK_BYTES", str(1 << 28)))
+        self.bulk_bytes_budget = knobs.get_int("NOMAD_TPU_BULK_BYTES")
         # fused wave dispatch (NOMAD_TPU_FUSE=0 restores the 3-way
         # sparse/delta/dense format split): one device call per bulk
         # wave — the format split paid ~1.5-2 dispatch+D2H round trips
         # per wave on mixed serving traffic for transfer savings that
         # stopped mattering once the heavy blocks went device-resident
-        self.fuse = os.environ.get("NOMAD_TPU_FUSE", "1") != "0"
+        self.fuse = knobs.get_bool("NOMAD_TPU_FUSE")
         # donated-carry bulk dispatch (NOMAD_TPU_DONATE=0 restores the
         # copy-on-dispatch carry): the usage-basis buffer is donated to
         # the kernel and its carry output adopted as the new resident
         # basis (world.loan_basis/adopt_basis) — the put_basis re-upload
         # per wave (BENCH_r05: 0.37 s) drops to zero bytes
-        self.donate = os.environ.get("NOMAD_TPU_DONATE", "1") != "0"
+        self.donate = knobs.get_bool("NOMAD_TPU_DONATE")
         # upload/compute overlap (NOMAD_TPU_OVERLAP=0 disables): hold
         # ONE bulk dispatch in flight and prep + dispatch the next part
         # against the adopted carry while the device computes — requires
         # donation (the carry is what makes the in-flight placements
         # visible to the chained dispatch without a resolve barrier)
         self.overlap = self.donate and \
-            os.environ.get("NOMAD_TPU_OVERLAP", "1") != "0"
+            knobs.get_bool("NOMAD_TPU_OVERLAP")
         self._pending: Optional[_PendingBulk] = None
         # (t0, t1) wall windows of in-flight device compute (bulk:
         # dispatch -> fetch complete) — intersected with upload_windows
@@ -574,7 +572,7 @@ class PlacementEngine:
             chunk = self._bulk_chunk(cm.n_rows)
             thunks += [(bulk_variant, (E,))
                        for E in self.BULK_E_BUCKETS if E <= chunk]
-        workers = int(os.environ.get("NOMAD_TPU_WARM_THREADS", "4"))
+        workers = knobs.get_int("NOMAD_TPU_WARM_THREADS")
         from concurrent.futures import ThreadPoolExecutor
         with ThreadPoolExecutor(
                 max_workers=max(1, min(workers, len(thunks)))) as ex:
@@ -1087,7 +1085,7 @@ class PlacementEngine:
     def _mesh_for(self, N: int):
         """The ('node_shard','wave') serving mesh when sharding applies
         to this node axis, else None."""
-        if os.environ.get("NOMAD_TPU_SHARD", "1") == "0":
+        if not knobs.get_bool("NOMAD_TPU_SHARD"):
             return None
         if not self._mesh_checked:
             import jax
@@ -1641,7 +1639,7 @@ _engine_lock = threading.Lock()
 def get_engine() -> Optional[PlacementEngine]:
     """Process-wide engine; disable with NOMAD_TPU_ENGINE=0."""
     global _engine
-    if os.environ.get("NOMAD_TPU_ENGINE", "1") == "0":
+    if not knobs.get_bool("NOMAD_TPU_ENGINE"):
         return None
     with _engine_lock:
         if _engine is None:

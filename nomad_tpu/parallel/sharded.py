@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from nomad_tpu import knobs
 from nomad_tpu.analysis import recompile
 from nomad_tpu.ops.fit import score_fit
 from nomad_tpu.ops.place import PlaceInputs, PlaceResult, TOP_K
@@ -104,8 +105,7 @@ def wave_mesh_shape(n_devices: int,
     if n_devices < 1:
         raise ValueError(f"n_devices must be >= 1, got {n_devices}")
     if wave_shards is None:
-        env = os.environ.get("NOMAD_TPU_WAVE_SHARDS", "")
-        wave_shards = int(env) if env else None
+        wave_shards = knobs.get_int("NOMAD_TPU_WAVE_SHARDS")
     if wave_shards is not None:
         w = max(1, int(wave_shards))
         if n_devices % w != 0:
@@ -417,6 +417,19 @@ def _field_specs_batched() -> dict:
 
 _SERVING_FN_CACHE: dict = {}
 
+# Loan/adopt protocol for every donate_argnums jit in this module (the
+# donation-safety checker fails an undeclared donating jit).  `fn` is
+# the bulk serving kernel built in place_bulk_batch_sharded and
+# registered as "sharded.bulk".
+_DONATE_PROTOCOL = {
+    "fn":
+        "arg 1 (used0) is the loaned usage basis: the engine takes it "
+        "via world.loan_basis() before dispatch, never reads the "
+        "loaned buffer in flight, and adopts the psum-merged carry "
+        "(used_tot) via world.adopt_basis() — or invalidates the "
+        "basis when the dispatch fails",
+}
+
 
 def place_batch_sharded(mesh: Mesh, capacity, used0, fields: dict,
                         delta_rows, delta_vals,
@@ -625,6 +638,10 @@ def place_bulk_batch_sharded(mesh: Mesh, capacity, used0,
         return (assign[None], scores[None], placed[None], n_eval[None],
                 n_exh[None], waves[None], used_tot)
 
+    # Loan/adopt protocol for the donating jit below (`fn`, registered
+    # as "sharded.bulk"): arg 1 (used0) is the loaned usage basis —
+    # world.loan_basis() before dispatch, no reads of the loaned buffer
+    # until world.adopt_basis(used_tot) lands the psum-merged carry.
     NS, W = NODE_AXIS_NAME, WAVE_AXIS_NAME
     in_specs = (P(NS, None), P(NS, None),
                 P(W, None, NS), P(W, None, NS), P(W, None), P(W, None),

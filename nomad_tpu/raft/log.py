@@ -61,7 +61,7 @@ import time
 import zlib
 from typing import List, Optional, Tuple
 
-from nomad_tpu import chaos
+from nomad_tpu import chaos, knobs
 
 log = logging.getLogger(__name__)
 
@@ -84,7 +84,7 @@ class WALCorruptionError(RuntimeError):
 
 
 def fsync_policy_from_env() -> str:
-    pol = os.environ.get("NOMAD_TPU_FSYNC", "batch").strip().lower()
+    pol = knobs.get_str("NOMAD_TPU_FSYNC").strip().lower()
     if pol not in FSYNC_POLICIES:
         raise ValueError(
             f"NOMAD_TPU_FSYNC={pol!r}: want one of {', '.join(FSYNC_POLICIES)}")

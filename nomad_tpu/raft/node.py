@@ -40,7 +40,7 @@ import time
 import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
-from nomad_tpu import chaos, tracing
+from nomad_tpu import chaos, knobs, tracing
 from nomad_tpu.analysis import race
 from nomad_tpu.raft.log import LogEntry, LogStore
 from nomad_tpu.raft.meta import DurableMeta, MetaPersistError
@@ -1116,10 +1116,10 @@ class RaftNode:
         """
         stream = None
         try:
-            chunk = max(1, int(os.environ.get(
-                "NOMAD_TPU_SNAP_CHUNK", str(SNAP_CHUNK_DEFAULT))))
-            window = max(1, int(os.environ.get(
-                "NOMAD_TPU_SNAP_WINDOW", str(SNAP_WINDOW_DEFAULT))))
+            chunk = max(1, knobs.get_int(
+                "NOMAD_TPU_SNAP_CHUNK", default=SNAP_CHUNK_DEFAULT))
+            window = max(1, knobs.get_int(
+                "NOMAD_TPU_SNAP_WINDOW", default=SNAP_WINDOW_DEFAULT))
             # windowed read handle: frames come off the sidecar blob
             # file at most `window` chunks at a time, so N concurrent
             # peer streams cost N*window*chunk — not N whole blobs

@@ -512,6 +512,12 @@ class Endpoints:
         store = self.server.store
         min_index = args.get("min_index", 0)
         timeout = min(args.get("timeout", 2.0), 30.0)
+        # the long-poll park must not outlive the request budget: a
+        # deadline-bound caller gets at most its remaining slice, then
+        # the current state (long-poll semantics, not an error)
+        rem = deadline.remaining()
+        if rem is not None:
+            timeout = min(timeout, rem)
         store.wait_for_index(min_index + 1, timeout=timeout)
         return {"index": store.latest_index,
                 "allocs": store.allocs_by_node(args["node_id"])}
@@ -640,8 +646,20 @@ class Endpoints:
                     f"Plan.Submit over limit for namespace {ns!r}",
                     retry_after=retry)
         try:
+            # shed before enqueue: an already-expired submission would
+            # only burn an applier slot to produce an unwanted result
+            if deadline.check("plan.submit"):
+                raise RpcError(
+                    "deadline_exceeded",
+                    "plan.submit: deadline expired before enqueue")
             pending = self.server.enqueue_plan(plan)
-            return pending.future.result(timeout=30.0)
+            # clamp the applier wait to the remaining budget so a
+            # deadline-bound submitter never parks the full 30 s
+            timeout = 30.0
+            rem = deadline.remaining()
+            if rem is not None:
+                timeout = min(timeout, rem)
+            return pending.future.result(timeout=timeout)
         finally:
             if gate is not None and gate.enabled:
                 gate.release(ns)

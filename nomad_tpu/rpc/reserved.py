@@ -1,0 +1,86 @@
+"""Reserved RPC-args keys and the forwarding-path propagation contract.
+
+An RPC args dict carries request-scoped context in underscore-prefixed
+"reserved" keys alongside the method's own arguments.  Every site that
+re-constructs, copies, or filters an args dict on a forwarding path
+must preserve (or deliberately consume) every reserved key — PR 18's
+drive-found bug was exactly this: the HTTP dispatch rebuilt args and
+silently dropped the `_read_mode` shed classification.
+
+This module is that contract, stated once: the key registry, the
+declared forwarding sites with the keys each must re-stamp, the strips
+that are deliberate consumption, and the wire-header spellings.  The
+`context-propagation` static checker
+(`nomad_tpu/analysis/context_propagation.py`) reads these declarations
+from the AST and fails any forwarding site that drops a reserved key
+without an entry here (or an inline `# analysis: allow(...)`).
+
+`restamp()` is the runtime half: the one sanctioned way to rebuild an
+args dict at an RPC origin, re-attaching every thread-recoverable key.
+"""
+from __future__ import annotations
+
+from nomad_tpu import deadline, tracing
+
+# Every reserved key that may ride an RPC args dict.  A key listed here
+# and never used is a finding (dead key); an underscore-prefixed key
+# used on a forwarding path and NOT listed here is a finding too.
+_RESERVED_KEYS = {
+    "_trace": "sampled trace context (tracing.TRACE_KEY); hops "
+              "re-attach it so one trace spans the forward chain",
+    "_deadline": "relative deadline budget (deadline.DEADLINE_KEY), "
+                 "re-encoded from the local binding at every hop",
+    "_read_mode": "read-path shed classification consumed by the "
+                  "brownout gate at dispatch",
+    "_forward_hops": "federation hop guard; incremented per forward "
+                     "and capped at MAX_FORWARD_HOPS",
+}
+
+# Keys recoverable from thread-local state: `restamp()` re-attaches
+# these, so an "origin" site that calls it covers all of them.
+_THREAD_KEYS = ("_trace", "_deadline")
+
+# qualname -> (kind, keys that site must re-stamp when it builds or
+# forwards an args dict).  "origin" sites build fresh args from
+# thread-local context (and must cover at least _THREAD_KEYS);
+# "forward" sites relay an existing dict and re-encode per-hop keys.
+_FORWARDING_SITES = {
+    "Endpoints.handle": ("forward", ("_forward_hops", "_deadline")),
+    "RegionRouter.route": ("forward", ("_deadline",)),
+    "Server.rpc_leader": ("origin", ("_trace", "_deadline")),
+    "Server.rpc_region": ("origin", ("_trace", "_deadline")),
+    "HTTPServer._rpc": ("origin", ("_trace", "_deadline", "_read_mode")),
+    "ApiClient._request": ("forward", ("_deadline",)),
+}
+
+# Deliberate consumption: at local dispatch the handler strips every
+# reserved key (they are transport context, not method arguments).
+# A pop/del of a reserved key at a forwarding site is a finding unless
+# the (site, key) pair is listed here or the key is re-stamped later
+# in the same function (pop-then-restore, like the hop counter).
+_ALLOWED_STRIPS = {
+    "Endpoints.handle": ("_trace", "_deadline", "_read_mode",
+                         "_forward_hops"),
+}
+
+# HTTP spellings of reserved keys: stamping the header is stamping the
+# key (the API client re-encodes `_deadline` per retry attempt).
+_WIRE_HEADERS = {"X-Nomad-Deadline": "_deadline"}
+
+
+def restamp(args: dict) -> dict:
+    """A copy of `args` with every thread-recoverable reserved key
+    re-attached from this thread's context: the sampled trace context
+    (when tracing is active and the dict doesn't already carry one) and
+    the remaining deadline budget re-encoded for the hop.  Never
+    mutates `args`."""
+    out = dict(args)
+    if tracing.active is not None and tracing.TRACE_KEY not in out:
+        ctx = tracing.current()
+        if ctx is not None:
+            out[tracing.TRACE_KEY] = ctx
+    if deadline.DEADLINE_KEY not in out:
+        wire = deadline.to_wire()
+        if wire is not None:
+            out[deadline.DEADLINE_KEY] = wire
+    return out

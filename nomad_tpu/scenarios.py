@@ -48,6 +48,7 @@ The three chaos points this plane owns:
 """
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import pickle
@@ -60,7 +61,7 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
-from nomad_tpu import chaos, mock
+from nomad_tpu import chaos, knobs, mock
 from nomad_tpu import deadline as request_deadline
 from nomad_tpu.chaos import ChaosRegistry
 from nomad_tpu.rpc import RpcError
@@ -1366,8 +1367,7 @@ class FleetSoakShape(Shape):
     name = "fleet_soak"
 
     def __init__(self):
-        self.n_agents = int(os.environ.get("NOMAD_TPU_FLEET_AGENTS",
-                                           "10000"))
+        self.n_agents = knobs.get_int("NOMAD_TPU_FLEET_AGENTS")
         self._driver: Optional[FleetDriver] = None
         self._drain_wave_done = False
         self._last_compact = 0.0
@@ -1557,9 +1557,10 @@ class FleetSoakShape(Shape):
         ctx.notes["snapshot_bytes"] = snap_bytes
         # carve the stream into many frames so "mid-transfer" exists
         # even at the reduced CI fleet size
-        old_chunk = os.environ.get("NOMAD_TPU_SNAP_CHUNK")
-        os.environ["NOMAD_TPU_SNAP_CHUNK"] = str(
-            min(max(4096, snap_bytes // 64), 256 * 1024))
+        chunk_override = contextlib.ExitStack()
+        chunk_override.enter_context(knobs.override(
+            "NOMAD_TPU_SNAP_CHUNK",
+            min(max(4096, snap_bytes // 64), 256 * 1024)))
         joiner = None
         try:
             # hold the stream in backoff until the chunk gate is
@@ -1619,10 +1620,7 @@ class FleetSoakShape(Shape):
         finally:
             if joiner is not None:
                 joiner.raft._on_snapshot_chunk = orig
-            if old_chunk is None:
-                os.environ.pop("NOMAD_TPU_SNAP_CHUNK", None)
-            else:
-                os.environ["NOMAD_TPU_SNAP_CHUNK"] = old_chunk
+            chunk_override.close()
 
     def check(self, cluster, ctx, timeout: float = 60.0) -> dict:
         try:
@@ -2440,7 +2438,7 @@ def run_matrix(cells=None, seed: int = 1, out_dir: str = ".",
     per-cell verdicts.  Honors a NOMAD_TPU_CHAOS env spec as a schedule
     override for every cell (schedule name 'env')."""
     cells = list(cells if cells is not None else ALL_CELLS)
-    spec_override = os.environ.get("NOMAD_TPU_CHAOS") or None
+    spec_override = knobs.get_str("NOMAD_TPU_CHAOS") or None
     if spec_override:
         chaos.uninstall()               # the runner installs per cell
         cells = [(shape, "env")

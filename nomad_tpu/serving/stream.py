@@ -18,13 +18,13 @@ import os
 import time
 from typing import Callable, Optional
 
-from nomad_tpu import chaos
+from nomad_tpu import chaos, knobs
 from nomad_tpu.api.codec import to_wire
 from nomad_tpu.core.events import Subscription
 
 
 def default_heartbeat() -> float:
-    return float(os.environ.get("NOMAD_TPU_STREAM_HEARTBEAT", "1.0"))
+    return knobs.get_float("NOMAD_TPU_STREAM_HEARTBEAT")
 
 
 class EventStreamer:

@@ -30,6 +30,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from nomad_tpu import knobs
 from nomad_tpu.analysis import race
 
 # reserved RPC-args key the context rides under; handlers pop it before
@@ -275,7 +276,5 @@ def bind(ctx: Optional[dict]) -> Optional[dict]:
     return prev
 
 
-_env = os.environ.get("NOMAD_TPU_TRACE", "")
-if _env and _env not in ("0", "false"):
-    active = Tracer(sample_rate=float(
-        os.environ.get("NOMAD_TPU_TRACE_SAMPLE", "1.0")))
+if knobs.get_bool("NOMAD_TPU_TRACE"):
+    active = Tracer(sample_rate=knobs.get_float("NOMAD_TPU_TRACE_SAMPLE"))

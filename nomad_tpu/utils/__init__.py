@@ -3,6 +3,8 @@ from __future__ import annotations
 
 import os
 
+from nomad_tpu import knobs
+
 
 _cache_enabled = False
 
@@ -50,12 +52,12 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     with NOMAD_TPU_JAX_CACHE_DIR, disable with NOMAD_TPU_JAX_CACHE=0.
     Returns the cache dir in use (None when disabled)."""
     global _cache_enabled
-    if os.environ.get("NOMAD_TPU_JAX_CACHE", "1") == "0":
+    if not knobs.get_bool("NOMAD_TPU_JAX_CACHE"):
         return None
     if _cache_enabled:
         import jax
         return jax.config.jax_compilation_cache_dir
-    root = (path or os.environ.get("NOMAD_TPU_JAX_CACHE_DIR")
+    root = (path or knobs.get_str("NOMAD_TPU_JAX_CACHE_DIR")
             or os.path.join(os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
     path = os.path.join(root, _machine_cache_key())

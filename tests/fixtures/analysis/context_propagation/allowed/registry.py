@@ -1,0 +1,17 @@
+"""Fixture contract: the retired key carries a reasoned allow."""
+
+_RESERVED_KEYS = {
+    "_trace": "trace context",
+    "_deadline": "deadline budget",
+    "_legacy": "retired",  # analysis: allow(context-propagation) — retired key stays registered until the v2 wire format lands
+}
+
+_THREAD_KEYS = ("_trace", "_deadline")
+
+_FORWARDING_SITES = {
+    "Router.forward": ("forward", ("_deadline",)),
+}
+
+_ALLOWED_STRIPS = {}
+
+_WIRE_HEADERS = {}

@@ -1,0 +1,10 @@
+"""Fixture site: every violation carries a reasoned allow."""
+
+
+class Router:
+    def forward(self, args):
+        out = dict(args)
+        out["_deadline"] = args.get("_deadline")
+        out.pop("_trace", None)  # analysis: allow(context-propagation) — trace is re-derived from wire headers on the next hop
+        out = {k: v for k, v in out.items() if k != "payload"}  # analysis: allow(context-propagation) — filter drops only the payload key; reserved keys pass through
+        return self.send(out)

@@ -1,0 +1,19 @@
+"""Fixture reserved-key contract with seeded declaration defects."""
+
+_RESERVED_KEYS = {
+    "_trace": "trace context",
+    "_deadline": "deadline budget",
+    "_ghost": "registered but never used anywhere",
+}
+
+_THREAD_KEYS = ("_trace", "_deadline")
+
+_FORWARDING_SITES = {
+    "Router.forward": ("forward", ("_deadline",)),
+    "Router.originate": ("origin", ("_deadline",)),
+    "Router.vanished": ("forward", ("_deadline",)),
+}
+
+_ALLOWED_STRIPS = {}
+
+_WIRE_HEADERS = {"X-Fixture-Deadline": "_deadline"}

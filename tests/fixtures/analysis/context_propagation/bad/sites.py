@@ -1,0 +1,19 @@
+"""Fixture forwarding sites that drop reserved keys."""
+
+
+class Router:
+    def forward(self, args):
+        out = dict(args)
+        out.pop("_deadline", None)
+        out["_mystery"] = 1
+        out = {k: v for k, v in out.items() if not k.startswith("_")}
+        return self.send(out)
+
+    def originate(self, req):
+        fresh = {"op": req.op}
+        fresh["_deadline"] = req.budget
+        return self.send(fresh)
+
+    def helper(self, args):
+        args.pop("_trace", None)
+        return args

@@ -1,0 +1,16 @@
+"""Fixture contract with a declared strip and a wire header."""
+
+_RESERVED_KEYS = {
+    "_trace": "trace context",
+    "_deadline": "deadline budget",
+}
+
+_THREAD_KEYS = ("_trace", "_deadline")
+
+_FORWARDING_SITES = {
+    "Router.forward": ("forward", ("_deadline", "_trace")),
+}
+
+_ALLOWED_STRIPS = {"Router.forward": ("_trace",)}
+
+_WIRE_HEADERS = {"X-Fixture-Deadline": "_deadline"}

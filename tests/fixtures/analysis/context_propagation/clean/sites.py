@@ -1,0 +1,21 @@
+"""Fixture site honoring the contract: alias stamp, declared strip,
+wire-header stamp, and a restamp covering the thread keys."""
+
+DEADLINE_KEY = "_deadline"
+
+
+def restamp(args):
+    args.setdefault("_trace", "trace-0")
+    args.setdefault("_deadline", 9.0)
+    return args
+
+
+class Router:
+    def forward(self, args):
+        out = dict(args)
+        out.pop("_trace", None)
+        out[DEADLINE_KEY] = args.get(DEADLINE_KEY)
+        headers = {}
+        self.stamp(headers, "X-Fixture-Deadline", args.get(DEADLINE_KEY))
+        restamp(out)
+        return self.send(out, headers)

@@ -1,0 +1,10 @@
+"""Fixture contract: the reserved stage carries a reasoned allow."""
+
+_DEADLINE_STAGES = (
+    "rpc",
+    "ghost",  # analysis: allow(deadline-coverage) — stage reserved for the next release's federation hop
+)
+
+_SERVING_ROOTS = ("Server.handle",)
+
+_SERVING_MODULES = ("serving",)

@@ -1,0 +1,13 @@
+"""Fixture serving path: every violation carries a reasoned allow."""
+
+
+class Server:
+    def handle(self, req):
+        deadline = req.deadline
+        deadline.check("rpc")
+        deadline.check(req.stage)  # analysis: allow(deadline-coverage) — stage names come from the closed dispatch table above
+        return self.park(req)
+
+    def park(self, req):
+        self.ready.wait()  # analysis: allow(deadline-coverage) — startup barrier, armed before serving begins
+        return req

@@ -1,0 +1,7 @@
+"""Fixture deadline contract with a dead stage seeded."""
+
+_DEADLINE_STAGES = ("rpc", "queue", "ghost")
+
+_SERVING_ROOTS = ("Server.handle",)
+
+_SERVING_MODULES = ("serving",)

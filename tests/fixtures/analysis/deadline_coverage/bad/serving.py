@@ -1,0 +1,22 @@
+"""Fixture serving path with unchecked waits in the cone."""
+import time
+
+
+class Server:
+    def handle(self, req):
+        deadline = req.deadline
+        deadline.check("rpc")
+        deadline.check("queue")
+        deadline.check(req.stage)
+        deadline.check("unknown")
+        self.park(req)
+        return self.drain(req)
+
+    def park(self, req):
+        self.ready.wait()
+        return self.inbox.get()
+
+    def drain(self, req):
+        while not self.done:
+            time.sleep(0.05)
+        return self.fut.result()

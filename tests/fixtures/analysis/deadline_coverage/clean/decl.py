@@ -1,0 +1,7 @@
+"""Fixture deadline contract, fully honored."""
+
+_DEADLINE_STAGES = ("rpc", "queue")
+
+_SERVING_ROOTS = ("Server.handle",)
+
+_SERVING_MODULES = ("serving",)

@@ -1,0 +1,14 @@
+"""Fixture serving path: every wait consults the deadline."""
+
+
+class Server:
+    def handle(self, req):
+        deadline = req.deadline
+        deadline.check("rpc")
+        return self.park(req, deadline)
+
+    def park(self, req, deadline):
+        deadline.check("queue")
+        rem = deadline.remaining()
+        self.ready.wait(rem)
+        return self.inbox.get(timeout=rem)

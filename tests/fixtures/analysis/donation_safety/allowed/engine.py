@@ -1,0 +1,14 @@
+"""Fixture loan flows: every violation carries a reasoned allow."""
+
+
+class Engine:
+    def fire_and_forget(self, world, delta):
+        loaned = world.loan_basis()  # analysis: allow(donation-safety) — adoption happens in the completion callback registered by place()
+        return self.place(delta, loaned)
+
+    def debug_probe(self, world, delta):
+        loaned = world.loan_basis()
+        out = self.place(delta, loaned)
+        shape = loaned.shape  # analysis: allow(donation-safety) — .shape reads host-side metadata, not the donated device buffer
+        world.adopt_basis(out)
+        return shape

@@ -1,0 +1,13 @@
+"""Fixture donate sites: every violation carries a reasoned allow."""
+import jax
+
+
+def _scatter(basis, delta):
+    return basis + delta
+
+
+scatter_donate = jax.jit(_scatter, donate_argnums=(0,))  # analysis: allow(donation-safety) — contract documented in the module docstring pending registry migration
+
+_DONATE_PROTOCOL = {
+    "retired_site": "removed jit site",  # analysis: allow(donation-safety) — entry kept declared one release for the changelog
+}

@@ -1,0 +1,22 @@
+"""Fixture loan/adopt flows that misuse donated buffers."""
+
+
+class Engine:
+    def dispatch_no_adopt(self, world, delta):
+        loaned = world.loan_basis()
+        return self.place(delta, loaned)
+
+    def read_after_dispatch(self, world, delta):
+        loaned = world.loan_basis()
+        basis = loaned
+        out = self.place(delta, basis)
+        norm = self.norm(basis)
+        world.adopt_basis(out)
+        return norm
+
+    def cache_alias(self, world, delta):
+        loaned = world.loan_basis()
+        self.cache["basis"] = loaned
+        out = self.place(delta, loaned)
+        world.adopt_basis(out)
+        return out

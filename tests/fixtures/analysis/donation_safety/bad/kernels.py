@@ -1,0 +1,16 @@
+"""Fixture donate sites missing their protocol declarations."""
+import jax
+
+
+def _place(basis, delta):
+    return basis + delta
+
+
+place_donate = jax.jit(_place, donate_argnums=(0,))
+
+maybe_donate = jax.jit(_place, donate_argnums=(0,)) if True \
+    else jax.jit(_place)
+
+_DONATE_PROTOCOL = {
+    "phantom": "declared but no such jit site exists",
+}

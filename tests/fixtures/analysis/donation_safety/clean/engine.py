@@ -1,0 +1,14 @@
+"""Fixture loan flow honoring the protocol: adopt on success,
+invalidate on failure, no reads between dispatch and adoption."""
+
+
+class Engine:
+    def dispatch(self, world, delta):
+        loaned = world.loan_basis()
+        try:
+            out = self.place(delta, loaned)
+            world.adopt_basis(out)
+        except Exception:
+            world.invalidate_basis()
+            raise
+        return out

@@ -1,0 +1,13 @@
+"""Fixture donate site with its protocol declared."""
+import jax
+
+
+def _place(basis, delta):
+    return basis + delta
+
+
+place_donate = jax.jit(_place, donate_argnums=(0,))
+
+_DONATE_PROTOCOL = {
+    "place_donate": "arg 0 is the loaned basis; caller adopts the output",
+}

@@ -1,0 +1,9 @@
+"""Fixture app: every violation carries a reasoned allow."""
+import os
+
+
+def reads(knobs):
+    beta = knobs.get_int("NOMAD_TPU_BETA")
+    legacy = os.environ.get("NOMAD_TPU_LEGACY")  # analysis: allow(knob-registry) — migration shim reads the retired spelling once at import
+    probe = knobs.get_str("NOMAD_TPU_PROBE")  # analysis: allow(knob-registry) — probe knob is injected by the chaos harness, never registered
+    return beta, legacy, probe

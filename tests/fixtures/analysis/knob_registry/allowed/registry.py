@@ -1,0 +1,14 @@
+"""Fixture registry: the dead entry carries a reasoned allow."""
+
+
+class Knob:
+    def __init__(self, default, kind, doc):
+        self.default, self.kind, self.doc = default, kind, doc
+
+
+_KNOB_REGISTRY = True
+
+KNOBS = {
+    "NOMAD_TPU_BETA": Knob("2", "int", "beta factor"),
+    "NOMAD_TPU_RETIRED": Knob("0", "int", "retired"),  # analysis: allow(knob-registry) — kept one release for rollback compatibility
+}

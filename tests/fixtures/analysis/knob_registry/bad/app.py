@@ -1,0 +1,19 @@
+"""Fixture app: raw environ reads and an unregistered accessor."""
+import os
+
+env = os.environ
+
+
+def raw_reads():
+    a = os.environ.get("NOMAD_TPU_RAW_GET")
+    b = os.getenv("NOMAD_TPU_RAW_GETENV", "0")
+    c = env.pop("NOMAD_TPU_RAW_ALIAS", None)
+    os.environ["NOMAD_TPU_RAW_WRITE"] = "1"
+    return a, b, c
+
+
+def accessor_reads(knobs):
+    alpha = knobs.get_int("NOMAD_TPU_ALPHA")
+    undoc = knobs.get_bool("NOMAD_TPU_UNDOC")
+    ghost = knobs.get_str("NOMAD_TPU_GHOST")
+    return alpha, undoc, ghost

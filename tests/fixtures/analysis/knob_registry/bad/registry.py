@@ -1,0 +1,15 @@
+"""Fixture knob registry with one dead and one undocumented entry."""
+
+
+class Knob:
+    def __init__(self, default, kind, doc):
+        self.default, self.kind, self.doc = default, kind, doc
+
+
+_KNOB_REGISTRY = True
+
+KNOBS = {
+    "NOMAD_TPU_ALPHA": Knob("1", "int", "alpha factor"),
+    "NOMAD_TPU_DEAD": Knob("0", "int", "never read anywhere"),
+    "NOMAD_TPU_UNDOC": Knob("0", "bool", "missing from the README"),
+}

@@ -1,0 +1,7 @@
+"""Fixture app: registered knobs read only through typed accessors."""
+
+
+def reads(knobs):
+    alpha = knobs.get_int("NOMAD_TPU_ALPHA")
+    gamma = knobs.get_float("NOMAD_TPU_GAMMA")
+    return alpha, gamma

@@ -1,0 +1,14 @@
+"""Fixture registry: every entry is read through an accessor."""
+
+
+class Knob:
+    def __init__(self, default, kind, doc):
+        self.default, self.kind, self.doc = default, kind, doc
+
+
+_KNOB_REGISTRY = True
+
+KNOBS = {
+    "NOMAD_TPU_ALPHA": Knob("1", "int", "alpha factor"),
+    "NOMAD_TPU_GAMMA": Knob("0.5", "float", "gamma damping"),
+}

@@ -33,6 +33,10 @@ CASES = [
     ("snapshot_completeness", "snapshot-completeness", 10),
     ("canonical_form", "canonical-form", 6),
     ("wait_graph", "wait-graph", 4),
+    ("context_propagation", "context-propagation", 8),
+    ("deadline_coverage", "deadline-coverage", 7),
+    ("donation_safety", "donation-safety", 6),
+    ("knob_registry", "knob-registry", 7),
     ("allow_audit", "allow-audit", 3),
 ]
 
@@ -157,7 +161,7 @@ def test_cli_list_checkers():
     res = _cli("--list-checkers")
     assert res.returncode == 0
     assert res.stdout.split() == list(CHECKERS)
-    assert len(CHECKERS) == 12
+    assert len(CHECKERS) == 16
 
 
 def test_cli_checkers_csv_and_json_counts():
@@ -169,6 +173,47 @@ def test_cli_checkers_csv_and_json_counts():
     assert doc["counts"]["wait-graph"] == 4
     assert doc["counts"]["allow-audit"] == 0
     assert len(doc["findings"]) == 4
+
+
+def test_cli_baseline_ratchets_known_findings(tmp_path):
+    """--baseline turns known debt into exit 0: a report generated from
+    the same tree baselines every finding away."""
+    root = str(FIXTURES / "knob_registry" / "bad")
+    res = _cli("--root", root, "--checker", "knob-registry", "--json")
+    assert res.returncode == 1
+    baseline = tmp_path / "report.json"
+    baseline.write_text(res.stdout)
+    res2 = _cli("--root", root, "--checker", "knob-registry",
+                "--baseline", str(baseline))
+    assert res2.returncode == 0
+    assert "0 new findings" in res2.stdout
+    assert "(7 baselined)" in res2.stdout
+
+
+def test_cli_baseline_fails_on_new_findings(tmp_path):
+    """Findings not in the baseline still fail, and only they print."""
+    root = str(FIXTURES / "knob_registry" / "bad")
+    res = _cli("--root", root, "--checker", "knob-registry", "--json")
+    doc = json.loads(res.stdout)
+    doc["findings"] = [f for f in doc["findings"]
+                       if "NOMAD_TPU_RAW_GET`" not in f["message"]]
+    baseline = tmp_path / "report.json"
+    baseline.write_text(json.dumps(doc))
+    res2 = _cli("--root", root, "--checker", "knob-registry",
+                "--baseline", str(baseline), "--json")
+    assert res2.returncode == 1
+    out = json.loads(res2.stdout)
+    assert len(out["findings"]) == 1
+    assert "NOMAD_TPU_RAW_GET" in out["findings"][0]["message"]
+    assert out["baselined"] == 6
+
+
+def test_cli_baseline_unreadable_is_usage_error(tmp_path):
+    p = tmp_path / "nope.json"
+    res = _cli("--root", str(FIXTURES / "lock_discipline" / "clean"),
+               "--baseline", str(p))
+    assert res.returncode == 2
+    assert "--baseline" in res.stderr
 
 
 def test_cli_lock_corpus_flag(tmp_path):

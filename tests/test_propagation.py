@@ -1,0 +1,219 @@
+"""Regression tests for the reserved-key propagation and deadline-
+coverage fixes the PR-19 static checkers flushed out (targeted tests
+outside the analysis fixture corpora):
+
+- `Server.rpc_region` / `Server.rpc_leader` rebuilt args without
+  re-encoding the deadline budget, so a cross-region (or transport-
+  forwarded) request ran unbounded on the remote side — both now go
+  through `reserved.restamp`.
+- `Plan.Submit` parked on the applier future for a fixed 30 s and
+  never consulted the deadline; it now sheds expired submissions
+  before enqueue (`deadline.expired.plan.submit`) and clamps the wait
+  to the remaining budget.
+- `Node.GetClientAllocs` and the HTTP blocking-query park honored only
+  the caller's `timeout`/`wait`, not the request deadline.
+"""
+import concurrent.futures
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from nomad_tpu import deadline, mock, tracing
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.core.server import Server
+from nomad_tpu.rpc import reserved
+from nomad_tpu.rpc.endpoints import Endpoints, RpcError
+from nomad_tpu.telemetry import global_metrics
+
+
+def _counter(name):
+    for c in global_metrics.snapshot()["Counters"]:
+        if c["Name"] == name:
+            return c["Count"]
+    return 0.0
+
+
+def _bound(budget):
+    return deadline.bind(time.monotonic() + budget)
+
+
+# ------------------------------------------------------------ restamp
+
+
+def test_restamp_attaches_deadline_and_preserves_args():
+    prev = _bound(5.0)
+    try:
+        args = {"x": 1, "_forward_hops": 2}
+        out = reserved.restamp(args)
+        assert out is not args and args == {"x": 1, "_forward_hops": 2}
+        assert out["x"] == 1 and out["_forward_hops"] == 2
+        assert 0.0 < out[deadline.DEADLINE_KEY] <= 5.0
+    finally:
+        deadline.bind(prev)
+
+
+def test_restamp_never_overwrites_an_existing_budget():
+    prev = _bound(5.0)
+    try:
+        out = reserved.restamp({deadline.DEADLINE_KEY: 1.25})
+        assert out[deadline.DEADLINE_KEY] == 1.25
+    finally:
+        deadline.bind(prev)
+
+
+def test_restamp_unbound_thread_adds_nothing():
+    out = reserved.restamp({"x": 1})
+    assert deadline.DEADLINE_KEY not in out
+    assert tracing.TRACE_KEY not in out
+
+
+def test_restamp_attaches_trace_context():
+    tracer = tracing.Tracer(sample_rate=1.0)
+    prev_active = tracing.active
+    tracing.active = tracer
+    tprev = tracing.bind(tracer.new_context())
+    try:
+        out = reserved.restamp({})
+        assert tracing.TRACE_KEY in out
+    finally:
+        tracing.bind(tprev)
+        tracing.active = prev_active
+
+
+# ------------------------------------- forwarding sites re-stamp args
+
+
+def test_rpc_region_restamps_deadline_budget():
+    srv = object.__new__(Server)
+    calls = []
+    srv.region_router = SimpleNamespace(
+        route=lambda region, method, args:
+            calls.append((region, method, args)) or "routed")
+    prev = _bound(5.0)
+    try:
+        assert Server.rpc_region(srv, "west", "Status.Ping",
+                                 {"q": 1}) == "routed"
+    finally:
+        deadline.bind(prev)
+    (_, _, args), = calls
+    assert args["q"] == 1
+    assert 0.0 < args[deadline.DEADLINE_KEY] <= 5.0
+
+
+def test_rpc_leader_transport_hop_restamps_deadline_budget():
+    srv = object.__new__(Server)
+    srv.name = "follower-1"
+    srv.raft = SimpleNamespace(is_leader=False, leader_id="leader-0")
+    calls = []
+    srv._transport = SimpleNamespace(
+        call=lambda src, dst, method, args:
+            calls.append((dst, method, args)) or "forwarded")
+    prev = _bound(5.0)
+    try:
+        assert Server.rpc_leader(srv, "Job.Register",
+                                 {"job": "j"}) == "forwarded"
+    finally:
+        deadline.bind(prev)
+    (dst, _, args), = calls
+    assert dst == "rpc:leader-0"
+    assert 0.0 < args[deadline.DEADLINE_KEY] <= 5.0
+
+
+# ------------------------------------------- Plan.Submit deadline gate
+
+
+def _submit_stub(future):
+    server = SimpleNamespace(
+        enqueue_plan=lambda plan: SimpleNamespace(future=future))
+    return SimpleNamespace(server=server)
+
+
+def test_plan_submit_sheds_expired_before_enqueue():
+    plan = SimpleNamespace(job=None)
+    before = _counter("deadline.expired.plan.submit")
+    prev = deadline.bind(time.monotonic() - 1.0)
+    try:
+        with pytest.raises(RpcError) as ei:
+            Endpoints.rpc_Plan__Submit(
+                _submit_stub(concurrent.futures.Future()),
+                {"plan": plan})
+    finally:
+        deadline.bind(prev)
+    assert ei.value.kind == "deadline_exceeded"
+    assert _counter("deadline.expired.plan.submit") == before + 1
+
+
+def test_plan_submit_wait_clamped_to_remaining_budget():
+    plan = SimpleNamespace(job=None)
+    never = concurrent.futures.Future()          # applier never answers
+    prev = _bound(0.3)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(concurrent.futures.TimeoutError):
+            Endpoints.rpc_Plan__Submit(_submit_stub(never),
+                                       {"plan": plan})
+    finally:
+        deadline.bind(prev)
+    assert time.monotonic() - t0 < 5.0           # not the fixed 30 s
+
+
+def test_plan_submit_unbound_keeps_full_window():
+    plan = SimpleNamespace(job=None)
+    done = concurrent.futures.Future()
+    done.set_result({"applied": True})
+    out = Endpoints.rpc_Plan__Submit(_submit_stub(done), {"plan": plan})
+    assert out == {"applied": True}
+
+
+# --------------------------------------- blocking queries honor budget
+
+
+def test_get_client_allocs_park_clamped_to_budget():
+    seen = {}
+
+    def wait_for_index(idx, timeout=None):
+        seen["timeout"] = timeout
+
+    store = SimpleNamespace(wait_for_index=wait_for_index,
+                            latest_index=7,
+                            allocs_by_node=lambda node_id: [])
+    ep = SimpleNamespace(server=SimpleNamespace(store=store))
+    prev = _bound(0.5)
+    try:
+        out = Endpoints.rpc_Node__GetClientAllocs(
+            ep, {"node_id": "n1", "min_index": 3, "timeout": 30.0})
+    finally:
+        deadline.bind(prev)
+    assert out["index"] == 7
+    assert seen["timeout"] <= 0.5
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(AgentConfig(http_port=0, num_schedulers=1,
+                          heartbeat_ttl=60.0))
+    a.start()
+    a.server.register_node(mock.node())
+    yield a
+    a.stop()
+
+
+def test_http_blocking_query_park_clamped_to_deadline(agent):
+    # pre-fix the park honored only `wait` (60 s here) and the 504 came
+    # a minute late; the clamp makes the refusal (or the current-state
+    # answer, if the budget outlives the park) arrive within budget
+    latest = agent.server.store.latest_index
+    req = urllib.request.Request(
+        f"{agent.http_addr}/v1/jobs?index={latest + 1000}&wait=60s")
+    req.add_header("X-Nomad-Deadline", "0.4")
+    t0 = time.monotonic()
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        code = e.code
+    assert code in (200, 504)
+    assert time.monotonic() - t0 < 5.0           # not the 60 s wait
