@@ -86,6 +86,7 @@ class HTTPServer:
                             "no_region_path": 502,
                             "admission_denied": 503,
                             "brownout": 503,
+                            "quarantined": 503,
                             "deadline_exceeded": 504}.get(e.kind, 500)
                     self._reply(code, {"error": str(e)},
                                 retry_after=getattr(e, "retry_after",
@@ -722,6 +723,11 @@ class HTTPServer:
                     for n in cfg["nonvoters"]
                 ],
             }
+        if parts[1:2] == ["integrity"]:
+            # local replica's integrity view: last checkpoint digest,
+            # quarantine state, repair counters (leader adds per-peer
+            # report table)
+            return self._rpc("Operator.Integrity", {})
         raise HTTPError(404, "unknown operator path")
 
     def _h_put_operator(self, h, parts, q):

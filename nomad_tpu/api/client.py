@@ -409,6 +409,13 @@ class Operator(_Section):
         return self.c.put("/v1/operator/raft/transfer-leadership",
                           {"ID": name})
 
+    def integrity(self) -> dict:
+        """The served replica's integrity-plane view: {"server", "leader",
+        "quarantined", "quarantine_reason", "last": {index, digest,
+        per_table, full, seq} | None, "peers": {name: {index, digest,
+        lag, divergent, unverified_acks}}, "counters": {...}}."""
+        return self.c.get("/v1/operator/integrity")
+
     # ----------------------------------------------------- tracing (r12)
 
     def traces(self) -> list:

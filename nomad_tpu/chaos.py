@@ -164,9 +164,36 @@ Fault points and their injection sites:
                               factor, simulating clock-rate skew between
                               nodes; correctness must not depend on
                               budgets agreeing across hops
+    fsm.apply_skip            raft/node.py — ONE targeted replica's FSM
+                              silently skips applying a committed entry
+                              while last_applied still advances: the log
+                              says it happened, the state says it didn't
+                              — invisible to raft, detectable only by
+                              the integrity plane's digest checkpoints
+    store.bitflip             raft/node.py — a targeted replica's state
+                              store silently corrupts one replicated
+                              record (StateStore.chaos_bitflip) right
+                              after an apply: no index bump, no notify,
+                              no dirty mark — the runtime analogue of a
+                              memory bitflip
+    disk.silent_corrupt       raft/node.py — the state restored from an
+                              installed snapshot is silently corrupted
+                              post-restore (a bad disk read that still
+                              unpickles); digest-verified re-admission
+                              must refuse to clear quarantine and the
+                              leader must retry the repair stream
 
 `REQUIRED_SITES` pins points to the hot-path functions that must carry
 them; the chaos-coverage linter fails if a refactor drops one.
+
+Divergence points are *targeted*, not rate-drawn: corruption drills need
+exactly one victim replica, while rates are process-global (every
+replica in an in-process cluster shares the registry and would fire
+together, destroying the healthy majority the vote needs).
+`registry.target(point, where, count)` arms a point to fire `count`
+times at the injection site whose `where` tag (the node name) matches;
+`should(point, where=...)` consumes it.  Points with no armed target
+keep the seeded-rate path unchanged.
 
 Zero-overhead-when-disabled contract: `active` is None unless a registry
 is installed; every injection site guards with `if chaos.active is not
@@ -219,6 +246,9 @@ FAULT_POINTS = (
     "overload.ingress_flood",
     "overload.applier_stall",
     "overload.deadline_skew",
+    "fsm.apply_skip",
+    "store.bitflip",
+    "disk.silent_corrupt",
 )
 
 # Points that must be injected in these specific functions (enforced by
@@ -248,6 +278,9 @@ REQUIRED_SITES = {
     "overload.ingress_flood": ("HTTPServer._route",),
     "overload.applier_stall": ("PlanApplier.run_loop",),
     "overload.deadline_skew": ("from_wire",),
+    "fsm.apply_skip": ("RaftNode._run_apply",),
+    "store.bitflip": ("RaftNode._run_apply",),
+    "disk.silent_corrupt": ("RaftNode._install_snapshot_blob",),
 }
 
 
@@ -316,6 +349,9 @@ class ChaosRegistry:
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
         self.stats: Dict[str, int] = defaultdict(int)
+        # point -> {where tag -> remaining fire count}; armed by
+        # target(), consumed by should(point, where=...)
+        self._targets: Dict[str, Dict[str, int]] = {}
 
     def arm(self, now: Optional[float] = None) -> None:
         """Anchor the phase clock: phase windows are measured from here.
@@ -402,7 +438,49 @@ class ChaosRegistry:
                   for ph, r in sched.items()]
         return ";".join(parts)
 
-    def should(self, point: str) -> bool:
+    def target(self, point: str, where: str, count: int = 1) -> None:
+        """Arm `point` to fire exactly `count` times at the injection
+        site tagged `where` (a node name).  While a point has any armed
+        target it fires ONLY by tag match — never by rate — so a drill
+        can corrupt one victim replica without the process-global rate
+        touching its healthy peers.  `count <= 0` disarms the
+        (point, where) target (a drill re-arming elsewhere must revoke
+        the old one, or a restarted victim could fire it later)."""
+        if point not in FAULT_POINTS:
+            raise ValueError(f"unknown chaos fault point {point!r} "
+                             f"(known: {', '.join(FAULT_POINTS)})")
+        with self._lock:
+            if count <= 0:
+                tmap = self._targets.get(point)
+                if tmap is not None:
+                    tmap.pop(str(where), None)
+                    if not tmap:
+                        del self._targets[point]
+                return
+            self._targets.setdefault(point, {})[str(where)] = int(count)
+
+    def pending_target(self, point: str, where: str) -> int:
+        """Remaining armed fire count for (point, where) — drills poll
+        this to learn whether the injection actually landed (the victim
+        may have been replaced before its apply loop hit the site)."""
+        with self._lock:
+            return self._targets.get(point, {}).get(str(where), 0)
+
+    def should(self, point: str, where: Optional[str] = None) -> bool:
+        with self._lock:
+            tmap = self._targets.get(point)
+            if tmap:
+                left = tmap.get(where, 0)
+                if left <= 0:
+                    return False
+                if left == 1:
+                    del tmap[where]
+                    if not tmap:
+                        del self._targets[point]
+                else:
+                    tmap[where] = left - 1
+                self.stats[point] += 1
+                return True
         rate = self.effective_rate(point)
         if rate <= 0.0:
             return False
@@ -446,9 +524,9 @@ def arm(now: Optional[float] = None) -> None:
         reg.arm(now)
 
 
-def should(point: str) -> bool:
+def should(point: str, where: Optional[str] = None) -> bool:
     reg = active
-    return reg is not None and reg.should(point)
+    return reg is not None and reg.should(point, where)
 
 
 def fire(point: str) -> None:

@@ -138,6 +138,20 @@ class Client:
             self.device_manager.fingerprint() + list(devices)
         changed = self._device_snapshot() != before
         if changed and register:
+            # delta path first: the fingerprint change rides the
+            # leader's batched write path (one NodeFingerprintBatch
+            # entry per flush tick across the whole fleet) instead of
+            # a full Node.Register raft entry per change.  Fall back
+            # to re-register if the server doesn't know us (or is too
+            # old to know the RPC).
+            try:
+                resp = self.rpc("Node.UpdateFingerprint", {
+                    "node_id": self.node.id,
+                    "devices": list(self.node.node_resources.devices)})
+                if resp.get("known", False):
+                    return changed
+            except Exception:                   # noqa: BLE001
+                pass
             try:
                 self.rpc("Node.Register", {"node": self.node})
             except Exception:                   # noqa: BLE001

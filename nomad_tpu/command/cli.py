@@ -568,6 +568,42 @@ class Cli:
         self.p("Leadership transfer did not complete")
         return 1
 
+    def cmd_operator_integrity(self, args) -> int:
+        v = self.api.operator.integrity()
+        last = v.get("last") or {}
+        self.p(f"Server              = {v.get('server')}"
+               f"{' (leader)' if v.get('leader') else ''}")
+        self.p(f"Quarantined         = {v.get('quarantined')}"
+               + (f" ({v['quarantine_reason']})"
+                  if v.get("quarantine_reason") else ""))
+        self.p(f"Last Checkpoint     = "
+               + (f"index {last['index']}  digest {last['digest']}  "
+                  f"{'full' if last.get('full') else 'incremental'}"
+                  if last else "<none>"))
+        c = v.get("counters") or {}
+        self.p(f"Checkpoints         = {c.get('checkpoints', 0)} "
+               f"({c.get('full_walks', 0)} full walks)")
+        self.p(f"Alarms / Repairs    = {c.get('alarms', 0)} alarms, "
+               f"{c.get('repairs_started', 0)} repairs started, "
+               f"{c.get('repairs_verified', 0)} verified")
+        peers = v.get("peers") or {}
+        if peers:
+            rows = []
+            for name in sorted(peers):
+                p = peers[name]
+                rows.append([
+                    name,
+                    str(p.get("index")) if p.get("index") is not None
+                    else "-",
+                    p.get("digest") or "-",
+                    str(p.get("lag")) if p.get("lag") is not None
+                    else "-",
+                    p.get("divergent") or "",
+                    str(p.get("unverified_acks", 0))])
+            self.p(_fmt_table(rows, ["Peer", "Index", "Digest", "Lag",
+                                     "Divergent", "Unverified"]))
+        return 1 if v.get("quarantined") else 0
+
     def cmd_operator_trace(self, args) -> int:
         if not getattr(args, "trace_id", None):
             traces = self.api.operator.traces()
@@ -960,6 +996,11 @@ def build_parser() -> argparse.ArgumentParser:
     o.add_argument("-chrome", dest="chrome_out", default=None,
                    metavar="FILE")
     o.set_defaults(fn="cmd_operator_trace")
+    o = op.add_parser("integrity",
+                      help="replica-integrity plane: last checkpoint "
+                           "digest, per-peer divergence, quarantine "
+                           "state, repair counters")
+    o.set_defaults(fn="cmd_operator_integrity")
 
     acl = sub.add_parser("acl", help="acl commands").add_subparsers(
         dest="sub", required=True)

@@ -180,6 +180,11 @@ class HeartbeatBatcher:
         self._pending: Dict[str, Tuple[str, float]] = {}
         self._transitions: Set[str] = set()
         self._last_stamp: Dict[str, float] = {}
+        # device/attribute re-fingerprint deltas (Node.UpdateFingerprint):
+        # coalesce per node, flush as ONE NodeFingerprintBatch entry —
+        # a 1K-node fingerprint storm commits O(flush-ticks) raft
+        # entries, not O(changes) full Node.Register round-trips
+        self._fp_pending: Dict[str, dict] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # bounded pending table: at the cap the writer forces a flush
@@ -195,6 +200,7 @@ class HeartbeatBatcher:
             self._pending.clear()
             self._transitions.clear()
             self._last_stamp.clear()
+            self._fp_pending.clear()
         self._stop = threading.Event()   # fresh per leadership tenure
         self._force = threading.Event()
         self._thread = threading.Thread(target=self._run,
@@ -208,9 +214,12 @@ class HeartbeatBatcher:
             self._thread.join(1.0)
         with self._lock:
             # a deposed leader's queued writes die with its tenure; the
-            # successor's own expiry/revival pass re-derives them
+            # successor's own expiry/revival pass re-derives them (a
+            # dropped fingerprint delta re-sends on the client's next
+            # fingerprint pass or full re-register)
             self._pending.clear()
             self._transitions.clear()
+            self._fp_pending.clear()
 
     @property
     def running(self) -> bool:
@@ -246,6 +255,18 @@ class HeartbeatBatcher:
         if full:
             self._force.set()
 
+    def note_fingerprint(self, node_id: str, update: dict) -> None:
+        """Queue a device/attribute re-fingerprint delta for the next
+        flush (newest delta per node wins — the client sends its full
+        current device list, so deltas are self-superseding)."""
+        with self._lock:
+            u = self._fp_pending.setdefault(node_id,
+                                            {"node_id": node_id})
+            u.update(update)
+            full = len(self._fp_pending) >= self.pending_max
+        if full:
+            self._force.set()
+
     def _run(self) -> None:
         while not self._stop.is_set():
             forced = self._force.wait(self.interval)
@@ -273,17 +294,28 @@ class HeartbeatBatcher:
                 return
             chaos.maybe_delay("heartbeat.batch_stall")
         with self._lock:
-            if not self._pending:
+            if not self._pending and not self._fp_pending:
                 return
             pending = self._pending
             transitions = self._transitions
+            fp_pending = self._fp_pending
             self._pending = {}
             self._transitions = set()
+            self._fp_pending = {}
         from nomad_tpu.raft.fsm import MessageType
-        self.server.apply(MessageType.NODE_HEARTBEAT_BATCH, {
-            "updates": [{"node_id": nid, "status": st, "updated_at": ts}
-                        for nid, (st, ts) in pending.items()]})
-        global_metrics.incr("heartbeat.batch_flush")
-        global_metrics.incr("heartbeat.batch_nodes", float(len(pending)))
+        if pending:
+            self.server.apply(MessageType.NODE_HEARTBEAT_BATCH, {
+                "updates": [{"node_id": nid, "status": st,
+                             "updated_at": ts}
+                            for nid, (st, ts) in pending.items()]})
+            global_metrics.incr("heartbeat.batch_flush")
+            global_metrics.incr("heartbeat.batch_nodes",
+                                float(len(pending)))
+        if fp_pending:
+            self.server.apply(MessageType.NODE_FINGERPRINT_BATCH, {
+                "updates": list(fp_pending.values())})
+            global_metrics.incr("heartbeat.fingerprint_flush")
+            global_metrics.incr("heartbeat.fingerprint_nodes",
+                                float(len(fp_pending)))
         for nid in transitions:
             self.server.create_node_evals(nid)

@@ -67,7 +67,9 @@ class ServerConfig:
                  gc_interval: float = 300.0,
                  data_dir: Optional[str] = None,
                  region: str = "global",
-                 failed_eval_followup_delay: float = 60.0):
+                 failed_eval_followup_delay: float = 60.0,
+                 integrity_interval: float = 2.0,
+                 integrity_full_every: int = 4):
         self.num_schedulers = num_schedulers
         self.enabled_schedulers = enabled_schedulers or \
             ["service", "batch", "system", "sysbatch"]
@@ -82,6 +84,14 @@ class ServerConfig:
         self.data_dir = data_dir
         self.region = region
         self.failed_eval_followup_delay = failed_eval_followup_delay
+        # replica-integrity plane: STATE_CHECKPOINT proposal cadence
+        # (seconds; <= 0 disables) and the every-Nth full digest walk;
+        # NOMAD_TPU_INTEGRITY_INTERVAL / _FULL_EVERY override
+        self.integrity_interval = knobs.get_float(
+            "NOMAD_TPU_INTEGRITY_INTERVAL", default=integrity_interval)
+        self.integrity_full_every = max(1, knobs.get_int(
+            "NOMAD_TPU_INTEGRITY_FULL_EVERY",
+            default=integrity_full_every))
 
 
 class Server:
@@ -460,6 +470,49 @@ class Server:
                                         daemon=True)
                 ap_t.start()
                 self._threads.append(ap_t)
+                if self.config.integrity_interval > 0:
+                    it_t = threading.Thread(target=self._integrity_loop,
+                                            args=(stop,), name="integrity",
+                                            daemon=True)
+                    it_t.start()
+                    self._threads.append(it_t)
+
+    # ------------------------------------------------------------- integrity
+
+    def _integrity_loop(self, stop: threading.Event) -> None:
+        """Leader-side STATE_CHECKPOINT proposer (Paxos-Made-Live
+        log-stamped checksums): one checkpoint entry per interval, every
+        `integrity_full_every`-th a full digest walk, plus an immediate
+        full walk whenever a mismatch at an incremental checkpoint
+        escalates.  The entry is stamped at PROPOSE time — the FSM never
+        reads the clock — and applies as a deterministic no-op; the raft
+        apply loop computes the digest at its log position."""
+        interval = self.config.integrity_interval
+        full_every = self.config.integrity_full_every
+        seq = 0
+        last = _time.monotonic()
+        while not stop.wait(min(0.05, interval / 4.0)):
+            raft = self.raft
+            if raft is None or not raft.is_leader:
+                continue
+            escalated = raft.integrity.escalation_pending()
+            if not escalated and _time.monotonic() - last < interval:
+                continue
+            seq += 1
+            full = escalated or (seq % full_every == 0)
+            if escalated:
+                raft.integrity.take_escalation()
+            last = _time.monotonic()
+            try:
+                self.apply_local(MessageType.STATE_CHECKPOINT, {
+                    "seq": seq, "full": full,
+                    "proposed_at": _time.time()})
+            except Exception:                       # noqa: BLE001
+                # deposed mid-propose or transient quorum loss: the
+                # next tick retries (seq gaps are fine — the digest
+                # protocol keys on log index, not seq)
+                log.debug("integrity checkpoint propose failed",
+                          exc_info=True)
 
     # ------------------------------------------------------------- autopilot
 
@@ -1034,6 +1087,29 @@ class Server:
         for nid in node_ids:
             ttl = self.node_heartbeat(nid)
         return ttl
+
+    def node_update_fingerprint(self, node_id: str, update: dict) -> dict:
+        """Node.UpdateFingerprint: a device/attribute re-fingerprint
+        DELTA from a registered client.  Rides the heartbeat batcher's
+        coalesced write path (one NodeFingerprintBatch raft entry per
+        flush tick) instead of a full Node.Register per change; an
+        unknown node returns known=False so the client falls back to a
+        full re-register."""
+        if self.raft is not None and not self.raft.is_leader:
+            args = dict(update)
+            args["node_id"] = node_id
+            return self.rpc_leader("Node.UpdateFingerprint", args)
+        if self.store.node_by_id(node_id) is None:
+            return {"known": False}
+        payload = {k: v for k, v in update.items()
+                   if k in ("devices", "attributes")}
+        payload["node_id"] = node_id
+        if self.heartbeat_batch.running:
+            self.heartbeat_batch.note_fingerprint(node_id, payload)
+        else:
+            self.apply(MessageType.NODE_FINGERPRINT_BATCH,
+                       {"updates": [payload]})
+        return {"known": True}
 
     def update_node_status(self, node_id: str, status: str) -> List[Evaluation]:
         """Node.UpdateStatus: transition + evals for affected jobs."""

@@ -127,6 +127,14 @@ KNOBS: Dict[str, Knob] = {
         "8192", "int",
         "heartbeat-batcher pending cap; at the cap the writer forces "
         "a flush"),
+    "NOMAD_TPU_INTEGRITY_INTERVAL": Knob(
+        "2.0", "float",
+        "seconds between leader `STATE_CHECKPOINT` proposals (replica "
+        "digest votes); <= 0 disables the integrity plane"),
+    "NOMAD_TPU_INTEGRITY_FULL_EVERY": Knob(
+        "4", "int",
+        "every Nth checkpoint full-walks all tables (ground truth for "
+        "divergence conviction; between them digests are incremental)"),
     "NOMAD_TPU_FLEET_AGENTS": Knob(
         "10000", "int",
         "in-process client agents the `fleet_soak` bench cells "

@@ -27,6 +27,7 @@ class MessageType:
     NODE_DEREGISTER = "NodeDeregisterRequest"
     NODE_UPDATE_STATUS = "NodeUpdateStatusRequest"
     NODE_HEARTBEAT_BATCH = "NodeHeartbeatBatchRequest"
+    NODE_FINGERPRINT_BATCH = "NodeFingerprintBatchRequest"
     NODE_UPDATE_DRAIN = "NodeUpdateDrainRequest"
     NODE_UPDATE_ELIGIBILITY = "NodeUpdateEligibilityRequest"
     JOB_REGISTER = "JobRegisterRequest"
@@ -56,6 +57,69 @@ class MessageType:
     SERVICE_REGISTER = "ServiceRegistrationUpsertRequest"
     SERVICE_DEREGISTER = "ServiceRegistrationDeleteRequest"
     NOOP = "Noop"                  # leadership-establishment barrier entry
+    STATE_CHECKPOINT = "StateCheckpointRequest"  # integrity digest stamp
+
+
+# Snapshot tables each message type may touch, for the integrity plane's
+# incremental digests (raft/integrity.py): a checkpoint recomputes only
+# the tables dirtied since the last one.  Entries are SUPERSETS of what
+# the handlers' store calls mutate — over-declaring costs a recompute,
+# and the periodic full walk (ground truth) plus the conviction-on-full
+# rule in IntegrityTracker.evaluate mean even an under-declared entry
+# can delay detection but never convict a healthy replica.  Types not
+# listed here (periphery `extra` handlers) dirty EVERYTHING.
+_APPLY_TOUCHES = {
+    MessageType.NODE_REGISTER: ("nodes", "csi_plugins"),
+    MessageType.NODE_DEREGISTER: ("nodes", "csi_plugins"),
+    MessageType.NODE_UPDATE_STATUS: ("nodes",),
+    MessageType.NODE_HEARTBEAT_BATCH: ("nodes",),
+    MessageType.NODE_FINGERPRINT_BATCH: ("nodes",),
+    MessageType.NODE_UPDATE_DRAIN: ("nodes",),
+    MessageType.NODE_UPDATE_ELIGIBILITY: ("nodes",),
+    MessageType.JOB_REGISTER:
+        ("jobs", "job_versions", "job_summaries", "namespaces"),
+    MessageType.JOB_DEREGISTER:
+        ("jobs", "job_versions", "job_summaries", "scaling_events",
+         "deployments", "evals", "allocs", "services", "quota_usage"),
+    MessageType.JOB_STABILITY: ("jobs", "job_versions"),
+    MessageType.EVAL_UPDATE: ("evals",),
+    MessageType.EVAL_DELETE:
+        ("evals", "allocs", "job_summaries", "quota_usage", "services"),
+    MessageType.ALLOC_UPDATE:
+        ("allocs", "job_summaries", "quota_usage", "services",
+         "deployments"),
+    MessageType.ALLOC_CLIENT_UPDATE:
+        ("allocs", "job_summaries", "quota_usage", "services",
+         "deployments"),
+    MessageType.ALLOC_UPDATE_DESIRED_TRANSITION:
+        ("allocs", "job_summaries", "quota_usage", "services",
+         "deployments", "evals"),
+    MessageType.APPLY_PLAN_RESULTS:
+        ("allocs", "evals", "deployments", "job_summaries", "quota_usage",
+         "applied_plan_ids", "services"),
+    MessageType.DEPLOYMENT_UPSERT:
+        ("deployments", "jobs", "job_versions", "allocs", "evals",
+         "job_summaries"),
+    MessageType.DEPLOYMENT_DELETE: ("deployments",),
+    MessageType.SCHEDULER_CONFIG: ("scheduler_config",),
+    MessageType.NAMESPACE_UPSERT: ("namespaces",),
+    MessageType.NAMESPACE_DELETE: ("namespaces",),
+    MessageType.QUOTA_SPEC_UPSERT: ("quota_specs", "quota_usage"),
+    MessageType.QUOTA_SPEC_DELETE: ("quota_specs", "quota_usage"),
+    MessageType.CSI_VOLUME_REGISTER: ("csi_volumes", "csi_plugins"),
+    MessageType.CSI_VOLUME_DEREGISTER: ("csi_volumes", "csi_plugins"),
+    MessageType.CSI_VOLUME_CLAIM: ("csi_volumes", "csi_plugins"),
+    MessageType.ACL_POLICY_UPSERT: ("acl_policies",),
+    MessageType.ACL_POLICY_DELETE: ("acl_policies",),
+    MessageType.ACL_TOKEN_UPSERT: ("acl_tokens",),
+    MessageType.ACL_TOKEN_DELETE: ("acl_tokens",),
+    MessageType.SCALING_EVENT: ("scaling_events",),
+    MessageType.SERVICE_REGISTER: ("services",),
+    MessageType.SERVICE_DEREGISTER: ("services",),
+    MessageType.NOOP: (),
+    MessageType.STATE_CHECKPOINT: (),
+    "RaftConfiguration": (),
+}
 
 
 class NomadFSM:
@@ -75,6 +139,8 @@ class NomadFSM:
             MessageType.NODE_UPDATE_STATUS: self._apply_node_update_status,
             MessageType.NODE_HEARTBEAT_BATCH:
                 self._apply_node_heartbeat_batch,
+            MessageType.NODE_FINGERPRINT_BATCH:
+                self._apply_node_fingerprint_batch,
             MessageType.NODE_UPDATE_DRAIN: self._apply_node_update_drain,
             MessageType.NODE_UPDATE_ELIGIBILITY: self._apply_node_eligibility,
             MessageType.JOB_REGISTER: self._apply_job_register,
@@ -105,6 +171,11 @@ class NomadFSM:
             MessageType.SERVICE_REGISTER: self._apply_service_register,
             MessageType.SERVICE_DEREGISTER: self._apply_service_deregister,
             MessageType.NOOP: lambda index, p: None,
+            # integrity checkpoints are deterministic no-ops in the FSM:
+            # the digest walk happens in the raft apply loop (outside the
+            # replicated-write cone), and the entry is stamped at propose
+            # time so the FSM never reads the clock
+            MessageType.STATE_CHECKPOINT: lambda index, p: None,
             # cluster configuration entries (Raft §4.1) are consumed by
             # the raft layer on append; the FSM treats them as no-ops so
             # replicas stay byte-identical across membership changes
@@ -114,6 +185,9 @@ class NomadFSM:
         self.extra: Dict[str, callable] = {}
         self.snapshot_extra: Dict[str, callable] = {}
         self.restore_extra: Dict[str, callable] = {}
+        # integrity plane's incremental-digest hook: called after each
+        # apply with the tables the entry may have touched (None = all)
+        self.dirty_hook = None
 
     # ------------------------------------------------------------- apply
 
@@ -122,6 +196,9 @@ class NomadFSM:
         if fn is None:
             raise ValueError(f"unknown FSM message type {msg_type!r}")
         fn(index, payload)
+        hook = self.dirty_hook
+        if hook is not None:
+            hook(_APPLY_TOUCHES.get(msg_type))
 
     # --- nodes
 
@@ -151,6 +228,13 @@ class NomadFSM:
         # single store write (updated_at was stamped at propose time —
         # the FSM never reads the clock)
         self.store.update_node_statuses_many(index, p["updates"])
+
+    def _apply_node_fingerprint_batch(self, index, p):
+        # device/attribute re-fingerprint deltas coalesce through the
+        # HeartbeatBatcher: one entry per flush tick carries a whole
+        # fleet's fingerprint churn instead of one full Node.Register
+        # per change (stamped at propose time, like the heartbeat batch)
+        self.store.update_node_fingerprints_many(index, p["updates"])
 
     def _apply_node_update_drain(self, index, p):
         self.store.update_node_drain(
@@ -315,9 +399,10 @@ class NomadFSM:
         self.store.delete_service_registrations(
             index, p.get("ids"), alloc_id=p.get("alloc_id"))
 
-    def snapshot(self) -> bytes:
-        """Serialize the full store (reference nomadFSM.Snapshot →
-        nomadSnapshot.Persist, nomad/fsm.go)."""
+    def snapshot_tables(self) -> dict:
+        """The snapshot record dict BEFORE pickling — the integrity
+        plane digests these tables directly (state/digest.py) so the
+        runtime digest and the snapshot bytes share one encoding."""
         s = self.store
         with s._lock:
             data = {
@@ -349,7 +434,12 @@ class NomadFSM:
                 "extra": {name: fn() for name, fn in
                           getattr(self, "snapshot_extra", {}).items()},
             }
-        return pickle.dumps(data)
+        return data
+
+    def snapshot(self) -> bytes:
+        """Serialize the full store (reference nomadFSM.Snapshot →
+        nomadSnapshot.Persist, nomad/fsm.go)."""
+        return pickle.dumps(self.snapshot_tables())
 
     def restore(self, blob: bytes) -> None:
         """Rebuild the store from a snapshot (reference nomadFSM.Restore).

@@ -1,0 +1,378 @@
+"""Replica-integrity plane: log-stamped state digests, divergence
+quarantine, and anti-entropy self-repair.
+
+Paxos Made Live (Chandra et al., PODC '07) describes periodic
+log-stamped state checksums catching real replica-divergence bugs in
+production Chubby cells; Dynamo (DeCandia et al., SOSP '07) repairs the
+inconsistency it detects with anti-entropy.  This module is both ideas
+on top of the machinery the repo already has: the byte-identity
+encoding the scenario battery gates on (state/digest.py) and the
+resumable chunked InstallSnapshot stream as the repair channel.
+
+Protocol:
+
+- The leader periodically proposes a ``STATE_CHECKPOINT`` log entry
+  (core/server.py `_integrity_loop`), stamped at PROPOSE time — the FSM
+  itself never reads the clock, so the entry applies as a deterministic
+  no-op on every replica.
+- At apply, every replica computes per-table digests of the replicated
+  tables over the canonical snapshot encoding (`on_checkpoint`).
+  Digests are incrementally maintained: FSM apply hooks mark the tables
+  each message type touches dirty, clean tables reuse the cached
+  digest, and every ``NOMAD_TPU_INTEGRITY_FULL_EVERY``-th checkpoint
+  full-walks all tables — the full walk is ground truth and catches
+  silent corruption (a bit flip marks nothing dirty).
+- Followers piggyback ``{index, digest, per_table}`` on heartbeat-ack
+  responses; the leader votes digest-equality by MAJORITY at each
+  checkpoint index.  An ack WITHOUT the digest field (a mixed-version
+  peer mid rolling-upgrade) is "unverified": counted, never judged — a
+  healthy old replica must never be false-positive repaired.
+- A mismatch at an INCREMENTAL checkpoint raises the integrity alarm
+  and escalates: the very next proposal is a full walk.  A mismatch at
+  a FULL checkpoint convicts: the minority replica is divergent.  The
+  two-step keeps a stale per-type dirty map from ever convicting a
+  healthy replica — conviction only happens on ground truth.
+- A convicted follower self-quarantines (serving/gate.py refuses
+  stale/lease reads with a ``quarantined`` hint, autopilot sees it
+  unhealthy) while still replicating and voting; the leader streams a
+  repair snapshot that wipes and rebuilds its FSM, and the follower
+  re-admits itself only after recomputing the digest of the restored
+  state and matching the leader's expected digest (`verify_restore`).
+  A divergent LEADER (it lost the majority vote) quarantines its own
+  reads and hands leadership off so it can be repaired as a follower.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from nomad_tpu.state import digest as state_digest
+from nomad_tpu.telemetry import global_metrics
+
+log = logging.getLogger("nomad_tpu.raft.integrity")
+
+# Tables recomputed at EVERY checkpoint regardless of dirty marks:
+# scalars too cheap to track that change on almost every apply.
+_ALWAYS_DIRTY = frozenset({"latest_index", "extra"})
+
+
+class IntegrityTracker:
+    """Per-replica integrity state: the local checkpoint digest, the
+    quarantine flag, and (on the leader) the per-peer report table the
+    majority vote runs over.  Owned by a RaftNode; all shared state
+    lives under `_lock` (leaf lock — never held across a digest walk or
+    any raft call)."""
+
+    _LOCK_NAME = "_lock"
+
+    def __init__(self, node):
+        self._node = node
+        self._lock = threading.Lock()
+        # local digest state
+        self._cache: Dict[str, str] = {}
+        self._dirty: Dict[str, bool] = {}
+        self._all_dirty = True          # boot: first checkpoint full-walks
+        self.last: Optional[dict] = None
+        # quarantine
+        self.quarantined = False
+        self.quarantine_reason = ""
+        # leader-side vote state
+        self._reports: Dict[str, dict] = {}
+        self._unverified: Dict[str, int] = {}
+        self._divergent: Dict[str, str] = {}   # peer -> first divergent table
+        self._alarmed_index = -1
+        self._escalate = threading.Event()
+        self.counters: Dict[str, int] = {
+            "checkpoints": 0, "full_walks": 0, "alarms": 0,
+            "quarantines": 0, "repairs_started": 0, "repairs_verified": 0,
+            "unverified_acks": 0,
+        }
+
+    # ------------------------------------------------------- local digests
+
+    def note_dirty(self, tables) -> None:
+        """FSM apply hook: mark the tables an applied entry may have
+        touched (None = everything, the conservative default)."""
+        with self._lock:
+            if tables is None:
+                self._all_dirty = True
+                return
+            for name in tables:
+                self._dirty[name] = True
+
+    def note_restore(self) -> None:
+        """A snapshot install replaced the store wholesale: the digest
+        cache is void and there is no current checkpoint."""
+        with self._lock:
+            self._cache = {}
+            self._dirty = {}
+            self._all_dirty = True
+            self.last = None
+
+    def on_checkpoint(self, index: int, payload: dict) -> dict:
+        """Compute this replica's digest at a STATE_CHECKPOINT apply.
+        Runs on the apply thread under the node's fsm lock, so the walk
+        sees a quiescent store; only bookkeeping takes `_lock`."""
+        tables = self._node.fsm.snapshot_tables()
+        with self._lock:
+            full = bool(payload.get("full")) or self._all_dirty
+            dirty = set(self._dirty)
+            self._dirty = {}
+            self._all_dirty = False
+            cache = self._cache
+        per: Dict[str, str] = {}
+        for name in sorted(tables):
+            if full or name in dirty or name in _ALWAYS_DIRTY \
+                    or name not in cache:
+                per[name] = state_digest.table_digest(tables[name])
+            else:
+                per[name] = cache[name]
+        overall = state_digest.combine(per)
+        rec = {"index": index, "digest": overall, "per_table": per,
+               "full": full, "seq": int(payload.get("seq", 0))}
+        with self._lock:
+            self._cache = per
+            self.last = rec
+            self.counters["checkpoints"] += 1
+            if full:
+                self.counters["full_walks"] += 1
+        global_metrics.incr("integrity.checkpoint")
+        if full:
+            global_metrics.incr("integrity.full_walk")
+        global_metrics.set_gauge("integrity.last_index", float(index))
+        return rec
+
+    def report(self) -> Optional[dict]:
+        """The `{index, digest, per_table}` record piggybacked on this
+        replica's heartbeat acks (None before the first checkpoint)."""
+        with self._lock:
+            if self.last is None:
+                return None
+            return {"index": self.last["index"],
+                    "digest": self.last["digest"],
+                    "per_table": self.last["per_table"]}
+
+    # --------------------------------------------------------- quarantine
+
+    def quarantine(self, reason: str) -> None:
+        with self._lock:
+            if self.quarantined:
+                return
+            self.quarantined = True
+            self.quarantine_reason = reason
+            self.counters["quarantines"] += 1
+        global_metrics.incr("integrity.quarantine")
+        global_metrics.set_gauge("integrity.quarantined", 1.0)
+        log.warning("integrity: %s quarantined (%s) — stale/lease reads "
+                    "refused until digest-verified re-admission",
+                    self._node.name, reason)
+
+    def clear_quarantine(self, why: str) -> None:
+        with self._lock:
+            if not self.quarantined:
+                return
+            self.quarantined = False
+            self.quarantine_reason = ""
+        global_metrics.set_gauge("integrity.quarantined", 0.0)
+        log.warning("integrity: %s re-admitted (%s)", self._node.name, why)
+
+    def verify_restore(self, expected: Optional[str]) -> Optional[bool]:
+        """Digest-verified re-admission after a repair install: recompute
+        the FULL digest of the restored store and compare against the
+        digest the leader computed from the streamed blob.  Match clears
+        quarantine; mismatch (the install path itself corrupted the
+        bytes) stays quarantined so the leader retries; an absent
+        expected digest (mixed-version leader) cannot verify."""
+        tables = self._node.fsm.snapshot_tables()
+        per = state_digest.tables_digests(tables)
+        overall = state_digest.combine(per)
+        with self._lock:
+            self._cache = per
+            self._dirty = {}
+            self._all_dirty = False
+            self.last = None        # no checkpoint since the rewind
+        if expected is None:
+            return None
+        if overall == expected:
+            self.clear_quarantine("repair digest verified")
+            return True
+        global_metrics.incr("integrity.repair_mismatch")
+        log.warning("integrity: %s repair digest mismatch (want %s got "
+                    "%s) — staying quarantined", self._node.name,
+                    expected, overall)
+        return False
+
+    # ------------------------------------------------------ leader voting
+
+    def observe_ack(self, peer: str, rep: Optional[dict]) -> None:
+        """Record a follower's piggybacked digest report (None = the ack
+        carried no digest field: a mixed-version peer, counted as
+        unverified and never judged)."""
+        with self._lock:
+            if rep is None:
+                self._unverified[peer] = self._unverified.get(peer, 0) + 1
+                self.counters["unverified_acks"] += 1
+            else:
+                self._reports[peer] = rep
+        if rep is None:
+            global_metrics.incr("integrity.ack_unverified")
+
+    def evaluate(self, voters, members=None) -> dict:
+        """Majority-vote the newest checkpoint index.  Returns the
+        actions the node must take: ``{"divergent": {peer: table},
+        "self_outlier": bool, "repair": [peers]}``.  Quorum is over the
+        VOTER set — non-voters are judged (and repaired) but never
+        outvote the quorum.  `members` is the full replication set
+        (voters + non-voters) when the caller knows it: convictions
+        and reports for peers no longer in it are dropped — a destroyed
+        server removed by membership change must not pin an
+        unresolvable conviction (and an unhealthy verdict) forever."""
+        actions = {"divergent": {}, "self_outlier": False, "repair": []}
+        newly: Dict[str, str] = {}
+        with self._lock:
+            if members is not None:
+                known = set(members)
+                for gone in [p for p in self._divergent
+                             if p not in known]:
+                    del self._divergent[gone]
+                for gone in [p for p in self._reports
+                             if p not in known]:
+                    del self._reports[gone]
+            last = self.last
+            if last is None:
+                return actions
+            idx = last["index"]
+            me = self._node.name
+            votes = {me: last}
+            for peer, rep in self._reports.items():
+                if rep.get("index") == idx:
+                    votes[peer] = rep
+            # clear divergence for peers whose current report agrees —
+            # the self-heal path (repair landed, or a replaced server)
+            for peer in list(self._divergent):
+                rep = votes.get(peer)
+                if rep is not None and rep["digest"] == last["digest"]:
+                    del self._divergent[peer]
+            digests = {rep["digest"] for rep in votes.values()}
+            if len(digests) <= 1:
+                actions["repair"] = sorted(self._divergent)
+                return actions
+            if idx > self._alarmed_index:
+                self._alarmed_index = idx
+                self.counters["alarms"] += 1
+                global_metrics.incr("integrity.mismatch")
+            if not last.get("full"):
+                # incremental mismatch: alarm + escalate to a full walk;
+                # conviction only ever happens on ground truth
+                self._escalate.set()
+                actions["repair"] = sorted(self._divergent)
+                return actions
+            # Judge fresh on EVERY ack at this index: reports trickle in
+            # one heartbeat at a time, so the first pass at an index may
+            # see too few same-index votes for any digest to reach
+            # quorum — a later ack completes the vote.  Conviction is
+            # idempotent through `_divergent`, so re-judging is free.
+            need = len(set(voters)) // 2 + 1
+            groups: Dict[str, list] = {}
+            for name, rep in votes.items():
+                groups.setdefault(rep["digest"], []).append(name)
+            majority = None
+            for dig, names in groups.items():
+                if sum(1 for n in names if n in voters or n == me) >= need:
+                    majority = dig
+                    break
+            if majority is None:
+                # no digest reaches quorum yet (votes still in flight,
+                # or too many unverified mixed-version peers): alarm
+                # only, never quarantine
+                actions["repair"] = sorted(self._divergent)
+                return actions
+            if last["digest"] != majority:
+                actions["self_outlier"] = True
+                return actions
+            for name, rep in votes.items():
+                if name == me or rep["digest"] == majority:
+                    continue
+                if name not in self._divergent:
+                    self.counters["repairs_started"] += 1
+                    table = state_digest.first_divergence(
+                        last["per_table"], rep.get("per_table") or {})
+                    self._divergent[name] = table or "?"
+                    newly[name] = table or "?"
+            actions["divergent"] = dict(self._divergent)
+            actions["repair"] = sorted(self._divergent)
+        for peer, table in sorted(newly.items()):
+            global_metrics.incr("integrity.repair_start")
+            log.warning(
+                "integrity ALARM: replica %s diverged at checkpoint "
+                "index %d — first divergent table %r; quarantining "
+                "and starting anti-entropy repair", peer, idx, table)
+        return actions
+
+    def peer_divergent(self, peer: str) -> Optional[str]:
+        """The first divergent table a convicted peer was convicted on
+        (truthy while convicted), or None for a healthy peer."""
+        with self._lock:
+            return self._divergent.get(peer)
+
+    def repair_result(self, peer: str, verified: Optional[bool]) -> None:
+        """A repair stream finished for `peer`.  True = the follower
+        digest-verified the restored state: conviction lifted.  False =
+        verification failed (retry).  None = a mixed-version follower
+        that cannot verify: lift the conviction and let the next
+        checkpoint re-judge rather than repair-looping forever."""
+        if verified is False:
+            return
+        with self._lock:
+            was = self._divergent.pop(peer, None)
+            # drop the pre-repair report too: it is stale by
+            # construction (the repair rewound the peer past it) and
+            # would instantly re-convict at the same checkpoint index
+            self._reports.pop(peer, None)
+            if was is not None and verified:
+                self.counters["repairs_verified"] += 1
+        if was is not None and verified:
+            global_metrics.incr("integrity.repair_verified")
+            log.warning("integrity: replica %s repaired and digest-"
+                        "verified — re-admitted", peer)
+
+    def escalation_pending(self) -> bool:
+        return self._escalate.is_set()
+
+    def take_escalation(self) -> bool:
+        """Consume the escalate-to-full-walk request (proposer side)."""
+        if self._escalate.is_set():
+            self._escalate.clear()
+            return True
+        return False
+
+    # ----------------------------------------------------- operator view
+
+    def operator_view(self) -> dict:
+        """The `/v1/operator/integrity` payload: this replica's local
+        view (the leader's includes the per-peer report table)."""
+        with self._lock:
+            last = dict(self.last) if self.last else None
+            if last is not None:
+                last["per_table"] = dict(last["per_table"])
+            peers = {}
+            names = set(self._reports) | set(self._unverified)
+            for peer in sorted(names):
+                rep = self._reports.get(peer)
+                peers[peer] = {
+                    "index": rep["index"] if rep else None,
+                    "digest": rep["digest"] if rep else None,
+                    "lag": (last["index"] - rep["index"])
+                    if (rep and last) else None,
+                    "divergent": self._divergent.get(peer),
+                    "unverified_acks": self._unverified.get(peer, 0),
+                }
+            return {
+                "server": self._node.name,
+                "quarantined": self.quarantined,
+                "quarantine_reason": self.quarantine_reason,
+                "last": last,
+                "peers": peers,
+                "counters": dict(self.counters),
+            }

@@ -42,10 +42,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from nomad_tpu import chaos, knobs, tracing
 from nomad_tpu.analysis import race
+from nomad_tpu.raft.integrity import IntegrityTracker
 from nomad_tpu.raft.log import LogEntry, LogStore
 from nomad_tpu.raft.meta import DurableMeta, MetaPersistError
 from nomad_tpu.raft.snapshot import ChunkSink, FileSnapshotStore
 from nomad_tpu.raft.transport import InMemTransport, Unreachable
+from nomad_tpu.state import digest as state_digest
 from nomad_tpu.telemetry import global_metrics
 from nomad_tpu.utils import requires_lock
 
@@ -65,6 +67,18 @@ SNAP_WINDOW_DEFAULT = 8
 # log entry type carrying a full cluster configuration (Raft §4.1);
 # dispatched as a no-op by the FSM — the raft layer consumes it on append
 CONFIGURATION_MSG = "RaftConfiguration"
+
+# log entry type carrying an integrity checkpoint (Paxos-Made-Live
+# log-stamped state checksums): a no-op for the FSM — the apply loop
+# computes the per-table digest when the entry applies, so every
+# replica stamps the SAME log position
+STATE_CHECKPOINT_MSG = "StateCheckpointRequest"
+
+# entry types the fsm.apply_skip chaos point never skips: skipping a
+# no-op cannot create state divergence, and skipping the checkpoint
+# itself would blind the very detector the drill is exercising
+_APPLY_SKIP_EXEMPT = frozenset({
+    "Noop", STATE_CHECKPOINT_MSG, CONFIGURATION_MSG})
 
 
 class NotLeaderError(Exception):
@@ -226,6 +240,12 @@ class RaftNode:
         self._leadership_q: "queue.Queue[str]" = queue.Queue()
         self._threads: List[threading.Thread] = []
 
+        # replica-integrity plane: per-table digest cache fed by FSM
+        # apply hooks, checkpoint vote state (leader), quarantine flag
+        self.integrity = IntegrityTracker(self)
+        if hasattr(fsm, "dirty_hook"):
+            fsm.dirty_hook = self.integrity.note_dirty
+
         # restart recovery: restore the snapshot (committed state only).
         # The persisted log tail is NOT replayed into the FSM here — those
         # entries may be uncommitted and could be truncated by a new
@@ -240,6 +260,14 @@ class RaftNode:
                 self._last_snapshot_index = rec["index"]
                 self._last_snap_term = rec["term"]
                 self._snap_config = rec.get("config")
+
+        # entries already in the WAL at boot are recovery replay, not
+        # live traffic: the divergence chaos points skip them (an armed
+        # fsm.apply_skip firing inside replay would corrupt whichever
+        # early entry happens to re-apply first, and two churn restarts
+        # replaying the same prefix could then manufacture a corrupt
+        # MAJORITY that outvotes the one still-healthy replica)
+        self._boot_log_end = self.log.last_index
 
         # the configuration is part of replicated state: recover the
         # latest one from snapshot / log tail / durable meta — an
@@ -491,7 +519,11 @@ class RaftNode:
                      < self.config.election_timeout)
             caught = self._match_index.get(server, 0) \
                 >= self.log.last_index - lag
-            return fresh and caught
+        if self.integrity.peer_divergent(server):
+            # a digest-convicted replica is never promoted, whatever its
+            # log position — it re-earns health via verified repair
+            return False
+        return fresh and caught
 
     # ----------------------------------------------------------- transfer
 
@@ -1044,12 +1076,18 @@ class RaftNode:
                 nxt, self.config.max_append_entries)
             commit = self.commit_index
         round_start = time.monotonic()
-        resp = self.transport.call(self.name, peer, "append_entries", {
+        args = {
             "term": term, "leader": self.name,
             "prev_log_index": prev_index, "prev_log_term": prev_term,
             "entries": [(e.index, e.term, e.msg_type, e.payload)
                         for e in entries],
-            "leader_commit": commit})
+            "leader_commit": commit}
+        bad_table = self.integrity.peer_divergent(peer)
+        if bad_table:
+            # convicted peer: the quarantine directive rides every
+            # append until the repair stream digest-verifies
+            args["integrity_quarantine"] = bad_table
+        resp = self.transport.call(self.name, peer, "append_entries", args)
         with self._lock:
             if resp["term"] > self.term:
                 self._step_down(resp["term"])
@@ -1067,24 +1105,98 @@ class RaftNode:
                 self._ack_round_start[peer] = round_start
                 self._peer_contact[peer] = time.monotonic()
                 self._refresh_lease()
+                self.integrity.observe_ack(peer, resp.get("integrity"))
             else:
                 # consistency check failed: back off
                 self._next_index[peer] = max(
                     1, min(nxt - 1, resp.get("last_index", nxt - 1) + 1))
+                return
+        # checkpoint vote + repair kicks run with no locks held — the
+        # evaluation takes only the tracker's leaf lock, and a repair
+        # spawn may force a snapshot (fsm lock)
+        self._integrity_evaluate()
+
+    def _integrity_evaluate(self) -> None:
+        """Leader-side checkpoint vote (no locks held on entry): judge
+        the newest checkpoint by majority, quarantine convicted peers
+        (the directive rides their next append), kick anti-entropy
+        repair streams, and — if WE lost the vote — quarantine our own
+        reads and hand leadership off so the successor repairs us as a
+        follower."""
+        with self._lock:
+            if self.state != LEADER:
+                return
+            race.read("RaftNode._voters", self)
+            voters = list(self._voters) or [self.name]
+            members = set(self._voters) | set(self._nonvoters) \
+                | {self.name}
+        actions = self.integrity.evaluate(voters, members=members)
+        if actions["self_outlier"]:
+            if not self.integrity.quarantined:
+                self.integrity.quarantine(
+                    "lost integrity majority vote as leader")
+                threading.Thread(
+                    target=self._integrity_step_aside,
+                    name=f"raft-integrity-stepdown-{self.name}",
+                    daemon=True).start()
+            return
+        if not actions["repair"]:
+            return
+        need_spawn = []
+        now = time.monotonic()
+        with self._lock:
+            if self.state != LEADER:
+                return
+            for peer in actions["repair"]:
+                t = self._snap_streams.get(peer)
+                if t is not None and t.is_alive():
+                    continue
+                _, next_ok = self._snap_backoff.get(peer, (0, 0.0))
+                if now < next_ok:
+                    continue
+                need_spawn.append(peer)
+        if not need_spawn:
+            return
+        # a FRESH snapshot so the repair base (and its expected digest)
+        # is at/above the judged checkpoint — the stream then rides the
+        # ordinary chunked InstallSnapshot machinery with repair framing
+        self.force_snapshot()
+        with self._lock:
+            if self.state != LEADER:
+                return
+            for peer in need_spawn:
+                self._spawn_snapshot_stream(peer, repair=True)
+
+    def _integrity_step_aside(self) -> None:
+        """A leader convicted by its own integrity vote transfers
+        leadership away (runs on a helper thread — transfer blocks on
+        the target catching up)."""
+        try:
+            if not self.transfer_leadership():
+                log.warning("raft: %s integrity step-aside could not "
+                            "transfer leadership", self.name)
+        except (NotLeaderError, ValueError):
+            pass
+        except Exception:                           # noqa: BLE001
+            log.warning("raft: %s integrity step-aside failed",
+                        self.name, exc_info=True)
 
     @requires_lock("_lock")
-    def _spawn_snapshot_stream(self, peer: str) -> None:
+    def _spawn_snapshot_stream(self, peer: str,
+                               repair: bool = False) -> None:
         """Kick off (or leave running) the chunked snapshot transfer to a
         lagging peer.  Called from the replication tick under `_lock`;
         only spawns the worker thread, so heartbeats to the remaining
-        peers proceed immediately."""
+        peers proceed immediately.  `repair=True` streams with integrity
+        repair framing (see _send_snapshot)."""
         t = self._snap_streams.get(peer)
         if t is not None and t.is_alive():
             return
         _, next_ok = self._snap_backoff.get(peer, (0, 0.0))
         if time.monotonic() < next_ok:
             return      # bounded backoff after repeated install failures
-        t = threading.Thread(target=self._send_snapshot, args=(peer,),
+        t = threading.Thread(target=self._send_snapshot,
+                             args=(peer, repair),
                              name=f"raft-snap-{self.name}-{peer}",
                              daemon=True)
         self._snap_streams[peer] = t
@@ -1101,7 +1213,7 @@ class RaftNode:
             delay = min(2.0, 0.05 * (2 ** fails))
             self._snap_backoff[peer] = (fails, time.monotonic() + delay)
 
-    def _send_snapshot(self, peer: str) -> None:
+    def _send_snapshot(self, peer: str, repair: bool = False) -> None:
         """Streamed, resumable InstallSnapshot (dissertation §7).
 
         Runs on its own thread, off the replication tick.  The blob goes
@@ -1113,6 +1225,14 @@ class RaftNode:
         leader streaming the same snapshot picks up at the offset the
         dead leader's stream reached.  The `done` frame adds the
         whole-stream CRC so the follower persists only a verified blob.
+
+        `repair=True` is the anti-entropy channel for a digest-convicted
+        peer: every frame carries ``repair: True`` (the follower's
+        install bypasses the dup/skip-restore guards and rewinds
+        last_applied to the snapshot index — entries above it re-apply
+        onto the restored base), and the `done` frame carries the
+        combined digest of the streamed blob so the follower can
+        digest-verify its restored state before re-admitting itself.
         """
         stream = None
         try:
@@ -1131,6 +1251,18 @@ class RaftNode:
             total = stream.total
             stream_crc = stream.stream_crc
             snap_config = stream.config
+            expected_digest = None
+            if repair:
+                # expected digest of the streamed state, computed from
+                # the SAME blob (one transient full read — repair only)
+                rec = self.snapshots.latest_full()
+                if rec is None or rec["index"] != s_idx:
+                    # another snapshot landed between open and read:
+                    # retry next tick with a consistent blob/digest pair
+                    self._note_snap_failure(peer)
+                    return
+                expected_digest = state_digest.combine(
+                    state_digest.blob_digests(rec["data"]))
             offset = 0
             stalls = drops = 0
             while True:
@@ -1156,8 +1288,12 @@ class RaftNode:
                     # joiner learns the membership without any log prefix
                     "config": snap_config,
                 }
+                if repair:
+                    frame["repair"] = True
                 if done:
                     frame["stream_crc32"] = stream_crc
+                    if repair:
+                        frame["digest"] = expected_digest
                 if chaos.active is not None \
                         and chaos.should("snapshot.chunk_drop"):
                     # frame lost in flight: re-probe the same offset — the
@@ -1189,6 +1325,15 @@ class RaftNode:
                         self._match_index[peer] = s_idx
                         self._peer_contact[peer] = time.monotonic()
                         self._snap_backoff.pop(peer, None)
+                    if repair:
+                        # verified True lifts the conviction; False
+                        # keeps it (back off, then re-stream); absent
+                        # (mixed-version follower that cannot verify)
+                        # lifts it and lets the next checkpoint re-judge
+                        verified = resp.get("verified")
+                        self.integrity.repair_result(peer, verified)
+                        if verified is False:
+                            self._note_snap_failure(peer)
                     return
                 if acked == offset:
                     # no progress (per-chunk CRC reject, or a done frame
@@ -1266,11 +1411,45 @@ class RaftNode:
                 tracer = tracing.active
                 ta = time.time() if tctx is not None else 0.0
                 try:
-                    self.fsm.apply(e.index, e.msg_type, e.payload)
+                    if chaos.active is not None \
+                            and e.msg_type not in _APPLY_SKIP_EXEMPT \
+                            and e.index > self._boot_log_end \
+                            and chaos.should("fsm.apply_skip", self.name):
+                        # injected divergence: the committed entry is
+                        # silently NOT applied while last_applied still
+                        # advances — the log says it happened, the state
+                        # says it didn't.  Invisible to raft; only the
+                        # integrity plane's digest checkpoints can tell.
+                        log.warning("chaos: %s skipped fsm apply of %s "
+                                    "at %d", self.name, e.msg_type,
+                                    e.index)
+                    else:
+                        self.fsm.apply(e.index, e.msg_type, e.payload)
                     err = None
                 except Exception as exc:           # noqa: BLE001
                     log.exception("fsm apply failed at %d", e.index)
                     err = exc
+                if err is None and chaos.active is not None \
+                        and e.index > self._boot_log_end \
+                        and chaos.should("store.bitflip", self.name):
+                    # injected silent corruption: flip one replicated
+                    # record post-apply — no index bump, no dirty mark,
+                    # caught only by a full digest walk
+                    store = getattr(self.fsm, "store", None)
+                    if store is not None \
+                            and hasattr(store, "chaos_bitflip"):
+                        hit = store.chaos_bitflip(chaos.active.uniform())
+                        log.warning("chaos: %s bitflipped %s after "
+                                    "apply %d", self.name, hit, e.index)
+                if err is None and e.msg_type == STATE_CHECKPOINT_MSG \
+                        and hasattr(self.fsm, "snapshot_tables"):
+                    # digest stamped here, under _fsm_lock, so the walk
+                    # sees exactly the state at this log position
+                    try:
+                        self.integrity.on_checkpoint(e.index, e.payload)
+                    except Exception:               # noqa: BLE001
+                        log.exception("integrity checkpoint at %d "
+                                      "failed", e.index)
                 if tctx is not None and tracer is not None:
                     # observe-time: timestamps taken around the FSM call,
                     # never inside it (the FSM must not read the clock)
@@ -1464,8 +1643,22 @@ class RaftNode:
                 self.commit_index = min(a["leader_commit"],
                                         self.log.last_index)
                 self._apply_cv.notify_all()
-            return {"term": self.term, "success": True,
+            if a.get("integrity_quarantine"):
+                # the leader's majority vote convicted us: stop serving
+                # stale/lease reads now, keep replicating and voting —
+                # the repair snapshot stream is already on its way
+                self.integrity.quarantine(
+                    "leader divergence verdict (table %s)"
+                    % a["integrity_quarantine"])
+            resp = {"term": self.term, "success": True,
                     "last_index": self.log.last_index}
+            rep = self.integrity.report()
+            if rep is not None:
+                # digest piggyback: {index, digest, per_table} of our
+                # newest applied STATE_CHECKPOINT (absent before the
+                # first one — the leader counts that as "unverified")
+                resp["integrity"] = rep
+            return resp
 
     def _on_install_snapshot(self, a: dict) -> dict:
         with self._lock:
@@ -1579,25 +1772,83 @@ class RaftNode:
         # last_applied must move in the same critical section as the
         # restore or the apply loop could re-apply a pre-snapshot entry
         # onto the restored state
+        repair = bool(a.get("repair"))
         with self._fsm_lock:
             with self._lock:
-                if a["last_index"] <= self._last_snapshot_index:
-                    # duplicate/stale install: never regress the FSM
-                    return {"term": self.term, "success": True}
-                # §7: if the apply loop already covered the snapshot's
-                # prefix via AppendEntries while the stream was in flight,
-                # the state ALREADY includes it (committed entries at an
-                # index are unique) — restoring would rewind the FSM past
-                # entries that will never re-apply.  Retain the state,
-                # still compact the now-redundant log prefix below.
-                skip_restore = a["last_index"] <= self.last_applied
+                if repair:
+                    if a["last_index"] < self._last_snapshot_index:
+                        # a repair rewind below our own compaction point
+                        # has no log tail left to replay through: reject
+                        # so the leader retries with a fresher snapshot
+                        return {"term": self.term, "success": False}
+                    # anti-entropy repair bypasses both guards below:
+                    # our state at these indexes is exactly what is
+                    # suspected corrupt, so "already covered" means
+                    # nothing — wipe and rebuild from the leader's blob
+                    skip_restore = False
+                else:
+                    if a["last_index"] <= self._last_snapshot_index:
+                        # duplicate/stale install: never regress the FSM
+                        return {"term": self.term, "success": True}
+                    # §7: if the apply loop already covered the
+                    # snapshot's prefix via AppendEntries while the
+                    # stream was in flight, the state ALREADY includes
+                    # it (committed entries at an index are unique) —
+                    # restoring would rewind the FSM past entries that
+                    # will never re-apply.  Retain the state, still
+                    # compact the now-redundant log prefix below.
+                    skip_restore = a["last_index"] <= self.last_applied
+            if repair:
+                # a repair stream IS the divergence verdict (it can
+                # outrun the quarantine directive riding our next
+                # append): refuse local reads from here until the
+                # restored state digest-verifies
+                self.integrity.quarantine(
+                    "anti-entropy repair in progress (leader divergence "
+                    "verdict)")
             if not skip_restore:
                 self.fsm.restore(data)
+                self.integrity.note_restore()
+                if chaos.active is not None \
+                        and chaos.should("disk.silent_corrupt", self.name):
+                    # injected silent disk corruption: the restored
+                    # state differs from the streamed blob (a bad read
+                    # that still unpickled) — digest verification below
+                    # must refuse re-admission and the leader retries
+                    store = getattr(self.fsm, "store", None)
+                    if store is not None \
+                            and hasattr(store, "chaos_bitflip"):
+                        hit = store.chaos_bitflip(chaos.active.uniform())
+                        log.warning("chaos: %s silent-corrupted %s on "
+                                    "snapshot restore", self.name, hit)
+            verified = None
+            if repair and hasattr(self.fsm, "snapshot_tables"):
+                # digest-verified re-admission: recompute the restored
+                # state's digest and match the leader's expected one —
+                # still under _fsm_lock, so the walk is quiescent
+                verified = self.integrity.verify_restore(a.get("digest"))
+            if repair and verified is None:
+                # no digest to verify against (mixed-version leader):
+                # the install itself was CRC-gated — do not brick the
+                # replica behind a verdict nobody can verify; the next
+                # checkpoint vote re-judges the restored state
+                self.integrity.clear_quarantine(
+                    "repair installed (no digest to verify)")
             with self._lock:
                 self._last_snapshot_index = a["last_index"]
                 self._last_snap_term = a["last_term"]
                 self.log.compact(a["last_index"])
-                self.last_applied = max(self.last_applied, a["last_index"])
+                if repair:
+                    # rewind-and-replay: the restored blob IS the state
+                    # at the snapshot index; committed entries above it
+                    # re-apply onto the clean base (exactly-once writes
+                    # are deduped by replicated state, e.g.
+                    # _applied_plan_ids)
+                    self.last_applied = a["last_index"]
+                    self._apply_cv.notify_all()
+                else:
+                    self.last_applied = max(self.last_applied,
+                                            a["last_index"])
                 self.commit_index = max(self.commit_index, a["last_index"])
                 cfg = a.get("config")
                 if cfg:
@@ -1609,4 +1860,7 @@ class RaftNode:
                         self._set_config(cfg["voters"],
                                          cfg.get("nonvoters", []),
                                          cfg.get("index", 0))
-                return {"term": self.term, "success": True}
+                resp = {"term": self.term, "success": True}
+                if repair:
+                    resp["verified"] = verified
+                return resp

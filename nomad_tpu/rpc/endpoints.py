@@ -426,6 +426,17 @@ class Endpoints:
             if self.server.leader else self.server.config.heartbeat_ttl
         return {"eval_ids": [e.id for e in evals], "heartbeat_ttl": ttl}
 
+    def rpc_Node__UpdateFingerprint(self, args):
+        """Device/attribute re-fingerprint DELTA: coalesces through the
+        leader's heartbeat batcher as one NodeFingerprintBatch entry per
+        flush instead of a full Node.Register per change.  Returns
+        known=False for an unregistered node so the client falls back
+        to Node.Register."""
+        update = {k: args[k] for k in ("devices", "attributes")
+                  if k in args}
+        return self.server.node_update_fingerprint(args["node_id"],
+                                                   update)
+
     def rpc_Node__BatchHeartbeat(self, args):
         """Fleet-scale liveness: one RPC re-arms many node TTLs through
         the real heartbeat path (the 10K-agent drivers' steady state —
@@ -790,6 +801,23 @@ class Endpoints:
         except NotLeaderError:
             return s.rpc_leader("Operator.TransferLeadership", args)
         return {"transferred": ok, "leader": s.raft.leader_id}
+
+    def rpc_Operator__Integrity(self, args):
+        """Replica-integrity plane view (reference shape:
+        `/v1/operator/autopilot/health`): THIS server's last checkpoint
+        digest, quarantine state and repair counters — the leader's view
+        includes the per-peer report table the majority vote runs over.
+        Served locally on purpose: an operator debugging divergence
+        wants each replica's own digest, and a quarantined replica must
+        still answer."""
+        s = self.server
+        if s.raft is None:
+            return {"server": s.name, "quarantined": False,
+                    "quarantine_reason": "", "last": None, "peers": {},
+                    "counters": {}, "leader": True}
+        view = s.raft.integrity.operator_view()
+        view["leader"] = s.raft.is_leader
+        return view
 
     def rpc_Operator__SnapshotSave(self, args):
         if self.server.raft is not None:

@@ -51,7 +51,6 @@ from __future__ import annotations
 import contextlib
 import json
 import os
-import pickle
 import random
 import shutil
 import tempfile
@@ -65,6 +64,7 @@ from nomad_tpu import chaos, knobs, mock
 from nomad_tpu import deadline as request_deadline
 from nomad_tpu.chaos import ChaosRegistry
 from nomad_tpu.rpc import RpcError
+from nomad_tpu.state import digest as state_digest
 from nomad_tpu.core.cluster import Cluster
 from nomad_tpu.core.server import Server, ServerConfig
 from nomad_tpu.core.worker import TRANSIENT_ERRORS
@@ -107,20 +107,12 @@ def _on_leader(cluster, fn, timeout=15.0):
             time.sleep(0.05)
 
 
-def _canon(blob):
-    """Canonicalize an FSM snapshot for equality (pickle memoizes shared
-    references, so byte-different blobs can encode identical state):
-    re-pickle each item standalone, order-free."""
-    data = pickle.loads(blob)
-    out = {}
-    for key, val in sorted(data.items()):
-        if isinstance(val, list):
-            out[key] = sorted(pickle.dumps(v) for v in val)
-        elif isinstance(val, dict):
-            out[key] = {k: pickle.dumps(v) for k, v in sorted(val.items())}
-        else:
-            out[key] = pickle.dumps(val)
-    return out
+# Canonicalize an FSM snapshot for equality (pickle memoizes shared
+# references, so byte-different blobs can encode identical state).  The
+# SAME canonical form backs the runtime integrity plane's per-table
+# digests, so the battery's byte-identity verdict and the online
+# divergence votes can never disagree about what "identical" means.
+_canon = state_digest.canon
 
 
 def _tune(server: Server) -> None:
@@ -2018,6 +2010,429 @@ class OverloadStormShape(Shape):
         return res
 
 
+class DivergenceDrillShape(Shape):
+    """Replica-divergence drill for the integrity plane: mid-storm, one
+    SEEDED-random non-leader replica is silently corrupted through a
+    targeted chaos point (`fsm.apply_skip` drops one applied entry on
+    that replica only; `store.bitflip` flips state bytes underneath the
+    FSM with no dirty mark), while batch work keeps committing so the
+    corruption is real divergence, not a no-op.  The cell gates the full
+    detect -> quarantine -> repair -> re-admit story:
+
+        injected            the targeted point actually fired inside the
+                            chaos window (applies trickle, so a pending
+                            target that outlives its victim is re-armed)
+        detected_fast       the leader's majority vote convicted the
+                            corrupted replica within DETECT_BOUND_S of
+                            the corruption landing (interval=0.25s and
+                            full_every=1 here, so every checkpoint is
+                            ground truth)
+        quarantined         the convicted replica self-quarantined
+        no_wrong_reads      zero stale reads served by the replica while
+                            quarantined — every probe was refused with
+                            the `quarantined` hint
+        repaired_readmitted the replica came back: quarantine cleared
+                            only through digest-verified re-admission
+                            (or a WAL-replay restart / server_replace
+                            genuinely rebuilt it), and no live leader
+                            still holds a conviction
+        quorum_available    the surviving majority kept serving reads
+                            after detection
+
+    Byte-identical repair is then proven by the battery's own
+    `fsm_identical` invariant — the same canonical encoding the runtime
+    digests vote over.  Under the `storm` schedule the churn driver can
+    hard-kill the victim before conviction lands; a WAL-replay restart
+    legitimately heals the in-memory corruption, so the drill re-injects
+    (bounded) until a conviction sticks inside the window."""
+
+    name = "divergence_drill"
+    n_nodes = 6
+    POINTS = ("fsm.apply_skip", "store.bitflip")
+    INJECT_AT_S = 1.2                   # mid-storm (both phases open)
+    REINJECT_AFTER_S = 1.2              # fired but healed (restart) / lost
+    MAX_INJECTIONS = 6
+    # Detection-latency gate.  On a quiet cluster conviction lands
+    # within ~one 0.25s checkpoint interval (tests/test_integrity.py
+    # proves that case); here the victim can fire mid-partition and
+    # stay unreachable until the chaos window closes (~2.6s after the
+    # earliest injection), with conviction on the first checkpoints
+    # after heal — the gate proves detection is prompt once the replica
+    # is reachable, not that storms cannot delay gossip
+    DETECT_BOUND_S = 5.0
+
+    def tune_config(self, cfg: ServerConfig) -> None:
+        # tight checkpoint cadence, and EVERY checkpoint full-walks:
+        # silent corruption marks nothing dirty, so only the full walk
+        # (ground truth) can convict it
+        cfg.integrity_interval = 0.25
+        cfg.integrity_full_every = 1
+
+    def setup(self, cluster, rng, ctx):
+        self._rng = rng                 # for the finish-phase fallback
+        self._injected = None           # (point, victim_name)
+        self._armed_raft_id = None
+        self._injections = 0
+        self._armed_at = 0.0
+        self._fired_at = None
+        self._detected_at = None
+        self._quarantine_seen = False
+        self._quarantine_cleared = False
+        self._refused_reads = 0
+        self._wrong_reads = 0
+        self._quorum_reads_ok = 0
+        self._extra_registered = False
+        for _ in range(2):
+            j = _batch_job(5)
+            _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+            ctx.exact_jobs.append(j.id)
+            _wait_live(cluster, ctx, j.id, 5)
+
+    # ------------------------------------------------------- injection
+
+    @staticmethod
+    def _server(cluster, name):
+        for s in cluster.servers:
+            if s.name == name:
+                return s
+        return None
+
+    def _pick_victim(self, cluster, rng):
+        try:
+            ld = cluster.leader(timeout=2.0)
+        except TimeoutError:
+            return None
+        followers = [s for s in cluster.servers
+                     if s is not ld and s.raft is not None]
+        if not followers:
+            return None
+        return followers[rng.randrange(len(followers))]
+
+    def _inject(self, cluster, rng, reg, ctx):
+        victim = self._pick_victim(cluster, rng)
+        if victim is None:
+            return False
+        if self._injected is not None:
+            # disarm the previous target first: if its victim comes
+            # back (storm restarts keep names) a second silent
+            # corruption could diverge TWO followers at once and rob
+            # the digest vote of any quorum
+            old_point, old_name = self._injected
+            try:
+                reg.target(old_point, old_name, count=0)
+            except Exception:           # noqa: BLE001
+                pass
+        point = self.POINTS[rng.randrange(len(self.POINTS))]
+        try:
+            reg.target(point, victim.raft.name)
+        except Exception:               # noqa: BLE001 — victim died
+            return False
+        self._injected = (point, victim.raft.name)
+        self._armed_raft_id = id(victim.raft)
+        self._injections += 1
+        self._armed_at = time.time()
+        self._fired_at = None
+        ctx.notes.setdefault("injections", []).append(
+            {"point": point, "victim": victim.raft.name,
+             "at_s": round(reg.elapsed() or 0.0, 2)})
+        # pump fresh non-exempt applies through the log so the armed
+        # point fires promptly — without them the victim may see
+        # nothing but exempt entries (noops, checkpoints) until chaos
+        # uninstalls.  Fingerprint deltas ride the batched write path
+        # and mutate no alloc state, so they cannot skew placement
+        # invariants the way an extra job would.
+        for nid in ctx.node_ids[:3]:
+            try:
+                _on_leader(cluster, lambda ld, nid=nid:
+                           ld.endpoints.handle(
+                               "Node.UpdateFingerprint",
+                               {"node_id": nid, "attributes": {
+                                   "drill.pump":
+                                   str(self._injections)}}))
+            except Exception:           # noqa: BLE001 — election gap
+                pass
+        return True
+
+    # --------------------------------------------------------- probing
+
+    @staticmethod
+    def _no_live_divergence(cluster) -> bool:
+        """True only when every replica's newest checkpoint digest
+        agrees with every replica that checkpointed at the same index.
+        The raft-identity heal check alone is not enough before
+        re-injecting: a store.bitflip that got folded into a snapshot
+        SURVIVES the victim's restart, and corrupting one more replica
+        on top would 3-way-split the vote with no convictable
+        majority."""
+        by_idx: Dict[int, set] = {}
+        for s in cluster.servers:
+            raft = getattr(s, "raft", None)
+            if raft is None:
+                continue
+            last = raft.integrity.last
+            if last is None:
+                continue
+            by_idx.setdefault(last["index"], set()).add(last["digest"])
+        if not by_idx:
+            return False
+        return all(len(ds) == 1 for ds in by_idx.values())
+
+    def _victim_tracker(self, cluster):
+        _, name = self._injected
+        srv = self._server(cluster, name)
+        if srv is None or srv.raft is None:
+            return None
+        return srv.raft.integrity
+
+    def _poll(self, cluster):
+        """One observation pass: did the armed point fire, did the vote
+        convict, is the quarantined victim refusing its local reads, is
+        the healthy majority still serving."""
+        point, name = self._injected
+        now = time.time()
+        # conviction, from whoever currently leads
+        try:
+            ld = cluster.leader(timeout=0.5)
+            if ld.raft.integrity.peer_divergent(name) \
+                    and self._detected_at is None:
+                self._detected_at = now
+        except Exception:               # noqa: BLE001 — election gap
+            ld = None
+        # durable evidence: conviction + repair counters survive on the
+        # convicting server even when the victim's own tracker was
+        # rebuilt with fresh counters by a churn restart
+        for s in cluster.servers:
+            try:
+                cnt = s.raft.integrity.counters
+            except Exception:           # noqa: BLE001 — churned member
+                continue
+            if cnt["repairs_started"] and self._detected_at is None:
+                self._detected_at = now
+            if cnt["repairs_verified"]:
+                # a digest-verified repair implies the victim held its
+                # quarantine through the install (the repair path
+                # self-quarantines before the wipe)
+                self._quarantine_seen = True
+        tracker = self._victim_tracker(cluster)
+        if tracker is not None and (
+                tracker.quarantined or tracker.counters["quarantines"]):
+            # counter, not just the flag: a quiet-cluster repair can
+            # open and close the quarantine inside one poll interval
+            self._quarantine_seen = True
+            if self._detected_at is None:
+                self._detected_at = now
+        if self._quarantine_seen and tracker is not None \
+                and not tracker.quarantined:
+            self._quarantine_cleared = True
+        # wrong-read probe: only reads served while the flag is up (on
+        # both sides of the call) count against the zero-wrong-reads gate
+        if tracker is not None and tracker.quarantined:
+            srv = self._server(cluster, name)
+            try:
+                srv.read("Job.List", {}, consistency="stale", timeout=0.5)
+                if tracker.quarantined:
+                    self._wrong_reads += 1
+            except RpcError as e:
+                if e.kind == "quarantined":
+                    self._refused_reads += 1
+            except Exception:           # noqa: BLE001 — victim churned
+                pass
+        if self._detected_at is not None and ld is not None:
+            try:
+                ld.read("Job.List", {}, consistency="default", timeout=0.5)
+                self._quorum_reads_ok += 1
+            except Exception:           # noqa: BLE001 — chaos
+                pass
+
+    def during(self, cluster, rng, ctx, reg):
+        now = reg.elapsed() or 0.0
+        if not self._extra_registered and reg.phase_now():
+            # keep non-exempt applies flowing so fsm.apply_skip has
+            # entries to drop and the post-skip divergence is real
+            # state; at-most tracking — a storm can legitimately strand
+            # a mid-window registration's eval, and these jobs are
+            # divergence fodder, not placement subjects
+            self._extra_registered = True
+            for _ in range(2):
+                j = _batch_job(3)
+                _on_leader(cluster, lambda ld, j=j: ld.register_job(j))
+                ctx.at_most_jobs.append(j.id)
+        if self._injected is None:
+            if reg.phase_now() and now >= self.INJECT_AT_S:
+                self._inject(cluster, rng, reg, ctx)
+            return
+        point, name = self._injected
+        pending = reg.pending_target(point, name)
+        if pending:
+            # armed but unconsumed: if the victim was destroyed
+            # (server_replace) the target can never fire, and a victim
+            # stuck behind a storm partition may apply nothing for the
+            # rest of the window — re-arm on a live follower, but only
+            # while an injection phase is still open so the fresh
+            # target has real runway before chaos uninstalls
+            srv = self._server(cluster, name)
+            stuck = time.time() - self._armed_at > self.REINJECT_AFTER_S
+            if (srv is None or srv.raft is None or stuck) \
+                    and reg.phase_now() \
+                    and self._injections < self.MAX_INJECTIONS:
+                self._inject(cluster, rng, reg, ctx)
+            return
+        if self._fired_at is None:
+            self._fired_at = time.time()
+        self._poll(cluster)
+        # fired but never convicted, AND the victim restarted since the
+        # fire (its WAL replay rebuilt the skipped entry, silently
+        # healing the corruption): inject again (phase open = runway)
+        # so a conviction lands inside the window.  While the original
+        # raft instance still lives its corruption is live too — a
+        # second corruption elsewhere would 3-way-split the digest vote
+        # and leave NO majority to convict anyone — so there we only
+        # wait for the (possibly storm-delayed) conviction.
+        srv = self._server(cluster, name)
+        healed = (srv is None or srv.raft is None
+                  or id(srv.raft) != self._armed_raft_id)
+        if self._detected_at is None and healed \
+                and time.time() - self._fired_at > self.REINJECT_AFTER_S \
+                and reg.phase_now() \
+                and self._no_live_divergence(cluster) \
+                and self._injections < self.MAX_INJECTIONS:
+            self._inject(cluster, rng, reg, ctx)
+
+    def finish(self, cluster, ctx):
+        # chaos is uninstalled, but detection/quarantine/repair are not
+        # chaos-gated: keep observing until the conviction resolves
+        if self._injected is not None:
+            _wait(lambda: (self._poll(cluster) or
+                           self._detected_at is not None), 8.0, 0.05)
+        if self._detected_at is None and self._fallback_safe(cluster):
+            # every mid-window corruption was healed before a vote could
+            # convict it (a churn restart's WAL replay legitimately
+            # rebuilds the skipped entry): re-run the injection on the
+            # now-quiet cluster through a private registry so the
+            # detect -> quarantine -> repair story is exercised every
+            # run, not just on seeds where the corruption outlives the
+            # storm
+            reg = ChaosRegistry.from_spec(
+                f"seed={self._rng.randrange(1 << 30)}")
+            prev = chaos.install(reg)
+            reg.arm()
+            try:
+                for _ in range(3):      # victim pick can race an election
+                    if not self._inject(cluster, self._rng, reg, ctx):
+                        time.sleep(0.5)
+                        continue
+                    point, name = self._injected
+
+                    def _observe():
+                        if self._fired_at is None \
+                                and not reg.pending_target(point, name):
+                            self._fired_at = time.time()
+                        self._poll(cluster)
+                        return self._detected_at is not None
+                    _wait(_observe, 10.0, 0.05)
+                    break
+            finally:
+                chaos.install(prev)
+        if self._injected is not None:
+            _wait(lambda: (self._poll(cluster) or
+                           self._resolved(cluster)), 20.0, 0.05)
+        ctx.notes["integrity_drill"] = self._notes(cluster)
+
+    def _fallback_safe(self, cluster) -> bool:
+        """A second corruption is only safe when the first one cannot
+        still be live — never fired, or the victim's raft instance was
+        rebuilt since the fire (replay healed it).  Corrupting a second
+        replica while the first is still divergent would 3-way-split
+        the digest vote and strand the cluster with no convictable
+        majority."""
+        if self._injected is None:
+            return True
+        if self._fired_at is None:
+            return True
+        _, name = self._injected
+        srv = self._server(cluster, name)
+        healed = (srv is None or srv.raft is None
+                  or id(srv.raft) != self._armed_raft_id)
+        return healed and self._no_live_divergence(cluster)
+
+    def _resolved(self, cluster) -> bool:
+        """The divergence is over: nobody is quarantined and no live
+        leader still holds a conviction against the victim."""
+        if self._injected is None:
+            return True
+        _, name = self._injected
+        tracker = self._victim_tracker(cluster)
+        if tracker is not None and tracker.quarantined:
+            return False
+        try:
+            ld = cluster.leader(timeout=1.0)
+            return not ld.raft.integrity.peer_divergent(name)
+        except Exception:               # noqa: BLE001
+            return False
+
+    def _notes(self, cluster) -> dict:
+        repairs = 0
+        for s in cluster.servers:
+            try:
+                repairs += s.raft.integrity.counters["repairs_verified"]
+            except Exception:           # noqa: BLE001 — churned member
+                pass
+        latency = None
+        if self._detected_at is not None and self._fired_at is not None:
+            latency = round(self._detected_at - self._fired_at, 3)
+        return {
+            "injections": self._injections,
+            "fired": self._fired_at is not None,
+            "detect_latency_s": latency,
+            "quarantine_seen": self._quarantine_seen,
+            "quarantine_cleared": self._quarantine_cleared,
+            "refused_reads": self._refused_reads,
+            "wrong_reads": self._wrong_reads,
+            "quorum_reads_ok": self._quorum_reads_ok,
+            "repairs_verified": repairs,
+        }
+
+    def check(self, cluster, ctx, timeout: float = 60.0) -> dict:
+        res = check_convergence(cluster, ctx, timeout=timeout)
+        # the conviction can outlive finish() on a loaded box: a
+        # re-elected leader self-heals its stale conviction only after
+        # the next checkpoint round-trip — give it a real window rather
+        # than judging one instantaneous snapshot
+        if self._injected is not None and not self._resolved(cluster):
+            _wait(lambda: (self._poll(cluster) or
+                           self._resolved(cluster)), 15.0, 0.1)
+        d = self._notes(cluster)
+        ctx.notes["integrity_drill"] = d
+        inv = res["invariants"]
+        inv["injected"] = {
+            "ok": d["fired"],
+            "detail": f"injections={d['injections']} fired={d['fired']}"}
+        inv["detected_fast"] = {
+            "ok": d["detect_latency_s"] is not None
+            and d["detect_latency_s"] <= self.DETECT_BOUND_S,
+            "detail": f"latency={d['detect_latency_s']}s "
+                      f"bound={self.DETECT_BOUND_S}s"}
+        inv["quarantined"] = {
+            "ok": d["quarantine_seen"],
+            "detail": "victim self-quarantined" if d["quarantine_seen"]
+            else "conviction never reached the victim"}
+        inv["no_wrong_reads"] = {
+            "ok": d["wrong_reads"] == 0,
+            "detail": (f"refused={d['refused_reads']} "
+                       f"wrong={d['wrong_reads']}")}
+        inv["repaired_readmitted"] = {
+            "ok": self._resolved(cluster),
+            "detail": (f"repairs_verified={d['repairs_verified']} "
+                       f"cleared={d['quarantine_cleared']}")}
+        inv["quorum_available"] = {
+            "ok": d["detect_latency_s"] is None
+            or d["quorum_reads_ok"] > 0,
+            "detail": f"quorum_reads_ok={d['quorum_reads_ok']}"}
+        res["converged"] = bool(res["converged"]) and \
+            all(v["ok"] for v in inv.values())
+        return res
+
+
 SHAPES: Dict[str, Callable[[], Shape]] = {
     "e2e_spine": E2ESpineShape,
     "scan_spread": ScanSpreadShape,
@@ -2030,6 +2445,7 @@ SHAPES: Dict[str, Callable[[], Shape]] = {
     "multi_region": MultiRegionShape,
     "fleet_soak": FleetSoakShape,
     "overload_storm": OverloadStormShape,
+    "divergence_drill": DivergenceDrillShape,
 }
 
 
@@ -2404,6 +2820,7 @@ SMOKE_CELLS = [
     ("e2e_spine", "server_replace"),
     ("multi_region", "region_partition"),
     ("overload_storm", "storm"),
+    ("divergence_drill", "storm"),
 ]
 
 # the core product crosses every single-cluster shape with every
@@ -2411,15 +2828,18 @@ SMOKE_CELLS = [
 # first-class cells (storm churn across both regions, and the
 # deterministic WAN-cut drill) — region_partition makes no sense for a
 # one-region cluster and lease_flap/server_replace add nothing the
-# single-cluster cells don't already cover
+# single-cluster cells don't already cover; the divergence drill rides
+# storm (churn can heal the victim, exercising re-injection) and
+# server_replace (repair racing membership change)
 ALL_CELLS = [(shape, schedule)
              for shape in SHAPES
              if shape not in ("multi_region", "multi_tenant", "fleet_soak",
-                              "overload_storm")
+                              "overload_storm", "divergence_drill")
              for schedule in SCHEDULES if schedule != "region_partition"] \
     + [("multi_region", "storm"), ("multi_region", "region_partition")] \
     + [("multi_tenant", "storm"), ("multi_tenant", "lease_flap")] \
-    + [("overload_storm", "storm"), ("overload_storm", "lease_flap")]
+    + [("overload_storm", "storm"), ("overload_storm", "lease_flap")] \
+    + [("divergence_drill", "storm"), ("divergence_drill", "server_replace")]
 
 # the 10K-agent fleet cells are their own tier (minutes per cell at
 # full size): `bench.py --fleet-soak` runs them, the CI fleet-soak leg

@@ -98,6 +98,18 @@ class ReadGate:
         raft = s.raft
         if raft is None:                      # dev mode: trivially current
             return ReadContext(s.store.latest_index, True, 0.0, mode)
+        integ = getattr(raft, "integrity", None)
+        if integ is not None and integ.quarantined:
+            # divergence quarantine: this replica's store failed the
+            # digest vote — NO local read (stale, lease or consistent)
+            # may be served until digest-verified re-admission.  It
+            # still replicates and votes; callers retry a healthy peer.
+            raise RpcError(
+                "quarantined",
+                f"replica integrity quarantine "
+                f"({integ.quarantine_reason}): local reads refused "
+                f"until digest-verified re-admission",
+                leader=raft.leader_id, retry_after=1.0)
         if mode == STALE:
             return ReadContext(s.store.latest_index,
                                raft.leader_id is not None,

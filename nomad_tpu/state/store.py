@@ -386,6 +386,41 @@ class StateStore:
         for node in changed:
             self._notify("nodes", node)
 
+    def update_node_fingerprints_many(self, index: int, updates) -> None:
+        """Batched device/attribute re-fingerprints — one lock pass for
+        a whole coalescer flush (mirrors update_node_statuses_many), so
+        a fleet-wide fingerprint storm costs O(batches) store passes
+        and O(flush-ticks) raft entries, not O(changes) Node.Register
+        round-trips.  Each update dict carries node_id plus optional
+        devices / attributes deltas."""
+        import copy as _copy
+        changed = []
+        with self._lock:
+            for u in updates:
+                old = self._nodes.get(u["node_id"])
+                if old is None:
+                    continue
+                node = _shallow_copy_node(old)
+                if "devices" in u:
+                    # node_resources is shared by the shallow copy —
+                    # copy it too or the old record aliases the new
+                    # device list and MVCC readers see torn state.
+                    node.node_resources = _copy.copy(old.node_resources)
+                    node.node_resources.devices = u["devices"]
+                if "attributes" in u:
+                    attrs = dict(old.attributes)
+                    attrs.update(u["attributes"])
+                    node.attributes = attrs
+                node.computed_class = compute_node_class(node)
+                node.modify_index = index
+                self._nodes[u["node_id"]] = node
+                self.matrix.upsert_node(node)
+                changed.append(node)
+            if changed:
+                self._bump(index)
+        for node in changed:
+            self._notify("nodes", node)
+
     def update_node_drain(self, index: int, node_id: str, drain_strategy,
                           mark_eligible: bool = False) -> None:
         with self._lock:
@@ -416,6 +451,35 @@ class StateStore:
             self.matrix.upsert_node(node)
             self._bump(index)
         self._notify("nodes", node)
+
+    def chaos_bitflip(self, u: float = 0.0):
+        """Silently corrupt ONE replicated record (the `store.bitflip`
+        / `disk.silent_corrupt` chaos payload): a copy-on-write of the
+        victim with a `\\x00` appended to an inert string field — no
+        index bump, no notify, no dirty mark.  Exactly the class of
+        divergence the integrity plane exists to catch; invisible to
+        everything except a digest walk.  Tables are visited in a fixed
+        order (namespaces first — `default` always exists) so drills
+        are deterministic; `u` (a seeded chaos uniform) picks the
+        victim record within the table.  Returns "table/key" or None
+        if every candidate table is empty."""
+        import copy as _copy
+        with self._lock:
+            for name, table in (("namespaces", self._namespaces),
+                                ("nodes", self._nodes),
+                                ("jobs", self._jobs)):
+                if not table:
+                    continue
+                keys = sorted(table)
+                key = keys[int(u * len(keys)) % len(keys)]
+                rec = _copy.copy(table[key])
+                if name == "namespaces":
+                    rec.description = (rec.description or "") + "\x00"
+                else:
+                    rec.name = (rec.name or "") + "\x00"
+                table[key] = rec
+                return "%s/%s" % (name, key)
+        return None
 
     def nodes(self) -> List[Node]:
         with self._lock:

@@ -1,0 +1,497 @@
+"""Replica-integrity plane tests: log-stamped state digests, divergence
+quarantine, anti-entropy self-repair, and the fingerprint-delta batch
+path (reference ideas: Paxos Made Live's periodic state checksums,
+Dynamo's anti-entropy repair)."""
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from nomad_tpu import chaos, mock
+from nomad_tpu.agent.http import HTTPServer
+from nomad_tpu.chaos import ChaosRegistry
+from nomad_tpu.core.cluster import Cluster
+from nomad_tpu.core.heartbeat import HeartbeatBatcher
+from nomad_tpu.core.server import ServerConfig
+from nomad_tpu.raft import MessageType, NomadFSM, RaftConfig
+from nomad_tpu.raft.integrity import IntegrityTracker
+from nomad_tpu.rpc import RpcError
+from nomad_tpu.state import StateStore
+from nomad_tpu.state import digest as state_digest
+
+FAST = RaftConfig(heartbeat_interval=0.02, election_timeout=0.1)
+
+
+def _wait(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    """3-server cluster with a fast checkpoint cadence, every
+    checkpoint a full walk (silent corruption marks nothing dirty)."""
+    c = Cluster(3, config=ServerConfig(
+        num_schedulers=1, integrity_interval=0.1, integrity_full_every=1),
+        raft_config=FAST, data_dir=str(tmp_path))
+    c.start()
+    yield c
+    c.stop()
+
+
+def _follower(c):
+    ld = c.leader(timeout=10.0)
+    return ld, [s for s in c.servers if s is not ld][0]
+
+
+# ============================================== digest <-> canon property
+
+
+# ops whose interleaving exercises list tables (allocs), dict tables
+# (jobs/nodes), deletes, and shared-reference pickling
+def _random_ops(rng, n=40):
+    jobs, nodes = [], []
+    ops = []
+    for i in range(n):
+        k = rng.random()
+        if k < 0.35 or not jobs:
+            j = mock.job()
+            jobs.append(j)
+            ops.append((MessageType.JOB_REGISTER, {"job": j}))
+        elif k < 0.6 or not nodes:
+            node = mock.node()
+            nodes.append(node)
+            ops.append((MessageType.NODE_REGISTER, {"node": node}))
+        elif k < 0.8:
+            j = jobs[rng.randrange(len(jobs))]
+            node = nodes[rng.randrange(len(nodes))]
+            ops.append((MessageType.ALLOC_UPDATE,
+                        {"allocs": [mock.alloc_for(j, node.id)]}))
+        else:
+            j = jobs.pop(rng.randrange(len(jobs)))
+            ops.append((MessageType.JOB_DEREGISTER,
+                        {"namespace": "default", "job_id": j.id,
+                         "purge": True}))
+    return ops
+
+
+def _apply_all(ops):
+    store = StateStore()
+    fsm = NomadFSM(store)
+    for i, (mt, payload) in enumerate(ops):
+        fsm.apply(i + 1, mt, payload)
+    return fsm
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_digest_equal_iff_canon_byte_equal(seed):
+    """Satellite: ONE shared canonical-encoding helper.  Two replicas
+    applying the same randomized op sequence agree on both the battery's
+    canonical bytes AND the runtime digest; a single corrupted record
+    flips both, never one without the other."""
+    rng = random.Random(seed)
+    ops = _random_ops(rng)
+    a, b = _apply_all(ops), _apply_all(ops)
+    assert state_digest.canon(a.snapshot()) == state_digest.canon(
+        b.snapshot())
+    da = state_digest.combine(state_digest.tables_digests(
+        a.snapshot_tables()))
+    db = state_digest.combine(state_digest.tables_digests(
+        b.snapshot_tables()))
+    assert da == db
+    # corrupt exactly one record on b: digests AND canon must both split
+    hit = b.store.chaos_bitflip(rng.random())
+    assert hit
+    assert state_digest.canon(a.snapshot()) != state_digest.canon(
+        b.snapshot())
+    db2 = state_digest.combine(state_digest.tables_digests(
+        b.snapshot_tables()))
+    assert da != db2
+    # ... and the divergent table the operator sees is the corrupted one
+    table = state_digest.first_divergence(
+        state_digest.tables_digests(a.snapshot_tables()),
+        state_digest.tables_digests(b.snapshot_tables()))
+    assert table == hit.split("/")[0]
+
+
+class _StubNode:
+    def __init__(self, fsm, name="stub"):
+        self.name = name
+        self.fsm = fsm
+
+
+@pytest.mark.parametrize("seed", [7, 8])
+def test_incremental_digest_matches_full_walk(seed):
+    """The per-type dirty map (_APPLY_TOUCHES) must be a SUPERSET of
+    what each apply really touches: interleave checkpoints with random
+    ops and the incrementally-maintained digest must equal a fresh full
+    walk every time."""
+    rng = random.Random(seed)
+    store = StateStore()
+    fsm = NomadFSM(store)
+    tracker = IntegrityTracker(_StubNode(fsm))
+    fsm.dirty_hook = tracker.note_dirty
+    idx = 0
+    for round_no in range(6):
+        for mt, payload in _random_ops(rng, n=8):
+            idx += 1
+            fsm.apply(idx, mt, payload)
+        idx += 1
+        rec = tracker.on_checkpoint(idx, {"seq": round_no, "full": False})
+        ground = state_digest.combine(state_digest.tables_digests(
+            fsm.snapshot_tables()))
+        assert rec["digest"] == ground, \
+            f"round {round_no}: stale dirty map (incremental != full)"
+    assert tracker.counters["checkpoints"] == 6
+    # only the boot checkpoint full-walked; the rest rode the cache
+    assert tracker.counters["full_walks"] == 1
+
+
+# ======================================================== leader voting
+
+
+def _tracker_with_checkpoint(name="leader"):
+    store = StateStore()
+    fsm = NomadFSM(store)
+    fsm.apply(1, MessageType.NODE_REGISTER, {"node": mock.node()})
+    t = IntegrityTracker(_StubNode(fsm, name))
+    rec = t.on_checkpoint(5, {"seq": 1, "full": True})
+    return t, rec
+
+
+def test_ack_without_digest_is_unverified_never_quarantined():
+    """Satellite: a mixed-version peer acks without the digest field —
+    counted as unverified, excluded from the vote, NEVER convicted."""
+    t, rec = _tracker_with_checkpoint()
+    t.observe_ack("old-peer", None)
+    t.observe_ack("old-peer", None)
+    t.observe_ack("new-peer", {"index": 5, "digest": rec["digest"],
+                               "per_table": rec["per_table"]})
+    actions = t.evaluate(["leader", "old-peer", "new-peer"])
+    assert actions == {"divergent": {}, "self_outlier": False,
+                       "repair": []}
+    assert t.counters["unverified_acks"] == 2
+    assert t.peer_divergent("old-peer") is None
+    view = t.operator_view()
+    assert view["peers"]["old-peer"]["unverified_acks"] == 2
+    assert view["peers"]["old-peer"]["divergent"] is None
+
+
+def test_vote_convicts_minority_on_full_checkpoint_only():
+    t, rec = _tracker_with_checkpoint()
+    bad = {"index": 5, "digest": "deadbeefdeadbeef",
+           "per_table": dict(rec["per_table"], nodes="deadbeefdeadbeef")}
+    good = {"index": 5, "digest": rec["digest"],
+            "per_table": rec["per_table"]}
+    t.observe_ack("healthy", good)
+    t.observe_ack("corrupt", bad)
+    actions = t.evaluate(["leader", "healthy", "corrupt"])
+    assert actions["divergent"] == {"corrupt": "nodes"}
+    assert actions["repair"] == ["corrupt"]
+    assert not actions["self_outlier"]
+    assert t.peer_divergent("corrupt") == "nodes"
+    # conviction is idempotent across re-evaluation
+    t.evaluate(["leader", "healthy", "corrupt"])
+    assert t.counters["repairs_started"] == 1
+
+
+def test_incremental_mismatch_escalates_but_never_convicts():
+    """A stale dirty map must not false-convict: incremental mismatch
+    raises the alarm and escalates the NEXT checkpoint to a full walk;
+    conviction waits for ground truth."""
+    t, rec = _tracker_with_checkpoint()
+    t.last = dict(t.last, full=False)
+    bad = {"index": 5, "digest": "deadbeefdeadbeef",
+           "per_table": dict(rec["per_table"], nodes="deadbeefdeadbeef")}
+    t.observe_ack("healthy", {"index": 5, "digest": rec["digest"],
+                              "per_table": rec["per_table"]})
+    t.observe_ack("suspect", bad)
+    actions = t.evaluate(["leader", "healthy", "suspect"])
+    assert actions["divergent"] == {}
+    assert t.peer_divergent("suspect") is None
+    assert t.counters["alarms"] == 1
+    assert t.escalation_pending()
+    assert t.take_escalation()
+    assert not t.escalation_pending()
+
+
+def test_vote_without_quorum_alarms_only():
+    """Too many unverified peers: no digest reaches quorum, so nobody
+    can be convicted (alarm only)."""
+    t, rec = _tracker_with_checkpoint()
+    t.observe_ack("old-1", None)
+    t.observe_ack("old-2", None)
+    bad = {"index": 5, "digest": "deadbeefdeadbeef",
+           "per_table": {"nodes": "deadbeefdeadbeef"}}
+    t.observe_ack("suspect", bad)
+    actions = t.evaluate(["leader", "old-1", "old-2", "suspect", "x5"])
+    assert actions["divergent"] == {}
+    assert not actions["self_outlier"]
+    assert t.counters["alarms"] == 1
+
+
+def test_leader_as_outlier_flags_self():
+    t, rec = _tracker_with_checkpoint()
+    bad = {"index": 5, "digest": "deadbeefdeadbeef",
+           "per_table": {"nodes": "deadbeefdeadbeef"}}
+    t.observe_ack("p1", bad)
+    t.observe_ack("p2", bad)
+    actions = t.evaluate(["leader", "p1", "p2"])
+    assert actions["self_outlier"]
+    assert actions["divergent"] == {}
+
+
+# ================================================= quarantine read path
+
+
+def test_quarantined_follower_refuses_local_reads_still_replicates(
+        cluster):
+    ld, follower = _follower(cluster)
+    follower.raft.integrity.quarantine("test verdict (table nodes)")
+    # stale AND lease/default local serving refused with the hint
+    for mode in ("stale", "default"):
+        with pytest.raises(RpcError) as exc:
+            follower.read("Node.List", {}, consistency=mode, timeout=2.0)
+        assert exc.value.kind == "quarantined"
+        assert "quarantine" in exc.value.detail
+    # ... but the replica still replicates: a write through the leader
+    # lands on the quarantined follower's FSM
+    node = mock.node()
+    ld.register_node(node)
+    assert _wait(lambda: follower.store.node_by_id(node.id) is not None,
+                 5.0), "quarantined follower stopped replicating"
+    # re-admission restores local serving
+    follower.raft.integrity.clear_quarantine("test over")
+    out, _ = follower.read("Node.List", {}, consistency="stale",
+                           timeout=2.0)
+    assert any(n.id == node.id for n in out)
+
+
+def test_quarantined_follower_503s_over_http(cluster):
+    _, follower = _follower(cluster)
+    follower.raft.integrity.quarantine("test verdict (table jobs)")
+
+    class _Shim:
+        server = follower
+
+        def rpc(self, method, args, consistency=None):
+            return follower.rpc_leader(method, args)
+
+    http = HTTPServer(_Shim(), port=0)
+    http.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{http.port}/v1/jobs?stale=true",
+                timeout=10.0)
+        assert exc.value.code == 503
+        body = exc.value.read().decode()
+        assert "quarantined" in body
+    finally:
+        http.stop()
+        follower.raft.integrity.clear_quarantine("test over")
+
+
+def test_quarantined_follower_reports_unhealthy_to_autopilot(cluster):
+    ld, follower = _follower(cluster)
+    assert _wait(lambda: ld.raft.server_healthy(follower.name), 5.0)
+    follower.raft.integrity.quarantine("test verdict")
+    # the leader's conviction map drives server_healthy for promote
+    # decisions; simulate the convicted state leader-side
+    ld.raft.integrity._divergent[follower.name] = "nodes"
+    assert not ld.raft.server_healthy(follower.name)
+    ld.raft.integrity.repair_result(follower.name, True)
+    follower.raft.integrity.clear_quarantine("test over")
+    assert _wait(lambda: ld.raft.server_healthy(follower.name), 5.0)
+
+
+# ================================================ end-to-end self-repair
+
+
+def test_corrupt_follower_detected_quarantined_repaired(cluster):
+    """The whole story on a live cluster: silent corruption on one
+    follower -> majority vote convicts it -> quarantine -> anti-entropy
+    snapshot repair -> digest-verified re-admission -> byte-identical
+    state everywhere, exactly one verified repair."""
+    ld, victim = _follower(cluster)
+    for _ in range(3):
+        ld.register_node(mock.node())
+    _wait(lambda: victim.raft.integrity.last is not None, 5.0)
+    hit = victim.store.chaos_bitflip(0.5)
+    assert hit
+    vt = victim.raft.integrity
+    assert _wait(lambda: vt.counters["quarantines"] > 0, 10.0), \
+        "corruption never detected/quarantined"
+    assert _wait(lambda: not vt.quarantined
+                 and not ld.raft.integrity.peer_divergent(victim.name),
+                 10.0), "repair never re-admitted the victim"
+    assert ld.raft.integrity.counters["repairs_verified"] >= 1
+    # repaired to byte-identical state (the battery's own invariant)
+    idx = ld.store.latest_index
+    assert cluster.wait_replication(idx, timeout=10.0)
+    blobs = [state_digest.canon(s.raft.fsm.snapshot())
+             for s in cluster.servers]
+    assert blobs[0] == blobs[1] == blobs[2]
+
+
+def test_repair_rejected_when_snapshot_predates_follower_compaction(
+        cluster):
+    """A repair rewind below the follower's own compaction point has no
+    log tail to replay through: the follower must refuse it (leader
+    retries with a fresher snapshot) instead of wedging its apply loop."""
+    ld, follower = _follower(cluster)
+    for _ in range(3):
+        ld.register_node(mock.node())
+    idx = ld.store.latest_index
+    assert cluster.wait_replication(idx, timeout=10.0)
+    follower.raft.force_snapshot()
+    stale_idx = follower.raft._last_snapshot_index - 1
+    resp = follower.raft._install_snapshot_blob(
+        {"term": follower.raft.term, "leader": ld.name, "repair": True,
+         "last_index": stale_idx, "last_term": 1}, b"not-a-snapshot")
+    assert resp["success"] is False
+
+
+# =============================================== operator surface + CLI
+
+
+def test_operator_integrity_endpoint_and_api(cluster):
+    ld, follower = _follower(cluster)
+    _wait(lambda: ld.raft.integrity.last is not None, 5.0)
+    view = ld.endpoints.handle("Operator.Integrity", {})
+    assert view["server"] == ld.name
+    assert view["leader"] is True
+    assert view["quarantined"] is False
+    assert view["last"]["digest"]
+    assert view["counters"]["checkpoints"] >= 1
+    # served locally on the follower too: a quarantined replica must
+    # still answer its own integrity query
+    follower.raft.integrity.quarantine("test verdict")
+    fview = follower.endpoints.handle("Operator.Integrity", {})
+    assert fview["quarantined"] is True
+    assert fview["leader"] is False
+    follower.raft.integrity.clear_quarantine("test over")
+
+
+# =========================================== chaos targeting semantics
+
+
+def test_chaos_target_fires_only_on_where_match_once():
+    reg = ChaosRegistry.from_spec("seed=1")
+    reg.arm(now=0.0)
+    reg.target("fsm.apply_skip", "server-1", count=2)
+    assert reg.pending_target("fsm.apply_skip", "server-1") == 2
+    # wrong replica: never fires, target not consumed
+    assert not reg.should("fsm.apply_skip", "server-0")
+    assert reg.pending_target("fsm.apply_skip", "server-1") == 2
+    # right replica: fires exactly `count` times, then never again
+    assert reg.should("fsm.apply_skip", "server-1")
+    assert reg.should("fsm.apply_skip", "server-1")
+    assert not reg.should("fsm.apply_skip", "server-1")
+    assert reg.pending_target("fsm.apply_skip", "server-1") == 0
+    # count<=0 disarms: a re-armed drill revokes its previous target
+    reg.target("fsm.apply_skip", "server-1", count=2)
+    reg.target("fsm.apply_skip", "server-1", count=0)
+    assert reg.pending_target("fsm.apply_skip", "server-1") == 0
+    assert not reg.should("fsm.apply_skip", "server-1")
+    with pytest.raises(ValueError):
+        reg.target("not.a.point", "server-1")
+
+
+def test_targeted_point_does_not_fire_by_rate():
+    """Divergence points are targeted-only: a rate would fire on every
+    in-process replica and destroy the healthy majority."""
+    reg = ChaosRegistry.from_spec("seed=1;store.bitflip=1.0")
+    reg.arm(now=0.0)
+    reg.target("store.bitflip", "server-2")
+    # rate=1.0 but armed targets exist: only the where-match fires
+    assert not reg.should("store.bitflip", "server-0")
+    assert reg.should("store.bitflip", "server-2")
+
+
+# ====================================== fingerprint-delta batched path
+
+
+class _StubServer:
+    class _Cfg:
+        heartbeat_ttl = 10.0
+
+    def __init__(self):
+        self.store = StateStore()
+        self.config = self._Cfg()
+        self.applies = []
+        self.heartbeat_batch = None
+
+    def apply(self, msg_type, payload):
+        self.applies.append((msg_type, payload))
+
+    def create_node_evals(self, node_id):
+        pass
+
+
+def test_fingerprint_storm_commits_one_entry_per_flush_tick():
+    """Satellite: a 1K-node fingerprint churn storm coalesces through
+    the batcher into O(flush-ticks) raft entries, not O(nodes)."""
+    srv = _StubServer()
+    b = HeartbeatBatcher(srv, interval=3600.0)   # manual flush only
+    b.pending_max = 10_000
+    for tick in range(3):
+        for i in range(1000):
+            b.note_fingerprint(f"n{i}", {"attributes": {"tick": tick}})
+            # repeated deltas for the same node coalesce in place
+            b.note_fingerprint(f"n{i}", {"devices": [tick]})
+        b.flush()
+    assert len(srv.applies) == 3                 # O(flush-ticks), not 6000
+    for _, payload in srv.applies:
+        assert len(payload["updates"]) == 1000
+    msg_type, payload = srv.applies[-1]
+    assert msg_type == MessageType.NODE_FINGERPRINT_BATCH
+    u = {x["node_id"]: x for x in payload["updates"]}
+    assert u["n7"]["attributes"] == {"tick": 2}
+    assert u["n7"]["devices"] == [2]
+    b.flush()                                    # drained: no extra entry
+    assert len(srv.applies) == 3
+
+
+def test_fsm_applies_fingerprint_batch():
+    store = StateStore()
+    fsm = NomadFSM(store)
+    nodes = [mock.node() for _ in range(2)]
+    for i, n in enumerate(nodes):
+        fsm.apply(i + 1, MessageType.NODE_REGISTER, {"node": n})
+    devs = list(nodes[0].node_resources.devices)
+    fsm.apply(5, MessageType.NODE_FINGERPRINT_BATCH, {"updates": [
+        {"node_id": nodes[0].id, "attributes": {"driver.docker": "1"},
+         "devices": devs},
+        {"node_id": "ghost", "attributes": {"x": "y"}},
+    ]})
+    got = store.node_by_id(nodes[0].id)
+    assert got.attributes["driver.docker"] == "1"
+    # merged, not replaced: pre-existing attributes survive the delta
+    assert len(got.attributes) > 1
+    assert store.latest_index == 5
+    # the untouched node's record is not copied/churned
+    assert store.node_by_id(nodes[1].id).attributes.get(
+        "driver.docker") is None
+
+
+def test_node_update_fingerprint_rpc_end_to_end(cluster):
+    ld, _ = _follower(cluster)
+    node = mock.node()
+    ld.register_node(node)
+    resp = ld.endpoints.handle("Node.UpdateFingerprint", {
+        "node_id": node.id, "attributes": {"driver.docker": "20.10"}})
+    assert resp["known"] is True
+    assert _wait(lambda: ld.store.node_by_id(node.id).attributes.get(
+        "driver.docker") == "20.10", 5.0)
+    # unknown node: the client falls back to full Node.Register
+    resp = ld.endpoints.handle("Node.UpdateFingerprint", {
+        "node_id": "no-such-node", "attributes": {"a": "b"}})
+    assert resp["known"] is False

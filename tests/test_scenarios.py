@@ -32,13 +32,15 @@ from nomad_tpu.scenarios import (
 def test_matrix_covers_every_shape_schedule_pair():
     # the core product: every single-cluster shape crossed with every
     # single-cluster schedule; the federated, multi-tenant, overload,
-    # and fleet shapes ride exactly their first-class cells
+    # divergence, and fleet shapes ride exactly their first-class cells
     # (region_partition is multi_region-only; multi_tenant and
-    # overload_storm gate storm + lease_flap; the 10K-agent fleet
-    # cells live in FLEET_CELLS, not ALL_CELLS)
+    # overload_storm gate storm + lease_flap; divergence_drill gates
+    # storm + server_replace; the 10K-agent fleet cells live in
+    # FLEET_CELLS, not ALL_CELLS)
     core_shapes = [sh for sh in SHAPES
                    if sh not in ("multi_region", "multi_tenant",
-                                 "fleet_soak", "overload_storm")]
+                                 "fleet_soak", "overload_storm",
+                                 "divergence_drill")]
     core_scheds = [sc for sc in SCHEDULES if sc != "region_partition"]
     expected = {(sh, sc) for sh in core_shapes for sc in core_scheds}
     expected |= {("multi_region", "storm"),
@@ -47,8 +49,10 @@ def test_matrix_covers_every_shape_schedule_pair():
                  ("multi_tenant", "lease_flap")}
     expected |= {("overload_storm", "storm"),
                  ("overload_storm", "lease_flap")}
+    expected |= {("divergence_drill", "storm"),
+                 ("divergence_drill", "server_replace")}
     assert set(ALL_CELLS) == expected
-    assert len(ALL_CELLS) == len(expected) == 27
+    assert len(ALL_CELLS) == len(expected) == 29
     # no duplicate cells
     assert len(ALL_CELLS) == len(set(ALL_CELLS))
     assert set(FLEET_CELLS) == {("fleet_soak", "storm"),
